@@ -1,0 +1,31 @@
+"""flowsentryx-tpu — a TPU-native DoS/DDoS mitigation framework.
+
+A ground-up rebuild of the capabilities of FlowSentryX
+(reference: AmruthSD/FlowSentryX) designed TPU-first:
+
+* **Kernel data plane** (``kern/``): C/eBPF XDP programs — packet parsing,
+  blacklist fast path, per-IP counters, streaming per-flow feature
+  extraction into a ring buffer (successor of the reference's
+  ``src/fsx_kern.c`` + the never-written ``src/fsx_kern_ml.c``).
+* **Host runtime** (``daemon/`` + :mod:`flowsentryx_tpu.engine`): a C++
+  drain daemon and a Python dispatch loop that micro-batch feature
+  vectors and move them to the TPU (successor of ``src/fsx_load.py``).
+* **TPU compute plane** (:mod:`flowsentryx_tpu.models`,
+  :mod:`flowsentryx_tpu.ops`, :mod:`flowsentryx_tpu.parallel`): a
+  ``jit(vmap(classify))`` int8 classifier, three vectorized rate
+  limiters, a device-resident sharded per-IP state table, and a fused
+  limiter∘classifier step under ``shard_map`` over a device mesh.
+* **Training plane** (:mod:`flowsentryx_tpu.train`): the
+  CICIDS2017/CICDDoS2019 training pipeline in JAX/optax with
+  quantization-aware training (successor of ``model/model.py``).
+
+Everything on the user side of the kernel↔user BPF-map seam is new; the
+seam itself (feature egress ring, verdict/blacklist ingress map) is kept
+as the plugin interface, per the reference's architecture
+(``src/fsx_kern.c:56-94``).
+"""
+
+__version__ = "0.1.0"
+
+from flowsentryx_tpu.core import config as config  # noqa: F401
+from flowsentryx_tpu.core import schema as schema  # noqa: F401

@@ -1,0 +1,88 @@
+"""``fsx`` command-line interface.
+
+The reference has no CLI — loading is manual ``bpftool prog load``
+(``TODO.md:282-289``) and its loader script crashes on run
+(``src/fsx_load.py:15`` references an undefined variable).  This CLI is
+the operator surface the reference's README promises
+(``README.md:142-147``: load/attach, stats display, dynamic rules).
+
+Subcommands grow with the framework; each delegates to the owning
+module so it stays a thin shell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _cmd_codegen(args: argparse.Namespace) -> int:
+    from flowsentryx_tpu.core import codegen
+
+    out = Path(args.out) if args.out else codegen.DEFAULT_OUT
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(codegen.generate())
+    print(f"wrote {out}")
+    return 0
+
+
+def _cmd_config(args: argparse.Namespace) -> int:
+    from flowsentryx_tpu.core.config import DEFAULT_CONFIG, FsxConfig
+
+    if args.file:
+        cfg = FsxConfig.from_json(Path(args.file).read_text())
+    else:
+        cfg = DEFAULT_CONFIG
+    if args.pack:
+        sys.stdout.buffer.write(cfg.pack_kernel_config())
+    else:
+        print(cfg.to_json())
+    return 0
+
+
+def _cmd_version(args: argparse.Namespace) -> int:
+    import flowsentryx_tpu
+
+    print(json.dumps({"version": flowsentryx_tpu.__version__}))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fsx",
+        description="flowsentryx-tpu: TPU-native DoS/DDoS mitigation framework",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("codegen", help="regenerate kern/fsx_schema.h from Python schemas")
+    g.add_argument("--out", help="output path (default: kern/fsx_schema.h)")
+    g.set_defaults(fn=_cmd_codegen)
+
+    c = sub.add_parser("config", help="show or pack the active config")
+    c.add_argument("--file", help="JSON config file (default: built-in defaults)")
+    c.add_argument("--pack", action="store_true",
+                   help="emit the binary kernel config-map blob to stdout")
+    c.set_defaults(fn=_cmd_config)
+
+    v = sub.add_parser("version", help="print version")
+    v.set_defaults(fn=_cmd_version)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout went away (e.g. piped to `head`); standard CLI etiquette.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from flowsentryx_tpu.core import config, schema  # noqa: F401

@@ -1,0 +1,223 @@
+"""Configuration system.
+
+The reference hard-codes all policy as magic constants in the kernel
+program — ``blocked_for_time = 10`` s, ``pps_threshold = 1000``,
+``bps_threshold = 125000000`` (``src/fsx_kern.c:308-310``) — with a
+comment that disagrees with the code (``fsx_kern.c:303-307``), and lists
+"config files" as future work (``README.md:70-74,142-145``,
+``TODO.md:60-61``).  This module is that promised config system:
+
+* typed, validated dataclasses for every knob,
+* JSON round-trip for files / CLI overrides,
+* :func:`pack_kernel_config` — serializes the policy subset into the
+  fixed binary layout of the kernel's BPF config map (generated as
+  ``struct fsx_config`` in ``kern/fsx_schema.h``), replacing the
+  reference's compile-time constants with a runtime-updatable map.
+
+Configs are hashable (frozen) so they can be closed over by ``jit``-ed
+functions as static arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import struct
+import typing
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class LimiterKind(enum.Enum):
+    """Which rate-limiter algorithm guards a flow.
+
+    The reference implements only FIXED_WINDOW (``fsx_kern.c:243-263``)
+    and *specifies* sliding-window and token-bucket
+    (``README.md:153-162``); all three are first-class here.
+    """
+
+    FIXED_WINDOW = "fixed_window"
+    SLIDING_WINDOW = "sliding_window"
+    TOKEN_BUCKET = "token_bucket"
+
+
+@dataclass(frozen=True)
+class LimiterConfig:
+    """Rate-limiter policy (successor of ``fsx_kern.c:303-312``)."""
+
+    kind: LimiterKind = LimiterKind.FIXED_WINDOW
+    pps_threshold: float = 1000.0       # fsx_kern.c:309
+    bps_threshold: float = 125_000_000.0  # fsx_kern.c:310 (125 MB/s ≈ 1 Gbit/s)
+    window_s: float = 1.0               # fsx_kern.c:243 (1e9 ns window)
+    bucket_rate_pps: float = 1000.0     # token refill rate
+    bucket_burst: float = 2000.0        # token bucket depth
+    block_s: float = 10.0               # fsx_kern.c:308 blacklist TTL
+
+    def __post_init__(self) -> None:
+        if self.window_s <= 0:
+            raise ValueError("window_s must be positive")
+        if self.block_s < 0:
+            raise ValueError("block_s must be non-negative")
+        if min(self.pps_threshold, self.bps_threshold,
+               self.bucket_rate_pps, self.bucket_burst) < 0:
+            raise ValueError("thresholds must be non-negative")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Classifier selection + decision policy."""
+
+    name: str = "logreg_int8"
+    threshold: float = 0.5              # sigmoid cutoff (model.py:205-208)
+    quantized: bool = True
+    ml_block_s: float = 10.0            # blacklist TTL for ML-flagged sources
+
+
+@dataclass(frozen=True)
+class TableConfig:
+    """Per-IP state table sizing.
+
+    ``capacity`` supersedes the reference's ``MAX_TRACK_IPS = 100000``
+    LRU cap (``fsx_struct.h:7``); default 2^20 ≈ 1M concurrent source
+    IPs (BASELINE config 5).  ``probes`` bounds the open-addressing
+    probe sequence (static for XLA).  ``stale_s``: slots idle longer
+    than this may be reclaimed on insert — the analog of
+    ``BPF_MAP_TYPE_LRU_HASH`` eviction (``fsx_kern.c:66``).
+    """
+
+    capacity: int = 1 << 20
+    probes: int = 8
+    stale_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.capacity & (self.capacity - 1) or self.capacity <= 0:
+            raise ValueError("capacity must be a power of two")
+        if self.probes < 1:
+            raise ValueError("probes must be >= 1")
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """Micro-batcher policy: flush at ``max_batch`` records or after
+    ``deadline_us``, whichever first (SURVEY.md §7.2: "2048 vectors or
+    200 µs")."""
+
+    max_batch: int = 2048
+    deadline_us: int = 200
+
+    def __post_init__(self) -> None:
+        if self.max_batch <= 0 or self.deadline_us <= 0:
+            raise ValueError("max_batch and deadline_us must be positive")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for the sharded state table + data-parallel
+    scoring.  ``ip_axis`` devices shard table rows by IP hash; batch
+    scoring is data-parallel over the same axis."""
+
+    ip_axis: int = 1                    # number of devices on the 'ip' axis
+    axis_name: str = "ip"
+
+
+@dataclass(frozen=True)
+class FsxConfig:
+    """Root config."""
+
+    limiter: LimiterConfig = field(default_factory=LimiterConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    table: TableConfig = field(default_factory=TableConfig)
+    batch: BatchConfig = field(default_factory=BatchConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    interface: str = "eth0"             # XDP attach point
+
+    # -- JSON round-trip ----------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        def enc(obj: Any) -> Any:
+            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+                return {f.name: enc(getattr(obj, f.name))
+                        for f in dataclasses.fields(obj)}
+            if isinstance(obj, enum.Enum):
+                return obj.value
+            return obj
+
+        return enc(self)
+
+    def to_json(self, **kw: Any) -> str:
+        return json.dumps(self.to_dict(), indent=2, **kw)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FsxConfig":
+        import typing
+
+        def dec(tp: type, v: Any) -> Any:
+            if dataclasses.is_dataclass(tp):
+                hints = typing.get_type_hints(tp)
+                names = {f.name for f in dataclasses.fields(tp)}
+                kwargs = {}
+                for k, val in v.items():
+                    if k not in names:
+                        raise KeyError(f"unknown config key {k!r} for {tp.__name__}")
+                    kwargs[k] = dec(hints[k], val)
+                return tp(**kwargs)
+            if isinstance(tp, type) and issubclass(tp, enum.Enum):
+                return tp(v)
+            return v
+
+        return dec(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "FsxConfig":
+        return cls.from_dict(json.loads(s))
+
+    # -- kernel config map --------------------------------------------------
+
+    #: ``struct fsx_config`` fields, in wire order.  The C struct in
+    #: ``kern/fsx_schema.h`` is GENERATED from this tuple (codegen.py),
+    #: and the pack format below is derived from it, so the three views
+    #: cannot drift.
+    KERNEL_CONFIG_FIELDS: typing.ClassVar[tuple[tuple[str, str, str], ...]] = (
+        ("limiter_kind", "u32", "FSX_LIMITER_*"),
+        ("_pad", "u32", ""),
+        ("pps_threshold", "u64", "packets per window"),
+        ("bps_threshold", "u64", "bytes per window"),
+        ("window_ns", "u64", ""),
+        ("block_ns", "u64", "blacklist TTL"),
+        ("bucket_rate_pps", "u64", "token refill rate"),
+        ("bucket_burst", "u64", "token bucket depth"),
+    )
+
+    KERNEL_CONFIG_FMT = "<" + "".join(
+        {"u32": "I", "u64": "Q"}[t] for _, t, _ in KERNEL_CONFIG_FIELDS
+    )
+    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 56
+
+    _KIND_CODE = {
+        LimiterKind.FIXED_WINDOW: 0,
+        LimiterKind.SLIDING_WINDOW: 1,
+        LimiterKind.TOKEN_BUCKET: 2,
+    }
+
+    def pack_kernel_config(self) -> bytes:
+        """Binary blob for the kernel's config array map (index 0).
+
+        Integer units (packets, bytes, nanoseconds) because eBPF has no
+        floats (``fsx_kern_ml.c:3-6``).
+        """
+        lim = self.limiter
+        return struct.pack(
+            self.KERNEL_CONFIG_FMT,
+            self._KIND_CODE[lim.kind],
+            0,
+            int(lim.pps_threshold),
+            int(lim.bps_threshold),
+            int(lim.window_s * 1e9),
+            int(lim.block_s * 1e9),
+            int(lim.bucket_rate_pps),
+            int(lim.bucket_burst),
+        )
+
+
+DEFAULT_CONFIG = FsxConfig()

@@ -1,0 +1,254 @@
+"""Shared schemas: the single source of truth for every plane.
+
+The reference splits its data layout across ``src/fsx_struct.h`` (map
+value structs, ``fsx_struct.h:11-22``), the feature list buried in the
+training script (``model/model.py:117``), and implicit conventions in
+``src/fsx_kern.c``.  Here one module defines:
+
+* the 8-feature vector layout (identical feature semantics to the
+  reference's ``feature_list``, ``model/model.py:117``),
+* the per-flow record the kernel pushes through the feature ring
+  (successor of the never-implemented ``src/fsx_kern_ml.c`` egress),
+* the streaming per-flow statistics the kernel keeps to estimate the
+  flow-level features (the reference never solved train/serve skew —
+  its in-kernel plan stopped at a comment block, ``fsx_kern_ml.c:1-17``),
+* the device-resident per-IP limiter state (successor of
+  ``struct ip_stats {pps,bps,track_time}``, ``fsx_struct.h:17-22``,
+  extended with sliding-window and token-bucket state that the
+  reference only specified, ``README.md:153-162``),
+* global stats (successor of ``struct stats {allowed,dropped}``,
+  ``fsx_struct.h:11-15``) and verdict codes.
+
+``kern/fsx_schema.h`` is *generated* from this module by
+:mod:`flowsentryx_tpu.core.codegen` so the C and JAX sides can never
+drift.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Feature vector
+# ---------------------------------------------------------------------------
+
+#: Feature names, in model-input order.  Semantics match the reference's
+#: ``feature_list`` (``model/model.py:117``): CICIDS2017 flow-level
+#: statistics.  The kernel computes streaming estimates of these (see
+#: FlowStats below); the offline trainer computes them exactly from CSVs.
+FEATURE_NAMES: tuple[str, ...] = (
+    "destination_port",
+    "packet_length_mean",
+    "packet_length_std",
+    "packet_length_variance",
+    "average_packet_size",
+    "fwd_iat_mean",
+    "fwd_iat_std",
+    "fwd_iat_max",
+)
+
+NUM_FEATURES: int = len(FEATURE_NAMES)  # 8
+
+
+class Feature(enum.IntEnum):
+    """Index of each feature within the 8-wide vector."""
+
+    DST_PORT = 0
+    PKT_LEN_MEAN = 1
+    PKT_LEN_STD = 2
+    PKT_LEN_VAR = 3
+    AVG_PKT_SIZE = 4
+    FWD_IAT_MEAN = 5
+    FWD_IAT_STD = 6
+    FWD_IAT_MAX = 7
+
+
+# ---------------------------------------------------------------------------
+# Flow record: the kernel → user wire format (feature ring entries)
+# ---------------------------------------------------------------------------
+
+#: Record flag bits (``flags`` field of the flow record).
+FLAG_IPV6 = 1 << 0
+FLAG_TCP_SYN = 1 << 1
+FLAG_TCP = 1 << 2
+FLAG_UDP = 1 << 3
+FLAG_ICMP = 1 << 4
+
+#: numpy structured dtype of one ring entry.  Field order/padding matches
+#: the generated C struct ``struct fsx_flow_record`` exactly (packed,
+#: 48 bytes).  10 Mpps × 48 B = 480 MB/s over the ring — within both
+#: per-CPU ringbuf and PCIe budgets (SURVEY.md §7.4).
+FLOW_RECORD_DTYPE = np.dtype(
+    [
+        ("ts_ns", "<u8"),       # bpf_ktime_get_ns() at packet arrival
+        ("saddr", "<u4"),       # IPv4 source addr, or 32-bit fold of IPv6
+        ("pkt_len", "<u2"),     # wire length of this packet
+        ("ip_proto", "u1"),     # IPPROTO_*
+        ("flags", "u1"),        # FLAG_* bits
+        ("feat", "<f4", (NUM_FEATURES,)),  # streaming feature estimates
+    ]
+)
+FLOW_RECORD_SIZE = FLOW_RECORD_DTYPE.itemsize  # 48
+assert FLOW_RECORD_SIZE == 48
+
+
+#: Streaming per-flow statistics the kernel keeps (one entry per tracked
+#: flow) to derive the 8 features online.  Welford-free: we keep sums and
+#: sums-of-squares in integer nanosecond / byte units and let the feature
+#: derivation divide once per emitted record.
+FLOW_STATS_FIELDS: tuple[tuple[str, str], ...] = (
+    ("pkt_count", "u64"),
+    ("byte_sum", "u64"),
+    ("byte_sq_sum", "u64"),
+    ("first_ts_ns", "u64"),
+    ("last_ts_ns", "u64"),
+    ("iat_sum_ns", "u64"),
+    # IAT sum-of-squares is accumulated in MICROsecond^2 units: a 1 s gap
+    # in ns^2 is 1e18, so ~18 such gaps would wrap a u64; in us^2 it is
+    # 1e12, good for ~1.8e7 seconds of worst-case gaps per flow.
+    ("iat_sq_sum_us2", "u64"),
+    ("iat_max_ns", "u64"),
+    ("dst_port", "u16"),
+)
+
+
+# ---------------------------------------------------------------------------
+# Verdicts
+# ---------------------------------------------------------------------------
+
+
+class Verdict(enum.IntEnum):
+    """Why a packet/flow was passed or dropped.
+
+    Successor of the reference's implicit XDP_PASS/XDP_DROP split
+    (``fsx_kern.c:210-214,335,346``) with the drop *cause* made explicit
+    so stats can attribute drops (the reference could not).
+    """
+
+    PASS = 0
+    DROP_BLACKLIST = 1   # source already blacklisted (fsx_kern.c:189-216)
+    DROP_RATE = 2        # rate limiter threshold exceeded (fsx_kern.c:308-312)
+    DROP_ML = 3          # classifier scored the flow malicious
+
+
+# ---------------------------------------------------------------------------
+# Device-side state (JAX pytrees)
+# ---------------------------------------------------------------------------
+
+
+class IpTableState(NamedTuple):
+    """SoA per-IP state table resident on device, ``[capacity]`` rows.
+
+    Successor of the reference's three LRU hash maps (``fsx_kern.c:64-94``:
+    ``ip_stats_map``, ``blacklist_v4``, ``blacklist_v6``) merged into one
+    open-addressing table so a single gather serves the blacklist check,
+    the limiter update, and the verdict writeback.  Rows are sharded
+    across the device mesh by slot index (= by IP hash).
+
+    All times are float32 seconds on a process-relative clock; counters
+    are float32 (exactly representable well past any 1-second window's
+    packet count).
+    """
+
+    key: jnp.ndarray            # uint32; 0 = empty slot sentinel
+    last_seen: jnp.ndarray      # f32 s; drives stale-slot reclamation (LRU analog)
+    win_start: jnp.ndarray      # f32 s; current fixed/sliding window start
+    win_pps: jnp.ndarray        # f32; packets in current window
+    win_bps: jnp.ndarray        # f32; bytes in current window
+    prev_pps: jnp.ndarray       # f32; previous window packets (sliding)
+    prev_bps: jnp.ndarray       # f32; previous window bytes (sliding)
+    tokens: jnp.ndarray         # f32; token-bucket level
+    tok_ts: jnp.ndarray         # f32 s; last token refill time
+    blocked_until: jnp.ndarray  # f32 s; 0 = not blacklisted (fsx_kern.c:193-204)
+
+    @property
+    def capacity(self) -> int:
+        return self.key.shape[-1]
+
+
+def make_table(capacity: int) -> IpTableState:
+    """Fresh, empty state table with ``capacity`` slots (power of two)."""
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    z = jnp.zeros((capacity,), jnp.float32)
+    return IpTableState(
+        key=jnp.zeros((capacity,), jnp.uint32),
+        last_seen=z, win_start=z, win_pps=z, win_bps=z,
+        prev_pps=z, prev_bps=z, tokens=z, tok_ts=z, blocked_until=z,
+    )
+
+
+class GlobalStats(NamedTuple):
+    """Global counters (successor of ``struct stats``, ``fsx_struct.h:11-15``).
+
+    The reference bumps ``allowed``/``dropped`` with racy plain increments
+    (``fsx_kern.c:210,332,342``); here updates are functional reductions,
+    race-free by construction, and drop causes are attributed.
+    """
+
+    allowed: jnp.ndarray            # i32 []
+    dropped_blacklist: jnp.ndarray  # i32 []
+    dropped_rate: jnp.ndarray       # i32 []
+    dropped_ml: jnp.ndarray         # i32 []
+    batches: jnp.ndarray            # i32 []
+
+    @property
+    def dropped(self) -> jnp.ndarray:
+        return self.dropped_blacklist + self.dropped_rate + self.dropped_ml
+
+
+def make_stats() -> GlobalStats:
+    z = jnp.zeros((), jnp.int32)
+    return GlobalStats(z, z, z, z, z)
+
+
+class FeatureBatch(NamedTuple):
+    """One micro-batch of flow records, decoded to device-friendly SoA.
+
+    Produced by the host batcher from raw ``FLOW_RECORD_DTYPE`` bytes.
+    ``valid`` masks ragged tails (batches are padded to a static size so
+    every shape under ``jit`` stays static).
+    """
+
+    key: jnp.ndarray      # [B] uint32 source address / fold
+    feat: jnp.ndarray     # [B, 8] f32
+    pkt_len: jnp.ndarray  # [B] f32 bytes
+    ts: jnp.ndarray       # [B] f32 seconds (process-relative)
+    valid: jnp.ndarray    # [B] bool
+
+
+def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch:
+    """Decode ``FLOW_RECORD_DTYPE`` entries into a padded :class:`FeatureBatch`.
+
+    ``buf`` may hold fewer than ``batch_size`` records; the tail is
+    zero-padded and masked via ``valid``.
+
+    ``t0_ns`` is mandatory and must be a *recent* kernel timestamp
+    (``bpf_ktime_get_ns`` is boot-relative): timestamps are stored as
+    float32 seconds relative to ``t0_ns``, and float32 spacing at 1e6 s
+    magnitude is ~0.06 s — far too coarse for 1 s limiter windows.
+    Records stamped slightly before ``t0_ns`` yield small negative
+    times (signed arithmetic; no uint64 wrap).
+    """
+    n = min(len(buf), batch_size)
+    key = np.zeros((batch_size,), np.uint32)
+    feat = np.zeros((batch_size, NUM_FEATURES), np.float32)
+    pkt_len = np.zeros((batch_size,), np.float32)
+    ts = np.zeros((batch_size,), np.float32)
+    valid = np.zeros((batch_size,), bool)
+    if n:
+        rec = buf[:n]
+        key[:n] = rec["saddr"]
+        feat[:n] = rec["feat"]
+        pkt_len[:n] = rec["pkt_len"]
+        ts[:n] = (rec["ts_ns"].astype(np.int64) - np.int64(t0_ns)) * 1e-9
+        valid[:n] = True
+    return FeatureBatch(
+        key=jnp.asarray(key), feat=jnp.asarray(feat),
+        pkt_len=jnp.asarray(pkt_len), ts=jnp.asarray(ts),
+        valid=jnp.asarray(valid),
+    )

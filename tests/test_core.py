@@ -1,0 +1,128 @@
+"""Tests for core schemas, config system, and C header codegen."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.core import codegen, schema
+from flowsentryx_tpu.core.config import (
+    DEFAULT_CONFIG,
+    BatchConfig,
+    FsxConfig,
+    LimiterConfig,
+    LimiterKind,
+    TableConfig,
+)
+
+
+class TestSchema:
+    def test_feature_layout_matches_reference(self):
+        # model/model.py:117 feature_list, same order
+        assert schema.FEATURE_NAMES == (
+            "destination_port",
+            "packet_length_mean",
+            "packet_length_std",
+            "packet_length_variance",
+            "average_packet_size",
+            "fwd_iat_mean",
+            "fwd_iat_std",
+            "fwd_iat_max",
+        )
+        assert schema.NUM_FEATURES == 8
+        assert schema.Feature.FWD_IAT_MAX == 7
+
+    def test_flow_record_dtype_packed(self):
+        assert schema.FLOW_RECORD_SIZE == 48
+        # no implicit padding
+        total = sum(
+            np.dtype(schema.FLOW_RECORD_DTYPE[name]).itemsize
+            for name in schema.FLOW_RECORD_DTYPE.names
+        )
+        assert total == schema.FLOW_RECORD_SIZE
+
+    def test_make_table(self):
+        t = schema.make_table(1 << 10)
+        assert t.capacity == 1024
+        assert t.key.dtype == np.uint32
+        assert float(t.blocked_until.sum()) == 0.0
+        with pytest.raises(ValueError):
+            schema.make_table(1000)  # not a power of two
+
+    def test_decode_records_pads_and_masks(self):
+        buf = np.zeros(3, dtype=schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = [10, 20, 30]
+        buf["pkt_len"] = [100, 200, 300]
+        buf["ts_ns"] = [1_000_000_000, 2_000_000_000, 3_000_000_000]
+        buf["feat"][:, 0] = [80.0, 443.0, 53.0]
+        b = schema.decode_records(buf, batch_size=8, t0_ns=2_000_000_000)
+        assert b.key.shape == (8,)
+        assert b.feat.shape == (8, 8)
+        assert bool(b.valid[:3].all()) and not bool(b.valid[3:].any())
+        # records 1 s BEFORE t0 must come out small-negative, not uint64-wrapped
+        np.testing.assert_allclose(np.asarray(b.ts[:3]), [-1.0, 0.0, 1.0], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(b.feat[:3, 0]), [80.0, 443.0, 53.0])
+
+    def test_stats(self):
+        s = schema.make_stats()
+        assert int(s.dropped) == 0
+
+
+class TestConfig:
+    def test_defaults_match_reference_policy(self):
+        # fsx_kern.c:308-310
+        lim = DEFAULT_CONFIG.limiter
+        assert lim.pps_threshold == 1000.0
+        assert lim.bps_threshold == 125_000_000.0
+        assert lim.block_s == 10.0
+        assert lim.kind is LimiterKind.FIXED_WINDOW
+
+    def test_json_roundtrip(self):
+        cfg = FsxConfig(
+            limiter=LimiterConfig(kind=LimiterKind.TOKEN_BUCKET, pps_threshold=5),
+            table=TableConfig(capacity=1 << 12, probes=4),
+            batch=BatchConfig(max_batch=256, deadline_us=50),
+        )
+        cfg2 = FsxConfig.from_json(cfg.to_json())
+        assert cfg2 == cfg
+        assert cfg2.limiter.kind is LimiterKind.TOKEN_BUCKET
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(KeyError):
+            FsxConfig.from_dict({"limiter": {"nope": 1}})
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LimiterConfig(window_s=0)
+        with pytest.raises(ValueError):
+            TableConfig(capacity=1000)
+        with pytest.raises(ValueError):
+            BatchConfig(max_batch=0)
+
+    def test_pack_kernel_config(self):
+        blob = DEFAULT_CONFIG.pack_kernel_config()
+        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 56
+        kind, _pad, pps, bps, win_ns, blk_ns, rate, burst = struct.unpack(
+            FsxConfig.KERNEL_CONFIG_FMT, blob
+        )
+        assert kind == 0 and pps == 1000 and bps == 125_000_000
+        assert win_ns == 1_000_000_000 and blk_ns == 10_000_000_000
+        assert rate == 1000 and burst == 2000
+
+    def test_configs_hashable_for_jit_static(self):
+        assert hash(DEFAULT_CONFIG) == hash(FsxConfig())
+
+
+class TestCodegen:
+    def test_header_contains_layouts(self):
+        h = codegen.generate()
+        assert "struct fsx_flow_record" in h
+        assert "struct fsx_config" in h
+        assert "struct fsx_ip_state" in h
+        assert "#define FSX_NUM_FEATURES 8" in h
+        assert "#define FSX_VERDICT_DROP_ML 3" in h
+
+    def test_checked_in_header_is_current(self):
+        # The header is a committed artifact; absence is drift, not a skip.
+        assert codegen.DEFAULT_OUT.exists(), "kern/fsx_schema.h missing — run python -m flowsentryx_tpu.core.codegen"
+        assert codegen.DEFAULT_OUT.read_text() == codegen.generate()
