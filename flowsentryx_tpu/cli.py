@@ -21,10 +21,7 @@ from pathlib import Path
 def _cmd_codegen(args: argparse.Namespace) -> int:
     from flowsentryx_tpu.core import codegen
 
-    out = Path(args.out) if args.out else codegen.DEFAULT_OUT
-    out.parent.mkdir(parents=True, exist_ok=True)
-    out.write_text(codegen.generate())
-    print(f"wrote {out}")
+    print(f"wrote {codegen.write_header(args.out)}")
     return 0
 
 

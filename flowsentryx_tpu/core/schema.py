@@ -116,6 +116,36 @@ FLOW_STATS_FIELDS: tuple[tuple[str, str], ...] = (
 )
 
 
+#: ``struct fsx_ip_state`` — the kernel-side per-source-IP fast-path
+#: counters (successor of ``struct ip_stats``, ``fsx_struct.h:17-22``,
+#: extended with sliding-window + token-bucket state, README.md:153-162).
+#: Integer units only (no floats in eBPF); tokens ×1000 for precision.
+#: The *device*-side mirror is :class:`IpTableState` below — richer
+#: (float32, blacklist merged in) because the TPU plane has no eBPF
+#: constraints; the two are intentionally distinct layouts.
+IP_STATE_FIELDS: tuple[tuple[str, str], ...] = (
+    ("win_start_ns", "u64"),
+    ("win_pps", "u64"),
+    ("win_bps", "u64"),
+    ("prev_pps", "u64"),
+    ("prev_bps", "u64"),
+    ("tokens_milli", "u64"),
+    ("tok_ts_ns", "u64"),
+)
+
+#: ``struct fsx_stats`` — kernel-side global counters, kept in a
+#: PER_CPU array map (race-free increments; user space aggregates —
+#: the improvement proposed at ``fsx_kern.c:253-257``).  The host-side
+#: :class:`GlobalStats` additionally tracks ``batches``, which is a
+#: TPU-plane concept with no kernel meaning — intentionally absent here.
+KERNEL_STATS_FIELDS: tuple[tuple[str, str], ...] = (
+    ("allowed", "u64"),
+    ("dropped_blacklist", "u64"),
+    ("dropped_rate", "u64"),
+    ("dropped_ml", "u64"),
+)
+
+
 # ---------------------------------------------------------------------------
 # Verdicts
 # ---------------------------------------------------------------------------

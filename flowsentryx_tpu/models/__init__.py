@@ -1,0 +1,2 @@
+from flowsentryx_tpu.models import logreg, mlp  # noqa: F401
+from flowsentryx_tpu.models.registry import get_model, register_model  # noqa: F401

@@ -1,0 +1,219 @@
+"""Int8-quantized logistic-regression classifier, TPU-native.
+
+Rebuild of the reference's ML plane (``model/model.py:124-137``: a
+``QuantStub → Linear(8,1) → sigmoid → DeQuantStub`` PyTorch module,
+quantization-aware-trained and converted to int8).  Two scoring paths:
+
+* :func:`classify` / :func:`classify_batch` — **exact int8 simulation**
+  of the torch quantized pipeline (quantize input → int8 matmul →
+  requantize → quantized sigmoid → dequantize), bit-matching the
+  reference's converted model so its published accuracy (83.02 %,
+  ``model.ipynb:4653``) transfers.  The matmul runs as an int8×int8→int32
+  ``dot_general`` — the dtype the MXU natively accelerates.
+* :func:`classify_float` — plain ``sigmoid(x @ w_dq + b)`` on
+  dequantized weights, for training-time evaluation and as the
+  reference point the quantized path is tested against.
+
+The checked-in reference artifact's parameters are embedded as
+:data:`GOLDEN` (values from ``src/fsx_load.py:28-46`` /
+``model/model.ipynb:4612``), giving an exact golden-parity target
+without depending on torch at runtime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flowsentryx_tpu.core.schema import NUM_FEATURES
+
+
+class LogRegParams(NamedTuple):
+    """Quantized logistic-regression parameters (torch-artifact semantics).
+
+    ``w_int8``: per-tensor affine qint8 weights, zero-point 0.
+    Input activations are quint8 (``in_zp`` in [0,255]); the linear
+    output is requantized to quint8 (``out_scale``/``out_zp``) before
+    the quantized sigmoid, which emits quint8 at fixed scale 1/256,
+    zero-point 0 — exactly torch's quantized sigmoid contract.
+    """
+
+    w_int8: jnp.ndarray   # [8] int8
+    bias: jnp.ndarray     # [] f32
+    w_scale: jnp.ndarray  # [] f32
+    in_scale: jnp.ndarray  # [] f32
+    in_zp: jnp.ndarray     # [] int32
+    out_scale: jnp.ndarray  # [] f32
+    out_zp: jnp.ndarray     # [] int32
+
+    @property
+    def w_dequant(self) -> jnp.ndarray:
+        return self.w_int8.astype(jnp.float32) * self.w_scale
+
+
+def make_params(
+    w_int8: np.ndarray | list[int],
+    bias: float,
+    w_scale: float,
+    in_scale: float,
+    in_zp: int = 0,
+    out_scale: float = 1.0,
+    out_zp: int = 0,
+) -> LogRegParams:
+    return LogRegParams(
+        w_int8=jnp.asarray(w_int8, jnp.int8),
+        bias=jnp.float32(bias),
+        w_scale=jnp.float32(w_scale),
+        in_scale=jnp.float32(in_scale),
+        in_zp=jnp.int32(in_zp),
+        out_scale=jnp.float32(out_scale),
+        out_zp=jnp.int32(out_zp),
+    )
+
+
+#: The reference's converted int8 artifact (src/fsx_load.py:28-46,
+#: model/model.ipynb:4612): weight ints, weight scale (zp 0), bias,
+#: input quant scale/zp (QuantStub observer), output requant scale/zp.
+GOLDEN = dict(
+    w_int8=[0, -80, 106, -9, -85, -52, 106, -45],
+    bias=0.0278,
+    w_scale=0.002657087752595544,
+    in_scale=944881.875,
+    in_zp=0,
+    out_scale=398330.9688,
+    out_zp=84,
+)
+
+
+def golden_params() -> LogRegParams:
+    """Parameters of the reference's checked-in quantized model."""
+    return make_params(**GOLDEN)
+
+
+# ---------------------------------------------------------------------------
+# Scoring
+# ---------------------------------------------------------------------------
+
+
+def _quantize_u8(x: jnp.ndarray, scale: jnp.ndarray, zp: jnp.ndarray) -> jnp.ndarray:
+    """quint8 affine quantization with round-half-to-even (torch semantics)."""
+    q = jnp.round(x / scale) + zp
+    return jnp.clip(q, 0, 255).astype(jnp.int32)
+
+
+def classify(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Score one 8-feature vector through the exact int8 pipeline.
+
+    Mirrors torch's converted graph (``model.py:130-135`` forward under
+    ``torch.ao.quantization.convert``):
+
+      1. quantize input to quint8 (QuantStub),
+      2. int8 matmul + bias — computed as (q_x - in_zp) · w_int8 in
+         int32 then scaled by ``in_scale * w_scale`` (exact: products of
+         exactly-representable ints),
+      3. requantize the linear output to quint8 (out_scale/out_zp),
+      4. quantized sigmoid: sigmoid of the dequantized value, emitted
+         at scale 1/256 zp 0 (torch's fixed qparams for sigmoid),
+      5. dequantize → probability in [0, 255/256].
+    """
+    q_x = _quantize_u8(x, params.in_scale, params.in_zp)
+    # int32 accumulate of int8-domain values: this is the MXU-native form
+    acc = jnp.sum(
+        (q_x - params.in_zp) * params.w_int8.astype(jnp.int32), dtype=jnp.int32
+    )
+    y = acc.astype(jnp.float32) * (params.in_scale * params.w_scale) + params.bias
+    q_y = _quantize_u8(y, params.out_scale, params.out_zp)
+    y_dq = (q_y - params.out_zp).astype(jnp.float32) * params.out_scale
+    p = jax.nn.sigmoid(y_dq)
+    # torch quantized sigmoid output: scale 1/256, zero_point 0
+    q_p = jnp.clip(jnp.round(p * 256.0), 0, 255)
+    return q_p * (1.0 / 256.0)
+
+
+def classify_float(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Float path: sigmoid(x @ w_dequant + bias), no activation quant."""
+    return jax.nn.sigmoid(x @ params.w_dequant + params.bias)
+
+
+@partial(jax.jit, static_argnames=("quantized",))
+def classify_batch(
+    params: LogRegParams, x: jnp.ndarray, quantized: bool = True
+) -> jnp.ndarray:
+    """``jit(vmap(classify))`` over a ``[B, 8]`` batch → ``[B]`` scores.
+
+    This is the north star's single-call TPU scoring entry point
+    (BASELINE.json north_star: "score with a single jit(vmap(classify))").
+    """
+    fn = classify if quantized else classify_float
+    return jax.vmap(fn, in_axes=(None, 0))(params, x)
+
+
+def classify_batch_int8_matmul(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Batched int8 scoring written as one ``dot_general`` (MXU form).
+
+    Semantically identical to ``classify_batch(..., quantized=True)``;
+    expressed as a single int8×int8→int32 matmul so XLA lowers the
+    whole batch onto the systolic array instead of vmapping a reduction.
+    Used by the fused engine step where the batch axis is large.
+    """
+    q_x = jax.vmap(_quantize_u8, in_axes=(0, None, None))(
+        x, params.in_scale, params.in_zp
+    )
+    # Recenter quint8 [0,255] into int8 range: q_x - 128 ∈ [-128,127].
+    # (q_x - in_zp)·w  ==  (q_x - 128)·w + (128 - in_zp)·Σw, all exact in i32.
+    xc = (q_x - 128).astype(jnp.int8)  # [B,8]
+    w_sum = jnp.sum(params.w_int8.astype(jnp.int32))
+    acc = jax.lax.dot_general(
+        xc,
+        params.w_int8.reshape(NUM_FEATURES, 1),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )[:, 0] + (128 - params.in_zp) * w_sum
+    y = acc.astype(jnp.float32) * (params.in_scale * params.w_scale) + params.bias
+    q_y = jax.vmap(_quantize_u8, in_axes=(0, None, None))(
+        y, params.out_scale, params.out_zp
+    )
+    y_dq = (q_y - params.out_zp).astype(jnp.float32) * params.out_scale
+    p = jax.nn.sigmoid(y_dq)
+    return jnp.clip(jnp.round(p * 256.0), 0, 255) * (1.0 / 256.0)
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O
+# ---------------------------------------------------------------------------
+
+
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+def _npz_path(path: str) -> str:
+    # np.savez appends ".npz" to suffix-less paths; normalize so
+    # save/load agree on the actual filename.
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_params(params: LogRegParams, path: str) -> str:
+    """Persist as .npz (the rebuild's artifact format; successor of the
+    reference's ``torch.save`` state-dict, ``model.py:238``).  Returns
+    the actual path written (".npz" appended if missing)."""
+    path = _npz_path(path)
+    np.savez(
+        path,
+        **{k: np.asarray(v) for k, v in params._asdict().items()},
+        schema_version=ARTIFACT_SCHEMA_VERSION,
+    )
+    return path
+
+
+def load_params(path: str) -> LogRegParams:
+    with np.load(_npz_path(path)) as z:
+        version = int(z["schema_version"]) if "schema_version" in z else 0
+        if version != ARTIFACT_SCHEMA_VERSION:
+            raise ValueError(
+                f"artifact schema version {version} != {ARTIFACT_SCHEMA_VERSION}"
+            )
+        return LogRegParams(**{k: jnp.asarray(z[k]) for k in LogRegParams._fields})

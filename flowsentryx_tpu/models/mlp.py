@@ -1,0 +1,74 @@
+"""Small MLP detector head — the framework's model-extensibility proof.
+
+The reference's model zoo is exactly one logistic regression
+(``model/model.py:124-137``); its README floats "per-attack-class"
+detection as future work.  This MLP (8 → hidden → hidden → 1) is the
+second registered model family: same 8-feature input contract, same
+scalar-probability output contract, so the engine can swap models via
+config without code changes.  bfloat16 by default — the MXU-native
+float dtype.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from flowsentryx_tpu.core.schema import NUM_FEATURES
+
+
+class MlpParams(NamedTuple):
+    w1: jnp.ndarray  # [8, H]
+    b1: jnp.ndarray  # [H]
+    w2: jnp.ndarray  # [H, H]
+    b2: jnp.ndarray  # [H]
+    w3: jnp.ndarray  # [H, 1]
+    b3: jnp.ndarray  # [1]
+
+
+def init_params(
+    key: jax.Array, hidden: int = 32, dtype: jnp.dtype = jnp.bfloat16
+) -> MlpParams:
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def he(k, fan_in, shape):
+        return (jax.random.normal(k, shape) * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+    return MlpParams(
+        w1=he(k1, NUM_FEATURES, (NUM_FEATURES, hidden)),
+        b1=jnp.zeros((hidden,), dtype),
+        w2=he(k2, hidden, (hidden, hidden)),
+        b2=jnp.zeros((hidden,), dtype),
+        w3=he(k3, hidden, (hidden, 1)),
+        b3=jnp.zeros((1,), dtype),
+    )
+
+
+def logits(params: MlpParams, x: jnp.ndarray) -> jnp.ndarray:
+    """``[B, 8] → [B]`` pre-sigmoid logits — the single forward pass all
+    entry points share.  Plain matmuls: XLA tiles these onto the MXU; no
+    vmap needed when the math is already batched."""
+    h = jax.nn.relu(x.astype(params.w1.dtype) @ params.w1 + params.b1)
+    h = jax.nn.relu(h @ params.w2 + params.b2)
+    return (h @ params.w3 + params.b3)[:, 0].astype(jnp.float32)
+
+
+def classify(params: MlpParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Score one 8-feature vector → probability."""
+    return jax.nn.sigmoid(logits(params, x[None, :])[0])
+
+
+@jax.jit
+def classify_batch(params: MlpParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Batched scoring → ``[B]`` probabilities."""
+    return jax.nn.sigmoid(logits(params, x))
+
+
+@jax.jit
+def loss_fn(params: MlpParams, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean binary cross-entropy (numerically stable logit form)."""
+    lg = logits(params, x)
+    losses = jnp.maximum(lg, 0) - lg * labels + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+    return losses.mean()

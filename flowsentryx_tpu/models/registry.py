@@ -1,0 +1,76 @@
+"""Model registry: name → (init, batch-scorer) pairs.
+
+Lets :class:`~flowsentryx_tpu.core.config.ModelConfig.name` select the
+classifier without the engine knowing model internals.  The reference
+hard-wires its single model into the training script; here new model
+families register themselves (the per-attack-class extension point
+noted in SURVEY.md §2.3 EP row).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    init: Callable[..., Any]                    # (key?, **kw) -> params
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray]  # (params, [B,8]) -> [B]
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> ModelSpec:
+    if spec.name in _REGISTRY:
+        raise ValueError(f"model {spec.name!r} already registered")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get_model(name: str) -> ModelSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def registered_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# -- built-ins ---------------------------------------------------------------
+
+from flowsentryx_tpu.models import logreg as _logreg  # noqa: E402
+from flowsentryx_tpu.models import mlp as _mlp  # noqa: E402
+
+register_model(
+    ModelSpec(
+        name="logreg_int8",
+        init=lambda key=None, **kw: _logreg.golden_params(),
+        classify_batch=lambda p, x: _logreg.classify_batch(p, x, quantized=True),
+    )
+)
+register_model(
+    ModelSpec(
+        name="logreg_float",
+        init=lambda key=None, **kw: _logreg.golden_params(),
+        classify_batch=lambda p, x: _logreg.classify_batch(p, x, quantized=False),
+    )
+)
+register_model(
+    ModelSpec(
+        name="mlp",
+        init=lambda key=None, **kw: _mlp.init_params(
+            key if key is not None else jax.random.PRNGKey(0), **kw
+        ),
+        classify_batch=_mlp.classify_batch,
+    )
+)
