@@ -218,21 +218,52 @@ class GlobalStats(NamedTuple):
     The reference bumps ``allowed``/``dropped`` with racy plain increments
     (``fsx_kern.c:210,332,342``); here updates are functional reductions,
     race-free by construction, and drop causes are attributed.
+
+    Each counter is a ``[2]`` uint32 ``(lo, hi)`` pair updated with
+    :func:`u64_add` — a 64-bit count without ``jax_enable_x64`` (int32
+    would wrap after ~3.5 minutes at the 10 Mpps design rate; the
+    kernel-side ``struct fsx_stats`` is u64 for the same reason).
+    Read with :func:`stat_value`.
     """
 
-    allowed: jnp.ndarray            # i32 []
-    dropped_blacklist: jnp.ndarray  # i32 []
-    dropped_rate: jnp.ndarray       # i32 []
-    dropped_ml: jnp.ndarray         # i32 []
-    batches: jnp.ndarray            # i32 []
+    allowed: jnp.ndarray            # [2] uint32 (lo, hi)
+    dropped_blacklist: jnp.ndarray  # [2] uint32
+    dropped_rate: jnp.ndarray       # [2] uint32
+    dropped_ml: jnp.ndarray         # [2] uint32
+    batches: jnp.ndarray            # [2] uint32
 
     @property
-    def dropped(self) -> jnp.ndarray:
-        return self.dropped_blacklist + self.dropped_rate + self.dropped_ml
+    def dropped(self) -> int:
+        """Total drops (host-side read)."""
+        return (
+            stat_value(self.dropped_blacklist)
+            + stat_value(self.dropped_rate)
+            + stat_value(self.dropped_ml)
+        )
+
+    def to_dict(self) -> dict:
+        d = {f: stat_value(getattr(self, f)) for f in self._fields}
+        d["dropped"] = self.dropped
+        return d
+
+
+def u64_add(field: jnp.ndarray, inc: jnp.ndarray) -> jnp.ndarray:
+    """Add a non-negative scalar to a ``[2]`` uint32 (lo, hi) counter,
+    with carry — jit-safe 64-bit accumulation on a 32-bit-only backend."""
+    inc = inc.astype(jnp.uint32)
+    lo = field[0] + inc
+    carry = (lo < field[0]).astype(jnp.uint32)
+    return jnp.stack([lo, field[1] + carry])
+
+
+def stat_value(field: jnp.ndarray) -> int:
+    """Host-side read of a (lo, hi) counter as a python int."""
+    f = np.asarray(field)
+    return int(f[0]) + (int(f[1]) << 32)
 
 
 def make_stats() -> GlobalStats:
-    z = jnp.zeros((), jnp.int32)
+    z = jnp.zeros((2,), jnp.uint32)
     return GlobalStats(z, z, z, z, z)
 
 

@@ -55,7 +55,9 @@ register_model(
     ModelSpec(
         name="logreg_int8",
         init=lambda key=None, **kw: _logreg.golden_params(),
-        classify_batch=lambda p, x: _logreg.classify_batch(p, x, quantized=True),
+        # the dot_general form: one int8 matmul on the MXU instead of a
+        # vmapped per-row reduction (bit-identical; see test_models)
+        classify_batch=_logreg.classify_batch_int8_matmul,
     )
 )
 register_model(

@@ -1,0 +1,1 @@
+from flowsentryx_tpu.ops import agg, hashtable, limiters  # noqa: F401

@@ -1,0 +1,133 @@
+"""Device-resident open-addressing IP state table.
+
+Successor of the reference's three ``BPF_MAP_TYPE_LRU_HASH`` maps
+(``fsx_kern.c:64-94``) as one SoA table of JAX arrays
+(:class:`~flowsentryx_tpu.core.schema.IpTableState`) that lives in HBM
+and is updated in place via donated buffers.  Design constraints that
+shaped it (SURVEY.md §7.4.2):
+
+* **Static shapes, bounded probes.**  Open addressing with a
+  compile-time probe count ``P``: lookup is one ``[R, P]`` gather + a
+  reduction — no data-dependent loops, so XLA vectorizes it flat.
+* **Batch-internal collision resolution.**  Two distinct keys in one
+  micro-batch can select the same slot (hash collision on insert); a
+  sort-based arbitration keeps the lowest-indexed flow and marks the
+  rest untracked for this batch (they still get classified — losing a
+  limiter update for one batch is the bounded-error analog of the
+  reference's LRU silently evicting attackers, SURVEY.md §5.3).
+* **Stale reclamation ≈ LRU.**  Slots idle longer than
+  ``TableConfig.stale_s`` are reclaimed by inserts, approximating the
+  kernel map's LRU eviction without global bookkeeping.
+
+Keys are uint32 (IPv4 address or 32-bit fold of IPv6); 0 and
+0xFFFFFFFF are reserved (empty slot / invalid sentinel) — neither is a
+routable unicast source.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from flowsentryx_tpu.core.config import TableConfig
+from flowsentryx_tpu.ops.agg import INVALID_KEY
+
+EMPTY_KEY = jnp.uint32(0)
+
+
+def hash_u32(k: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3 finalizer — avalanches all 32 bits (uint32 wraparound)."""
+    k = k.astype(jnp.uint32)
+    k ^= k >> 16
+    k *= jnp.uint32(0x85EBCA6B)
+    k ^= k >> 13
+    k *= jnp.uint32(0xC2B2AE35)
+    k ^= k >> 16
+    return k
+
+
+class SlotAssignment(NamedTuple):
+    """Result of resolving a batch of flow keys against the table."""
+
+    slot: jnp.ndarray      # [R] int32 table row (garbage where ~tracked)
+    found: jnp.ndarray     # [R] bool: key already present
+    inserted: jnp.ndarray  # [R] bool: claimed an empty/stale slot
+    tracked: jnp.ndarray   # [R] bool: found | inserted (and won arbitration)
+
+
+def assign_slots(
+    table_key: jnp.ndarray,
+    table_last_seen: jnp.ndarray,
+    rep_key: jnp.ndarray,
+    rep_valid: jnp.ndarray,
+    now: jnp.ndarray,
+    cfg: TableConfig,
+) -> SlotAssignment:
+    """Find-or-claim a table slot for each representative key.
+
+    Probe sequence: double hashing ``(h1 + p·step) mod N`` with an odd
+    ``step`` derived from a second hash — odd step sizes generate the
+    full ring for power-of-two ``N``, so probes don't clump the way
+    linear probing does under adversarial many-IP floods.
+
+    Claim priority per flow: exact match > first empty > stalest
+    reclaimable slot.  All candidates are examined in one ``[R, P]``
+    gather; selection is ``argmin`` over a priority score — branch-free.
+    """
+    n = table_key.shape[0]
+    mask = jnp.uint32(n - 1)
+    r = rep_key.shape[0]
+    p = cfg.probes
+
+    h1 = hash_u32(rep_key)
+    step = (hash_u32(rep_key ^ jnp.uint32(0x9E3779B9)) | jnp.uint32(1))
+    offs = jnp.arange(p, dtype=jnp.uint32)  # [P]
+    slots = (h1[:, None] + offs[None, :] * step[:, None]) & mask  # [R, P]
+    slots = slots.astype(jnp.int32)
+
+    cand_key = table_key[slots]            # [R, P] gather
+    cand_seen = table_last_seen[slots]     # [R, P]
+
+    match = cand_key == rep_key[:, None]
+    empty = cand_key == EMPTY_KEY
+    stale = (~match) & (~empty) & (now - cand_seen > cfg.stale_s)
+
+    # Priority score per candidate (lower = better):
+    #   match  -> 0 + probe index        (prefer earliest probe)
+    #   empty  -> P + probe index
+    #   stale  -> 2P + probe index       (prefer earliest, not stalest:
+    #                                     cheaper and just as correct)
+    #   else   -> 4P (unusable)
+    probe_idx = jnp.arange(p, dtype=jnp.int32)[None, :]
+    score = jnp.where(
+        match, probe_idx,
+        jnp.where(empty, p + probe_idx,
+                  jnp.where(stale, 2 * p + probe_idx, 4 * p)),
+    )
+    best = jnp.argmin(score, axis=1)  # [R]
+    best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+    slot = jnp.take_along_axis(slots, best[:, None], axis=1)[:, 0]
+
+    found = rep_valid & (best_score < p)
+    usable = rep_valid & (best_score < 4 * p)
+    inserted = usable & ~found
+
+    # --- batch-internal arbitration: one winner per claimed slot -----------
+    # Distinct keys may claim the same empty/stale slot.  Sort by
+    # (slot, found-first); the head of each slot group wins.  A flow that
+    # FOUND its key always beats one reclaiming that slot as stale
+    # (same-key collisions are impossible: agg yields distinct reps).
+    slot_for_sort = jnp.where(usable, slot, jnp.int32(n))  # park unusable at n
+    order = jnp.lexsort((~found, slot_for_sort))  # primary: slot, secondary: found
+    sorted_slot = slot_for_sort[order]
+    head = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_slot[1:] != sorted_slot[:-1]]
+    )
+    is_winner_sorted = head & (sorted_slot < n)
+    winner = jnp.zeros((r,), bool).at[order].set(is_winner_sorted)
+
+    tracked = usable & winner
+    inserted = inserted & winner
+    found = found & winner
+    return SlotAssignment(slot=slot, found=found, inserted=inserted, tracked=tracked)

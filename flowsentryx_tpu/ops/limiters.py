@@ -1,0 +1,157 @@
+"""Rate limiters as vectorized functional state transitions.
+
+The reference implements one fixed-window limiter inline in the XDP
+program (``fsx_kern.c:243-263``: reset window after 1 s, atomically bump
+pps/bps, compare to thresholds at ``:308-312``) and *specifies* sliding
+window and token bucket (``README.md:153-162``).  Here all three are
+pure functions ``(state slice, deltas, now) → (state slice, over_limit)``
+operating on whole arrays of flows at once — the per-packet branchy C
+becomes a branch-free ``jnp.where`` dataflow that XLA fuses into the
+surrounding gather/scatter, and the *same* compiled code serves 1 flow
+or 1M flows.
+
+Two reference bugs deliberately not replicated (SURVEY.md §7.5):
+
+* window reset counted the first packet of a new window as 0
+  (``fsx_kern.c:245-250`` resets to 0; the insert path sets 1) — here a
+  reset window starts at the batch's delta;
+* comment/code threshold mismatch — thresholds come from
+  :class:`~flowsentryx_tpu.core.config.LimiterConfig`, one source.
+
+All inputs are *aggregated per flow per micro-batch* (see
+:mod:`flowsentryx_tpu.ops.agg`): ``d_pkts``/``d_bytes`` are this
+flow's packet/byte counts within the batch, ``now`` the flow's newest
+timestamp.  Limiters never see individual packets — that is what makes
+10 Mpps affordable: state transitions run once per (flow, batch), not
+once per packet.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from flowsentryx_tpu.core.config import LimiterConfig, LimiterKind
+
+
+class WindowState(NamedTuple):
+    """Slice of the IP table a window limiter reads/writes."""
+
+    win_start: jnp.ndarray  # [R] f32 s
+    win_pps: jnp.ndarray    # [R] f32
+    win_bps: jnp.ndarray    # [R] f32
+    prev_pps: jnp.ndarray   # [R] f32 (sliding window only)
+    prev_bps: jnp.ndarray   # [R] f32
+
+
+class BucketState(NamedTuple):
+    """Slice of the IP table the token bucket reads/writes."""
+
+    tokens: jnp.ndarray  # [R] f32
+    tok_ts: jnp.ndarray  # [R] f32 s
+
+
+class LimiterDecision(NamedTuple):
+    window: WindowState
+    bucket: BucketState
+    over_limit: jnp.ndarray  # [R] bool
+
+
+def fixed_window(
+    cfg: LimiterConfig,
+    st: WindowState,
+    d_pkts: jnp.ndarray,
+    d_bytes: jnp.ndarray,
+    now: jnp.ndarray,
+) -> tuple[WindowState, jnp.ndarray]:
+    """Fixed-window counting (``fsx_kern.c:243-263`` semantics, repaired).
+
+    A window is ``[win_start, win_start + window_s)``; deltas landing
+    past the edge open a fresh window *seeded with the delta* (reference
+    bug: seeded with 0)."""
+    expired = now - st.win_start >= cfg.window_s
+    pps = jnp.where(expired, d_pkts, st.win_pps + d_pkts)
+    bps = jnp.where(expired, d_bytes, st.win_bps + d_bytes)
+    start = jnp.where(expired, now, st.win_start)
+    over = (pps > cfg.pps_threshold) | (bps > cfg.bps_threshold)
+    return WindowState(start, pps, bps, st.prev_pps, st.prev_bps), over
+
+
+def sliding_window(
+    cfg: LimiterConfig,
+    st: WindowState,
+    d_pkts: jnp.ndarray,
+    d_bytes: jnp.ndarray,
+    now: jnp.ndarray,
+) -> tuple[WindowState, jnp.ndarray]:
+    """Two-bucket sliding-window estimate (the CDN-standard smoothing).
+
+    Rate ≈ ``prev_bucket × overlap + cur_bucket`` where ``overlap`` is
+    the fraction of the previous fixed window still inside the sliding
+    window ending at ``now``.  Eliminates the fixed window's 2× burst
+    at window boundaries while keeping O(1) state (the specified
+    "sliding window" limiter, ``README.md:156-158``)."""
+    elapsed = now - st.win_start
+    # how many whole windows have rolled past since win_start
+    rolled_one = (elapsed >= cfg.window_s) & (elapsed < 2 * cfg.window_s)
+    rolled_many = elapsed >= 2 * cfg.window_s
+
+    prev_pps = jnp.where(rolled_one, st.win_pps, jnp.where(rolled_many, 0.0, st.prev_pps))
+    prev_bps = jnp.where(rolled_one, st.win_bps, jnp.where(rolled_many, 0.0, st.prev_bps))
+    rolled = rolled_one | rolled_many
+    # snap the new window start to the grid so overlap stays calibrated
+    n_windows = jnp.floor(elapsed / cfg.window_s)
+    start = jnp.where(rolled, st.win_start + n_windows * cfg.window_s, st.win_start)
+    pps = jnp.where(rolled, d_pkts, st.win_pps + d_pkts)
+    bps = jnp.where(rolled, d_bytes, st.win_bps + d_bytes)
+
+    frac = jnp.clip((now - start) / cfg.window_s, 0.0, 1.0)
+    overlap = 1.0 - frac
+    est_pps = prev_pps * overlap + pps
+    est_bps = prev_bps * overlap + bps
+    over = (est_pps > cfg.pps_threshold) | (est_bps > cfg.bps_threshold)
+    return WindowState(start, pps, bps, prev_pps, prev_bps), over
+
+
+def token_bucket(
+    cfg: LimiterConfig,
+    st: BucketState,
+    d_pkts: jnp.ndarray,
+    now: jnp.ndarray,
+) -> tuple[BucketState, jnp.ndarray]:
+    """Token bucket: ``bucket_rate_pps`` tokens/s, depth ``bucket_burst``.
+
+    A fresh slot (tokens=0, tok_ts=0) refills to a full bucket on first
+    touch because ``now`` seconds have "elapsed" — new flows start with
+    full burst allowance, the conventional semantics.  Over-limit flows
+    drain to 0 and stay flagged until refill catches up (packet-count
+    based; the byte dimension is governed by the window limiters)."""
+    refill = (now - st.tok_ts) * cfg.bucket_rate_pps
+    tokens = jnp.minimum(cfg.bucket_burst, st.tokens + refill)
+    over = tokens < d_pkts
+    tokens = jnp.maximum(tokens - d_pkts, 0.0)
+    return BucketState(tokens, now), over
+
+
+def apply_limiter(
+    cfg: LimiterConfig,
+    window: WindowState,
+    bucket: BucketState,
+    d_pkts: jnp.ndarray,
+    d_bytes: jnp.ndarray,
+    now: jnp.ndarray,
+) -> LimiterDecision:
+    """Dispatch on the (static) configured limiter kind.
+
+    The branch is resolved at trace time — each config compiles to a
+    program containing only its own limiter's ops."""
+    if cfg.kind is LimiterKind.FIXED_WINDOW:
+        window, over = fixed_window(cfg, window, d_pkts, d_bytes, now)
+    elif cfg.kind is LimiterKind.SLIDING_WINDOW:
+        window, over = sliding_window(cfg, window, d_pkts, d_bytes, now)
+    elif cfg.kind is LimiterKind.TOKEN_BUCKET:
+        bucket, over = token_bucket(cfg, bucket, d_pkts, now)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown limiter kind {cfg.kind}")
+    return LimiterDecision(window, bucket, over)

@@ -1,0 +1,3 @@
+from flowsentryx_tpu.parallel import mesh, step  # noqa: F401
+from flowsentryx_tpu.parallel.mesh import make_mesh  # noqa: F401
+from flowsentryx_tpu.parallel.step import make_sharded_step, shard_table  # noqa: F401

@@ -1,0 +1,134 @@
+"""Multi-device fused step: IP-hash-sharded state + DP scoring.
+
+This is the scale-out analog of SURVEY.md §2.3's parallelism table:
+
+* **"Sequence parallelism" analog** — the per-IP state table shards by
+  IP hash across the mesh's ``ip`` axis.  A flow's owner device is
+  given by the *top* hash bits, its slot within the owner's shard by
+  the *low* bits — ownership and probing use disjoint bits, and a key's
+  owner never changes, so limiter state never migrates between devices.
+* **Data parallelism** — classifier scoring splits the packet batch
+  across the same axis; an ``all_gather`` (ICI) rebuilds the full score
+  vector.
+* **Collectives** — one ``all_gather`` for scores + one ``psum`` for
+  verdicts/writebacks per step.  Flow ownership is disjoint, so a sum
+  over devices *is* the global verdict vector (non-owners contribute
+  PASS=0).
+
+Everything runs under ``jax.shard_map`` over a
+:func:`~flowsentryx_tpu.parallel.mesh.make_mesh` mesh; the same code
+compiles for 8 virtual CPU devices (tests) or a v5e pod slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flowsentryx_tpu.core.config import FsxConfig
+from flowsentryx_tpu.core.schema import GlobalStats, IpTableState, Verdict, make_table
+from flowsentryx_tpu.ops import agg, fused, hashtable
+
+
+def shard_table(table: IpTableState, mesh: Mesh) -> IpTableState:
+    """Place a state table row-sharded over the mesh's first axis."""
+    spec = NamedSharding(mesh, P(mesh.axis_names[0]))
+    return jax.tree.map(lambda a: jax.device_put(a, spec), table)
+
+
+def make_sharded_table(cfg: FsxConfig, mesh: Mesh) -> IpTableState:
+    """Fresh empty table of ``cfg.table.capacity`` rows, row-sharded."""
+    return shard_table(make_table(cfg.table.capacity), mesh)
+
+
+def make_sharded_step(
+    cfg: FsxConfig,
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    donate: bool | None = None,
+):
+    """Build the jitted multi-device step.
+
+    Signature matches the single-device
+    :func:`~flowsentryx_tpu.ops.fused.make_jitted_step`:
+    ``step(table, stats, params, batch) -> (table, stats, out)`` — the
+    engine swaps one for the other based on mesh size.  ``table`` must
+    be sharded with :func:`shard_table`; batch/params/stats replicated.
+    """
+    if donate is None:
+        donate = fused.donation_supported()
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    k_bits = n_dev.bit_length() - 1  # n_dev = 2**k_bits (validated by make_mesh)
+    if cfg.table.capacity % n_dev:
+        raise ValueError("table capacity must divide by device count")
+    local_tbl = dataclasses.replace(cfg.table, capacity=cfg.table.capacity // n_dev)
+    local_cfg = dataclasses.replace(cfg, table=local_tbl)
+
+    def device_step(table_shard, stats, params, batch):
+        d = jax.lax.axis_index(axis)
+
+        # replicated aggregation (cheap; avoids a shuffle of raw packets)
+        fa = agg.aggregate(batch.key, batch.pkt_len, batch.ts, batch.valid)
+        now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
+
+        # --- DP scoring: each device scores B/n_dev packets, ICI gather ----
+        b = batch.feat.shape[0]
+        if b % n_dev:
+            raise ValueError(
+                f"batch size {b} must divide by the {n_dev}-device mesh "
+                "(pad the batch; decode_records already pads to a static size)"
+            )
+        local_b = b // n_dev
+        feat_local = jax.lax.dynamic_slice_in_dim(batch.feat, d * local_b, local_b)
+        score_local = classify_batch(params, feat_local)
+        score = jax.lax.all_gather(score_local, axis, tiled=True)  # [B]
+        ml_flow = fused.ml_flow_verdict(cfg, score, batch.valid, fa.inv)
+
+        # --- hash ownership: top k bits pick the device --------------------
+        h1 = hashtable.hash_u32(fa.rep_key)
+        owner = (h1 >> (32 - k_bits)).astype(jnp.int32) if k_bits else jnp.zeros_like(h1, jnp.int32)
+        mine = fa.rep_valid & (owner == d)
+
+        new_shard, dec = fused.flow_step(
+            local_cfg, table_shard, fa, mine, ml_flow, now
+        )
+
+        # --- combine disjoint per-owner decisions (PASS=0 identity) --------
+        flow_verdict = jax.lax.psum(
+            jnp.where(mine, dec.flow_verdict, 0), axis
+        )
+        newly = jax.lax.psum(
+            jnp.where(mine & dec.newly_blocked, 1, 0), axis
+        ).astype(bool)
+        block_until = jax.lax.psum(
+            jnp.where(mine & dec.newly_blocked, dec.new_blocked_until, 0.0), axis
+        )
+
+        verdict = jnp.where(batch.valid, flow_verdict[fa.inv], int(Verdict.PASS))
+        new_stats = fused.update_stats(stats, verdict, batch.valid)
+
+        out = fused.StepOutput(
+            verdict=verdict,
+            score=score,
+            block_key=jnp.where(newly, fa.rep_key, agg.INVALID_KEY),
+            block_until=block_until,
+        )
+        return new_shard, new_stats, out
+
+    table_specs = IpTableState(*([P(axis)] * len(IpTableState._fields)))
+    stats_specs = GlobalStats(*([P()] * len(GlobalStats._fields)))
+    out_specs = fused.StepOutput(*([P()] * len(fused.StepOutput._fields)))
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(table_specs, stats_specs, P(), P()),
+        out_specs=(table_specs, stats_specs, out_specs),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -65,7 +65,16 @@ class TestSchema:
 
     def test_stats(self):
         s = schema.make_stats()
-        assert int(s.dropped) == 0
+        assert s.dropped == 0
+        assert s.to_dict()["allowed"] == 0
+
+    def test_u64_counter_survives_32bit_overflow(self):
+        import jax.numpy as jnp
+
+        # start just below the u32 boundary; adding 100 must carry
+        field = jnp.array([0xFFFFFFF0, 0], jnp.uint32)
+        field = schema.u64_add(field, jnp.uint32(100))
+        assert schema.stat_value(field) == 0xFFFFFFF0 + 100
 
 
 class TestConfig:

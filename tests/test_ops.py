@@ -1,0 +1,242 @@
+"""Tests for the ops layer: limiters, batch aggregation, hash table."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flowsentryx_tpu.core.config import LimiterConfig, LimiterKind, TableConfig
+from flowsentryx_tpu.ops import agg, hashtable, limiters
+
+
+def _win(n, start=0.0, pps=0.0, bps=0.0, prev_pps=0.0, prev_bps=0.0):
+    f = lambda v: jnp.full((n,), v, jnp.float32)
+    return limiters.WindowState(f(start), f(pps), f(bps), f(prev_pps), f(prev_bps))
+
+
+def _bucket(n, tokens=0.0, ts=0.0):
+    f = lambda v: jnp.full((n,), v, jnp.float32)
+    return limiters.BucketState(f(tokens), f(ts))
+
+
+CFG = LimiterConfig(pps_threshold=100.0, bps_threshold=1e6, window_s=1.0,
+                    bucket_rate_pps=100.0, bucket_burst=200.0)
+
+
+class TestFixedWindow:
+    def test_accumulates_within_window(self):
+        st = _win(1, start=0.0, pps=50.0)
+        st, over = limiters.fixed_window(CFG, st, jnp.array([40.0]), jnp.array([0.0]),
+                                         jnp.array([0.5]))
+        assert float(st.win_pps[0]) == 90.0 and not bool(over[0])
+        st, over = limiters.fixed_window(CFG, st, jnp.array([20.0]), jnp.array([0.0]),
+                                         jnp.array([0.9]))
+        assert float(st.win_pps[0]) == 110.0 and bool(over[0])
+
+    def test_window_reset_counts_first_delta(self):
+        # reference bug fsx_kern.c:245-250: reset seeded 0; must seed delta
+        st = _win(1, start=0.0, pps=99.0)
+        st, over = limiters.fixed_window(CFG, st, jnp.array([7.0]), jnp.array([0.0]),
+                                         jnp.array([1.5]))
+        assert float(st.win_pps[0]) == 7.0
+        assert float(st.win_start[0]) == 1.5
+        assert not bool(over[0])
+
+    def test_bytes_threshold(self):
+        st = _win(1)
+        _, over = limiters.fixed_window(CFG, st, jnp.array([1.0]),
+                                        jnp.array([2e6]), jnp.array([0.1]))
+        assert bool(over[0])
+
+    def test_vectorized_independent_rows(self):
+        st = _win(3, pps=99.0)
+        d = jnp.array([0.0, 5.0, 0.0])
+        st, over = limiters.fixed_window(CFG, st, d, jnp.zeros(3), jnp.full((3,), 0.5))
+        assert list(np.asarray(over)) == [False, True, False]
+
+
+class TestSlidingWindow:
+    def test_boundary_burst_caught(self):
+        # 90 pkts at t=0.95 then 90 more at t=1.05: fixed window would see
+        # 90 and 90 (both under 100); sliding sees ~90*0.95+90 = 175 > 100.
+        st = _win(1, start=0.0)
+        st, over1 = limiters.sliding_window(CFG, st, jnp.array([90.0]),
+                                            jnp.array([0.0]), jnp.array([0.95]))
+        assert not bool(over1[0])
+        st, over2 = limiters.sliding_window(CFG, st, jnp.array([90.0]),
+                                            jnp.array([0.0]), jnp.array([1.05]))
+        assert bool(over2[0])
+        assert float(st.prev_pps[0]) == 90.0  # rolled into prev bucket
+
+    def test_long_idle_clears_history(self):
+        st = _win(1, start=0.0, pps=90.0, prev_pps=90.0)
+        st, over = limiters.sliding_window(CFG, st, jnp.array([10.0]),
+                                           jnp.array([0.0]), jnp.array([5.0]))
+        assert not bool(over[0])
+        assert float(st.prev_pps[0]) == 0.0
+
+    def test_steady_rate_under_threshold_never_flags(self):
+        st = _win(1, start=0.0)
+        flagged = False
+        for i in range(20):
+            t = jnp.array([i * 0.25])
+            st, over = limiters.sliding_window(CFG, st, jnp.array([20.0]),
+                                               jnp.array([0.0]), t)
+            flagged = flagged or bool(over[0])
+        assert not flagged  # 80 pps steady < 100 threshold
+
+
+class TestTokenBucket:
+    def test_fresh_flow_gets_full_burst(self):
+        st = _bucket(1)
+        st, over = limiters.token_bucket(CFG, st, jnp.array([150.0]), jnp.array([10.0]))
+        assert not bool(over[0])  # burst 200 covers 150
+        assert float(st.tokens[0]) == pytest.approx(50.0)
+
+    def test_drain_then_refill(self):
+        st = _bucket(1, tokens=10.0, ts=0.0)
+        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]), jnp.array([0.0]))
+        assert bool(over[0]) and float(st.tokens[0]) == 0.0
+        # 1 s later: refilled 100 tokens
+        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]), jnp.array([1.0]))
+        assert not bool(over[0]) and float(st.tokens[0]) == pytest.approx(50.0)
+
+    def test_burst_cap(self):
+        st = _bucket(1, tokens=0.0, ts=0.0)
+        st, _ = limiters.token_bucket(CFG, st, jnp.array([0.0]), jnp.array([100.0]))
+        assert float(st.tokens[0]) == 200.0  # capped at burst
+
+
+class TestApplyLimiter:
+    @pytest.mark.parametrize("kind", list(LimiterKind))
+    def test_dispatch(self, kind):
+        cfg = LimiterConfig(kind=kind, pps_threshold=10.0,
+                            bucket_rate_pps=10.0, bucket_burst=20.0)
+        dec = limiters.apply_limiter(cfg, _win(2), _bucket(2),
+                                     jnp.array([5.0, 500.0]),
+                                     jnp.array([0.0, 0.0]),
+                                     jnp.array([0.5, 0.5]))
+        assert not bool(dec.over_limit[0])
+        assert bool(dec.over_limit[1])
+
+
+class TestAggregate:
+    def test_groups_duplicates(self):
+        key = jnp.array([10, 20, 10, 10, 30, 20], jnp.uint32)
+        plen = jnp.array([100.0, 50.0, 100.0, 100.0, 25.0, 50.0])
+        ts = jnp.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+        valid = jnp.ones((6,), bool)
+        fa = agg.aggregate(key, plen, ts, valid)
+
+        got = {}
+        for i in range(6):
+            if bool(fa.rep_valid[i]):
+                got[int(fa.rep_key[i])] = (
+                    float(fa.rep_pkts[i]), float(fa.rep_bytes[i]), float(fa.rep_ts[i])
+                )
+        assert got == {10: (3.0, 300.0, 4.0), 20: (2.0, 100.0, 6.0),
+                       30: (1.0, 25.0, 5.0)}
+
+    def test_inv_broadcasts_back(self):
+        key = jnp.array([10, 20, 10, 30], jnp.uint32)
+        fa = agg.aggregate(key, jnp.ones(4), jnp.zeros(4), jnp.ones((4,), bool))
+        rep_of_packet = np.asarray(fa.rep_key)[np.asarray(fa.inv)]
+        np.testing.assert_array_equal(rep_of_packet, [10, 20, 10, 30])
+
+    def test_invalid_packets_excluded(self):
+        key = jnp.array([10, 10, 10], jnp.uint32)
+        valid = jnp.array([True, False, True])
+        fa = agg.aggregate(key, jnp.full((3,), 100.0), jnp.zeros(3), valid)
+        idx = int(np.asarray(fa.inv)[0])
+        assert float(fa.rep_pkts[idx]) == 2.0
+        assert float(fa.rep_bytes[idx]) == 200.0
+
+    def test_all_invalid(self):
+        fa = agg.aggregate(jnp.array([1, 2], jnp.uint32), jnp.ones(2),
+                           jnp.zeros(2), jnp.zeros((2,), bool))
+        assert not bool(fa.rep_valid.any())
+
+    def test_single_source_flood(self):
+        b = 2048
+        key = jnp.full((b,), 0xC0A80001, jnp.uint32)  # 192.168.0.1
+        fa = agg.aggregate(key, jnp.full((b,), 64.0),
+                           jnp.linspace(0, 0.001, b), jnp.ones((b,), bool))
+        assert int(fa.rep_valid.sum()) == 1
+        i = int(np.asarray(fa.rep_valid).argmax())
+        assert float(fa.rep_pkts[i]) == b
+
+
+class TestHashTable:
+    CFG4 = TableConfig(capacity=1 << 10, probes=4, stale_s=30.0)
+
+    def _fresh(self, cap):
+        return (jnp.zeros((cap,), jnp.uint32), jnp.zeros((cap,), jnp.float32))
+
+    def test_insert_then_find(self):
+        tk, seen = self._fresh(1 << 10)
+        keys = jnp.array([111, 222, 333, agg.INVALID_KEY], jnp.uint32)
+        valid = jnp.array([True, True, True, False])
+        a1 = hashtable.assign_slots(tk, seen, keys, valid, jnp.float32(1.0), self.CFG4)
+        assert list(np.asarray(a1.inserted)) == [True, True, True, False]
+        assert not bool(a1.found.any())
+        # caller scatters keys (as the fused step does)
+        tk = tk.at[a1.slot].set(jnp.where(a1.tracked, keys, tk[a1.slot]))
+        seen = seen.at[a1.slot].set(jnp.where(a1.tracked, 1.0, seen[a1.slot]))
+        a2 = hashtable.assign_slots(tk, seen, keys, valid, jnp.float32(2.0), self.CFG4)
+        assert list(np.asarray(a2.found)) == [True, True, True, False]
+        np.testing.assert_array_equal(np.asarray(a2.slot[:3]), np.asarray(a1.slot[:3]))
+
+    def test_no_duplicate_slots_among_tracked(self, rng):
+        # tiny table forces collisions; arbitration must keep winners unique
+        cfg = TableConfig(capacity=16, probes=2, stale_s=30.0)
+        tk, seen = self._fresh(16)
+        keys = jnp.asarray(rng.integers(1, 2**31, 64).astype(np.uint32))
+        valid = jnp.ones((64,), bool)
+        a = hashtable.assign_slots(tk, seen, keys, valid, jnp.float32(1.0), cfg)
+        slots = np.asarray(a.slot)[np.asarray(a.tracked)]
+        assert len(slots) == len(set(slots.tolist()))
+        assert len(slots) <= 16
+
+    def test_stale_reclamation(self):
+        cfg = TableConfig(capacity=2, probes=2, stale_s=5.0)
+        tk = jnp.array([0, 999], jnp.uint32)   # slot 1 occupied by key 999
+        seen = jnp.array([0.0, 1.0], jnp.float32)
+        key = jnp.array([12345], jnp.uint32)
+        # at t=3 (999 fresh): key lands in the empty slot 0 or loses
+        a_fresh = hashtable.assign_slots(tk, seen, key, jnp.array([True]),
+                                         jnp.float32(3.0), cfg)
+        # at t=20 (999 stale): key must be tracked somewhere
+        a_stale = hashtable.assign_slots(tk, seen, key, jnp.array([True]),
+                                         jnp.float32(20.0), cfg)
+        assert bool(a_stale.tracked[0])
+        assert bool(a_fresh.tracked[0])  # capacity-2, probes=2 covers both slots
+
+    def test_found_beats_stale_reclaimer(self, rng):
+        # Fill a 2-slot table with keys A,B (both stale).  Rep batch has
+        # B (a match) plus new keys that want B's slot as stale.  B must
+        # keep its slot.
+        cfg = TableConfig(capacity=2, probes=2, stale_s=1.0)
+        tk = jnp.array([777, 888], jnp.uint32)
+        seen = jnp.zeros((2,), jnp.float32)
+        keys = jnp.array([888, 555, 666], jnp.uint32)
+        a = hashtable.assign_slots(tk, seen, keys, jnp.ones((3,), bool),
+                                   jnp.float32(100.0), cfg)
+        assert bool(a.found[0]) and bool(a.tracked[0])
+        b_slot = int(a.slot[0])
+        assert int(tk[b_slot]) == 888
+        others = np.asarray(a.slot[1:])[np.asarray(a.tracked[1:])]
+        assert b_slot not in others.tolist()
+
+    def test_full_table_fails_open(self):
+        cfg = TableConfig(capacity=2, probes=2, stale_s=1e9)
+        tk = jnp.array([777, 888], jnp.uint32)  # full, never stale
+        seen = jnp.full((2,), 1e9, jnp.float32)
+        keys = jnp.array([111, 222, 333], jnp.uint32)
+        a = hashtable.assign_slots(tk, seen, keys, jnp.ones((3,), bool),
+                                   jnp.float32(2e9), cfg)
+        assert not bool(a.tracked.any())  # untracked, not mis-tracked
+
+    def test_hash_avalanche(self):
+        # sequential keys must not map to sequential slots
+        ks = jnp.arange(1, 1025, dtype=jnp.uint32)
+        hs = np.asarray(hashtable.hash_u32(ks)) & 1023
+        assert len(set(hs.tolist())) > 600  # good dispersion
