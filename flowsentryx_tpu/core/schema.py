@@ -82,6 +82,11 @@ FLAG_ICMP = 1 << 4
 #: the generated C struct ``struct fsx_flow_record`` exactly (packed,
 #: 48 bytes).  10 Mpps × 48 B = 480 MB/s over the ring — within both
 #: per-CPU ringbuf and PCIe budgets (SURVEY.md §7.4).
+#:
+#: Features are u32, not f32: eBPF has no FPU (``fsx_kern_ml.c:3-6``),
+#: so the kernel emits integer estimates (ports, bytes, µs — all
+#: integral quantities, saturated at 2^32-1) and the host batcher casts
+#: to float32 once per record in :func:`decode_records`.
 FLOW_RECORD_DTYPE = np.dtype(
     [
         ("ts_ns", "<u8"),       # bpf_ktime_get_ns() at packet arrival
@@ -89,7 +94,7 @@ FLOW_RECORD_DTYPE = np.dtype(
         ("pkt_len", "<u2"),     # wire length of this packet
         ("ip_proto", "u1"),     # IPPROTO_*
         ("flags", "u1"),        # FLAG_* bits
-        ("feat", "<f4", (NUM_FEATURES,)),  # streaming feature estimates
+        ("feat", "<u4", (NUM_FEATURES,)),  # streaming feature estimates
     ]
 )
 FLOW_RECORD_SIZE = FLOW_RECORD_DTYPE.itemsize  # 48
@@ -304,7 +309,7 @@ def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch
     if n:
         rec = buf[:n]
         key[:n] = rec["saddr"]
-        feat[:n] = rec["feat"]
+        feat[:n] = rec["feat"].astype(np.float32)  # u32 wire → f32 model input
         pkt_len[:n] = rec["pkt_len"]
         ts[:n] = (rec["ts_ns"].astype(np.int64) - np.int64(t0_ns)) * 1e-9
         valid[:n] = True

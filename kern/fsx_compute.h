@@ -1,0 +1,136 @@
+/* fsx_compute.h — pure integer compute shared by the XDP program and
+ * the userspace test harness.
+ *
+ * The three rate limiters (integer mirrors of the TPU plane's
+ * flowsentryx_tpu/ops/limiters.py — same semantics, no floats because
+ * eBPF has no FPU, fsx_kern_ml.c:3-6) plus the helpers the feature
+ * extractor needs.  Everything here is side-effect-free on maps, so the
+ * identical code compiles under clang -target bpf and host gcc
+ * (FSX_HOST_BUILD) and is unit-tested with no kernel at all
+ * (SURVEY.md §4).
+ */
+#ifndef FSX_COMPUTE_H
+#define FSX_COMPUTE_H
+
+#include "fsx_schema.h"
+
+#ifdef FSX_HOST_BUILD
+#define FSX_CINLINE static inline
+#define fsx_atomic_add(p, v) (*(p) += (v))
+#else
+#define FSX_CINLINE static __always_inline
+#define fsx_atomic_add(p, v) __sync_fetch_and_add((p), (v))
+#endif
+
+FSX_CINLINE __u32 fsx_sat_u32(__u64 x)
+{
+	return x > 0xFFFFFFFFULL ? 0xFFFFFFFF : (__u32)x;
+}
+
+/* Integer sqrt, bounded loop (verifier-safe: fixed 32 iterations). */
+FSX_CINLINE __u32 fsx_isqrt_u64(__u64 x)
+{
+	__u64 r = 0, bit = 1ULL << 62;
+
+	while (bit > x)
+		bit >>= 2;
+#ifndef FSX_HOST_BUILD
+#pragma unroll
+#endif
+	for (int i = 0; i < 32; i++) {
+		if (bit == 0)
+			break;
+		if (x >= r + bit) {
+			x -= r + bit;
+			r = (r >> 1) + bit;
+		} else {
+			r >>= 1;
+		}
+		bit >>= 2;
+	}
+	return (__u32)r;
+}
+
+/* Fixed window (fsx_kern.c:243-263 semantics; window reset seeds with
+ * THIS packet — the reference seeded 0, SURVEY.md §7.5). */
+FSX_CINLINE int fsx_limiter_fixed_window(
+	const struct fsx_config *cfg, struct fsx_ip_state *st,
+	__u64 now, __u64 bytes)
+{
+	if (now - st->win_start_ns >= cfg->window_ns) {
+		st->win_start_ns = now;
+		st->win_pps = 1;
+		st->win_bps = bytes;
+	} else {
+		fsx_atomic_add(&st->win_pps, 1);
+		fsx_atomic_add(&st->win_bps, bytes);
+	}
+	return st->win_pps > cfg->pps_threshold ||
+	       st->win_bps > cfg->bps_threshold;
+}
+
+/* Two-bucket sliding window (README.md:153-162 spec; estimate =
+ * prev * overlap + cur in 1/1024 fixed point). */
+FSX_CINLINE int fsx_limiter_sliding_window(
+	const struct fsx_config *cfg, struct fsx_ip_state *st,
+	__u64 now, __u64 bytes)
+{
+	__u64 elapsed = now - st->win_start_ns;
+
+	if (elapsed >= 2 * cfg->window_ns) {
+		st->prev_pps = 0;
+		st->prev_bps = 0;
+		st->win_start_ns = now - (now % cfg->window_ns);
+		st->win_pps = 1;
+		st->win_bps = bytes;
+	} else if (elapsed >= cfg->window_ns) {
+		st->prev_pps = st->win_pps;
+		st->prev_bps = st->win_bps;
+		st->win_start_ns += cfg->window_ns;
+		st->win_pps = 1;
+		st->win_bps = bytes;
+	} else {
+		fsx_atomic_add(&st->win_pps, 1);
+		fsx_atomic_add(&st->win_bps, bytes);
+	}
+	{
+		__u64 frac = ((now - st->win_start_ns) << 10) / cfg->window_ns;
+		__u64 overlap = frac > 1024 ? 0 : 1024 - frac;
+		__u64 est_pps = ((st->prev_pps * overlap) >> 10) + st->win_pps;
+		__u64 est_bps = ((st->prev_bps * overlap) >> 10) + st->win_bps;
+
+		return est_pps > cfg->pps_threshold ||
+		       est_bps > cfg->bps_threshold;
+	}
+}
+
+/* Token bucket in milli-tokens (no floats; README.md:153-162 spec).
+ * Refill is ns-granular — (elapsed_ns * rate) / 1e6 milli-tokens — so
+ * sub-millisecond inter-arrivals still accumulate credit (truncating
+ * to whole ms before multiplying would starve any flow arriving faster
+ * than 1 kpps).  elapsed is clamped to 1000 s before the multiply to
+ * keep it overflow-free for rates up to ~1.8e7 pps; a bucket idle
+ * longer than that is full anyway. */
+FSX_CINLINE int fsx_limiter_token_bucket(
+	const struct fsx_config *cfg, struct fsx_ip_state *st, __u64 now)
+{
+	__u64 elapsed_ns = now - st->tok_ts_ns;
+	__u64 refill_milli;
+	if (elapsed_ns > 1000000000000ULL)
+		elapsed_ns = 1000000000000ULL;
+	refill_milli = (elapsed_ns * cfg->bucket_rate_pps) / 1000000;
+	__u64 burst_milli = cfg->bucket_burst * 1000;
+	__u64 tokens = st->tokens_milli + refill_milli;
+
+	if (tokens > burst_milli)
+		tokens = burst_milli;
+	st->tok_ts_ns = now;
+	if (tokens < 1000) {
+		st->tokens_milli = tokens;
+		return 1;
+	}
+	st->tokens_milli = tokens - 1000;
+	return 0;
+}
+
+#endif /* FSX_COMPUTE_H */

@@ -1,0 +1,307 @@
+/* host_test.c — userspace harness for the kernel-plane compute.
+ *
+ * Tests the packet parsers with crafted byte buffers and the integer
+ * limiters against their specs — no root, no NIC, no kernel
+ * (SURVEY.md §4; the reference has no tests at all, TODO.md:272).
+ * Compile: gcc -DFSX_HOST_BUILD -I. host_test.c && ./a.out
+ */
+#include <stdio.h>
+#include <string.h>
+#include <stdlib.h>
+#include <math.h>
+
+#include "fsx_schema.h"
+#include "parsing.h"
+#include "fsx_compute.h"
+
+static int failures;
+
+#define CHECK(cond, name) do { \
+	if (cond) { printf("ok   %s\n", name); } \
+	else { printf("FAIL %s (line %d)\n", name, __LINE__); failures++; } \
+} while (0)
+
+/* ---- packet builders --------------------------------------------------- */
+
+static size_t build_eth(unsigned char *p, __u16 ethertype)
+{
+	memset(p, 0xAA, 12);
+	p[12] = ethertype >> 8;
+	p[13] = ethertype & 0xFF;
+	return 14;
+}
+
+static size_t build_ip4(unsigned char *p, __u32 saddr, __u8 proto,
+			__u16 total_len, int ihl_words)
+{
+	memset(p, 0, (size_t)ihl_words * 4);
+	p[0] = 0x40 | ihl_words;      /* version 4, IHL */
+	p[2] = total_len >> 8;
+	p[3] = total_len & 0xFF;
+	p[8] = 64;                    /* TTL */
+	p[9] = proto;
+	memcpy(p + 12, &saddr, 4);    /* network order not needed for test */
+	p[16] = 10; p[17] = 0; p[18] = 0; p[19] = 1;
+	return (size_t)ihl_words * 4;
+}
+
+static size_t build_udp(unsigned char *p, __u16 sport, __u16 dport)
+{
+	p[0] = sport >> 8; p[1] = sport & 0xFF;
+	p[2] = dport >> 8; p[3] = dport & 0xFF;
+	p[4] = 0; p[5] = 8; p[6] = 0; p[7] = 0;
+	return 8;
+}
+
+static size_t build_tcp(unsigned char *p, __u16 sport, __u16 dport, __u8 flags)
+{
+	memset(p, 0, 20);
+	p[0] = sport >> 8; p[1] = sport & 0xFF;
+	p[2] = dport >> 8; p[3] = dport & 0xFF;
+	p[12] = 5 << 4;               /* data offset 5 words */
+	p[13] = flags;
+	return 20;
+}
+
+/* ---- parser tests ------------------------------------------------------ */
+
+static void test_parse_udp4(void)
+{
+	unsigned char buf[128];
+	size_t off = build_eth(buf, 0x0800);
+	__u32 src = 0x01020304;
+	off += build_ip4(buf + off, src, 17 /*UDP*/, 28, 5);
+	off += build_udp(buf + off, 1234, 53);
+	struct fsx_pkt pkt;
+
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0, "udp4 parses");
+	CHECK(pkt.saddr == src, "udp4 saddr");
+	CHECK(pkt.l4_proto == 17, "udp4 proto");
+	CHECK(fsx_htons(pkt.dport) == 53, "udp4 dport");
+	CHECK(!pkt.is_ipv6, "udp4 not v6");
+}
+
+static void test_parse_tcp_syn(void)
+{
+	unsigned char buf[128];
+	size_t off = build_eth(buf, 0x0800);
+	off += build_ip4(buf + off, 0x05060708, 6 /*TCP*/, 40, 5);
+	off += build_tcp(buf + off, 40000, 443, FSX_TCP_SYN);
+	struct fsx_pkt pkt;
+
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0, "tcp parses");
+	CHECK(pkt.tcp_flags & FSX_TCP_SYN, "tcp SYN flag seen");
+	CHECK(fsx_htons(pkt.dport) == 443, "tcp dport");
+}
+
+static void test_parse_ip4_options(void)
+{
+	/* IHL=8 words (options): parser must honor variable header length */
+	unsigned char buf[128];
+	size_t off = build_eth(buf, 0x0800);
+	off += build_ip4(buf + off, 0x0A0B0C0D, 17, 40, 8);
+	off += build_udp(buf + off, 9, 99);
+	struct fsx_pkt pkt;
+
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0, "ip4+options parses");
+	CHECK(fsx_htons(pkt.dport) == 99, "options: dport after IHL skip");
+}
+
+static void test_truncated_drops(void)
+{
+	unsigned char buf[128];
+	struct fsx_pkt pkt;
+	size_t eth = build_eth(buf, 0x0800);
+	size_t full = eth + build_ip4(buf + eth, 1, 17, 28, 5);
+
+	CHECK(fsx_parse_packet(buf, buf + 10, &pkt) < 0, "truncated eth -> drop");
+	CHECK(fsx_parse_packet(buf, buf + eth + 10, &pkt) < 0,
+	      "truncated ip4 -> drop");
+	/* IP ok but UDP header missing: must refuse, not read OOB */
+	CHECK(fsx_parse_packet(buf, buf + full + 4, &pkt) < 0,
+	      "truncated udp -> drop");
+	/* bogus IHL < 5 must be rejected */
+	buf[eth] = 0x42;
+	CHECK(fsx_parse_packet(buf, buf + full, &pkt) < 0, "ihl<5 -> drop");
+}
+
+static void test_non_ip_passes(void)
+{
+	unsigned char buf[64];
+	size_t off = build_eth(buf, 0x0806 /* ARP */);
+	struct fsx_pkt pkt;
+
+	CHECK(fsx_parse_packet(buf, buf + off + 28, &pkt) == 1, "arp -> pass");
+}
+
+static void test_parse_ip6(void)
+{
+	unsigned char buf[128];
+	size_t off = build_eth(buf, 0x86DD);
+	unsigned char *ip6 = buf + off;
+
+	memset(ip6, 0, 40);
+	ip6[0] = 0x60;                 /* version 6 */
+	ip6[6] = 17;                   /* next header: UDP */
+	ip6[7] = 64;                   /* hop limit */
+	for (int i = 0; i < 16; i++)
+		ip6[8 + i] = i + 1;    /* src addr 0102..10 */
+	off += 40;
+	off += build_udp(buf + off, 1, 2);
+	struct fsx_pkt pkt;
+
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0, "ip6 parses");
+	CHECK(pkt.is_ipv6 == 1, "ip6 flagged");
+	/* fold = xor of 4 words of the source address */
+	__u32 w[4];
+	memcpy(w, ip6 + 8, 16);
+	CHECK(pkt.saddr == (w[0] ^ w[1] ^ w[2] ^ w[3]), "ip6 fold");
+}
+
+/* ---- limiter tests (mirror tests/test_ops.py semantics) ---------------- */
+
+static struct fsx_config mkcfg(void)
+{
+	struct fsx_config c;
+
+	memset(&c, 0, sizeof(c));
+	c.pps_threshold = 100;
+	c.bps_threshold = 1000000;
+	c.window_ns = 1000000000ULL;        /* 1 s */
+	c.block_ns = 10000000000ULL;
+	c.bucket_rate_pps = 100;
+	c.bucket_burst = 200;
+	return c;
+}
+
+static void test_fixed_window(void)
+{
+	struct fsx_config cfg = mkcfg();
+	struct fsx_ip_state st;
+	int over = 0;
+
+	memset(&st, 0, sizeof(st));
+	st.win_start_ns = 0;
+	for (int i = 0; i < 100; i++)
+		over = fsx_limiter_fixed_window(&cfg, &st, 500000000ULL, 100);
+	CHECK(!over, "fixed: 100 pkts under threshold");
+	over = fsx_limiter_fixed_window(&cfg, &st, 600000000ULL, 100);
+	CHECK(over, "fixed: 101st over");
+	/* window roll: seeds with this packet (reference bug fixed) */
+	over = fsx_limiter_fixed_window(&cfg, &st, 2000000000ULL, 100);
+	CHECK(!over && st.win_pps == 1, "fixed: roll seeds 1");
+}
+
+static void test_sliding_window(void)
+{
+	struct fsx_config cfg = mkcfg();
+	struct fsx_ip_state st;
+	int over = 0;
+
+	memset(&st, 0, sizeof(st));
+	/* 90 pkts at t=0.9s */
+	for (int i = 0; i < 90; i++)
+		over = fsx_limiter_sliding_window(&cfg, &st, 900000000ULL, 10);
+	CHECK(!over, "sliding: 90 in window1 ok");
+	/* 90 more just after the boundary: est ~ 90*0.95 + 90 > 100 */
+	for (int i = 0; i < 90 && !over; i++)
+		over = fsx_limiter_sliding_window(&cfg, &st, 1050000000ULL, 10);
+	CHECK(over, "sliding: boundary burst caught");
+	/* long idle clears history */
+	memset(&st, 0, sizeof(st));
+	st.prev_pps = 90;
+	st.win_pps = 90;
+	over = fsx_limiter_sliding_window(&cfg, &st, 5000000000ULL, 10);
+	CHECK(!over && st.prev_pps == 0, "sliding: idle clears");
+}
+
+static void test_token_bucket(void)
+{
+	struct fsx_config cfg = mkcfg();
+	struct fsx_ip_state st;
+	int over;
+
+	memset(&st, 0, sizeof(st));
+	/* fresh flow at t=10s: full burst of 200 */
+	int dropped = 0;
+	for (int i = 0; i < 250; i++) {
+		over = fsx_limiter_token_bucket(&cfg, &st, 10000000000ULL);
+		dropped += over;
+	}
+	CHECK(dropped == 50, "bucket: burst 200 then drops");
+	/* 1 s later: 100 refilled */
+	dropped = 0;
+	for (int i = 0; i < 150; i++) {
+		over = fsx_limiter_token_bucket(&cfg, &st, 11000000000ULL);
+		dropped += over;
+	}
+	CHECK(dropped == 50, "bucket: refill 100/s");
+}
+
+static void test_token_bucket_subms_refill(void)
+{
+	/* 2000 pps flow (0.5 ms gaps) against rate=10000: sub-ms refill
+	 * credit must accumulate — ms-truncated refill would starve it */
+	struct fsx_config cfg = mkcfg();
+	struct fsx_ip_state st;
+	__u64 t = 1000000000ULL;
+	int dropped = 0;
+
+	cfg.bucket_rate_pps = 10000;
+	cfg.bucket_burst = 10;
+	memset(&st, 0, sizeof(st));
+	for (int i = 0; i < 4000; i++) {
+		dropped += fsx_limiter_token_bucket(&cfg, &st, t);
+		t += 500000;       /* +0.5 ms */
+	}
+	CHECK(dropped == 0, "bucket: sub-ms refill sustains 2kpps under 10k rate");
+	/* and a huge idle gap must not overflow the refill multiply */
+	dropped = fsx_limiter_token_bucket(&cfg, &st, t + (1ULL << 62));
+	CHECK(dropped == 0 && st.tokens_milli <= 10000,
+	      "bucket: multi-year idle clamps, no overflow");
+}
+
+static void test_isqrt(void)
+{
+	int bad = 0;
+
+	for (__u64 i = 0; i < 100000; i += 7) {
+		__u64 x = i * i;
+		if (fsx_isqrt_u64(x) != i)
+			bad++;
+	}
+	CHECK(bad == 0, "isqrt exact on squares");
+	CHECK(fsx_isqrt_u64(2) == 1 && fsx_isqrt_u64(3) == 1 &&
+	      fsx_isqrt_u64(8) == 2, "isqrt floors");
+	CHECK(fsx_isqrt_u64(0xFFFFFFFFFFFFFFFFULL) == 0xFFFFFFFF,
+	      "isqrt max");
+}
+
+static void test_struct_sizes(void)
+{
+	CHECK(sizeof(struct fsx_flow_record) == 48, "flow_record 48B");
+	CHECK(sizeof(struct fsx_config) == 56, "config 56B");
+}
+
+int main(void)
+{
+	test_parse_udp4();
+	test_parse_tcp_syn();
+	test_parse_ip4_options();
+	test_truncated_drops();
+	test_non_ip_passes();
+	test_parse_ip6();
+	test_fixed_window();
+	test_sliding_window();
+	test_token_bucket();
+	test_token_bucket_subms_refill();
+	test_isqrt();
+	test_struct_sizes();
+
+	if (failures) {
+		printf("\n%d FAILURES\n", failures);
+		return 1;
+	}
+	printf("\nall kern host tests passed\n");
+	return 0;
+}

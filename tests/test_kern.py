@@ -1,0 +1,72 @@
+"""Kernel-plane tests: build + run the userspace C harness, and check
+the eBPF object builds when clang is available (SURVEY.md §4)."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+KERN = Path(__file__).resolve().parents[1] / "kern"
+
+
+def test_host_harness_passes():
+    """The C parsers + integer limiters, exercised with crafted buffers."""
+    r = subprocess.run(
+        ["make", "-C", str(KERN), "test"], capture_output=True, text=True
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all kern host tests passed" in r.stdout
+    assert "FAIL" not in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("clang") is None,
+                    reason="clang (BPF target) not in this image")
+def test_bpf_object_builds():
+    r = subprocess.run(
+        ["make", "-C", str(KERN), "bpf"], capture_output=True, text=True
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (KERN / "fsx_kern.o").exists()
+
+
+def test_integer_limiters_match_jax_semantics():
+    """The kernel's integer fixed-window limiter and the TPU plane's
+    float one must agree on over-limit decisions for integer inputs.
+    (The C side is exercised via the harness; here we cross-check the
+    JAX side against the same scenario the harness asserts.)"""
+    import jax.numpy as jnp
+
+    from flowsentryx_tpu.core.config import LimiterConfig
+    from flowsentryx_tpu.ops import limiters
+
+    cfg = LimiterConfig(pps_threshold=100.0, bps_threshold=1e6, window_s=1.0)
+    st = limiters.WindowState(*[jnp.zeros((1,)) for _ in range(5)])
+    # 100 packets at t=0.5 in one aggregated delta: not over
+    st, over = limiters.fixed_window(cfg, st, jnp.array([100.0]),
+                                     jnp.array([10000.0]), jnp.array([0.5]))
+    assert not bool(over[0])
+    # 1 more: over (same as C harness "101st over")
+    st, over = limiters.fixed_window(cfg, st, jnp.array([1.0]),
+                                     jnp.array([100.0]), jnp.array([0.6]))
+    assert bool(over[0])
+    # roll seeds with the delta (C harness "roll seeds 1")
+    st, over = limiters.fixed_window(cfg, st, jnp.array([1.0]),
+                                     jnp.array([100.0]), jnp.array([2.0]))
+    assert float(st.win_pps[0]) == 1.0 and not bool(over[0])
+
+
+def test_flow_record_feature_u32_roundtrip():
+    """u32 wire features decode to f32 with exact integer values."""
+    from flowsentryx_tpu.core import schema
+
+    buf = np.zeros(2, dtype=schema.FLOW_RECORD_DTYPE)
+    buf["feat"][0] = [53, 1400, 37, 1369, 1400, 1000000, 999, 4000000]
+    buf["feat"][1][3] = 0xFFFFFFFF  # kernel saturation value
+    b = schema.decode_records(buf, batch_size=2, t0_ns=0)
+    assert b.feat.dtype == np.float32
+    np.testing.assert_array_equal(
+        np.asarray(b.feat[0]), [53, 1400, 37, 1369, 1400, 1000000, 999, 4000000]
+    )
+    assert float(b.feat[1, 3]) == float(np.float32(0xFFFFFFFF))
