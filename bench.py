@@ -1,0 +1,229 @@
+"""Headline benchmark: Mpps classified through the fused TPU pipeline step.
+
+Measures the full user-plane hot path on whatever accelerator the session
+exposes (real TPU chip under axon; CPU elsewhere): raw flow records →
+one contiguous host→device transfer → fused step (on-device decode →
+aggregate → hash-table → limiter → int8 classifier → verdict → state
+scatter) → verdict readback.
+
+The reference publishes no throughput numbers (SURVEY.md §6); the target
+is BASELINE.json's north star: >=10 Mpps classified, <1 ms p99
+feature→verdict, on one chip.  ``vs_baseline`` is the ratio of measured
+Mpps to the 10 Mpps target.
+
+Environment honesty — the dev/CI environment reaches the TPU through the
+axon tunnel, which has three measured pathologies that real (locally
+attached) TPU runtimes do not (each auto-detected and engineered around,
+see flowsentryx_tpu/ops/fused.py:donation_supported):
+
+* every device→host readback of a computed result costs a fixed ~70 ms
+  RPC round trip regardless of payload size — reported as
+  ``sync_floor_ms`` so p99 can be read net of the floor;
+* the first such readback permanently drops the process's dispatch rate
+  ~40×, so each phase below runs in its own subprocess with readbacks
+  only at the end;
+* buffer donation wedges the client on first readback (compute keeps
+  full speed), so the donated steady-state throughput phase is a
+  compute-only epoch that reports before exiting.
+
+Usage: ``python bench.py`` prints exactly ONE JSON line on stdout;
+progress chatter goes to stderr.  (``--phase=...`` runs a single phase —
+used internally via subprocess.)
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+TARGET_MPPS = 10.0  # BASELINE.json north_star: >=10 Mpps on one v5e chip
+B = 16384  # 2048-record kernel micro-batches, coalesced 8:1 under load
+TABLE_CAP = 1 << 20  # BASELINE config 5: 1M concurrent source IPs
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_raw_batches(n_batches: int, batch: int, n_ips: int, seed: int = 0):
+    """Synthetic flood traffic, pre-packed to the device wire format
+    (BASELINE config 4/5 shape: mixed traffic, many concurrent IPs)."""
+    from flowsentryx_tpu.core import schema
+
+    rng = np.random.default_rng(seed)
+    bufs = []
+    for i in range(n_batches):
+        buf = np.zeros(batch, dtype=schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = rng.integers(1, n_ips + 1, batch).astype(np.uint32)
+        buf["pkt_len"] = rng.integers(64, 1500, batch)
+        buf["ts_ns"] = (i * batch + np.arange(batch)) * 100  # 10 Mpps spacing
+        buf["ip_proto"] = rng.choice([1, 6, 17], batch)  # ICMP/TCP/UDP mix
+        buf["feat"] = rng.integers(0, 1 << 20, (batch, schema.NUM_FEATURES))
+        bufs.append(buf)
+    return bufs
+
+
+def _setup(donate: bool):
+    import jax
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    cfg = FsxConfig(
+        table=TableConfig(capacity=TABLE_CAP), batch=BatchConfig(max_batch=B)
+    )
+    spec = get_model(cfg.model.name)
+    params = spec.init()
+    step = fused.make_jitted_raw_step(cfg, spec.classify_batch, donate=donate)
+    table = jax.device_put(schema.make_table(cfg.table.capacity))
+    stats = jax.device_put(schema.make_stats())
+    raws = [
+        schema.encode_raw(b, B, t0_ns=0)
+        for b in make_raw_batches(16, B, n_ips=1 << 20)
+    ]
+    return jax, schema, cfg, params, step, table, stats, raws
+
+
+def phase_throughput() -> dict:
+    """Donated steady-state loop; compute-only (see module docstring)."""
+    jax, schema, cfg, params, step, table, stats, raws = _setup(donate=True)
+    dev = jax.devices()[0]
+
+    t0 = time.perf_counter()
+    table, stats, out = step(table, stats, params, raws[0])
+    jax.block_until_ready(out.verdict)
+    compile_s = time.perf_counter() - t0
+    for i in range(1, 4):
+        table, stats, out = step(table, stats, params, raws[i % len(raws)])
+    jax.block_until_ready(out.verdict)
+
+    # The tunnel's effective bandwidth is noisy run-to-run (5-30 Mpps on
+    # identical code); measure in chunks and report the median chunk as
+    # the sustainable steady state, robust to transient stalls.
+    n_chunks, chunk_iters = (8, 100) if dev.platform != "cpu" else (4, 10)
+    chunk_mpps = []
+    k = 0
+    for _ in range(n_chunks):
+        t0 = time.perf_counter()
+        for _ in range(chunk_iters):
+            table, stats, out = step(table, stats, params, raws[k % len(raws)])
+            k += 1
+        jax.block_until_ready(out.verdict)
+        chunk_mpps.append(chunk_iters * B / (time.perf_counter() - t0) / 1e6)
+    return {
+        "mpps": float(np.median(chunk_mpps)),
+        "chunk_mpps": [round(m, 2) for m in chunk_mpps],
+        "iters": n_chunks * chunk_iters,
+        "compile_s": compile_s,
+        "backend": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
+def phase_latency() -> dict:
+    """Undonated per-batch round trips (feature → verdict readback) +
+    cumulative verdict stats.  Readbacks degrade the axon session, which
+    is why this runs in its own subprocess — the measured p50/p99
+    include that degradation plus the tunnel sync floor, both absent on
+    locally attached hardware."""
+    jax, schema, cfg, params, step, table, stats, raws = _setup(donate=False)
+    dev = jax.devices()[0]
+
+    table, stats, out = step(table, stats, params, raws[0])
+    jax.block_until_ready(out.verdict)
+
+    # sync floor: trivial 32-byte compute+readback round trip
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jax.device_put(jnp.zeros((8,), jnp.float32))
+    np.asarray(f(x))
+    floors = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        floors.append(time.perf_counter() - t0)
+    sync_floor_ms = float(np.median(floors) * 1e3)
+
+    lat_iters = 40 if dev.platform != "cpu" else 15
+    lats = []
+    for i in range(lat_iters):
+        t1 = time.perf_counter()
+        table, stats, out = step(table, stats, params, raws[i % len(raws)])
+        np.asarray(out.verdict)
+        np.asarray(out.block_key)
+        lats.append(time.perf_counter() - t1)
+    lats_ms = np.array(lats) * 1e3
+
+    st = schema.GlobalStats(*stats)
+    return {
+        "p50_ms": float(np.percentile(lats_ms, 50)),
+        "p99_ms": float(np.percentile(lats_ms, 99)),
+        "sync_floor_ms": sync_floor_ms,
+        "stats": st.to_dict(),
+    }
+
+
+def _run_phase(phase: str) -> dict:
+    """Run one phase in a subprocess, return its JSON result."""
+    proc = subprocess.run(
+        [sys.executable, __file__, f"--phase={phase}"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=str(__import__("pathlib").Path(__file__).parent),
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(f"phase {phase} failed:\n{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--phase="):
+        phase = sys.argv[1].split("=", 1)[1]
+        result = {"throughput": phase_throughput, "latency": phase_latency}[phase]()
+        print(json.dumps(result), flush=True)
+        return 0
+
+    tput = _run_phase("throughput")
+    log(f"throughput: {tput['mpps']:.2f} Mpps median over chunks {tput['chunk_mpps']} "
+        f"({tput['iters']} x {B} pkts, {tput['backend']}/{tput['device_kind']}, "
+        f"compile {tput['compile_s']:.1f}s)")
+    lat = _run_phase("latency")
+    log(f"latency per {B}-batch round trip: p50={lat['p50_ms']:.1f}ms "
+        f"p99={lat['p99_ms']:.1f}ms (incl. ~{lat['sync_floor_ms']:.0f}ms tunnel sync floor)")
+
+    mpps = tput["mpps"]
+    detail = {
+        "metric": "mpps_classified",
+        "value": round(mpps, 3),
+        "unit": "Mpps",
+        "vs_baseline": round(mpps / TARGET_MPPS, 3),
+        "p50_ms": round(lat["p50_ms"], 3),
+        "p99_ms": round(lat["p99_ms"], 3),
+        "sync_floor_ms": round(lat["sync_floor_ms"], 1),
+        "p99_minus_floor_ms": round(max(0.0, lat["p99_ms"] - lat["sync_floor_ms"]), 3),
+        "target_mpps": TARGET_MPPS,
+        "target_p99_ms": 1.0,
+        "chunk_mpps": tput["chunk_mpps"],
+        "batch": B,
+        "table_capacity": TABLE_CAP,
+        "backend": tput["backend"],
+        "device_kind": tput["device_kind"],
+        "stats": lat["stats"],
+        "wall_s": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps(detail), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,7 +180,8 @@ class FsxConfig:
     #: cannot drift.
     KERNEL_CONFIG_FIELDS: typing.ClassVar[tuple[tuple[str, str, str], ...]] = (
         ("limiter_kind", "u32", "FSX_LIMITER_*"),
-        ("_pad", "u32", ""),
+        ("valid", "u32", "nonzero once a config has been pushed; the"
+         " all-zero ARRAY-map default means \"no config yet\" (fail open)"),
         ("pps_threshold", "u64", "packets per window"),
         ("bps_threshold", "u64", "bytes per window"),
         ("window_ns", "u64", ""),
@@ -210,7 +211,7 @@ class FsxConfig:
         return struct.pack(
             self.KERNEL_CONFIG_FMT,
             self._KIND_CODE[lim.kind],
-            0,
+            1,  # valid: distinguishes a pushed config from the map's zero fill
             int(lim.pps_threshold),
             int(lim.bps_threshold),
             int(lim.window_s * 1e9),

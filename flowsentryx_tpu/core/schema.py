@@ -287,6 +287,74 @@ class FeatureBatch(NamedTuple):
     valid: jnp.ndarray    # [B] bool
 
 
+#: Number of 32-bit words per flow record (48 B / 4).
+RECORD_WORDS = FLOW_RECORD_SIZE // 4  # 12
+
+
+def encode_raw(buf: np.ndarray, batch_size: int, t0_ns: int) -> np.ndarray:
+    """Pack ring records into the device wire format: ``[B+1, 12]`` uint32.
+
+    Rows ``0..B-1`` are the raw 48-byte records reinterpreted as 12
+    little-endian u32 words (zero-copy view + one memcpy); row ``B`` is a
+    metadata row ``(n_valid, t0_lo, t0_hi, 0...)``.  All field extraction
+    and integer→float casts then run *on device* (:func:`decode_raw`
+    inside the jitted step) — at 10 Mpps the host's only per-packet cost
+    is the memcpy, and the batch crosses PCIe as ONE contiguous buffer.
+
+    The production engine writes ring records directly into the first
+    ``B`` rows of a preallocated ``[B+1, 12]`` array and only updates the
+    metadata row per batch, skipping even this memcpy.
+    """
+    n = min(len(buf), batch_size)
+    out = np.zeros((batch_size + 1, RECORD_WORDS), np.uint32)
+    if n:
+        out[:n] = buf[:n].view(np.uint32).reshape(n, RECORD_WORDS)
+    out[batch_size, 0] = n
+    out[batch_size, 1] = t0_ns & 0xFFFFFFFF
+    out[batch_size, 2] = (t0_ns >> 32) & 0xFFFFFFFF
+    return out
+
+
+def decode_raw(raw) -> "FeatureBatch":
+    """Device-side decode of :func:`encode_raw`'s wire format (jit-inlined).
+
+    Timestamps: ``ts_ns`` is u64 (boot-relative, ``bpf_ktime_get_ns``)
+    split across words 0 (lo) and 1 (hi).  There is no u64 on a 32-bit
+    jit backend, so the relative-seconds conversion runs in f32 as
+    ``(hi - t0_hi)·2^32·1e-9 + (lo·1e-9 - t0_lo·1e-9)``: each term is a
+    few seconds in magnitude, giving ~0.5 µs worst-case error — three
+    orders of magnitude below the 1 s limiter windows.
+    """
+    import jax.numpy as jnp
+
+    words = raw[:-1]
+    meta = raw[-1]
+    n = meta[0].astype(jnp.int32)
+    t0_lo = meta[1].astype(jnp.float32)
+    t0_hi = meta[2]
+    lo = words[:, 0]
+    hi = words[:, 1]
+    dhi = (hi - t0_hi).astype(jnp.int32).astype(jnp.float32)
+    ts = dhi * np.float32(4.294967296) + (
+        lo.astype(jnp.float32) * np.float32(1e-9) - t0_lo * np.float32(1e-9)
+    )
+    w3 = words[:, 3]
+    return FeatureBatch(
+        key=words[:, 2],
+        feat=words[:, 4:12].astype(jnp.float32),
+        pkt_len=(w3 & np.uint32(0xFFFF)).astype(jnp.float32),
+        ts=ts,
+        valid=jnp.arange(words.shape[0]) < n,
+    )
+
+
+def raw_proto_flags(raw) -> tuple:
+    """(ip_proto, flags) u32 vectors from the wire format, for consumers
+    that need the L4 breakdown (stats attribution, per-proto policy)."""
+    w3 = raw[:-1, 3]
+    return (w3 >> np.uint32(16)) & np.uint32(0xFF), w3 >> np.uint32(24)
+
+
 def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch:
     """Decode ``FLOW_RECORD_DTYPE`` entries into a padded :class:`FeatureBatch`.
 

@@ -24,10 +24,17 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 #: Sentinel sorted past every real key (source 0xFFFFFFFF =
 #: 255.255.255.255 is never a legitimate unicast source).
-INVALID_KEY = jnp.uint32(0xFFFFFFFF)
+#:
+#: A numpy scalar, NOT ``jnp.uint32``: a module-level concrete
+#: ``jax.Array`` captured by a jitted function becomes an embedded
+#: buffer-constant, and on the axon (tunneled TPU) runtime executing any
+#: program with one degrades EVERY subsequent dispatch in the process
+#: from ~20µs to ~4ms.  numpy scalars fold into the HLO as literals.
+INVALID_KEY = np.uint32(0xFFFFFFFF)
 
 
 class FlowAgg(NamedTuple):

@@ -29,11 +29,15 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from flowsentryx_tpu.core.config import TableConfig
 from flowsentryx_tpu.ops.agg import INVALID_KEY
 
-EMPTY_KEY = jnp.uint32(0)
+# numpy scalar, not jnp: a closure-captured concrete jax.Array poisons
+# the axon runtime's dispatch path for the whole process (see
+# agg.INVALID_KEY note).
+EMPTY_KEY = np.uint32(0)
 
 
 def hash_u32(k: jnp.ndarray) -> jnp.ndarray:

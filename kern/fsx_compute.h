@@ -16,7 +16,15 @@
 
 #ifdef FSX_HOST_BUILD
 #define FSX_CINLINE static inline
-#define fsx_atomic_add(p, v) (*(p) += (v))
+/* fetch-then-add, matching __sync_fetch_and_add's return contract.
+ * Statement expression + void* cast: the structs are packed but every
+ * __u64 field is naturally aligned by construction (codegen orders
+ * fields by size), so the unaligned-pointer warning is a false alarm. */
+#define fsx_atomic_add(p, v) ({					\
+	__u64 _old = *(p);					\
+	*(p) = _old + (v);					\
+	_old;							\
+})
 #else
 #define FSX_CINLINE static __always_inline
 #define fsx_atomic_add(p, v) __sync_fetch_and_add((p), (v))

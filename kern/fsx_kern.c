@@ -111,26 +111,36 @@ static __always_inline void extract_features(
 			return;
 	}
 
-	if (fs->pkt_count > 0) {
+	/* Same flow key can run on several CPUs at once (same saddr/dport,
+	 * different sport → different RSS queues), so counter updates are
+	 * atomic, mirroring fsx_compute.h's limiter counters.  The
+	 * now > last_ts_ns guard rejects the cross-CPU ordering race where
+	 * another CPU committed a NEWER last_ts first — an unguarded
+	 * subtraction would wrap to ~2^64 and poison the IAT features. */
+	if (fs->pkt_count > 0 && now > fs->last_ts_ns) {
 		__u64 iat = now - fs->last_ts_ns;
-		/* saturate before squaring: (2^32-1)^2 just fits u64; an
-		 * unclamped multi-hour gap would wrap and poison the
-		 * flow's IAT variance forever */
-		__u64 iat_us = fsx_sat_u32(iat / 1000);
+		/* clamp to 2^21 µs (~35 min) before squaring: square 2^42
+		 * leaves 2^22 worst-case additions of headroom in the u64
+		 * accumulator (centuries per flow) — saturating only the
+		 * single multiply would let the SUM wrap after two
+		 * near-maximal gaps */
+		__u64 iat_us = iat / 1000;
 
-		fs->iat_sum_ns += iat;
-		fs->iat_sq_sum_us2 += iat_us * iat_us;
+		if (iat_us > (1ULL << 21))
+			iat_us = 1ULL << 21;
+		fsx_atomic_add(&fs->iat_sum_ns, iat);
+		fsx_atomic_add(&fs->iat_sq_sum_us2, iat_us * iat_us);
 		if (iat > fs->iat_max_ns)
-			fs->iat_max_ns = iat;
+			fs->iat_max_ns = iat;  /* benign race: a lost max */
 	}
-	fs->pkt_count++;
-	fs->byte_sum += bytes;
-	fs->byte_sq_sum += bytes * bytes;
+	__u64 n_now = fsx_atomic_add(&fs->pkt_count, 1) + 1;
+	fsx_atomic_add(&fs->byte_sum, bytes);
+	fsx_atomic_add(&fs->byte_sq_sum, bytes * bytes);
 	fs->last_ts_ns = now;
 
 	/* Emit every packet while the flow is young, then every 16th:
 	 * bounds ring bandwidth at line rate without starving the model. */
-	if (fs->pkt_count > 16 && (fs->pkt_count & 15))
+	if (n_now > 16 && (n_now & 15))
 		return;
 
 	rec = bpf_ringbuf_reserve(&feature_ring, sizeof(*rec), 0);
@@ -195,9 +205,12 @@ int fsx(struct xdp_md *ctx)
 		return XDP_PASS;    /* verifier-mandated NULL checks */
 	/* ARRAY map lookups never return NULL — they return the pre-zeroed
 	 * element.  An all-zero config would make every limiter fire on the
-	 * first packet (fail CLOSED).  window_ns==0 is the "daemon hasn't
-	 * pushed a config yet" sentinel: pass everything (fail open). */
-	if (cfg->window_ns == 0)
+	 * first packet (fail CLOSED).  The explicit valid flag (set by
+	 * pack_kernel_config) is the "daemon has pushed a config" marker:
+	 * until then, pass everything (fail open).  A dedicated flag rather
+	 * than overloading window_ns, which is legitimately 0 for a
+	 * token-bucket config. */
+	if (!cfg->valid)
 		return XDP_PASS;
 
 	rc = fsx_parse_packet(data, data_end, &pkt);

@@ -111,10 +111,13 @@ class TestConfig:
     def test_pack_kernel_config(self):
         blob = DEFAULT_CONFIG.pack_kernel_config()
         assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 56
-        kind, _pad, pps, bps, win_ns, blk_ns, rate, burst = struct.unpack(
+        kind, valid, pps, bps, win_ns, blk_ns, rate, burst = struct.unpack(
             FsxConfig.KERNEL_CONFIG_FMT, blob
         )
         assert kind == 0 and pps == 1000 and bps == 125_000_000
+        # valid=1 marks "config pushed" vs the kernel ARRAY map's zero
+        # fill (which the XDP program treats as fail-open)
+        assert valid == 1
         assert win_ns == 1_000_000_000 and blk_ns == 10_000_000_000
         assert rate == 1000 and burst == 2000
 
@@ -135,3 +138,74 @@ class TestCodegen:
         # The header is a committed artifact; absence is drift, not a skip.
         assert codegen.DEFAULT_OUT.exists(), "kern/fsx_schema.h missing — run python -m flowsentryx_tpu.core.codegen"
         assert codegen.DEFAULT_OUT.read_text() == codegen.generate()
+
+
+class TestRawWireFormat:
+    """Device-side decode (encode_raw/decode_raw) vs the host decoder."""
+
+    def _random_buf(self, rng, n):
+        buf = np.zeros(n, dtype=schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = rng.integers(1, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+        buf["pkt_len"] = rng.integers(64, 1500, n)
+        # within +-10 s of the t0 used below: both decoders store f32
+        # *relative* seconds and document that t0 must be recent
+        buf["ts_ns"] = rng.integers(
+            5 * 10**12 - 10**10, 5 * 10**12 + 10**10, n, dtype=np.uint64
+        )
+        buf["ip_proto"] = rng.choice([1, 6, 17], n)
+        buf["flags"] = rng.integers(0, 32, n)
+        buf["feat"] = rng.integers(0, 1 << 30, (n, schema.NUM_FEATURES))
+        return buf
+
+    def test_raw_matches_host_decode(self, rng):
+        import jax
+
+        n, batch = 100, 128
+        t0 = 5 * 10**12
+        buf = self._random_buf(rng, n)
+        raw = schema.encode_raw(buf, batch, t0_ns=t0)
+        assert raw.shape == (batch + 1, schema.RECORD_WORDS)
+        got = jax.jit(schema.decode_raw)(raw)
+        want = schema.decode_records(buf, batch, t0_ns=t0)
+        np.testing.assert_array_equal(np.asarray(got.key), np.asarray(want.key))
+        np.testing.assert_array_equal(np.asarray(got.valid), np.asarray(want.valid))
+        np.testing.assert_array_equal(np.asarray(got.feat), np.asarray(want.feat))
+        np.testing.assert_array_equal(np.asarray(got.pkt_len), np.asarray(want.pkt_len))
+        # f32 split-word time reconstruction: within ~1us of the host path
+        np.testing.assert_allclose(
+            np.asarray(got.ts)[:n], np.asarray(want.ts)[:n], atol=2e-6
+        )
+
+    def test_raw_proto_flags(self, rng):
+        buf = self._random_buf(rng, 16)
+        raw = schema.encode_raw(buf, 16, t0_ns=0)
+        proto, flags = schema.raw_proto_flags(raw)
+        np.testing.assert_array_equal(np.asarray(proto), buf["ip_proto"])
+        np.testing.assert_array_equal(np.asarray(flags), buf["flags"])
+
+    def test_raw_step_matches_decoded_step(self, rng):
+        import jax
+
+        from flowsentryx_tpu.models import get_model
+        from flowsentryx_tpu.ops import fused
+
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 10))
+        spec = get_model(cfg.model.name)
+        params = spec.init()
+        buf = self._random_buf(rng, 200)
+        batch = 256
+
+        t1 = schema.make_table(cfg.table.capacity)
+        s1 = schema.make_stats()
+        step_raw = jax.jit(fused.make_raw_step(cfg, spec.classify_batch))
+        t1, s1, out1 = step_raw(t1, s1, params, schema.encode_raw(buf, batch, 0))
+
+        t2 = schema.make_table(cfg.table.capacity)
+        s2 = schema.make_stats()
+        step = jax.jit(fused.make_step(cfg, spec.classify_batch))
+        t2, s2, out2 = step(t2, s2, params, schema.decode_records(buf, batch, 0))
+
+        np.testing.assert_array_equal(np.asarray(out1.verdict), np.asarray(out2.verdict))
+        np.testing.assert_array_equal(np.asarray(out1.block_key), np.asarray(out2.block_key))
+        for a, b in zip(t1, t2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
