@@ -44,6 +44,11 @@ TARGET_MPPS = 10.0  # BASELINE.json north_star: >=10 Mpps on one v5e chip
 B = 16384  # 2048-record kernel micro-batches, coalesced 8:1 under load
 TABLE_CAP = 1 << 20  # BASELINE config 5: 1M concurrent source IPs
 
+if "--smoke" in sys.argv:  # CI-shape run: small and CPU-friendly
+    sys.argv.remove("--smoke")
+    B = 1024
+    TABLE_CAP = 1 << 12
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -172,8 +177,9 @@ def phase_latency() -> dict:
 
 def _run_phase(phase: str) -> dict:
     """Run one phase in a subprocess, return its JSON result."""
+    smoke = ["--smoke"] if B == 1024 else []
     proc = subprocess.run(
-        [sys.executable, __file__, f"--phase={phase}"],
+        [sys.executable, __file__, f"--phase={phase}"] + smoke,
         capture_output=True,
         text=True,
         timeout=900,

@@ -1,0 +1,140 @@
+// shm_ring.hpp — file-backed SPSC ring shared with the Python engine.
+//
+// The daemon produces flow records into the feature ring and consumes
+// blacklist updates from the verdict ring; the engine does the reverse.
+// Layout is struct fsx_shm_ring_hdr (kern/fsx_schema.h, GENERATED from
+// flowsentryx_tpu/core/schema.py) followed by `capacity` fixed-size
+// records.  Cursors are monotonic record counts; acquire/release pairs
+// order record payloads against cursor publication.
+#pragma once
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <stdexcept>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "fsx_schema.h"
+
+namespace fsx {
+
+class ShmRing {
+public:
+    // Create (producer side, truncates) or open (consumer side) a ring.
+    static ShmRing create(const std::string &path, uint64_t capacity,
+                          uint64_t record_size) {
+        if (capacity == 0 || (capacity & (capacity - 1)))
+            throw std::invalid_argument("capacity must be a power of two");
+        int fd = ::open(path.c_str(), O_RDWR | O_CREAT, 0644);
+        if (fd < 0)
+            throw std::runtime_error("open " + path + ": " + strerror(errno));
+        size_t bytes = sizeof(fsx_shm_ring_hdr) + capacity * record_size;
+        if (ftruncate(fd, (off_t)bytes) != 0) {
+            ::close(fd);
+            throw std::runtime_error("ftruncate: " + std::string(strerror(errno)));
+        }
+        ShmRing r(fd, bytes);
+        std::memset(r.base_, 0, sizeof(fsx_shm_ring_hdr));
+        r.hdr()->capacity = capacity;
+        r.hdr()->record_size = record_size;
+        std::atomic_thread_fence(std::memory_order_release);
+        r.hdr()->magic = FSX_SHM_MAGIC;  // publish last
+        return r;
+    }
+
+    static ShmRing open(const std::string &path) {
+        int fd = ::open(path.c_str(), O_RDWR);
+        if (fd < 0)
+            throw std::runtime_error("open " + path + ": " + strerror(errno));
+        struct stat st {};
+        if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(fsx_shm_ring_hdr)) {
+            ::close(fd);
+            throw std::runtime_error("ring file too small: " + path);
+        }
+        ShmRing r(fd, (size_t)st.st_size);
+        if (r.hdr()->magic != FSX_SHM_MAGIC)
+            throw std::runtime_error("bad ring magic in " + path);
+        return r;
+    }
+
+    ShmRing(ShmRing &&o) noexcept : fd_(o.fd_), bytes_(o.bytes_), base_(o.base_) {
+        o.fd_ = -1;
+        o.base_ = nullptr;
+    }
+    ShmRing(const ShmRing &) = delete;
+    ~ShmRing() {
+        if (base_)
+            munmap(base_, bytes_);
+        if (fd_ >= 0)
+            ::close(fd_);
+    }
+
+    fsx_shm_ring_hdr *hdr() const { return (fsx_shm_ring_hdr *)base_; }
+    uint64_t capacity() const { return hdr()->capacity; }
+    uint64_t record_size() const { return hdr()->record_size; }
+    char *slot(uint64_t i) const {
+        return (char *)base_ + sizeof(fsx_shm_ring_hdr) +
+               (i & (capacity() - 1)) * record_size();
+    }
+
+    // Cursor access via __atomic builtins on the mmap'd u64s (std::atomic
+    // can't legally be overlaid on plain struct fields).
+    uint64_t load_head(int order) const { return __atomic_load_n(&hdr()->head, order); }
+    uint64_t load_tail(int order) const { return __atomic_load_n(&hdr()->tail, order); }
+
+    // ---- producer ----
+    // Copy up to n records in; returns how many fit (drops the rest —
+    // the ring-full policy mirrors bpf_ringbuf_reserve failing: the
+    // consumer lags, fail open and let the kernel limiter stand alone).
+    uint64_t produce(const void *records, uint64_t n) {
+        uint64_t h = load_head(__ATOMIC_RELAXED);
+        uint64_t t = load_tail(__ATOMIC_ACQUIRE);
+        uint64_t space = capacity() - (h - t);
+        if (n > space)
+            n = space;
+        for (uint64_t i = 0; i < n; i++)
+            std::memcpy(slot(h + i),
+                        (const char *)records + i * record_size(),
+                        record_size());
+        __atomic_store_n(&hdr()->head, h + n, __ATOMIC_RELEASE);
+        return n;
+    }
+
+    // ---- consumer ----
+    uint64_t consume(void *out, uint64_t max) {
+        uint64_t t = load_tail(__ATOMIC_RELAXED);
+        uint64_t h = load_head(__ATOMIC_ACQUIRE);
+        uint64_t n = h - t;
+        if (n > max)
+            n = max;
+        for (uint64_t i = 0; i < n; i++)
+            std::memcpy((char *)out + i * record_size(), slot(t + i),
+                        record_size());
+        __atomic_store_n(&hdr()->tail, t + n, __ATOMIC_RELEASE);
+        return n;
+    }
+
+    uint64_t readable() const {
+        return load_head(__ATOMIC_ACQUIRE) - load_tail(__ATOMIC_ACQUIRE);
+    }
+
+private:
+    ShmRing(int fd, size_t bytes) : fd_(fd), bytes_(bytes) {
+        base_ = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+        if (base_ == MAP_FAILED) {
+            ::close(fd);
+            throw std::runtime_error("mmap: " + std::string(strerror(errno)));
+        }
+    }
+
+    int fd_ = -1;
+    size_t bytes_ = 0;
+    void *base_ = nullptr;
+};
+
+}  // namespace fsx

@@ -46,6 +46,91 @@ def _cmd_version(args: argparse.Namespace) -> int:
     return 0
 
 
+def _load_cfg(args: argparse.Namespace):
+    from flowsentryx_tpu.core.config import DEFAULT_CONFIG, FsxConfig
+
+    if getattr(args, "config", None):
+        return FsxConfig.from_json(Path(args.config).read_text())
+    return DEFAULT_CONFIG
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Run the serving engine over a record source.
+
+    ``--feature-ring`` consumes the daemon's shm ring (production);
+    ``--scenario`` runs an in-process synthetic scenario (no daemon)."""
+    from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
+
+    cfg = _load_cfg(args)
+    if args.feature_ring:
+        from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
+
+        source = ShmRingSource(args.feature_ring)
+        sink = (
+            ShmVerdictSink(args.verdict_ring) if args.verdict_ring else NullSink()
+        )
+    else:
+        source = TrafficSource(
+            TrafficSpec(scenario=Scenario(args.scenario), rate_pps=args.rate),
+            total=args.packets or None,
+        )
+        sink = NullSink()
+    eng = Engine(cfg, source, sink)
+    rep = eng.run(
+        max_batches=args.batches or None, max_seconds=args.seconds or None
+    )
+    print(json.dumps(rep._asdict(), indent=2))
+    return 0
+
+
+def _cmd_status(args: argparse.Namespace) -> int:
+    """Inspect the shm transport: ring cursors and backlog."""
+    import numpy as np
+
+    from flowsentryx_tpu.core import schema
+
+    out = {}
+    for name, path in (("feature_ring", args.feature_ring),
+                       ("verdict_ring", args.verdict_ring)):
+        p = Path(path)
+        if not p.exists():
+            out[name] = {"present": False}
+            continue
+        with open(p, "rb") as f:
+            import mmap
+
+            m = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+        hdr = np.frombuffer(m, np.uint64, schema.SHM_HDR_SIZE // 8, 0)
+        head = int(hdr[schema.SHM_HEAD_OFFSET // 8])
+        tail = int(hdr[schema.SHM_TAIL_OFFSET // 8])
+        out[name] = {
+            "present": True,
+            "magic_ok": int(hdr[0]) == schema.SHM_MAGIC,
+            "capacity": int(hdr[1]),
+            "record_size": int(hdr[2]),
+            "produced": head,
+            "consumed": tail,
+            "backlog": head - tail,
+        }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    """Run the headline benchmark (delegates to bench.py)."""
+    import subprocess
+    import sys as _sys
+
+    bench = Path(__file__).resolve().parents[1] / "bench.py"
+    if not bench.exists():
+        print("fsx bench requires a source checkout (bench.py not found "
+              f"at {bench})", file=sys.stderr)
+        return 1
+    cmd = [_sys.executable, str(bench)] + (["--smoke"] if args.smoke else [])
+    return subprocess.run(cmd, cwd=bench.parent).returncode
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="fsx",
@@ -65,6 +150,28 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=_cmd_version)
+
+    s = sub.add_parser("serve", help="run the serving engine")
+    s.add_argument("--config", help="JSON config file")
+    s.add_argument("--feature-ring", help="daemon shm feature ring path")
+    s.add_argument("--verdict-ring", help="daemon shm verdict ring path")
+    s.add_argument("--scenario", default="syn_benign_mix",
+                   help="synthetic scenario when no ring is given")
+    s.add_argument("--rate", type=float, default=1e6, help="synthetic pps")
+    s.add_argument("--packets", type=int, default=0, help="stop after N records")
+    s.add_argument("--batches", type=int, default=0, help="stop after N batches")
+    s.add_argument("--seconds", type=float, default=0, help="stop after S seconds")
+    s.set_defaults(fn=_cmd_serve)
+
+    st = sub.add_parser("status", help="inspect the shm transport")
+    st.add_argument("--feature-ring", default="/tmp/fsx_feature_ring")
+    st.add_argument("--verdict-ring", default="/tmp/fsx_verdict_ring")
+    st.set_defaults(fn=_cmd_status)
+
+    b = sub.add_parser("bench", help="run the headline benchmark")
+    b.add_argument("--smoke", action="store_true",
+                   help="small shapes, CPU-friendly")
+    b.set_defaults(fn=_cmd_bench)
 
     return p
 

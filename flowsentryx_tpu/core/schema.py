@@ -152,6 +152,37 @@ KERNEL_STATS_FIELDS: tuple[tuple[str, str], ...] = (
 
 
 # ---------------------------------------------------------------------------
+# Shared-memory rings (daemon <-> engine transport)
+# ---------------------------------------------------------------------------
+
+#: Magic for the mmap'd SPSC ring segments the C++ daemon and the Python
+#: engine share.  Layout (generated into C as struct fsx_shm_ring_hdr):
+#: one 128-byte header — magic/capacity/record_size, then head (producer
+#: cursor) and tail (consumer cursor) on separate cache lines — followed
+#: by ``capacity`` fixed-size records.  Single-producer single-consumer;
+#: cursors are monotonically increasing record counts (mod capacity for
+#: the slot index), which distinguishes full from empty without a spare
+#: slot.  x86-TSO plain loads/stores are sufficient on the Python side;
+#: the C++ side uses acquire/release atomics.
+SHM_MAGIC = 0x46535852494E4731  # "FSXRING1"
+SHM_HDR_SIZE = 192              # 3 cache lines: meta / head / tail
+SHM_CAPACITY_OFFSET = 8         # u64: record slots, power of two
+SHM_RECORD_SIZE_OFFSET = 16     # u64: bytes per record
+SHM_HEAD_OFFSET = 64            # u64: producer cursor (records written)
+SHM_TAIL_OFFSET = 128           # u64: consumer cursor (records read)
+
+#: One verdict-ring entry (engine -> daemon): newly blacklisted source.
+VERDICT_RECORD_DTYPE = np.dtype(
+    [
+        ("saddr", "<u4"),      # folded source address
+        ("_pad", "<u4"),
+        ("until_ns", "<u8"),   # blacklist expiry, kernel clock ns
+    ]
+)
+VERDICT_RECORD_SIZE = VERDICT_RECORD_DTYPE.itemsize  # 16
+
+
+# ---------------------------------------------------------------------------
 # Verdicts
 # ---------------------------------------------------------------------------
 
@@ -209,11 +240,17 @@ def make_table(capacity: int) -> IpTableState:
     """Fresh, empty state table with ``capacity`` slots (power of two)."""
     if capacity & (capacity - 1):
         raise ValueError(f"capacity must be a power of two, got {capacity}")
-    z = jnp.zeros((capacity,), jnp.float32)
+
+    # Distinct arrays per field (not one shared zeros array): donated
+    # steps reject the same buffer appearing in two donated arguments.
+    def z():
+        return jnp.zeros((capacity,), jnp.float32)
+
     return IpTableState(
         key=jnp.zeros((capacity,), jnp.uint32),
-        last_seen=z, win_start=z, win_pps=z, win_bps=z,
-        prev_pps=z, prev_bps=z, tokens=z, tok_ts=z, blocked_until=z,
+        last_seen=z(), win_start=z(), win_pps=z(), win_bps=z(),
+        prev_pps=z(), prev_bps=z(), tokens=z(), tok_ts=z(),
+        blocked_until=z(),
     )
 
 
@@ -268,8 +305,8 @@ def stat_value(field: jnp.ndarray) -> int:
 
 
 def make_stats() -> GlobalStats:
-    z = jnp.zeros((2,), jnp.uint32)
-    return GlobalStats(z, z, z, z, z)
+    # Distinct arrays per field — see make_table's donation note.
+    return GlobalStats(*(jnp.zeros((2,), jnp.uint32) for _ in range(5)))
 
 
 class FeatureBatch(NamedTuple):

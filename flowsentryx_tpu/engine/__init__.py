@@ -1,0 +1,33 @@
+"""Host runtime: ring drain → micro-batch → TPU step → verdict writeback.
+
+Successor of the reference's user-space control plane, which exists only
+as a broken loader stub (``src/fsx_load.py:15`` crashes on an undefined
+variable).  The engine is the Python half of the host pipeline; the C++
+daemon (``daemon/``) is the kernel-facing half.  They meet at a
+shared-memory record ring with the same layout as the BPF feature ring's
+records (``flowsentryx_tpu.core.schema.FLOW_RECORD_DTYPE``), so the
+engine is indifferent to whether records come from a real XDP plane, the
+daemon's replay mode, or an in-process traffic generator.
+
+Pipeline stages (SURVEY.md §7.2 "daemon"):
+
+    source.poll() → MicroBatcher (size/deadline) → raw [B+1,12] u32
+    → fused step on device → deferred verdict readback → VerdictSink
+
+Stage latencies are tracked per batch (:mod:`.metrics`) — the reference
+has no profiling at all (SURVEY.md §5.1).
+"""
+
+from flowsentryx_tpu.engine.batcher import MicroBatcher  # noqa: F401
+from flowsentryx_tpu.engine.engine import Engine, EngineReport  # noqa: F401
+from flowsentryx_tpu.engine.sources import (  # noqa: F401
+    ArraySource,
+    RecordSource,
+    TrafficSource,
+)
+from flowsentryx_tpu.engine.writeback import (  # noqa: F401
+    BlacklistUpdate,
+    CollectSink,
+    NullSink,
+    VerdictSink,
+)

@@ -1,0 +1,110 @@
+"""Size- and deadline-triggered micro-batcher.
+
+SURVEY.md §7.2's "micro-batcher (size- and deadline-triggered, e.g.
+2048 vectors or 200 µs)".  Records accumulate in a preallocated
+``[B+1, 12]`` uint32 wire buffer (:func:`schema.encode_raw` layout) so a
+flush is metadata-row update + hand-off — no per-flush allocation or
+repacking.  Double-buffered: the engine can have one buffer in flight on
+device while the next fills.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import BatchConfig
+
+
+class MicroBatcher:
+    """Accumulates ring records; flushes at ``max_batch`` or ``deadline_us``.
+
+    ``add()`` returns a full wire buffer when the size trigger fires,
+    else None; ``flush_due()`` says whether the deadline trigger fires;
+    ``take()`` hands off whatever is pending (padded, metadata row set).
+
+    ``n_buffers`` bounds how many sealed buffers may be outstanding at
+    once: a buffer is reused after ``n_buffers`` further seals, so the
+    engine must have reaped (or at least completed the H2D transfer of)
+    a batch within that many seals — the engine sizes this from its
+    readback depth.  ``pop_seal_time()`` yields, per sealed buffer, when
+    its FIRST record entered the batcher (the honest start of e2e
+    latency: batcher residency counts).
+    """
+
+    def __init__(self, cfg: BatchConfig, t0_ns: int = 0, n_buffers: int = 4):
+        self.cfg = cfg
+        self.t0_ns = t0_ns
+        self.n_buffers = max(2, n_buffers)
+        b = cfg.max_batch
+        self._bufs = [
+            np.zeros((b + 1, schema.RECORD_WORDS), np.uint32)
+            for _ in range(self.n_buffers)
+        ]
+        self._cur = 0
+        self.fill = 0
+        self._first_add_t: float | None = None
+        self._seal_times: list[float] = []
+        self.batches_emitted = 0
+        self.records_emitted = 0
+
+    # -- triggers -----------------------------------------------------------
+
+    def add(self, records: np.ndarray) -> list[np.ndarray]:
+        """Append records; returns the (possibly several) wire buffers
+        completed by this addition."""
+        out: list[np.ndarray] = []
+        pos = 0
+        b = self.cfg.max_batch
+        while pos < len(records):
+            if self.fill == 0:
+                self._first_add_t = time.perf_counter()
+            take = min(b - self.fill, len(records) - pos)
+            chunk = records[pos : pos + take]
+            buf = self._bufs[self._cur]
+            buf[self.fill : self.fill + take] = (
+                chunk.view(np.uint32).reshape(take, schema.RECORD_WORDS)
+            )
+            self.fill += take
+            pos += take
+            if self.fill == b:
+                out.append(self._seal())
+        return out
+
+    def flush_due(self) -> bool:
+        """Deadline trigger: something pending for longer than deadline_us."""
+        return (
+            self.fill > 0
+            and self._first_add_t is not None
+            and (time.perf_counter() - self._first_add_t) * 1e6
+            >= self.cfg.deadline_us
+        )
+
+    def take(self) -> np.ndarray | None:
+        """Flush whatever is pending (deadline path); None if empty."""
+        return self._seal() if self.fill else None
+
+    def pop_seal_time(self) -> float:
+        """First-record-arrival time of the oldest unclaimed sealed batch."""
+        return self._seal_times.pop(0)
+
+    # -- internals ----------------------------------------------------------
+
+    def _seal(self) -> np.ndarray:
+        buf = self._bufs[self._cur]
+        b = self.cfg.max_batch
+        meta = buf[b]
+        meta[0] = self.fill
+        meta[1] = self.t0_ns & 0xFFFFFFFF
+        meta[2] = (self.t0_ns >> 32) & 0xFFFFFFFF
+        # tail rows beyond fill are stale from an earlier batch; they are
+        # masked by n_valid on device, so no need to zero them.
+        self.batches_emitted += 1
+        self.records_emitted += self.fill
+        self._seal_times.append(self._first_add_t or time.perf_counter())
+        self.fill = 0
+        self._first_add_t = None
+        self._cur = (self._cur + 1) % self.n_buffers
+        return buf

@@ -1,0 +1,71 @@
+"""Per-stage latency/throughput accounting for the serving pipeline.
+
+The reference's only observability is printk in the packet path
+(SURVEY.md §5.1, which it even identifies as a perf bug).  Here every
+pipeline stage records its wall time per batch; percentiles come out in
+the engine report and feed the bench harness.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class StageTimer:
+    """Rolling record of one stage's per-batch durations (seconds)."""
+
+    def __init__(self, name: str, keep: int = 100_000):
+        self.name = name
+        self.keep = keep
+        self._samples: list[float] = []
+
+    def add(self, seconds: float) -> None:
+        if len(self._samples) < self.keep:
+            self._samples.append(seconds)
+
+    def time(self):
+        """Context manager: ``with timer.time(): ...``"""
+        return _Timing(self)
+
+    def percentiles_ms(self) -> dict[str, float]:
+        if not self._samples:
+            return {}
+        a = np.asarray(self._samples) * 1e3
+        return {
+            "p50": round(float(np.percentile(a, 50)), 4),
+            "p99": round(float(np.percentile(a, 99)), 4),
+            "max": round(float(a.max()), 4),
+            "mean": round(float(a.mean()), 4),
+            "n": len(a),
+        }
+
+
+class _Timing:
+    def __init__(self, timer: StageTimer):
+        self.timer = timer
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.add(time.perf_counter() - self.t0)
+        return False
+
+
+class PipelineMetrics:
+    """The engine's stage set."""
+
+    def __init__(self) -> None:
+        self.fill = StageTimer("fill")          # source poll + batcher copy
+        self.dispatch = StageTimer("dispatch")  # step call (async enqueue)
+        self.readback = StageTimer("readback")  # D2H verdict fetch
+        self.e2e = StageTimer("e2e")            # first record in -> sink
+
+    def to_dict(self) -> dict:
+        return {
+            t.name: t.percentiles_ms()
+            for t in (self.fill, self.dispatch, self.readback, self.e2e)
+        }

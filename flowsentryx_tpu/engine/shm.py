@@ -1,0 +1,139 @@
+"""Python side of the daemon's shared-memory rings.
+
+Mirror of ``daemon/shm_ring.hpp`` (layout generated into
+``kern/fsx_schema.h`` from :mod:`flowsentryx_tpu.core.schema`): a
+192-byte header (magic/capacity/record_size; head and tail cursors on
+their own cache lines) followed by ``capacity`` fixed-size records.
+SPSC — the daemon produces features / consumes verdicts, this process
+does the reverse.  On x86-TSO, numpy u64 loads/stores of the cursors
+are single MOVs and the memcpy-before-cursor-publish ordering matches
+the C++ side's release stores.
+"""
+
+from __future__ import annotations
+
+import mmap
+import time
+from pathlib import Path
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+
+
+class RingNotReady(Exception):
+    """The ring file exists but its creator hasn't published the header
+    magic yet (transient; wait_for retries this, and only this)."""
+
+
+class ShmRing:
+    """One mapped ring.  ``role`` is "consumer" or "producer"."""
+
+    def __init__(self, path: str | Path, expect_record: np.dtype):
+        self.path = Path(path)
+        with open(self.path, "r+b") as f:
+            self._mm = mmap.mmap(f.fileno(), 0)
+        hdr = np.frombuffer(self._mm, np.uint64, 3, 0)
+        if int(hdr[0]) != schema.SHM_MAGIC:
+            # RingNotReady, not ValueError: the creator publishes magic
+            # last, so this is the retryable mid-create window — a
+            # record-size mismatch below is a REAL error that wait_for
+            # must not retry into a misleading timeout.
+            raise RingNotReady(f"ring magic not published yet in {self.path}")
+        self.capacity = int(hdr[1])
+        self.record_size = int(hdr[2])
+        if self.record_size != expect_record.itemsize:
+            raise ValueError(
+                f"{self.path}: ring record size {self.record_size} != "
+                f"dtype {expect_record.itemsize}"
+            )
+        self.dtype = expect_record
+        self._records = np.frombuffer(
+            self._mm, expect_record, self.capacity, schema.SHM_HDR_SIZE
+        )
+        # single-element u64 views of the cursors
+        self._head = np.frombuffer(self._mm, np.uint64, 1, schema.SHM_HEAD_OFFSET)
+        self._tail = np.frombuffer(self._mm, np.uint64, 1, schema.SHM_TAIL_OFFSET)
+
+    @classmethod
+    def wait_for(
+        cls, path: str | Path, expect_record: np.dtype, timeout_s: float = 10.0
+    ) -> "ShmRing":
+        """Open a ring the daemon creates, waiting for it to appear."""
+        deadline = time.monotonic() + timeout_s
+        path = Path(path)
+        while True:
+            if path.exists() and path.stat().st_size >= schema.SHM_HDR_SIZE:
+                try:
+                    return cls(path, expect_record)
+                except RingNotReady:
+                    pass  # creator publishes magic last; retry
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"ring {path} did not appear")
+            time.sleep(0.01)
+
+    # -- consumer side ------------------------------------------------------
+
+    def consume(self, max_records: int) -> np.ndarray:
+        t = int(self._tail[0])
+        h = int(self._head[0])  # plain load; producer published with release
+        n = min(h - t, max_records)
+        if n <= 0:
+            return self._records[:0].copy()
+        idx = (t + np.arange(n)) & (self.capacity - 1)
+        out = self._records[idx]  # fancy indexing copies
+        self._tail[0] = t + n     # publish after the copy
+        return out
+
+    # -- producer side ------------------------------------------------------
+
+    def produce(self, records: np.ndarray) -> int:
+        h = int(self._head[0])
+        t = int(self._tail[0])
+        n = min(len(records), self.capacity - (h - t))
+        if n <= 0:
+            return 0
+        idx = (h + np.arange(n)) & (self.capacity - 1)
+        self._records[idx] = records[:n]
+        self._head[0] = h + n
+        return n
+
+    def readable(self) -> int:
+        return int(self._head[0]) - int(self._tail[0])
+
+
+class ShmRingSource:
+    """RecordSource over the daemon's feature ring."""
+
+    def __init__(self, path: str | Path, timeout_s: float = 10.0):
+        self.ring = ShmRing.wait_for(path, schema.FLOW_RECORD_DTYPE, timeout_s)
+
+    def poll(self, max_records: int) -> np.ndarray:
+        return self.ring.consume(max_records)
+
+    def exhausted(self) -> bool:
+        return False  # live transport; the engine stops on its own bounds
+
+
+class ShmVerdictSink:
+    """VerdictSink into the daemon's verdict ring.
+
+    Expiry translation: the engine works in f32 seconds relative to its
+    ``t0_ns``; the daemon/kernel want absolute kernel-clock ns."""
+
+    def __init__(self, path: str | Path, t0_ns: int = 0, timeout_s: float = 10.0):
+        self.ring = ShmRing.wait_for(path, schema.VERDICT_RECORD_DTYPE, timeout_s)
+        self.t0_ns = t0_ns
+        self.dropped = 0
+
+    def apply(self, update) -> None:
+        n = len(update.key)
+        if not n:
+            return
+        rec = np.zeros(n, schema.VERDICT_RECORD_DTYPE)
+        rec["saddr"] = update.key
+        rec["until_ns"] = (
+            update.until_s.astype(np.float64) * 1e9
+        ).astype(np.uint64) + np.uint64(self.t0_ns)
+        pushed = self.ring.produce(rec)
+        self.dropped += n - pushed

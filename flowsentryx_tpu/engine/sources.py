@@ -1,0 +1,70 @@
+"""Record sources: where the engine's packets come from.
+
+One protocol, three producers:
+
+* :class:`TrafficSource` — in-process synthetic scenarios (tests, bench).
+* :class:`ArraySource` — replay of a fixed record array (pcap-derived
+  datasets, golden tests).
+* :class:`~flowsentryx_tpu.engine.shm.ShmRingSource` — the production
+  path: drains the C++ daemon's shared-memory ring, which the daemon
+  fills from the kernel's BPF feature ring (kept in its own module so
+  importing the engine never requires the daemon to be built).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import numpy as np
+
+from flowsentryx_tpu.engine.traffic import TrafficGen, TrafficSpec
+
+
+class RecordSource(Protocol):
+    """A pull-based producer of ``FLOW_RECORD_DTYPE`` arrays."""
+
+    def poll(self, max_records: int) -> np.ndarray:
+        """Up to ``max_records`` new records; empty array when none are
+        ready right now.  Must not block longer than ~a batch deadline."""
+        ...
+
+    def exhausted(self) -> bool:
+        """True when no records will ever arrive again (replay done).
+        Live sources return False forever."""
+        ...
+
+
+class TrafficSource:
+    """Synthetic scenario traffic, optionally bounded to ``total`` packets."""
+
+    def __init__(self, spec: TrafficSpec, total: int | None = None):
+        self.gen = TrafficGen(spec)
+        self.remaining = total
+
+    def poll(self, max_records: int) -> np.ndarray:
+        n = max_records
+        if self.remaining is not None:
+            n = min(n, self.remaining)
+            self.remaining -= n
+        if n <= 0:
+            return np.empty(0, dtype=self.gen.next_records(0).dtype)
+        return self.gen.next_records(n)
+
+    def exhausted(self) -> bool:
+        return self.remaining is not None and self.remaining <= 0
+
+
+class ArraySource:
+    """Replays a pre-built record array once, in ``poll``-sized slices."""
+
+    def __init__(self, records: np.ndarray):
+        self.records = records
+        self.pos = 0
+
+    def poll(self, max_records: int) -> np.ndarray:
+        out = self.records[self.pos : self.pos + max_records]
+        self.pos += len(out)
+        return out
+
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.records)

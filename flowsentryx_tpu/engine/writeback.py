@@ -1,0 +1,60 @@
+"""Verdict writeback: the TPU plane's output side of the map seam.
+
+The fused step returns, per batch, the flow keys newly condemned and
+their blacklist expiries (``StepOutput.block_key`` / ``block_until``).
+A :class:`VerdictSink` carries them back toward the kernel's
+``blacklist_map`` — closing the loop the reference never built
+(``fsx_load.py:5-12`` intent).  Sinks:
+
+* :class:`NullSink` — benching the compute path alone.
+* :class:`CollectSink` — tests/offline analysis: keeps everything.
+* :class:`~flowsentryx_tpu.engine.shm.ShmVerdictSink` — production:
+  pushes updates into the daemon's verdict ring; the daemon applies
+  them to the pinned BPF map (kept with the shm transport).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Protocol
+
+import numpy as np
+
+from flowsentryx_tpu.ops.agg import INVALID_KEY
+
+
+class BlacklistUpdate(NamedTuple):
+    """One batch's newly blocked sources."""
+
+    key: np.ndarray        # [K] uint32 folded source addrs
+    until_s: np.ndarray    # [K] f32 expiry, engine-relative seconds
+
+
+def extract_updates(block_key: np.ndarray, block_until: np.ndarray) -> BlacklistUpdate:
+    """Compact a step's padded block arrays to the real updates."""
+    block_key = np.asarray(block_key)
+    mask = block_key != INVALID_KEY
+    return BlacklistUpdate(
+        key=block_key[mask], until_s=np.asarray(block_until)[mask]
+    )
+
+
+class VerdictSink(Protocol):
+    def apply(self, update: BlacklistUpdate) -> None: ...
+
+
+class NullSink:
+    def apply(self, update: BlacklistUpdate) -> None:
+        pass
+
+
+class CollectSink:
+    """Accumulates updates (last expiry wins per key, like the kernel map)."""
+
+    def __init__(self) -> None:
+        self.blocked: dict[int, float] = {}
+        self.updates = 0
+
+    def apply(self, update: BlacklistUpdate) -> None:
+        self.updates += 1
+        for k, u in zip(update.key.tolist(), update.until_s.tolist()):
+            self.blocked[k] = u
