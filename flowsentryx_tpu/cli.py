@@ -117,6 +117,48 @@ def _cmd_status(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_train(args: argparse.Namespace) -> int:
+    """Train a model and export the deployable artifact.
+
+    ``--data`` globs CICIDS2017/CICDDoS2019 CSVs (model.py:53-66 path);
+    without it, trains on the synthetic labeled set."""
+    from flowsentryx_tpu.train import data, evaluate, qat
+
+    if args.epochs < 1:
+        raise SystemExit("--epochs must be >= 1")
+    if args.data:
+        X, y = data.load_csvs(args.data)
+    else:
+        X, y = data.synthetic_dataset(args.synthetic, seed=args.seed)
+    Xtr, Xte, ytr, yte = data.train_test_split(X, y)
+
+    out: dict = {"model": args.model, "train_n": len(Xtr), "test_n": len(Xte)}
+    if args.model == "logreg_int8":
+        from flowsentryx_tpu.models import logreg
+
+        res = qat.train_logreg_qat(Xtr, ytr, epochs=args.epochs)
+        out["final_loss"] = float(res.losses[-1])
+        out["test"] = evaluate.evaluate_model(
+            logreg.classify_batch_int8_matmul, res.params, Xte, yte
+        )
+        if args.out:
+            out["artifact"] = logreg.save_params(res.params, args.out)
+    elif args.model == "mlp":
+        from flowsentryx_tpu.models import mlp
+
+        params, losses = qat.train_mlp(
+            Xtr, ytr, epochs=args.epochs, seed=args.seed
+        )
+        out["final_loss"] = float(losses[-1])
+        out["test"] = evaluate.evaluate_model(mlp.classify_batch, params, Xte, yte)
+        if args.out:
+            out["artifact"] = mlp.save_params(params, args.out)
+    else:
+        raise SystemExit(f"unknown trainable model {args.model!r}")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def _cmd_bench(args: argparse.Namespace) -> int:
     """Run the headline benchmark (delegates to bench.py)."""
     import subprocess
@@ -167,6 +209,17 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--feature-ring", default="/tmp/fsx_feature_ring")
     st.add_argument("--verdict-ring", default="/tmp/fsx_verdict_ring")
     st.set_defaults(fn=_cmd_status)
+
+    t = sub.add_parser("train", help="train a model, export the artifact")
+    t.add_argument("--model", default="logreg_int8",
+                   choices=["logreg_int8", "mlp"])
+    t.add_argument("--data", help="CSV glob (CICIDS2017/CICDDoS2019 format)")
+    t.add_argument("--synthetic", type=int, default=50_000,
+                   help="synthetic dataset size when no --data")
+    t.add_argument("--epochs", type=int, default=200)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--out", help="artifact output path (.npz)")
+    t.set_defaults(fn=_cmd_train)
 
     b = sub.add_parser("bench", help="run the headline benchmark")
     b.add_argument("--smoke", action="store_true",

@@ -40,6 +40,15 @@ class LogRegParams(NamedTuple):
     output is requantized to quint8 (``out_scale``/``out_zp``) before
     the quantized sigmoid, which emits quint8 at fixed scale 1/256,
     zero-point 0 — exactly torch's quantized sigmoid contract.
+
+    ``log1p``: when nonzero, features pass through ``log1p`` before
+    quantization.  Raw CIC features span 1e0..1e6, so a per-tensor
+    quint8 input step is ~4000 and every small-magnitude feature
+    (ports, flood IATs) quantizes to 0 — the reference's artifact has
+    exactly this pathology (in_scale 944881.875 zeroes any feature
+    below ~472k).  log-domain inputs give heavy-tailed network
+    statistics uniform relative resolution; the flag ships in the
+    artifact so serving and training can never disagree.
     """
 
     w_int8: jnp.ndarray   # [8] int8
@@ -49,6 +58,9 @@ class LogRegParams(NamedTuple):
     in_zp: jnp.ndarray     # [] int32
     out_scale: jnp.ndarray  # [] f32
     out_zp: jnp.ndarray     # [] int32
+    log1p: jnp.ndarray      # [] int32 (0/1); make_params/load_params
+    #                         default it to 0 (no field-level default:
+    #                         that would create a device array at import)
 
     @property
     def w_dequant(self) -> jnp.ndarray:
@@ -63,6 +75,7 @@ def make_params(
     in_zp: int = 0,
     out_scale: float = 1.0,
     out_zp: int = 0,
+    log1p: bool = False,
 ) -> LogRegParams:
     return LogRegParams(
         w_int8=jnp.asarray(w_int8, jnp.int8),
@@ -72,7 +85,14 @@ def make_params(
         in_zp=jnp.int32(in_zp),
         out_scale=jnp.float32(out_scale),
         out_zp=jnp.int32(out_zp),
+        log1p=jnp.int32(bool(log1p)),
     )
+
+
+def _maybe_log1p(params: "LogRegParams", x: jnp.ndarray) -> jnp.ndarray:
+    """Feature-domain transform, branch-free (log1p is a handful of VPU
+    ops; where() keeps the program static across artifacts)."""
+    return jnp.where(params.log1p > 0, jnp.log1p(x), x)
 
 
 #: The reference's converted int8 artifact (src/fsx_load.py:28-46,
@@ -120,6 +140,7 @@ def classify(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
          at scale 1/256 zp 0 (torch's fixed qparams for sigmoid),
       5. dequantize → probability in [0, 255/256].
     """
+    x = _maybe_log1p(params, x)
     q_x = _quantize_u8(x, params.in_scale, params.in_zp)
     # int32 accumulate of int8-domain values: this is the MXU-native form
     acc = jnp.sum(
@@ -136,6 +157,7 @@ def classify(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
 
 def classify_float(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
     """Float path: sigmoid(x @ w_dequant + bias), no activation quant."""
+    x = _maybe_log1p(params, x)
     return jax.nn.sigmoid(x @ params.w_dequant + params.bias)
 
 
@@ -160,6 +182,7 @@ def classify_batch_int8_matmul(params: LogRegParams, x: jnp.ndarray) -> jnp.ndar
     whole batch onto the systolic array instead of vmapping a reduction.
     Used by the fused engine step where the batch axis is large.
     """
+    x = _maybe_log1p(params, x)
     q_x = jax.vmap(_quantize_u8, in_axes=(0, None, None))(
         x, params.in_scale, params.in_zp
     )
@@ -187,7 +210,11 @@ def classify_batch_int8_matmul(params: LogRegParams, x: jnp.ndarray) -> jnp.ndar
 # ---------------------------------------------------------------------------
 
 
-ARTIFACT_SCHEMA_VERSION = 1
+#: v1: torch-parity fields only.  v2: + log1p feature-domain flag (a v1
+#: consumer would silently skip the log transform and quantize raw
+#: 1e0..1e6 features against log-domain qparams, so the version gates it).
+ARTIFACT_SCHEMA_VERSION = 2
+_READABLE_SCHEMA_VERSIONS = (1, 2)
 
 
 def _npz_path(path: str) -> str:
@@ -212,8 +239,11 @@ def save_params(params: LogRegParams, path: str) -> str:
 def load_params(path: str) -> LogRegParams:
     with np.load(_npz_path(path)) as z:
         version = int(z["schema_version"]) if "schema_version" in z else 0
-        if version != ARTIFACT_SCHEMA_VERSION:
+        if version not in _READABLE_SCHEMA_VERSIONS:
             raise ValueError(
-                f"artifact schema version {version} != {ARTIFACT_SCHEMA_VERSION}"
+                f"artifact schema version {version} not in "
+                f"{_READABLE_SCHEMA_VERSIONS}"
             )
-        return LogRegParams(**{k: jnp.asarray(z[k]) for k in LogRegParams._fields})
+        d = {k: jnp.asarray(z[k]) for k in LogRegParams._fields if k in z}
+        d.setdefault("log1p", jnp.int32(0))  # v1 artifacts predate the flag
+        return LogRegParams(**d)

@@ -49,7 +49,14 @@ def init_params(
 def logits(params: MlpParams, x: jnp.ndarray) -> jnp.ndarray:
     """``[B, 8] → [B]`` pre-sigmoid logits — the single forward pass all
     entry points share.  Plain matmuls: XLA tiles these onto the MXU; no
-    vmap needed when the math is already batched."""
+    vmap needed when the math is already batched.
+
+    Inputs pass through a symmetric log compression,
+    ``sign(x)·log1p(|x|)``: CIC flow features are heavy-tailed
+    (1e0..1e6) and raw magnitudes at bf16 destroy He-initialized
+    training.  Part of this model family's feature contract — applied
+    identically at train and serve time."""
+    x = jnp.sign(x) * jnp.log1p(jnp.abs(x))
     h = jax.nn.relu(x.astype(params.w1.dtype) @ params.w1 + params.b1)
     h = jax.nn.relu(h @ params.w2 + params.b2)
     return (h @ params.w3 + params.b3)[:, 0].astype(jnp.float32)
@@ -72,3 +79,45 @@ def loss_fn(params: MlpParams, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarr
     lg = logits(params, x)
     losses = jnp.maximum(lg, 0) - lg * labels + jnp.log1p(jnp.exp(-jnp.abs(lg)))
     return losses.mean()
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O (same .npz discipline as logreg.save_params)
+# ---------------------------------------------------------------------------
+
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+def _npz_path(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_params(params: MlpParams, path: str) -> str:
+    """Persist as .npz; bf16 has no numpy dtype, so weights are stored
+    as float32 with the original dtype name recorded for exact restore.
+    Returns the actual path written."""
+    import numpy as np
+
+    path = _npz_path(path)
+    np.savez(
+        path,
+        **{k: np.asarray(v, np.float32) for k, v in params._asdict().items()},
+        dtype=str(params.w1.dtype),
+        schema_version=ARTIFACT_SCHEMA_VERSION,
+    )
+    return path
+
+
+def load_params(path: str) -> MlpParams:
+    import numpy as np
+
+    with np.load(_npz_path(path)) as z:
+        version = int(z["schema_version"]) if "schema_version" in z else 0
+        if version != ARTIFACT_SCHEMA_VERSION:
+            raise ValueError(
+                f"mlp artifact schema version {version} != {ARTIFACT_SCHEMA_VERSION}"
+            )
+        dtype = jnp.dtype(str(z["dtype"]))
+        return MlpParams(
+            **{k: jnp.asarray(z[k], dtype) for k in MlpParams._fields}
+        )

@@ -1,0 +1,24 @@
+"""Training plane: datasets → QAT → deployable int8 artifacts.
+
+JAX/optax rebuild of the reference's ``model/model.py`` (C6/C8 in
+SURVEY.md §2.1) with its bugs fixed (§7.5): the exporter saves the
+*converted* quantized parameters (the reference script saved the
+un-converted fp32 model, so re-running it could never reproduce its own
+checked-in artifact), and the cleaning step doesn't depend on a missing
+import.
+
+Modules:
+
+* :mod:`.data` — CICIDS2017/CICDDoS2019 CSV loading + cleaning, and a
+  synthetic labeled dataset from the traffic generators (the image has
+  no dataset; the CSV path is exercised with generated fixture files).
+* :mod:`.qat` — quantization-aware training of the logistic regression
+  (fake-quant with straight-through estimators, min/max observers —
+  the JAX equivalent of torch's ``prepare_qat``/``convert``), plus a
+  float MLP trainer for the second model family.
+* :mod:`.evaluate` — accuracy / precision / recall / F1 / confusion.
+"""
+
+from flowsentryx_tpu.train import data as data  # noqa: F401
+from flowsentryx_tpu.train import evaluate as evaluate  # noqa: F401
+from flowsentryx_tpu.train import qat as qat  # noqa: F401

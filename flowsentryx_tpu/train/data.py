@@ -1,0 +1,122 @@
+"""Dataset loading and cleaning.
+
+CSV path mirrors the reference's pipeline (``model/model.py:53-117``):
+glob + concat the CICIDS2017 ``MachineLearningCVE`` CSVs, clean, relabel
+binary (BENIGN=0, every attack class=1), select the 8 features.  The
+cleaning semantics are kept (clip negatives to 0, drop NaN/inf rows,
+drop duplicate rows) minus the reference's bugs: its duplicate-column
+pass used an unimported ``combinations`` (``model.py:99``) and its
+zero-variance scan is irrelevant once we select 8 fixed columns.
+
+CICDDoS2019 ships the same flow-feature schema (both come from
+CICFlowMeter), so one loader serves both datasets.
+
+The synthetic path labels generator traffic for environments without
+the datasets (this image) and for fast tests.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+from pathlib import Path
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+
+#: CSV column → feature index.  CICFlowMeter emits these with
+#: inconsistent leading spaces; names are matched after strip().
+CSV_COLUMNS: tuple[str, ...] = (
+    "Destination Port",
+    "Packet Length Mean",
+    "Packet Length Std",
+    "Packet Length Variance",
+    "Average Packet Size",
+    "Fwd IAT Mean",
+    "Fwd IAT Std",
+    "Fwd IAT Max",
+)
+LABEL_COLUMN = "Label"
+BENIGN_LABEL = "BENIGN"
+
+
+def load_csvs(pattern: str) -> tuple[np.ndarray, np.ndarray]:
+    """Load + clean CICIDS2017/CICDDoS2019-format CSVs.
+
+    Returns ``(X [N, 8] float32, y [N] float32)`` with y∈{0,1}
+    (``model.py:109-112`` binary relabel).
+    """
+    import pandas as pd
+
+    paths = sorted(_glob.glob(pattern))
+    if not paths:
+        raise FileNotFoundError(f"no CSVs match {pattern!r}")
+    frames = [pd.read_csv(p, skipinitialspace=True) for p in paths]
+    df = pd.concat(frames, ignore_index=True)
+    df.columns = [c.strip() for c in df.columns]
+
+    missing = [c for c in (*CSV_COLUMNS, LABEL_COLUMN) if c not in df.columns]
+    if missing:
+        raise KeyError(f"dataset lacks expected columns: {missing}")
+
+    y = (df[LABEL_COLUMN].str.strip() != BENIGN_LABEL).to_numpy(np.float32)
+    X = df[list(CSV_COLUMNS)].to_numpy(np.float32)
+
+    # clean (model.py:73-106 semantics): negatives are CICFlowMeter
+    # artifacts -> clip to 0; NaN/inf rows dropped; exact duplicate
+    # (row, label) pairs dropped.
+    X = np.where(X < 0, 0, X)
+    finite = np.isfinite(X).all(axis=1)
+    X, y = X[finite], y[finite]
+    _, keep = np.unique(
+        np.concatenate([X, y[:, None]], axis=1), axis=0, return_index=True
+    )
+    keep.sort()
+    return X[keep], y[keep]
+
+
+def synthetic_dataset(
+    n: int = 50_000, attack_fraction: float = 0.5, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Labeled feature set from the traffic generators — the stand-in
+    dataset when no CIC CSVs are present (and the test fixture)."""
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficGen, TrafficSpec
+
+    gen = TrafficGen(
+        TrafficSpec(
+            scenario=Scenario.MIXED_L34_1M,
+            attack_fraction=attack_fraction,
+            seed=seed,
+        )
+    )
+    buf = gen.next_records(n)
+    X = buf["feat"].astype(np.float32)
+    y = gen.labels_for(buf).astype(np.float32)
+    return X, y
+
+
+def train_test_split(
+    X: np.ndarray, y: np.ndarray, test_fraction: float = 0.2, seed: int = 42
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """80/20 shuffled split, seed 42 — the reference's split
+    (``model.py:122``: test_size=0.2, random_state=42)."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(X))
+    n_test = int(len(X) * test_fraction)
+    test, train = order[:n_test], order[n_test:]
+    return X[train], X[test], y[train], y[test]
+
+
+def write_fixture_csv(path: str | Path, n: int = 500, seed: int = 3) -> Path:
+    """A tiny CICIDS-format CSV (leading-space column names and all) for
+    exercising the real loader without the real 2.8M-row dataset."""
+    path = Path(path)
+    X, y = synthetic_dataset(n, seed=seed)
+    cols = [" " + c if i else c for i, c in enumerate(CSV_COLUMNS)]
+    header = ",".join(cols) + ", Label"
+    rows = [header]
+    for xi, yi in zip(X, y):
+        label = "DDoS" if yi else BENIGN_LABEL
+        rows.append(",".join(f"{v:.1f}" for v in xi) + f", {label}")
+    path.write_text("\n".join(rows) + "\n")
+    return path

@@ -1,0 +1,57 @@
+"""Classifier quality metrics.
+
+The reference evaluates accuracy only (``model.py:202-217``); the north
+star's quality metric is F1 (BASELINE.json), so the full confusion set
+is first-class here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+
+def confusion(scores: np.ndarray, labels: np.ndarray, threshold: float = 0.5) -> dict:
+    """All quality numbers from scores + ground truth at a threshold."""
+    scores = np.asarray(scores)
+    labels = np.asarray(labels).astype(bool)
+    pred = scores > threshold
+    tp = int((pred & labels).sum())
+    tn = int((~pred & ~labels).sum())
+    fp = int((pred & ~labels).sum())
+    fn = int((~pred & labels).sum())
+    n = max(len(labels), 1)
+    precision = tp / (tp + fp) if tp + fp else 0.0
+    recall = tp / (tp + fn) if tp + fn else 0.0
+    f1 = (
+        2 * precision * recall / (precision + recall)
+        if precision + recall
+        else 0.0
+    )
+    return {
+        "n": len(labels),
+        "accuracy": round((tp + tn) / n, 6),
+        "precision": round(precision, 6),
+        "recall": round(recall, 6),
+        "f1": round(f1, 6),
+        "tp": tp, "tn": tn, "fp": fp, "fn": fn,
+    }
+
+
+def evaluate_model(
+    classify_batch: Callable[[Any, np.ndarray], np.ndarray],
+    params: Any,
+    X: np.ndarray,
+    y: np.ndarray,
+    threshold: float = 0.5,
+    batch: int = 65536,
+) -> dict:
+    """Batched scoring + confusion (keeps peak memory flat on big sets)."""
+    scores = np.concatenate(
+        [
+            np.asarray(classify_batch(params, X[s : s + batch]))
+            for s in range(0, len(X), batch)
+        ]
+    )
+    return confusion(scores, y, threshold)

@@ -1,0 +1,189 @@
+"""Training-plane tests: data loading, QAT, conversion, evaluation.
+
+The reference's model quality claim (83.02 % int8 accuracy on
+CICIDS2017, model.ipynb:4653) can't be reproduced without the dataset;
+what IS testable end-to-end: the pipeline learns a separable problem,
+the converted int8 artifact scores close to its own float master, the
+artifact round-trips to disk, and the CSV loader handles the real
+format's quirks (leading-space columns, negative artifacts, dup rows).
+"""
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.models import logreg
+from flowsentryx_tpu.train import data, evaluate, qat
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    X, y = data.synthetic_dataset(20_000, seed=11)
+    return data.train_test_split(X, y)
+
+
+@pytest.fixture(scope="module")
+def qat_result(dataset):
+    Xtr, Xte, ytr, yte = dataset
+    return qat.train_logreg_qat(Xtr, ytr, epochs=120)
+
+
+class TestData:
+    def test_synthetic_shapes_and_balance(self):
+        X, y = data.synthetic_dataset(5000, attack_fraction=0.5, seed=1)
+        assert X.shape == (5000, 8) and X.dtype == np.float32
+        assert 0.4 < y.mean() < 0.6
+
+    def test_split_is_deterministic_and_disjoint(self):
+        X, y = data.synthetic_dataset(1000, seed=2)
+        a = data.train_test_split(X, y)
+        b = data.train_test_split(X, y)
+        np.testing.assert_array_equal(a[0], b[0])
+        assert len(a[0]) == 800 and len(a[1]) == 200
+
+    def test_csv_loader_roundtrip(self, tmp_path):
+        p = data.write_fixture_csv(tmp_path / "day1.csv", n=300, seed=5)
+        data.write_fixture_csv(tmp_path / "day2.csv", n=200, seed=6)
+        X, y = data.load_csvs(str(tmp_path / "*.csv"))
+        assert X.shape[1] == 8
+        # dups may be dropped; most rows survive
+        assert 400 <= len(X) <= 500
+        assert set(np.unique(y)) <= {0.0, 1.0}
+        assert (X >= 0).all()
+        # single file works too
+        X1, _ = data.load_csvs(str(p))
+        assert 250 <= len(X1) <= 300
+
+    def test_csv_loader_cleans_artifacts(self, tmp_path):
+        cols = ",".join(data.CSV_COLUMNS) + ",Label"
+        rows = [
+            cols,
+            "80,-5,1,1,1,1,1,1,BENIGN",          # negative -> clipped to 0
+            "80,1,1,1,1,1,1,inf,BENIGN",         # inf -> dropped
+            "443,2,2,2,2,2,2,2,DDoS",
+            "443,2,2,2,2,2,2,2,DDoS",            # exact dup -> dropped
+        ]
+        f = tmp_path / "x.csv"
+        f.write_text("\n".join(rows))
+        X, y = data.load_csvs(str(f))
+        assert len(X) == 2
+        assert X.min() >= 0
+        assert y.sum() == 1
+
+    def test_missing_columns_raise(self, tmp_path):
+        f = tmp_path / "bad.csv"
+        f.write_text("a,b\n1,2\n")
+        with pytest.raises(KeyError):
+            data.load_csvs(str(f))
+
+
+class TestQat:
+    def test_loss_decreases(self, qat_result):
+        losses = qat_result.losses
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_learns_separable_problem(self, dataset, qat_result):
+        _, Xte, _, yte = dataset
+        m = evaluate.evaluate_model(
+            logreg.classify_batch_int8_matmul, qat_result.params, Xte, yte
+        )
+        # synthetic attack/benign stats are strongly separable; the int8
+        # model must clear the reference's real-data bar (83%) easily
+        assert m["f1"] > 0.9, m
+        assert m["accuracy"] > 0.9, m
+
+    def test_quantized_close_to_float_master(self, dataset, qat_result):
+        """Converted int8 artifact ≈ its own float master (the quant
+        error budget, not a golden value)."""
+        _, Xte, _, _ = dataset
+        st = qat_result.state
+        import jax.numpy as jnp
+
+        # master weights live in the log1p feature domain (the artifact
+        # carries the flag; the int8 path applies it internally)
+        Xlog = np.log1p(Xte)
+        p_float = np.asarray(
+            1 / (1 + np.exp(-(Xlog @ np.asarray(st.w) + float(st.b))))
+        )
+        p_int8 = np.asarray(
+            logreg.classify_batch_int8_matmul(qat_result.params, jnp.asarray(Xte))
+        )
+        # same decisions on the overwhelming majority of rows
+        agree = ((p_float > 0.5) == (p_int8 > 0.5)).mean()
+        assert agree > 0.98, agree
+
+    def test_convert_fields_sane(self, qat_result):
+        p = qat_result.params
+        assert p.w_int8.dtype == np.int8
+        assert np.abs(np.asarray(p.w_int8)).max() <= 127
+        assert float(p.in_scale) > 0 and float(p.out_scale) > 0
+        assert 0 <= int(p.in_zp) <= 255 and 0 <= int(p.out_zp) <= 255
+
+    def test_artifact_roundtrip_and_serving(self, tmp_path, qat_result):
+        """Exported artifact loads back and drives the fused engine step
+        (deploy path: train -> save -> load -> serve)."""
+        path = logreg.save_params(qat_result.params, str(tmp_path / "model"))
+        loaded = logreg.load_params(path)
+        X, _ = data.synthetic_dataset(256, seed=9)
+        import jax.numpy as jnp
+
+        a = logreg.classify_batch_int8_matmul(qat_result.params, jnp.asarray(X))
+        b = logreg.classify_batch_int8_matmul(loaded, jnp.asarray(X))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mlp_trains(self, dataset):
+        Xtr, Xte, ytr, yte = dataset
+        from flowsentryx_tpu.models import mlp
+
+        params, losses = qat.train_mlp(
+            Xtr[:5000], ytr[:5000], epochs=20, batch_size=1024
+        )
+        m = evaluate.evaluate_model(mlp.classify_batch, params, Xte, yte)
+        assert m["f1"] > 0.9, m
+
+
+class TestEvaluate:
+    def test_confusion_exact(self):
+        scores = np.array([0.9, 0.1, 0.8, 0.3])
+        labels = np.array([1, 0, 0, 1])
+        m = evaluate.confusion(scores, labels)
+        assert (m["tp"], m["tn"], m["fp"], m["fn"]) == (1, 1, 1, 1)
+        assert m["accuracy"] == 0.5
+        assert m["precision"] == 0.5 and m["recall"] == 0.5 and m["f1"] == 0.5
+
+    def test_degenerate_no_positives(self):
+        m = evaluate.confusion(np.zeros(4), np.zeros(4))
+        assert m["f1"] == 0.0 and m["accuracy"] == 1.0
+
+
+class TestMlpArtifact:
+    def test_mlp_save_load_roundtrip(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from flowsentryx_tpu.models import mlp
+
+        p = mlp.init_params(jax.random.PRNGKey(1), hidden=8)
+        path = mlp.save_params(p, str(tmp_path / "m"))
+        assert path.endswith(".npz")
+        q = mlp.load_params(path)
+        assert q.w1.dtype == p.w1.dtype == jnp.bfloat16
+        X, _ = data.synthetic_dataset(64, seed=2)
+        np.testing.assert_array_equal(
+            np.asarray(mlp.classify_batch(p, X)), np.asarray(mlp.classify_batch(q, X))
+        )
+
+    def test_v1_logreg_artifact_still_loads(self, tmp_path):
+        """Pre-log1p (v1) artifacts load with the flag defaulting to 0."""
+        g = logreg.golden_params()
+        d = {k: np.asarray(v) for k, v in g._asdict().items() if k != "log1p"}
+        path = str(tmp_path / "v1.npz")
+        np.savez(path, **d, schema_version=1)
+        loaded = logreg.load_params(path)
+        assert int(loaded.log1p) == 0
+        X, _ = data.synthetic_dataset(32, seed=4)
+        import jax.numpy as jnp
+
+        np.testing.assert_array_equal(
+            np.asarray(logreg.classify_batch(g, jnp.asarray(X))),
+            np.asarray(logreg.classify_batch(loaded, jnp.asarray(X))),
+        )
