@@ -26,7 +26,7 @@ from flowsentryx_tpu.engine.metrics import PipelineMetrics
 from flowsentryx_tpu.engine.sources import RecordSource
 from flowsentryx_tpu.engine.writeback import VerdictSink, extract_updates
 from flowsentryx_tpu.models import get_model
-from flowsentryx_tpu.ops import fused
+from flowsentryx_tpu.ops import fused, pallas_kernels
 
 
 class EngineReport(NamedTuple):
@@ -37,6 +37,7 @@ class EngineReport(NamedTuple):
     stats: dict
     stages_ms: dict
     blocked_sources: int
+    table: dict           # live-table summary (pallas single-pass scan)
 
 
 class _InFlight(NamedTuple):
@@ -86,6 +87,7 @@ class Engine:
         self.metrics = PipelineMetrics()
         self._inflight: list[_InFlight] = []
         self._blocked: set[int] = set()
+        self._device_now = 0.0  # newest stream time seen in reaped outputs
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -104,6 +106,7 @@ class Engine:
                 upd = extract_updates(inf.out.block_key, inf.out.block_until)
             self.sink.apply(upd)
             self._blocked.update(upd.key.tolist())
+            self._device_now = max(self._device_now, float(np.asarray(inf.out.now)))
             self.metrics.e2e.add(time.perf_counter() - inf.t_enqueue)
 
     # -- main loop ----------------------------------------------------------
@@ -148,6 +151,12 @@ class Engine:
         self._reap(0)
         wall = time.perf_counter() - t_start
 
+        # "now" on the device clock (t0-anchored stream seconds, not wall
+        # time) comes from the reaped step outputs — no extra reduction.
+        table_sum = pallas_kernels.table_summary(
+            self.table, now=self._device_now, stale_s=self.cfg.table.stale_s
+        )
+
         st = schema.GlobalStats(*self.stats)
         return EngineReport(
             batches=self.batcher.batches_emitted,
@@ -157,4 +166,5 @@ class Engine:
             stats=st.to_dict(),
             stages_ms=self.metrics.to_dict(),
             blocked_sources=len(self._blocked),
+            table=table_sum,
         )

@@ -67,6 +67,24 @@ register_model(
         classify_batch=lambda p, x: _logreg.classify_batch(p, x, quantized=False),
     )
 )
+def _pallas_score(params, x):
+    # Lazy import: pallas_kernels imports models.logreg; importing it at
+    # module top would cycle through this registry.
+    from flowsentryx_tpu.ops import pallas_kernels
+
+    return pallas_kernels.score_int8(params, x)
+
+
+register_model(
+    ModelSpec(
+        # Hand-written Pallas twin of logreg_int8 (bit-identical output;
+        # tests/test_pallas.py asserts equality): the whole quantize ->
+        # int8 dot -> requant -> sigmoid pipeline in one VPU pass.
+        name="logreg_int8_pallas",
+        init=lambda key=None, **kw: _logreg.golden_params(),
+        classify_batch=_pallas_score,
+    )
+)
 register_model(
     ModelSpec(
         name="mlp",

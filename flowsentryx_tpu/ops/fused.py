@@ -39,6 +39,9 @@ class StepOutput(NamedTuple):
     score: jnp.ndarray     # [B] f32 classifier probability, per packet
     block_key: jnp.ndarray  # [B] uint32 keys newly blacklisted (INVALID_KEY pad)
     block_until: jnp.ndarray  # [B] f32 absolute expiry for block_key entries
+    now: jnp.ndarray       # [] f32 newest valid timestamp in the batch —
+    #                        the device-clock reading the host side (stats,
+    #                        expiry math) uses without re-reducing anything
 
 
 class FlowDecision(NamedTuple):
@@ -232,6 +235,7 @@ def make_step(
             score=score,
             block_key=jnp.where(dec.newly_blocked, fa.rep_key, agg.INVALID_KEY),
             block_until=jnp.where(dec.newly_blocked, dec.new_blocked_until, 0.0),
+            now=now,
         )
         return new_table, new_stats, out
 

@@ -117,6 +117,7 @@ def make_sharded_step(
             score=score,
             block_key=jnp.where(newly, fa.rep_key, agg.INVALID_KEY),
             block_until=block_until,
+            now=now,
         )
         return new_shard, new_stats, out
 

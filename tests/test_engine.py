@@ -200,3 +200,21 @@ class TestEngineLoop:
         rep = Engine(cfg, src, NullSink()).run()
         assert rep.records == 300
         assert rep.batches == 2  # 256 + padded 44
+
+
+class TestPallasModelFamily:
+    def test_engine_with_pallas_scorer(self):
+        """The registered Pallas scorer drives the full serving loop
+        (interpret mode here; Mosaic on real TPU) and produces the same
+        verdicts as the XLA scorer."""
+        import dataclasses
+
+        cfg = small_cfg(batch=256, pps_threshold=1e9, bps_threshold=1e12)
+        cfg_p = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, name="logreg_int8_pallas")
+        )
+        spec = TrafficSpec(scenario=Scenario.SYN_BENIGN_MIX, seed=12)
+        rep_a = Engine(cfg, TrafficSource(spec, total=1024), CollectSink()).run()
+        rep_b = Engine(cfg_p, TrafficSource(spec, total=1024), CollectSink()).run()
+        assert rep_a.stats == rep_b.stats
+        assert rep_a.table == rep_b.table

@@ -1,0 +1,88 @@
+"""Pallas kernels vs their XLA-composed twins.
+
+These tests run the kernels in interpret mode (CPU harness).  The
+Mosaic/TPU lowering is exercised by selecting the registered
+``logreg_int8_pallas`` model (registry.py) in an engine/bench config on
+real hardware; the kernels were validated bit-exact under Mosaic at
+batch 2048/16384/131072 during development."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.models import logreg
+from flowsentryx_tpu.ops import pallas_kernels as pk
+
+
+class TestScoreInt8:
+    @pytest.mark.parametrize("b", [1, 7, 512, 1000])
+    def test_matches_xla_twin_golden(self, rng, b):
+        params = logreg.golden_params()
+        x = rng.uniform(0, 2e6, (b, schema.NUM_FEATURES)).astype(np.float32)
+        want = np.asarray(logreg.classify_batch_int8_matmul(params, jnp.asarray(x)))
+        got = np.asarray(pk.score_int8(params, jnp.asarray(x)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_xla_twin_log1p_artifact(self, rng):
+        """Trained (log-domain) artifacts score identically too."""
+        from flowsentryx_tpu.train import data, qat
+
+        X, y = data.synthetic_dataset(4000, seed=21)
+        res = qat.train_logreg_qat(X, y, epochs=60)
+        xt = rng.uniform(0, 1e6, (256, schema.NUM_FEATURES)).astype(np.float32)
+        want = np.asarray(
+            logreg.classify_batch_int8_matmul(res.params, jnp.asarray(xt))
+        )
+        got = np.asarray(pk.score_int8(res.params, jnp.asarray(xt)))
+        np.testing.assert_array_equal(got, want)
+
+    def test_output_domain(self, rng):
+        params = logreg.golden_params()
+        x = rng.uniform(0, 1e7, (64, 8)).astype(np.float32)
+        p = np.asarray(pk.score_int8(params, jnp.asarray(x)))
+        q = p * 256.0
+        np.testing.assert_array_equal(q, np.round(q))  # exact 1/256 grid
+        assert (p >= 0).all() and (p <= 255 / 256).all()
+
+
+class TestTableSummary:
+    def test_counts_match_numpy(self, rng):
+        cap = 4096
+        table = schema.make_table(cap)
+        n_fill = 600
+        keys = rng.choice(np.arange(1, 1 << 24), n_fill, replace=False)
+        slots = rng.choice(cap, n_fill, replace=False)
+        key = np.zeros(cap, np.uint32)
+        key[slots] = keys
+        seen = np.zeros(cap, np.float32)
+        seen[slots] = rng.uniform(0, 100, n_fill)
+        blocked = np.zeros(cap, np.float32)
+        blocked[slots[:200]] = rng.uniform(100, 200, 200)  # future expiry
+        table = table._replace(
+            key=jnp.asarray(key),
+            last_seen=jnp.asarray(seen),
+            blocked_until=jnp.asarray(blocked),
+        )
+        now, stale_s = 90.0, 30.0
+        s = pk.table_summary(table, now=now, stale_s=stale_s)
+        tracked = key != 0
+        assert s["tracked"] == int(tracked.sum()) == n_fill
+        assert s["blocked"] == int((tracked & (blocked > now)).sum())
+        assert s["stale"] == int((tracked & (now - seen > stale_s)).sum())
+        assert s["newest_seen_s"] == pytest.approx(seen.max(), rel=1e-6)
+
+    def test_empty_table(self):
+        table = schema.make_table(2048)
+        s = pk.table_summary(table, now=5.0)
+        assert s == {"tracked": 0, "blocked": 0, "stale": 0, "newest_seen_s": 0.0}
+
+    def test_small_table_falls_back_to_xla(self, rng):
+        """Capacities below one kernel chunk use the XLA twin."""
+        table = schema.make_table(512)  # < one 1024-element chunk
+        key = np.zeros(512, np.uint32)
+        key[:40] = rng.integers(1, 1 << 24, 40)
+        table = table._replace(key=jnp.asarray(key))
+        s = pk.table_summary(table, now=1.0)
+        assert s["tracked"] == 40 and s["blocked"] == 0
