@@ -160,9 +160,19 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
-    """Run the headline benchmark (delegates to bench.py)."""
+    """Run the headline benchmark (delegates to bench.py), or the
+    five-scenario BASELINE suite with --scenarios."""
     import subprocess
     import sys as _sys
+
+    if args.scenarios:
+        from flowsentryx_tpu import benchmarks
+
+        for result in benchmarks.run_suite(
+            scale=args.scale, names=args.only or None
+        ):
+            print(json.dumps(result), flush=True)
+        return 0
 
     bench = Path(__file__).resolve().parents[1] / "bench.py"
     if not bench.exists():
@@ -224,6 +234,12 @@ def build_parser() -> argparse.ArgumentParser:
     b = sub.add_parser("bench", help="run the headline benchmark")
     b.add_argument("--smoke", action="store_true",
                    help="small shapes, CPU-friendly")
+    b.add_argument("--scenarios", action="store_true",
+                   help="run the five BASELINE configs instead")
+    b.add_argument("--scale", type=float, default=1.0,
+                   help="packet-count multiplier for --scenarios")
+    b.add_argument("--only", action="append",
+                   help="substring filter on scenario names (repeatable)")
     b.set_defaults(fn=_cmd_bench)
 
     return p

@@ -1,0 +1,62 @@
+"""Checkpoint/resume of the device-resident serving state.
+
+The reference's only persistence is BPF map pinning under /sys/fs/bpf
+(``src/Makefile:22``, ``TODO.md:289``) — kernel state survives loader
+restarts, user state does not exist.  Here the TPU-plane state (per-IP
+limiter/blacklist table + global stats + the t0 clock anchor) round-
+trips through one ``.npz``, so a restarted engine resumes with every
+tracked flow, window counter, and blacklist expiry intact — the
+user-plane analog of map pinning.
+
+(Plain npz rather than orbax: the state is a flat dict of 11 arrays,
+~40 MB at 1M rows; zero-dependency and byte-inspectable wins here.)
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+
+CHECKPOINT_SCHEMA_VERSION = 1
+
+
+def save_state(
+    path: str | Path,
+    table: schema.IpTableState,
+    stats: schema.GlobalStats,
+    t0_ns: int,
+) -> Path:
+    """Snapshot serving state.  Arrays are fetched from device (the one
+    deliberate D2H of the engine's lifetime)."""
+    path = Path(path)
+    np.savez_compressed(
+        path,
+        **{f"table_{k}": np.asarray(v) for k, v in table._asdict().items()},
+        **{f"stats_{k}": np.asarray(v) for k, v in stats._asdict().items()},
+        t0_ns=np.uint64(t0_ns),
+        schema_version=CHECKPOINT_SCHEMA_VERSION,
+    )
+    return path
+
+
+def load_state(
+    path: str | Path,
+) -> tuple[schema.IpTableState, schema.GlobalStats, int]:
+    """Restore serving state to device.  Returns (table, stats, t0_ns)."""
+    with np.load(Path(path)) as z:
+        version = int(z["schema_version"])
+        if version != CHECKPOINT_SCHEMA_VERSION:
+            raise ValueError(
+                f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
+            )
+        table = schema.IpTableState(
+            **{k: jax.device_put(z[f"table_{k}"]) for k in schema.IpTableState._fields}
+        )
+        stats = schema.GlobalStats(
+            **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}
+        )
+        return table, stats, int(z["t0_ns"])

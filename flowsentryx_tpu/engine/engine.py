@@ -99,15 +99,56 @@ class Engine:
         self._inflight.append(_InFlight(out, t_enqueue))
 
     def _reap(self, down_to: int) -> None:
-        """Fetch + sink verdicts until only ``down_to`` batches remain queued."""
-        while len(self._inflight) > down_to:
-            inf = self._inflight.pop(0)
-            with self.metrics.readback.time():
-                upd = extract_updates(inf.out.block_key, inf.out.block_until)
-            self.sink.apply(upd)
-            self._blocked.update(upd.key.tolist())
-            self._device_now = max(self._device_now, float(np.asarray(inf.out.now)))
-            self.metrics.e2e.add(time.perf_counter() - inf.t_enqueue)
+        """Fetch + sink verdicts until only ``down_to`` batches remain
+        queued.  The whole group is reaped as ONE device concatenation +
+        one host fetch: a D2H round trip has a fixed cost (RPC floor on
+        tunneled runtimes, sync overhead everywhere), so it is paid per
+        reap group, not per batch."""
+        n = len(self._inflight) - down_to
+        if n <= 0:
+            return
+        group = [self._inflight.pop(0) for _ in range(n)]
+        import jax.numpy as jnp
+
+        with self.metrics.readback.time():
+            keys = np.asarray(
+                jnp.concatenate([g.out.block_key for g in group])
+            )
+            untils = np.asarray(
+                jnp.concatenate([g.out.block_until for g in group])
+            )
+            now = float(np.asarray(group[-1].out.now))
+        upd = extract_updates(keys, untils)
+        self.sink.apply(upd)
+        self._blocked.update(upd.key.tolist())
+        self._device_now = max(self._device_now, now)
+        t_done = time.perf_counter()
+        for g in group:
+            self.metrics.e2e.add(t_done - g.t_enqueue)
+
+    # -- checkpoint/resume (SURVEY.md §5.4: the map-pinning analog) ---------
+
+    def checkpoint(self, path) -> str:
+        """Snapshot table+stats+clock so a restarted engine resumes with
+        every tracked flow and blacklist expiry intact."""
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        return str(ckpt.save_state(path, self.table, self.stats, self.batcher.t0_ns))
+
+    def restore(self, path) -> None:
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        table, stats, t0_ns = ckpt.load_state(path)
+        if table.capacity != self.cfg.table.capacity:
+            raise ValueError(
+                f"checkpoint capacity {table.capacity} != configured "
+                f"{self.cfg.table.capacity}"
+            )
+        self.table, self.stats = table, stats
+        self.batcher.t0_ns = t0_ns
+        self._t0_auto = False
+        if hasattr(self.sink, "t0_ns"):
+            self.sink.t0_ns = t0_ns
 
     # -- main loop ----------------------------------------------------------
 
