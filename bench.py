@@ -261,7 +261,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
         np.asarray(out.block_key)
         lats.append(time.perf_counter() - t1)
         if len(lats) % 10 == 0:
-            side.emit("lat_partial", n=len(lats),
+            side.emit("lat_partial", n_lat_iters=len(lats),
                       p50_ms=round(float(np.percentile(np.array(lats) * 1e3, 50)), 2))
 
     st = schema.GlobalStats(*stats)
@@ -376,8 +376,11 @@ def _child_main(phase: str) -> int:
         log(f"phase {phase}: SIGALRM hard stop")
         os._exit(3)
 
+    # Armed BEFORE the parent's kill at deadline_rel+10 so a pure-Python
+    # overrun exits cleanly (sidecar 'alarm' record, flushed stderr)
+    # instead of taking the SIGKILL.
     signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(int(deadline_rel) + 15)
+    signal.alarm(max(1, int(deadline_rel) + 5))
 
     fn = {"throughput": phase_throughput, "latency": phase_latency}[phase]
     result = fn(side, deadline_rel)
@@ -403,7 +406,11 @@ def main() -> int:
     }
     try:
         # Throughput gets the lion's share; latency runs in what's left.
-        tput = _run_phase("throughput", min(0.70 * BUDGET_S, remaining() - 30)) or {}
+        tput_budget = max(0.0, min(0.70 * BUDGET_S, remaining() - 30))
+        if tput_budget < 30:
+            raise RuntimeError(
+                f"budget {BUDGET_S:.0f}s too small to run the throughput phase")
+        tput = _run_phase("throughput", tput_budget) or {}
         if tput and tput.get("mpps"):
             mpps = tput["mpps"]
             detail.update(
@@ -442,7 +449,8 @@ def main() -> int:
         else:
             log(f"skipping latency phase ({lat_budget:.0f}s left)")
     except Exception as e:  # noqa: BLE001 — one JSON line, always
-        detail["error"] = f"{type(e).__name__}: {e}"
+        msg = f"{type(e).__name__}: {e}"
+        detail["error"] = f"{detail['error']}; {msg}" if "error" in detail else msg
     finally:
         detail["wall_s"] = round(time.perf_counter() - T_START, 1)
         print(json.dumps(detail), flush=True)

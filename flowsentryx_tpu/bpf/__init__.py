@@ -1,0 +1,25 @@
+"""In-repo eBPF toolchain: assembler, raw-syscall loader, XDP programs.
+
+This package replaces the clang/libbpf build dependency the image lacks
+(there is no clang with a BPF target anywhere in the environment — see
+docs/BPF_BUILD.md) with a first-party toolchain:
+
+* :mod:`isa` — BPF instruction encodings (the stable kernel uapi ISA);
+* :mod:`asm` — a macro assembler (labels, map relocations, helpers);
+* :mod:`loader` — raw ``bpf(2)`` syscall loader: map create/update,
+  PROG_LOAD with the real in-kernel verifier, PROG_TEST_RUN with
+  crafted packets, and an mmap'd ringbuf consumer;
+* :mod:`progs` — the fsx XDP fast path, hand-assembled, mirroring
+  kern/fsx_kern.c instruction for instruction in semantics;
+* :mod:`elf` — emits a standard relocatable ELF object (kern/fsx_kern.o
+  successor of the reference's checked-in src/fsx_kern.o).
+
+The reference loads its program with ``bpftool prog load``
+(/root/reference/TODO.md:282-289) and a broken BCC stub
+(/root/reference/src/fsx_load.py:10-17); this package performs the same
+kernel handshake (BPF_MAP_CREATE/BPF_PROG_LOAD/BPF_PROG_TEST_RUN
+syscalls) without external tooling, so the data plane is testable
+against the real verifier inside any container that grants bpf().
+"""
+
+from flowsentryx_tpu.bpf.loader import bpf_available  # noqa: F401

@@ -1,0 +1,725 @@
+"""The fsx XDP fast path, hand-assembled to BPF bytecode.
+
+Instruction-level implementation of the same semantics as
+``kern/fsx_kern.c`` (which this image cannot compile — no clang with a
+BPF target exists here; see docs/BPF_BUILD.md): parse → blacklist gate →
+per-IP rate limit (all three limiters) → streaming feature extraction →
+ringbuf egress → per-CPU stats.  The C source remains the reference
+implementation for NIC deployments built where clang exists; this
+module produces a loadable program NOW, verified by the real in-kernel
+verifier and exercised by BPF_PROG_TEST_RUN in the test suite
+(SURVEY.md §4's no-NIC plan).
+
+Parity contracts (tested in tests/test_bpf.py):
+* parse semantics mirror kern/parsing.h:225-266 (Eth → IPv4/IPv6 →
+  TCP/UDP/ICMP, cursor bounds-checks before every dereference — the
+  discipline the reference recorded at TODO.md:264-268);
+* limiter arithmetic mirrors kern/fsx_compute.h:64-142 (integer-only,
+  window reset seeds with the current packet);
+* feature estimators mirror kern/fsx_kern.c:97-185 (mean/var/IAT in
+  integer space, IATs in microseconds, emit every packet while the flow
+  is young then every 16th);
+* struct offsets match the generated kern/fsx_schema.h (single source
+  of truth: flowsentryx_tpu.core.schema / core.config).
+
+Register allocation in the main function:
+  r6 = config ptr        r7 = now (ktime ns)
+  r8 = per-CPU stats ptr r9 = packet byte count
+Packet fields (saddr/dport/l4/tcp_flags) and derived features live in
+the stack frame; layout constants below.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from flowsentryx_tpu.bpf import loader
+from flowsentryx_tpu.bpf.asm import Asm, Program
+from flowsentryx_tpu.bpf.isa import (
+    BPF_ADD, BPF_AND, BPF_B, BPF_DIV, BPF_DW, BPF_H, BPF_JEQ, BPF_JGE,
+    BPF_JGT, BPF_JLE, BPF_JLT, BPF_JNE, BPF_LSH, BPF_MOD, BPF_MUL, BPF_OR,
+    BPF_RSH, BPF_SUB, BPF_W, BPF_XOR,
+    FN_ktime_get_ns, FN_map_delete_elem, FN_map_lookup_elem,
+    FN_map_update_elem, FN_ringbuf_reserve, FN_ringbuf_submit,
+    R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10,
+    XDP_DROP, XDP_MD_DATA, XDP_MD_DATA_END, XDP_PASS,
+    alu64, alu64_imm, atomic_add64, call, endian_be, exit_,
+    ld_imm64, ldx, mov64, mov64_imm, mov32_imm, st_imm, stx,
+)
+
+# ---- struct offsets (must match kern/fsx_schema.h; asserted by
+# tests/test_bpf.py against the generated header via gcc) ----
+
+# struct fsx_config (core.config.FsxConfig.KERNEL_CONFIG_FIELDS)
+CFG_LIMITER_KIND = 0
+CFG_VALID = 4
+CFG_PPS_THRESHOLD = 8
+CFG_BPS_THRESHOLD = 16
+CFG_WINDOW_NS = 24
+CFG_BLOCK_NS = 32
+CFG_BUCKET_RATE_PPS = 40
+CFG_BUCKET_BURST = 48
+CFG_SIZE = 56
+
+# struct fsx_ip_state
+IPS_WIN_START_NS = 0
+IPS_WIN_PPS = 8
+IPS_WIN_BPS = 16
+IPS_PREV_PPS = 24
+IPS_PREV_BPS = 32
+IPS_TOKENS_MILLI = 40
+IPS_TOK_TS_NS = 48
+IPS_SIZE = 56
+
+# struct fsx_flow_stats
+FS_PKT_COUNT = 0
+FS_BYTE_SUM = 8
+FS_BYTE_SQ_SUM = 16
+FS_FIRST_TS_NS = 24
+FS_LAST_TS_NS = 32
+FS_IAT_SUM_NS = 40
+FS_IAT_SQ_SUM_US2 = 48
+FS_IAT_MAX_NS = 56
+FS_DST_PORT = 64
+FS_SIZE = 66
+
+# struct fsx_flow_record (core.schema.FLOW_RECORD_DTYPE)
+REC_TS_NS = 0
+REC_SADDR = 8
+REC_PKT_LEN = 12
+REC_IP_PROTO = 14
+REC_FLAGS = 15
+REC_FEAT = 16
+REC_SIZE = 48
+
+# struct fsx_stats (per-CPU)
+ST_ALLOWED = 0
+ST_DROPPED_BLACKLIST = 8
+ST_DROPPED_RATE = 16
+ST_DROPPED_ML = 24
+ST_SIZE = 32
+
+# flags (core.schema.FLAG_*)
+FLAG_IPV6, FLAG_TCP_SYN, FLAG_TCP, FLAG_UDP, FLAG_ICMP = 1, 2, 4, 8, 16
+FSX_TCP_SYN = 0x02  # tcp header flags byte (kern/parsing.h:187)
+
+IPPROTO_ICMP, IPPROTO_TCP, IPPROTO_UDP = 1, 6, 17
+
+# ---- stack frame layout (r10-relative; eBPF allows [-512, 0)) ----
+S_KEY = -4          # u32: zero key, then saddr key for hash maps
+S_FKEY = -8         # u32: flow key saddr ^ (dport << 16)
+S_VAL64 = -16       # u64: blacklist-until / variance scratch
+S_IPS_ZERO = -72    # 56B: fsx_ip_state insert template    [-72, -16)
+S_FS_ZERO = -144    # 72B (>=66): fsx_flow_stats template  [-144, -72)
+S_SADDR = -152      # u64 slot: folded source address
+S_DPORT = -160      # u64 slot: dport, network byte order
+S_L4 = -168         # u64 slot: l4 protocol
+S_TCPFLAGS = -176   # u64 slot: tcp flags byte
+S_IS6 = -184        # u64 slot: ipv6 indicator (== FLAG_IPV6 when set)
+S_FEAT = -224       # 8 x u32: derived features            [-224, -192)
+S_CTX = -232        # u64 slot: ctx pointer
+S_N = -240          # u64 slot: flow pkt_count snapshot (n)
+
+
+@dataclass(frozen=True)
+class MapSizes:
+    """Deploy-scale defaults; tests shrink these (a 1M-entry LRU hash
+    preallocates ~100 MB of kernel memory per map)."""
+
+    max_track_ips: int = 1 << 20  # FSX_MAX_TRACK_IPS
+    ring_bytes: int = 1 << 22  # FSX_RING_SIZE
+
+
+MAP_SPECS = {
+    # name -> (map_type, key_size, value_size, max_entries selector)
+    "config_map": (loader.MAP_TYPE_ARRAY, 4, CFG_SIZE, "one"),
+    "blacklist_map": (loader.MAP_TYPE_LRU_HASH, 4, 8, "ips"),
+    "ip_state_map": (loader.MAP_TYPE_LRU_HASH, 4, IPS_SIZE, "ips"),
+    "flow_stats_map": (loader.MAP_TYPE_LRU_HASH, 4, FS_SIZE, "ips"),
+    "stats_map": (loader.MAP_TYPE_PERCPU_ARRAY, 4, ST_SIZE, "one"),
+    "feature_ring": (loader.MAP_TYPE_RINGBUF, 0, 0, "ring"),
+}
+
+
+def create_maps(sizes: MapSizes = MapSizes()) -> dict[str, loader.Map]:
+    """Create the six-map kernel/user seam (kern/fsx_kern.c:39-87)."""
+    out = {}
+    for name, (mtype, ks, vs, ent) in MAP_SPECS.items():
+        n = {"one": 1, "ips": sizes.max_track_ips,
+             "ring": sizes.ring_bytes}[ent]
+        out[name] = loader.map_create(mtype, ks, vs, n, name)
+    return out
+
+
+def _sat_u32(a: Asm, reg: int, tmp: int, label: str) -> None:
+    """reg = min(reg, 0xFFFFFFFF)  (fsx_compute.h:33-36)."""
+    a += mov64(tmp, reg)
+    a += alu64_imm(BPF_RSH, tmp, 32)
+    a.jmp_imm(BPF_JEQ, tmp, 0, label)
+    a += mov32_imm(reg, -1)  # 0xFFFFFFFF zero-extended
+    a.label(label)
+
+
+def _emit_isqrt_fn(a: Asm) -> None:
+    """BPF-to-BPF function: r0 = isqrt(r1), fully unrolled.
+
+    Mirrors fsx_compute.h:39-60 (binary-restoring integer sqrt; the C
+    version's bounded loops become straight-line code here — the
+    simplest shape for the verifier).  Uses r0-r3 only.
+    """
+    a.label("fn_isqrt")
+    a += mov64_imm(R0, 0)  # r = 0
+    a += mov64_imm(R2, 1)
+    a += alu64_imm(BPF_LSH, R2, 62)  # bit = 1 << 62
+    # while (bit > x) bit >>= 2  — 32 bounded steps
+    for i in range(32):
+        a.jmp_reg(BPF_JLE, R2, R1, f"isq_main_{i}")
+        a += alu64_imm(BPF_RSH, R2, 2)
+        a.label(f"isq_main_{i}")
+    # 32 restoring steps
+    for i in range(32):
+        a.jmp_imm(BPF_JEQ, R2, 0, "isq_done")
+        a += mov64(R3, R0)
+        a += alu64(BPF_ADD, R3, R2)  # r3 = r + bit
+        a += alu64_imm(BPF_RSH, R0, 1)  # r >>= 1
+        a.jmp_reg(BPF_JLT, R1, R3, f"isq_skip_{i}")
+        a += alu64(BPF_SUB, R1, R3)  # x -= r + bit
+        a += alu64(BPF_ADD, R0, R2)  # r += bit
+        a.label(f"isq_skip_{i}")
+        a += alu64_imm(BPF_RSH, R2, 2)  # bit >>= 2
+    a.label("isq_done")
+    a += exit_()
+
+
+def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
+    """Assemble the full fsx fast path (see module docstring)."""
+    a = Asm("fsx")
+
+    # ---- prologue ----------------------------------------------------
+    a += stx(BPF_DW, R10, S_CTX, R1)
+    a += call(FN_ktime_get_ns)
+    a += mov64(R7, R0)
+
+    # ---- stats + config lookups (fsx_kern.c:202-214) -----------------
+    a += st_imm(BPF_W, R10, S_KEY, 0)
+    a.ld_map(R1, "stats_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "pass_quiet")  # verifier NULL check
+    a += mov64(R8, R0)  # r8 = stats (this CPU's slot)
+
+    a.ld_map(R1, "config_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "pass_quiet")
+    a += mov64(R6, R0)  # r6 = cfg
+    # fail open until a config is pushed (valid flag, fsx_kern.c:206-214)
+    a += ldx(BPF_W, R1, R6, CFG_VALID)
+    a.jmp_imm(BPF_JEQ, R1, 0, "pass_quiet")
+
+    # ---- parse (kern/parsing.h:225-266) ------------------------------
+    a += ldx(BPF_DW, R1, R10, S_CTX)
+    a += ldx(BPF_W, R2, R1, XDP_MD_DATA)
+    a += ldx(BPF_W, R3, R1, XDP_MD_DATA_END)
+    a += mov64(R9, R3)
+    a += alu64(BPF_SUB, R9, R2)  # r9 = packet byte count
+
+    # defaults: dport = 0, tcp_flags = 0 (parsing.h:232-234)
+    a += st_imm(BPF_DW, R10, S_DPORT, 0)
+    a += st_imm(BPF_DW, R10, S_TCPFLAGS, 0)
+
+    # eth bounds, then h_proto (parsing.h:90-108).  Network-order u16
+    # read as LE: ETH_P_IP 0x0800 -> 0x0008, ETH_P_IPV6 0x86DD -> 0xDD86.
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 14)
+    a.jmp_reg(BPF_JGT, R4, R3, "drop")  # truncated eth → -1 → DROP
+    a += ldx(BPF_H, R5, R2, 12)
+    a.jmp_imm(BPF_JEQ, R5, 0x0008, "ip4")
+    a.jmp_imm(BPF_JEQ, R5, 0xDD86, "ip6")
+    a.ja("pass_quiet")  # non-IP passes, uncounted (fsx_kern.c:219-220)
+
+    # ---- IPv4 (parsing.h:113-137): honors variable IHL ---------------
+    a.label("ip4")
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 14)  # r4 = ip header start
+    a += mov64(R5, R4)
+    a += alu64_imm(BPF_ADD, R5, 20)
+    a.jmp_reg(BPF_JGT, R5, R3, "drop")  # sizeof(iphdr) bounds
+    a += ldx(BPF_B, R5, R4, 0)  # version<<4 | ihl
+    a += alu64_imm(BPF_AND, R5, 0x0F)
+    a += alu64_imm(BPF_LSH, R5, 2)  # hdrsize = ihl * 4
+    a.jmp_imm(BPF_JLT, R5, 20, "drop")  # hdrsize < 20 → malformed
+    a += alu64(BPF_ADD, R5, R4)  # r5 = l4 start
+    a.jmp_reg(BPF_JGT, R5, R3, "drop")  # variable-IHL bounds
+    a += ldx(BPF_B, R1, R4, 9)  # protocol
+    a += stx(BPF_DW, R10, S_L4, R1)
+    a += ldx(BPF_W, R1, R4, 12)  # saddr, wire order (as the C keeps it)
+    a += stx(BPF_DW, R10, S_SADDR, R1)
+    a += st_imm(BPF_DW, R10, S_IS6, 0)
+    a.ja("l4")
+
+    # ---- IPv6 (parsing.h:141-161): fixed header, fold saddr ----------
+    a.label("ip6")
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 14)
+    a += mov64(R5, R4)
+    a += alu64_imm(BPF_ADD, R5, 40)
+    a.jmp_reg(BPF_JGT, R5, R3, "drop")
+    a += ldx(BPF_B, R1, R4, 6)  # nexthdr
+    a += stx(BPF_DW, R10, S_L4, R1)
+    # fsx_fold_ip6 (parsing.h:82-85): XOR of the four saddr words
+    a += ldx(BPF_W, R1, R4, 8)
+    a += ldx(BPF_W, R0, R4, 12)
+    a += alu64(BPF_XOR, R1, R0)
+    a += ldx(BPF_W, R0, R4, 16)
+    a += alu64(BPF_XOR, R1, R0)
+    a += ldx(BPF_W, R0, R4, 20)
+    a += alu64(BPF_XOR, R1, R0)
+    a += stx(BPF_DW, R10, S_SADDR, R1)
+    a += st_imm(BPF_DW, R10, S_IS6, 1)
+    # r5 already = l4 start (fixed 40 B header; ext hdrs not walked)
+
+    # ---- L4 dispatch (parsing.h:249-264); r5 = l4 start, r3 = end ----
+    a.label("l4")
+    a += ldx(BPF_DW, R1, R10, S_L4)
+    a.jmp_imm(BPF_JEQ, R1, IPPROTO_TCP, "tcp")
+    a.jmp_imm(BPF_JEQ, R1, IPPROTO_UDP, "udp")
+    a.jmp_imm(BPF_JEQ, R1, IPPROTO_ICMP, "icmp")
+    a.ja("parsed")  # other L4: L3 info is enough (parsing.h:262-263)
+
+    a.label("tcp")  # parsing.h:165-184
+    a += mov64(R4, R5)
+    a += alu64_imm(BPF_ADD, R4, 20)
+    a.jmp_reg(BPF_JGT, R4, R3, "drop")
+    a += ldx(BPF_H, R1, R5, 2)  # dest port, network order
+    a += stx(BPF_DW, R10, S_DPORT, R1)
+    a += ldx(BPF_B, R1, R5, 13)  # flags byte (layout-stable)
+    a += stx(BPF_DW, R10, S_TCPFLAGS, R1)
+    a.ja("parsed")
+
+    a.label("udp")  # parsing.h:191-208
+    a += mov64(R4, R5)
+    a += alu64_imm(BPF_ADD, R4, 8)
+    a.jmp_reg(BPF_JGT, R4, R3, "drop")
+    a += ldx(BPF_H, R1, R5, 2)
+    a += stx(BPF_DW, R10, S_DPORT, R1)
+    a.ja("parsed")
+
+    a.label("icmp")  # parsing.h:211-220
+    a += mov64(R4, R5)
+    a += alu64_imm(BPF_ADD, R4, 8)  # sizeof(icmphdr)
+    a.jmp_reg(BPF_JGT, R4, R3, "drop")
+
+    # ---- blacklist gate with TTL expiry (fsx_kern.c:222-233) ---------
+    a.label("parsed")
+    a += ldx(BPF_DW, R1, R10, S_SADDR)
+    a += stx(BPF_W, R10, S_KEY, R1)
+    a.ld_map(R1, "blacklist_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "ratelimit")
+    a += ldx(BPF_DW, R1, R0, 0)  # *until
+    a.jmp_reg(BPF_JGE, R7, R1, "bl_expired")
+    # still blocked: dropped_blacklist++ (per-CPU slot: plain add), DROP
+    a += ldx(BPF_DW, R1, R8, ST_DROPPED_BLACKLIST)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += stx(BPF_DW, R8, ST_DROPPED_BLACKLIST, R1)
+    a.ja("drop_counted")
+    a.label("bl_expired")  # TTL passed: delete, continue
+    a.ld_map(R1, "blacklist_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_delete_elem)
+
+    # ---- per-IP rate limit (fsx_kern.c:235-269) ----------------------
+    a.label("ratelimit")
+    a.ld_map(R1, "ip_state_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JNE, R0, 0, "limiter")
+    # miss: insert {win_start_ns = now, rest 0}, then re-lookup
+    a += mov64_imm(R1, 0)
+    for off in range(8, IPS_SIZE, 8):
+        a += stx(BPF_DW, R10, S_IPS_ZERO + off, R1)
+    a += stx(BPF_DW, R10, S_IPS_ZERO + IPS_WIN_START_NS, R7)
+    a.ld_map(R1, "ip_state_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += mov64(R3, R10)
+    a += alu64_imm(BPF_ADD, R3, S_IPS_ZERO)
+    a += mov64_imm(R4, 0)  # BPF_ANY
+    a += call(FN_map_update_elem)
+    a.ld_map(R1, "ip_state_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "features")  # table churn: fail open
+
+    # r0 = st.  Dispatch on cfg->limiter_kind (fsx_kern.c:249-258).
+    a.label("limiter")
+    a += mov64(R2, R0)  # r2 = st (limiters are call-free: r0-r5 free)
+    a += ldx(BPF_W, R1, R6, CFG_LIMITER_KIND)
+    a.jmp_imm(BPF_JEQ, R1, 1, "lim_sliding")
+    a.jmp_imm(BPF_JEQ, R1, 2, "lim_token")
+
+    # -- fixed window (fsx_compute.h:64-78) --
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_START_NS)
+    a += mov64(R3, R7)
+    a += alu64(BPF_SUB, R3, R1)  # now - win_start
+    a += ldx(BPF_DW, R4, R6, CFG_WINDOW_NS)
+    a.jmp_reg(BPF_JLT, R3, R4, "fw_accum")
+    # rollover: seed with THIS packet (the reference seeded 0 — the
+    # §7.5 first-packet bug, not replicated)
+    a += stx(BPF_DW, R2, IPS_WIN_START_NS, R7)
+    a += mov64_imm(R1, 1)
+    a += stx(BPF_DW, R2, IPS_WIN_PPS, R1)
+    a += stx(BPF_DW, R2, IPS_WIN_BPS, R9)
+    a.ja("fw_check")
+    a.label("fw_accum")
+    a += mov64_imm(R1, 1)
+    a += atomic_add64(R2, IPS_WIN_PPS, R1)
+    a += mov64(R1, R9)
+    a += atomic_add64(R2, IPS_WIN_BPS, R1)
+    a.label("fw_check")
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_PPS)
+    a += ldx(BPF_DW, R3, R6, CFG_PPS_THRESHOLD)
+    a.jmp_reg(BPF_JGT, R1, R3, "over")
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_BPS)
+    a += ldx(BPF_DW, R3, R6, CFG_BPS_THRESHOLD)
+    a.jmp_reg(BPF_JGT, R1, R3, "over")
+    a.ja("features")
+
+    # -- two-bucket sliding window (fsx_compute.h:82-113) --
+    a.label("lim_sliding")
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_START_NS)
+    a += mov64(R3, R7)
+    a += alu64(BPF_SUB, R3, R1)  # elapsed
+    a += ldx(BPF_DW, R4, R6, CFG_WINDOW_NS)
+    a += mov64(R5, R4)
+    a += alu64_imm(BPF_LSH, R5, 1)  # 2 * window
+    a.jmp_reg(BPF_JGE, R3, R5, "sw_stale")
+    a.jmp_reg(BPF_JGE, R3, R4, "sw_roll")
+    a += mov64_imm(R1, 1)  # in-window accumulate
+    a += atomic_add64(R2, IPS_WIN_PPS, R1)
+    a += mov64(R1, R9)
+    a += atomic_add64(R2, IPS_WIN_BPS, R1)
+    a.ja("sw_est")
+    a.label("sw_stale")  # >= 2 windows idle: zero prev, snap to grid
+    a += mov64_imm(R1, 0)
+    a += stx(BPF_DW, R2, IPS_PREV_PPS, R1)
+    a += stx(BPF_DW, R2, IPS_PREV_BPS, R1)
+    a += mov64(R1, R7)
+    a += alu64(BPF_MOD, R1, R4)  # now % window
+    a += mov64(R3, R7)
+    a += alu64(BPF_SUB, R3, R1)
+    a += stx(BPF_DW, R2, IPS_WIN_START_NS, R3)
+    a += mov64_imm(R1, 1)
+    a += stx(BPF_DW, R2, IPS_WIN_PPS, R1)
+    a += stx(BPF_DW, R2, IPS_WIN_BPS, R9)
+    a.ja("sw_est")
+    a.label("sw_roll")  # one window passed: cur → prev
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_PPS)
+    a += stx(BPF_DW, R2, IPS_PREV_PPS, R1)
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_BPS)
+    a += stx(BPF_DW, R2, IPS_PREV_BPS, R1)
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_START_NS)
+    a += alu64(BPF_ADD, R1, R4)
+    a += stx(BPF_DW, R2, IPS_WIN_START_NS, R1)
+    a += mov64_imm(R1, 1)
+    a += stx(BPF_DW, R2, IPS_WIN_PPS, R1)
+    a += stx(BPF_DW, R2, IPS_WIN_BPS, R9)
+    a.label("sw_est")
+    # overlap = 1024 - min(((now - win_start) << 10) / window, 1024)
+    a += ldx(BPF_DW, R1, R2, IPS_WIN_START_NS)
+    a += mov64(R3, R7)
+    a += alu64(BPF_SUB, R3, R1)
+    a += alu64_imm(BPF_LSH, R3, 10)
+    a += alu64(BPF_DIV, R3, R4)  # frac (1/1024 fixed point)
+    a += mov64_imm(R5, 0)
+    a.jmp_imm(BPF_JGT, R3, 1024, "sw_havefrac")
+    a += mov64_imm(R5, 1024)
+    a += alu64(BPF_SUB, R5, R3)  # overlap
+    a.label("sw_havefrac")
+    a += ldx(BPF_DW, R1, R2, IPS_PREV_PPS)
+    a += alu64(BPF_MUL, R1, R5)
+    a += alu64_imm(BPF_RSH, R1, 10)
+    a += ldx(BPF_DW, R3, R2, IPS_WIN_PPS)
+    a += alu64(BPF_ADD, R1, R3)  # est_pps
+    a += ldx(BPF_DW, R3, R6, CFG_PPS_THRESHOLD)
+    a.jmp_reg(BPF_JGT, R1, R3, "over")
+    a += ldx(BPF_DW, R1, R2, IPS_PREV_BPS)
+    a += alu64(BPF_MUL, R1, R5)
+    a += alu64_imm(BPF_RSH, R1, 10)
+    a += ldx(BPF_DW, R3, R2, IPS_WIN_BPS)
+    a += alu64(BPF_ADD, R1, R3)  # est_bps
+    a += ldx(BPF_DW, R3, R6, CFG_BPS_THRESHOLD)
+    a.jmp_reg(BPF_JGT, R1, R3, "over")
+    a.ja("features")
+
+    # -- token bucket in milli-tokens (fsx_compute.h:122-142) --
+    a.label("lim_token")
+    a += ldx(BPF_DW, R1, R2, IPS_TOK_TS_NS)
+    a += mov64(R3, R7)
+    a += alu64(BPF_SUB, R3, R1)  # elapsed_ns
+    a += ld_imm64(R4, 1_000_000_000_000)  # 1000 s clamp
+    a.jmp_reg(BPF_JLE, R3, R4, "tb_clamped")
+    a += mov64(R3, R4)
+    a.label("tb_clamped")
+    a += ldx(BPF_DW, R4, R6, CFG_BUCKET_RATE_PPS)
+    a += alu64(BPF_MUL, R3, R4)
+    a += ld_imm64(R4, 1_000_000)
+    a += alu64(BPF_DIV, R3, R4)  # refill_milli
+    a += ldx(BPF_DW, R1, R2, IPS_TOKENS_MILLI)
+    a += alu64(BPF_ADD, R3, R1)  # tokens
+    a += ldx(BPF_DW, R4, R6, CFG_BUCKET_BURST)
+    a += alu64_imm(BPF_MUL, R4, 1000)  # burst_milli
+    a.jmp_reg(BPF_JLE, R3, R4, "tb_capped")
+    a += mov64(R3, R4)
+    a.label("tb_capped")
+    a += stx(BPF_DW, R2, IPS_TOK_TS_NS, R7)
+    a.jmp_imm(BPF_JGE, R3, 1000, "tb_spend")
+    a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)  # broke: keep tokens
+    a.ja("over")
+    a.label("tb_spend")
+    a += alu64_imm(BPF_SUB, R3, 1000)
+    a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)
+    a.ja("features")
+
+    # ---- over threshold: blacklist + drop (fsx_kern.c:260-268) -------
+    a.label("over")
+    a += ldx(BPF_DW, R1, R6, CFG_BLOCK_NS)
+    a += alu64(BPF_ADD, R1, R7)  # until = now + block_ns
+    a += stx(BPF_DW, R10, S_VAL64, R1)
+    a.ld_map(R1, "blacklist_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_KEY)
+    a += mov64(R3, R10)
+    a += alu64_imm(BPF_ADD, R3, S_VAL64)
+    a += mov64_imm(R4, 0)  # BPF_ANY
+    a += call(FN_map_update_elem)
+    a += ldx(BPF_DW, R1, R8, ST_DROPPED_RATE)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += stx(BPF_DW, R8, ST_DROPPED_RATE, R1)
+    a.ja("drop_counted")
+
+    # ---- streaming feature extraction (fsx_kern.c:97-185) ------------
+    # cfg (r6) is dead past the limiter; r6 is reused for the flow-stats
+    # pointer so it survives the BPF-to-BPF isqrt calls (r6-r9 are the
+    # only callee-saved registers).
+    a.label("features")
+    # fkey = saddr ^ (dport << 16); 32-bit store truncates as in C
+    a += ldx(BPF_DW, R1, R10, S_SADDR)
+    a += ldx(BPF_DW, R2, R10, S_DPORT)
+    a += alu64_imm(BPF_LSH, R2, 16)
+    a += alu64(BPF_XOR, R1, R2)
+    a += stx(BPF_W, R10, S_FKEY, R1)
+    a.ld_map(R1, "flow_stats_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_FKEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JNE, R0, 0, "fs_have")
+    # miss: insert zeroed stats {first_ts_ns = now, dst_port = htons}
+    a += mov64_imm(R1, 0)
+    for off in range(0, 72, 8):
+        a += stx(BPF_DW, R10, S_FS_ZERO + off, R1)
+    a += stx(BPF_DW, R10, S_FS_ZERO + FS_FIRST_TS_NS, R7)
+    a += ldx(BPF_DW, R1, R10, S_DPORT)
+    a += endian_be(R1, 16)  # fsx_htons: wire → host order
+    a += stx(BPF_H, R10, S_FS_ZERO + FS_DST_PORT, R1)
+    a.ld_map(R1, "flow_stats_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_FKEY)
+    a += mov64(R3, R10)
+    a += alu64_imm(BPF_ADD, R3, S_FS_ZERO)
+    a += mov64_imm(R4, 0)
+    a += call(FN_map_update_elem)
+    a.ld_map(R1, "flow_stats_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_FKEY)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "allowed")  # churn: fail open
+    a.label("fs_have")
+    a += mov64(R6, R0)  # r6 = fs (callee-saved across isqrt calls)
+
+    # IAT update, guarded against the cross-CPU ordering race
+    # (fsx_kern.c:114-135): only when pkt_count > 0 AND now > last_ts.
+    a += ldx(BPF_DW, R1, R6, FS_PKT_COUNT)
+    a.jmp_imm(BPF_JEQ, R1, 0, "fs_count")
+    a += ldx(BPF_DW, R3, R6, FS_LAST_TS_NS)
+    a.jmp_reg(BPF_JGE, R3, R7, "fs_count")
+    a += mov64(R4, R7)
+    a += alu64(BPF_SUB, R4, R3)  # iat (ns)
+    a += mov64(R1, R4)
+    a += atomic_add64(R6, FS_IAT_SUM_NS, R1)
+    a += mov64(R5, R4)
+    a += alu64_imm(BPF_DIV, R5, 1000)  # iat_us
+    # clamp to 2^21 us before squaring (headroom analysis at
+    # fsx_kern.c:122-127)
+    a += ld_imm64(R1, 1 << 21)
+    a.jmp_reg(BPF_JLE, R5, R1, "iat_clamped")
+    a += mov64(R5, R1)
+    a.label("iat_clamped")
+    a += alu64(BPF_MUL, R5, R5)
+    a += mov64(R1, R5)
+    a += atomic_add64(R6, FS_IAT_SQ_SUM_US2, R1)
+    a += ldx(BPF_DW, R1, R6, FS_IAT_MAX_NS)
+    a.jmp_reg(BPF_JLE, R4, R1, "fs_count")
+    a += stx(BPF_DW, R6, FS_IAT_MAX_NS, R4)  # benign race: a lost max
+    a.label("fs_count")
+    # n_now = fetch_add(pkt_count, 1) + 1  (BPF_FETCH needs kernel >=
+    # 5.12 — same floor as the C build, see kern/fsx_compute.h note)
+    a += mov64_imm(R1, 1)
+    a += atomic_add64(R6, FS_PKT_COUNT, R1, fetch=True)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += mov64(R5, R1)  # r5 = n_now
+    a += mov64(R1, R9)
+    a += atomic_add64(R6, FS_BYTE_SUM, R1)
+    a += mov64(R1, R9)
+    a += alu64(BPF_MUL, R1, R9)
+    a += atomic_add64(R6, FS_BYTE_SQ_SUM, R1)
+    a += stx(BPF_DW, R6, FS_LAST_TS_NS, R7)
+
+    # Emit every packet while the flow is young, then every 16th
+    # (fsx_kern.c:141-144): skip when n_now > 16 && (n_now & 15) != 0.
+    a.jmp_imm(BPF_JLE, R5, 16, "derive")
+    a += alu64_imm(BPF_AND, R5, 15)
+    a.jmp_imm(BPF_JNE, R5, 0, "allowed")
+
+    # ---- derive the 8 features into the frame (fsx_kern.c:150-183).
+    # n is snapshotted once (C reads it into a local); isqrt calls all
+    # happen BEFORE ringbuf_reserve, so no ringbuf reference is ever
+    # held across a BPF-to-BPF call.
+    a.label("derive")
+    a += ldx(BPF_DW, R5, R6, FS_PKT_COUNT)  # n (reloaded, as in C)
+    a += stx(BPF_DW, R10, S_N, R5)
+    a += ldx(BPF_DW, R1, R6, FS_BYTE_SUM)
+    a += alu64(BPF_DIV, R1, R5)  # mean
+    a += mov64(R3, R1)
+    _sat_u32(a, R1, R4, "f_mean_sat")  # feat1 = feat4 = sat(mean)
+    a += stx(BPF_W, R10, S_FEAT + 4, R1)
+    a += stx(BPF_W, R10, S_FEAT + 16, R1)
+    a += ldx(BPF_DW, R1, R6, FS_BYTE_SQ_SUM)
+    a += alu64(BPF_DIV, R1, R5)
+    a += alu64(BPF_MUL, R3, R3)  # mean^2
+    a += mov64_imm(R4, 0)
+    a.jmp_reg(BPF_JLE, R1, R3, "f_var_zero")
+    a += mov64(R4, R1)
+    a += alu64(BPF_SUB, R4, R3)  # var = byte_sq_sum/n - mean^2
+    a.label("f_var_zero")
+    a += mov64(R1, R4)
+    _sat_u32(a, R1, R3, "f_var_sat")  # feat3 = sat(var)
+    a += stx(BPF_W, R10, S_FEAT + 12, R1)
+    a += mov64(R1, R4)
+    a.call_local("fn_isqrt")  # feat2 = isqrt(var)
+    a += stx(BPF_W, R10, S_FEAT + 8, R0)
+    # iat_n = max(n - 1, 1)
+    a += ldx(BPF_DW, R4, R10, S_N)
+    a += alu64_imm(BPF_SUB, R4, 1)
+    a.jmp_imm(BPF_JGE, R4, 1, "f_iatn_ok")
+    a += mov64_imm(R4, 1)
+    a.label("f_iatn_ok")
+    # iat_mean_us = (iat_sum_ns / iat_n) / 1000; feat5 = sat(...)
+    a += ldx(BPF_DW, R1, R6, FS_IAT_SUM_NS)
+    a += alu64(BPF_DIV, R1, R4)
+    a += alu64_imm(BPF_DIV, R1, 1000)
+    a += mov64(R3, R1)  # iat_mean_us
+    _sat_u32(a, R1, R5, "f_iatmean_sat")
+    a += stx(BPF_W, R10, S_FEAT + 20, R1)
+    # iat_var = max(iat_sq_sum_us2 / iat_n - iat_mean_us^2, 0)
+    a += ldx(BPF_DW, R1, R6, FS_IAT_SQ_SUM_US2)
+    a += alu64(BPF_DIV, R1, R4)
+    a += alu64(BPF_MUL, R3, R3)
+    a += mov64_imm(R4, 0)
+    a.jmp_reg(BPF_JLE, R1, R3, "f_iatvar_zero")
+    a += mov64(R4, R1)
+    a += alu64(BPF_SUB, R4, R3)
+    a.label("f_iatvar_zero")
+    a += mov64(R1, R4)
+    a.call_local("fn_isqrt")  # feat6 = isqrt(iat_var)
+    a += stx(BPF_W, R10, S_FEAT + 24, R0)
+    # feat7 = sat(iat_max_ns / 1000)
+    a += ldx(BPF_DW, R1, R6, FS_IAT_MAX_NS)
+    a += alu64_imm(BPF_DIV, R1, 1000)
+    _sat_u32(a, R1, R3, "f_iatmax_sat")
+    a += stx(BPF_W, R10, S_FEAT + 28, R1)
+    # feat0 = dst_port (host order, stored at flow creation)
+    a += ldx(BPF_H, R1, R6, FS_DST_PORT)
+    a += stx(BPF_W, R10, S_FEAT + 0, R1)
+
+    # ---- ringbuf emit (fsx_kern.c:146-184) ---------------------------
+    a.ld_map(R1, "feature_ring")
+    a += mov64_imm(R2, REC_SIZE)
+    a += mov64_imm(R3, 0)
+    a += call(FN_ringbuf_reserve)
+    a.jmp_imm(BPF_JEQ, R0, 0, "allowed")  # ring full: fail open
+    a += mov64(R2, R0)  # r2 = rec
+    a += stx(BPF_DW, R2, REC_TS_NS, R7)
+    a += ldx(BPF_DW, R1, R10, S_SADDR)
+    a += stx(BPF_W, R2, REC_SADDR, R1)
+    a += stx(BPF_H, R2, REC_PKT_LEN, R9)
+    a += ldx(BPF_DW, R1, R10, S_L4)
+    a += stx(BPF_B, R2, REC_IP_PROTO, R1)
+    # flags byte: ipv6 | tcp | udp | icmp | tcp_syn (fsx_kern.c:170-174)
+    a += ldx(BPF_DW, R3, R10, S_IS6)  # FLAG_IPV6 == 1 == is6
+    a += ldx(BPF_DW, R1, R10, S_L4)
+    a.jmp_imm(BPF_JNE, R1, IPPROTO_TCP, "fl_chk_udp")
+    a += alu64_imm(BPF_OR, R3, FLAG_TCP)
+    a += ldx(BPF_DW, R4, R10, S_TCPFLAGS)
+    a += alu64_imm(BPF_AND, R4, FSX_TCP_SYN)
+    a.jmp_imm(BPF_JEQ, R4, 0, "fl_done")
+    a += alu64_imm(BPF_OR, R3, FLAG_TCP_SYN)
+    a.ja("fl_done")
+    a.label("fl_chk_udp")
+    a.jmp_imm(BPF_JNE, R1, IPPROTO_UDP, "fl_chk_icmp")
+    a += alu64_imm(BPF_OR, R3, FLAG_UDP)
+    a.ja("fl_done")
+    a.label("fl_chk_icmp")
+    a.jmp_imm(BPF_JNE, R1, IPPROTO_ICMP, "fl_done")
+    a += alu64_imm(BPF_OR, R3, FLAG_ICMP)
+    a.label("fl_done")
+    a += stx(BPF_B, R2, REC_FLAGS, R3)
+    # copy the 8 derived features
+    for i in range(8):
+        a += ldx(BPF_W, R1, R10, S_FEAT + 4 * i)
+        a += stx(BPF_W, R2, REC_FEAT + 4 * i, R1)
+    a += mov64(R1, R2)
+    a += mov64_imm(R2, 0)
+    a += call(FN_ringbuf_submit)
+
+    # ---- exits -------------------------------------------------------
+    a.label("allowed")  # fsx_kern.c:275-276
+    a += ldx(BPF_DW, R1, R8, ST_ALLOWED)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += stx(BPF_DW, R8, ST_ALLOWED, R1)
+    a += mov64_imm(R0, XDP_PASS)
+    a += exit_()
+
+    a.label("pass_quiet")  # no config / non-IP: pass, uncounted
+    a += mov64_imm(R0, XDP_PASS)
+    a += exit_()
+
+    a.label("drop")  # malformed: drop, uncounted (fsx_kern.c:217-218)
+    a += mov64_imm(R0, XDP_DROP)
+    a += exit_()
+
+    a.label("drop_counted")  # blacklist / rate-limit drop
+    a += mov64_imm(R0, XDP_DROP)
+    a += exit_()
+
+    # ---- subfunction ------------------------------------------------
+    _emit_isqrt_fn(a)
+
+    return a.assemble()
+
+
+def load(sizes: MapSizes = MapSizes()) -> tuple[int, dict[str, loader.Map]]:
+    """Create maps, load the program through the verifier; returns
+    (prog_fd, maps).  Caller owns the fds."""
+    maps = create_maps(sizes)
+    prog = build()
+    fd = loader.prog_load(prog, map_fds={k: m.fd for k, m in maps.items()})
+    return fd, maps

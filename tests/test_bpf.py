@@ -1,0 +1,413 @@
+"""End-to-end tests of the in-repo BPF toolchain against the REAL kernel.
+
+This is SURVEY.md §4's integration plan realized: the hand-assembled
+fsx XDP program (flowsentryx_tpu/bpf/progs.py) is loaded through the
+actual in-kernel verifier and executed against crafted packets with
+``BPF_PROG_TEST_RUN`` — no NIC, no clang needed.  The reference never
+had any of this (its only test artifact is a scratch verifier
+experiment, /root/reference/public/experiments/trail_kern.c).
+
+Skipped wholesale when the container's seccomp policy denies bpf(2).
+"""
+
+from __future__ import annotations
+
+import struct
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.bpf import loader
+
+pytestmark = pytest.mark.skipif(
+    not loader.bpf_available(), reason="bpf(2) not permitted in this container"
+)
+
+from flowsentryx_tpu.bpf import progs  # noqa: E402
+from flowsentryx_tpu.core import schema  # noqa: E402
+from flowsentryx_tpu.core.config import (  # noqa: E402
+    FsxConfig,
+    LimiterConfig,
+    LimiterKind,
+)
+
+SMALL = progs.MapSizes(max_track_ips=1024, ring_bytes=1 << 14)
+ZERO_KEY = struct.pack("<I", 0)
+XDP_DROP, XDP_PASS = 1, 2
+
+
+def ktime_ns() -> int:
+    """bpf_ktime_get_ns reads CLOCK_MONOTONIC."""
+    return time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+
+
+# ---- packet crafting (wire format per kern/parsing.h layouts) --------
+
+
+def eth(proto: int = 0x0800) -> bytes:
+    return b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", proto)
+
+
+def ip4_pkt(saddr: int, proto: int = 17, dport: int = 53, plen: int = 100,
+            tcp_flags: int = 0, ihl: int = 5) -> bytes:
+    """saddr is given in host int form but written in wire (BE) order --
+    the program treats it as an opaque folded u32 read with a LE load,
+    so map keys below must use the same LE view of the wire bytes."""
+    hdr = bytes([0x40 | ihl, 0]) + struct.pack(">H", plen - 14)
+    hdr += b"\x00" * 4 + bytes([64, proto]) + b"\x00\x00"
+    hdr += struct.pack("<I", saddr)  # LE write == LE program load
+    hdr += b"\x01\x02\x03\x04"
+    hdr += b"\x00" * (ihl * 4 - 20)
+    if proto == 6:
+        l4 = struct.pack(">HH", 1234, dport) + b"\x00" * 9 + \
+            bytes([tcp_flags]) + b"\x00" * 6
+    elif proto == 17:
+        l4 = struct.pack(">HHHH", 1234, dport, plen - 14 - ihl * 4, 0)
+    else:
+        l4 = b"\x00" * 8
+    pkt = eth() + hdr + l4
+    return pkt + b"X" * max(0, plen - len(pkt))
+
+
+def ip6_pkt(saddr_words: tuple[int, int, int, int], nexthdr: int = 17,
+            dport: int = 443, plen: int = 120) -> bytes:
+    hdr = b"\x60\x00\x00\x00" + struct.pack(">H", plen - 54) + \
+        bytes([nexthdr, 64])
+    hdr += b"".join(struct.pack("<I", w) for w in saddr_words)
+    hdr += b"\xaa" * 16  # daddr
+    l4 = struct.pack(">HHHH", 1234, dport, plen - 54, 0)
+    pkt = eth(0x86DD) + hdr + l4
+    return pkt + b"X" * max(0, plen - len(pkt))
+
+
+def saddr_key(saddr: int) -> bytes:
+    return struct.pack("<I", saddr)
+
+
+# ---- harness ---------------------------------------------------------
+
+
+class Fsx:
+    """One loaded program instance + its maps + ring reader."""
+
+    def __init__(self, sizes: progs.MapSizes = SMALL):
+        self.fd, self.maps = progs.load(sizes)
+        self.ring = loader.RingbufReader(self.maps["feature_ring"])
+
+    def push_config(self, **limiter_kw) -> None:
+        cfg = FsxConfig(limiter=LimiterConfig(**limiter_kw))
+        self.maps["config_map"].update(ZERO_KEY, cfg.pack_kernel_config())
+
+    def run(self, pkt: bytes, repeat: int = 1) -> int:
+        rv, _, _ = loader.prog_test_run(self.fd, pkt, repeat=repeat)
+        return rv
+
+    def stats(self) -> dict[str, int]:
+        names = ("allowed", "dropped_blacklist", "dropped_rate", "dropped_ml")
+        tot = [0, 0, 0, 0]
+        for v in self.maps["stats_map"].lookup_percpu(ZERO_KEY):
+            for i, x in enumerate(struct.unpack("<4Q", v)):
+                tot[i] += x
+        return dict(zip(names, tot))
+
+    def records(self) -> np.ndarray:
+        recs = self.ring.read()
+        if not recs:
+            return np.zeros(0, dtype=schema.FLOW_RECORD_DTYPE)
+        return np.frombuffer(b"".join(recs), dtype=schema.FLOW_RECORD_DTYPE)
+
+
+@pytest.fixture()
+def fsx() -> Fsx:
+    f = Fsx()
+    f.push_config()  # defaults: fixed window, 1000 pps, 125 MB/s
+    return f
+
+
+# ---- verifier + parse ------------------------------------------------
+
+
+def test_verifier_accepts_full_fast_path():
+    """The complete hand-assembled program (parse → blacklist → three
+    limiters → features → ringbuf) passes the real kernel verifier."""
+    prog = progs.build()
+    assert len(prog.insns) > 500  # the real thing, not a stub
+    f = Fsx()  # loads or raises VerifierError with the log
+    assert f.fd > 0
+
+
+def test_no_config_fail_open():
+    """Until user space pushes a config the program passes everything
+    (fsx_kern.c:206-214 fail-open contract)."""
+    f = Fsx()  # no push_config
+    assert f.run(ip4_pkt(0x01010101)) == XDP_PASS
+    assert f.stats()["allowed"] == 0  # uncounted: quiet pass
+
+
+def test_non_ip_passes(fsx):
+    assert fsx.run(eth(0x0806) + b"\x00" * 28) == XDP_PASS  # ARP
+    assert fsx.stats()["allowed"] == 0  # parsing.h rc>0: quiet pass
+
+
+def test_eth_only_frame_drops(fsx):
+    """An IP ethertype with zero IP bytes is truncated → DROP.  (A
+    frame shorter than ETH_HLEN cannot be tested: BPF_PROG_TEST_RUN
+    itself requires >= 14 bytes of input for XDP.)"""
+    assert fsx.run(eth(0x0800)) == XDP_DROP
+
+
+def test_truncated_ip_drops(fsx):
+    assert fsx.run(eth() + b"\x45\x00" + b"\x00" * 10) == XDP_DROP
+
+
+def test_bad_ihl_drops(fsx):
+    pkt = ip4_pkt(0x01010101)
+    bad = pkt[:14] + bytes([0x42]) + pkt[15:]  # ihl=2 < 5
+    assert fsx.run(bad) == XDP_DROP
+
+
+def test_variable_ihl_parses(fsx):
+    assert fsx.run(ip4_pkt(0x0A0B0C0D, ihl=7)) == XDP_PASS
+    rec = fsx.records()
+    assert rec["saddr"][0] == 0x0A0B0C0D
+
+
+def test_ipv4_udp_features(fsx):
+    assert fsx.run(ip4_pkt(0x01010101, proto=17, dport=53, plen=100)) == XDP_PASS
+    rec = fsx.records()
+    assert len(rec) == 1
+    r = rec[0]
+    assert r["saddr"] == 0x01010101
+    assert r["pkt_len"] == 100
+    assert r["ip_proto"] == 17
+    assert r["flags"] == schema.FLAG_UDP
+    assert r["feat"][0] == 53  # dst_port, host order
+    assert r["feat"][1] == 100  # byte mean of a 1-packet flow
+    assert r["feat"][2] == 0  # byte std
+    assert fsx.stats()["allowed"] == 1
+
+
+def test_ipv6_fold_and_flag(fsx):
+    words = (0x11111111, 0x22222222, 0x33333333, 0x44444444)
+    assert fsx.run(ip6_pkt(words)) == XDP_PASS
+    rec = fsx.records()
+    assert len(rec) == 1
+    fold = words[0] ^ words[1] ^ words[2] ^ words[3]
+    assert rec["saddr"][0] == fold  # parsing.h:82-85 fsx_fold_ip6
+    assert rec["flags"][0] & schema.FLAG_IPV6
+    assert rec["flags"][0] & schema.FLAG_UDP
+
+
+def test_tcp_syn_flag(fsx):
+    assert fsx.run(ip4_pkt(0x05050505, proto=6, tcp_flags=0x02)) == XDP_PASS
+    rec = fsx.records()
+    assert rec["flags"][0] == (schema.FLAG_TCP | schema.FLAG_TCP_SYN)
+    assert rec["feat"][0][0] == 53
+
+
+def test_icmp_flag(fsx):
+    assert fsx.run(ip4_pkt(0x06060606, proto=1)) == XDP_PASS
+    rec = fsx.records()
+    assert rec["flags"][0] == schema.FLAG_ICMP
+    assert rec["feat"][0][0] == 0  # no ports
+
+
+# ---- blacklist gate (verdict ingress seam) ---------------------------
+
+
+def test_blacklist_drop_and_ttl_expiry(fsx):
+    saddr = 0x0A000001
+    until = ktime_ns() + 300_000_000  # 300 ms
+    fsx.maps["blacklist_map"].update(saddr_key(saddr), struct.pack("<Q", until))
+
+    assert fsx.run(ip4_pkt(saddr)) == XDP_DROP
+    assert fsx.stats()["dropped_blacklist"] == 1
+
+    time.sleep(0.35)  # TTL passes
+    assert fsx.run(ip4_pkt(saddr)) == XDP_PASS
+    # expired entry was deleted by the program (fsx_kern.c:231)
+    assert fsx.maps["blacklist_map"].lookup(saddr_key(saddr)) is None
+    st = fsx.stats()
+    assert st["allowed"] == 1 and st["dropped_blacklist"] == 1
+
+
+# ---- the three limiters ----------------------------------------------
+
+
+def test_fixed_window_limiter_blocks_flood():
+    f = Fsx()
+    f.push_config(kind=LimiterKind.FIXED_WINDOW, pps_threshold=5,
+                  window_s=10.0, block_s=10.0)
+    saddr = 0x0B000001
+    results = [f.run(ip4_pkt(saddr)) for _ in range(10)]
+    assert results[:5] == [XDP_PASS] * 5
+    assert results[5] == XDP_DROP  # win_pps=6 > 5 → rate drop
+    assert results[6:] == [XDP_DROP] * 4  # now blacklisted
+    st = f.stats()
+    assert st == {"allowed": 5, "dropped_blacklist": 4, "dropped_rate": 1,
+                  "dropped_ml": 0}
+    # rate-limit verdict landed in the blacklist with a TTL
+    raw = f.maps["blacklist_map"].lookup(saddr_key(saddr))
+    until = struct.unpack("<Q", raw)[0]
+    assert until > ktime_ns()  # ~10 s out
+
+
+def test_fixed_window_bps_threshold():
+    f = Fsx()
+    f.push_config(kind=LimiterKind.FIXED_WINDOW, pps_threshold=10**9,
+                  bps_threshold=250, window_s=10.0)
+    saddr = 0x0B000002
+    assert f.run(ip4_pkt(saddr, plen=200)) == XDP_PASS  # 200 B
+    assert f.run(ip4_pkt(saddr, plen=200)) == XDP_DROP  # 400 B > 250
+
+
+def test_sliding_window_limiter_blocks_flood():
+    f = Fsx()
+    f.push_config(kind=LimiterKind.SLIDING_WINDOW, pps_threshold=5,
+                  window_s=10.0, block_s=10.0)
+    saddr = 0x0C000001
+    results = [f.run(ip4_pkt(saddr)) for _ in range(8)]
+    assert results[:5] == [XDP_PASS] * 5
+    assert XDP_DROP in results[5:]
+    assert f.stats()["dropped_rate"] >= 1
+
+
+def test_token_bucket_limiter():
+    f = Fsx()
+    f.push_config(kind=LimiterKind.TOKEN_BUCKET, bucket_rate_pps=1,
+                  bucket_burst=3, block_s=0.05)
+    saddr = 0x0D000001
+    results = [f.run(ip4_pkt(saddr)) for _ in range(5)]
+    # fresh state refills to the full burst (3 tokens): 3 pass, then broke
+    assert results[:3] == [XDP_PASS] * 3
+    assert results[3] == XDP_DROP
+    st = f.stats()
+    assert st["allowed"] == 3 and st["dropped_rate"] >= 1
+
+
+def test_limiter_fail_open_keeps_ml_features_flowing():
+    """Rate-limited sources never reach the feature ring (kernel drops
+    before extraction), but allowed ones always do."""
+    f = Fsx()
+    f.push_config(pps_threshold=2, window_s=10.0)
+    saddr = 0x0E000001
+    for _ in range(6):
+        f.run(ip4_pkt(saddr))
+    recs = f.records()
+    assert len(recs) == 2  # only the 2 allowed packets emitted features
+
+
+# ---- feature stream parity (integer estimators) ----------------------
+
+
+def _derive_mirror(fs: dict) -> list[int]:
+    """Python mirror of the integer feature derivation
+    (fsx_kern.c:150-183); operates on the raw flow-stats map value."""
+    import math
+
+    M = (1 << 64) - 1
+
+    def sat(x):
+        return min(x, 0xFFFFFFFF)
+
+    n = fs["pkt_count"]
+    mean = fs["byte_sum"] // n
+    var = max(fs["byte_sq_sum"] // n - (mean * mean & M), 0)
+    iat_n = max(n - 1, 1)
+    iat_mean_us = (fs["iat_sum_ns"] // iat_n) // 1000
+    iat_var = max(fs["iat_sq_sum_us2"] // iat_n - iat_mean_us * iat_mean_us, 0)
+    return [
+        fs["dst_port"], sat(mean), math.isqrt(var),
+        sat(var), sat(mean), sat(iat_mean_us),
+        math.isqrt(iat_var), sat(fs["iat_max_ns"] // 1000),
+    ]
+
+
+def _read_flow_stats(fsx: Fsx, fkey: int) -> dict:
+    raw = fsx.maps["flow_stats_map"].lookup(struct.pack("<I", fkey))
+    vals = struct.unpack("<8QH", raw[:66])
+    names = ("pkt_count", "byte_sum", "byte_sq_sum", "first_ts_ns",
+             "last_ts_ns", "iat_sum_ns", "iat_sq_sum_us2", "iat_max_ns",
+             "dst_port")
+    return dict(zip(names, vals))
+
+
+def test_feature_parity_with_map_state(fsx):
+    """Every emitted record's features must equal the pure-integer
+    derivation applied to the flow-stats map state — BPF vs Python
+    mirror, with real (uncontrolled) kernel timestamps."""
+    saddr, dport = 0x0F000001, 8080
+    rng = np.random.default_rng(7)
+    # the program XORs the dport as read off the wire (network order)
+    dport_be = ((dport & 0xFF) << 8) | (dport >> 8)
+    fkey = (saddr ^ (dport_be << 16)) & 0xFFFFFFFF
+    for i in range(12):
+        plen = int(rng.integers(60, 1400))
+        assert fsx.run(ip4_pkt(saddr, proto=17, dport=dport, plen=plen)) \
+            == XDP_PASS
+        fs = _read_flow_stats(fsx, fkey)
+        rec = fsx.records()
+        assert len(rec) == 1  # young flow: every packet emits
+        expected = _derive_mirror(fs)
+        got = rec["feat"][0].tolist()
+        assert got == expected, f"packet {i}: {got} != {expected}"
+
+
+def test_emit_gating_every_16th(fsx):
+    saddr = 0x10000001
+    for _ in range(40):
+        assert fsx.run(ip4_pkt(saddr)) == XDP_PASS
+    recs = fsx.records()
+    # packets 1..16 each emit; then only n % 16 == 0 (n=32) → 17 total
+    assert len(recs) == 17
+
+
+def test_ringbuf_reader_wraparound():
+    """More records than the ring holds: reserve fails → fail open
+    (packets still pass), reader never sees torn records."""
+    f = Fsx(progs.MapSizes(max_track_ips=1024, ring_bytes=1 << 12))
+    f.push_config()
+    for i in range(200):
+        assert f.run(ip4_pkt(0x11000000 + i)) == XDP_PASS  # new flow each
+    recs = f.records()
+    assert 0 < len(recs) <= 73  # 4096 / (8 hdr + 48) floor
+    assert all(r["pkt_len"] == 100 for r in recs)
+    # drain, run more, read again: cursor advances correctly after wrap
+    for i in range(100):
+        f.run(ip4_pkt(0x12000000 + i))
+    recs2 = f.records()
+    assert len(recs2) > 0
+
+
+# ---- cross-checks with the C layouts ---------------------------------
+
+
+def test_struct_offsets_match_generated_header(tmp_path):
+    """progs.py offset constants vs the C truth (gcc offsetof on the
+    codegen-generated kern/fsx_schema.h)."""
+    src = tmp_path / "offs.c"
+    src.write_text(
+        '#include <stdio.h>\n#include <stddef.h>\n'
+        '#define FSX_HOST_BUILD 1\n#include "fsx_schema.h"\n'
+        "int main(void){\n"
+        'printf("%zu %zu %zu %zu\\n", sizeof(struct fsx_config),'
+        " sizeof(struct fsx_ip_state), sizeof(struct fsx_flow_stats),"
+        " sizeof(struct fsx_flow_record));\n"
+        'printf("%zu %zu %zu\\n", offsetof(struct fsx_config, block_ns),'
+        " offsetof(struct fsx_ip_state, tokens_milli),"
+        " offsetof(struct fsx_flow_stats, dst_port));\n"
+        "return 0;}\n"
+    )
+    import pathlib
+    kern = pathlib.Path(__file__).resolve().parent.parent / "kern"
+    exe = tmp_path / "offs"
+    subprocess.run(["gcc", "-I", str(kern), str(src), "-o", str(exe)],
+                   check=True)
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         check=True).stdout.split()
+    assert [int(x) for x in out] == [
+        progs.CFG_SIZE, progs.IPS_SIZE, progs.FS_SIZE, progs.REC_SIZE,
+        progs.CFG_BLOCK_NS, progs.IPS_TOKENS_MILLI, progs.FS_DST_PORT,
+    ]
