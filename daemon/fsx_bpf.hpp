@@ -1,0 +1,356 @@
+// fsx_bpf.hpp — the daemon's kernel seam, with no libbpf dependency.
+//
+// Loads an FSXPROG image (emitted by flowsentryx_tpu/bpf/image.py from
+// the hand-assembled fast path) using raw bpf(2) syscalls: create maps,
+// patch map fds into the ld_imm64 relocation slots, PROG_LOAD through
+// the in-kernel verifier, optional XDP attach via BPF_LINK_CREATE, and
+// an mmap ringbuf consumer for the feature egress.  This is the same
+// kernel handshake libbpf's bpf_object__load performs on an ELF .o —
+// done first-party because this image has no clang to produce the .o
+// (docs/BPF_BUILD.md) and no libbpf-dev headers.
+//
+// The reference's intended control path was `bpftool prog load` +
+// pinning (/root/reference/TODO.md:282-289) and a BCC stub that never
+// ran (/root/reference/src/fsx_load.py:10-17); this header IS that
+// control path, working.
+
+#ifndef FSX_BPF_HPP
+#define FSX_BPF_HPP
+
+#include <cerrno>
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace fsxbpf {
+
+constexpr long SYS_bpf_nr = 321;  // x86_64
+
+// bpf(2) commands (kernel uapi, stable ABI)
+enum {
+    CMD_MAP_CREATE = 0,
+    CMD_MAP_LOOKUP_ELEM = 1,
+    CMD_MAP_UPDATE_ELEM = 2,
+    CMD_MAP_DELETE_ELEM = 3,
+    CMD_MAP_GET_NEXT_KEY = 4,
+    CMD_PROG_LOAD = 5,
+    CMD_OBJ_PIN = 6,
+    CMD_OBJ_GET = 7,
+    CMD_PROG_TEST_RUN = 10,
+    CMD_LINK_CREATE = 28,
+};
+
+enum { ATTACH_TYPE_XDP = 37 };
+enum { BPF_ANY_FLAG = 0 };
+
+inline long bpf(int cmd, void *attr, unsigned size) {
+    return ::syscall(SYS_bpf_nr, cmd, attr, size);
+}
+
+// union bpf_attr slices we use, packed to the uapi layout.
+struct MapCreateAttr {
+    uint32_t map_type, key_size, value_size, max_entries, map_flags;
+    uint32_t inner_map_fd, numa_node;
+    char map_name[16];
+    uint8_t pad[84];
+};
+struct ElemAttr {
+    uint32_t map_fd, _pad;
+    uint64_t key, value, flags;
+    uint8_t pad[96];
+};
+struct ProgLoadAttr {
+    uint32_t prog_type, insn_cnt;
+    uint64_t insns, license;
+    uint32_t log_level, log_size;
+    uint64_t log_buf;
+    uint32_t kern_version, prog_flags;
+    char prog_name[16];
+    uint8_t pad[60];
+};
+struct PinAttr {
+    uint64_t pathname;
+    uint32_t bpf_fd, file_flags;
+    uint8_t pad[108];
+};
+struct LinkCreateAttr {
+    uint32_t prog_fd, target_ifindex, attach_type, flags;
+    uint8_t pad[104];
+};
+static_assert(sizeof(MapCreateAttr) == 128, "attr layout");
+static_assert(sizeof(ElemAttr) == 128, "attr layout");
+// 124 bytes of fields, padded to 128 by alignment; the kernel accepts
+// oversize attrs with zeroed tails.
+static_assert(sizeof(ProgLoadAttr) == 128, "attr layout");
+static_assert(offsetof(fsxbpf::ProgLoadAttr, prog_name) == 48, "attr layout");
+
+inline int map_create(uint32_t type, uint32_t key, uint32_t value,
+                      uint32_t entries, const char *name) {
+    MapCreateAttr a{};
+    a.map_type = type;
+    a.key_size = key;
+    a.value_size = value;
+    a.max_entries = entries;
+    std::snprintf(a.map_name, sizeof(a.map_name), "%s", name);
+    return (int)bpf(CMD_MAP_CREATE, &a, sizeof(a));
+}
+
+inline int map_update(int fd, const void *key, const void *value,
+                      uint64_t flags = BPF_ANY_FLAG) {
+    ElemAttr a{};
+    a.map_fd = (uint32_t)fd;
+    a.key = (uint64_t)key;
+    a.value = (uint64_t)value;
+    a.flags = flags;
+    return (int)bpf(CMD_MAP_UPDATE_ELEM, &a, sizeof(a));
+}
+
+inline int map_lookup(int fd, const void *key, void *value) {
+    ElemAttr a{};
+    a.map_fd = (uint32_t)fd;
+    a.key = (uint64_t)key;
+    a.value = (uint64_t)value;
+    return (int)bpf(CMD_MAP_LOOKUP_ELEM, &a, sizeof(a));
+}
+
+inline int obj_pin(int fd, const std::string &path) {
+    PinAttr a{};
+    a.pathname = (uint64_t)path.c_str();
+    a.bpf_fd = (uint32_t)fd;
+    return (int)bpf(CMD_OBJ_PIN, &a, sizeof(a));
+}
+
+inline int obj_get(const std::string &path) {
+    PinAttr a{};
+    a.pathname = (uint64_t)path.c_str();
+    return (int)bpf(CMD_OBJ_GET, &a, sizeof(a));
+}
+
+inline int link_create_xdp(int prog_fd, int ifindex) {
+    LinkCreateAttr a{};
+    a.prog_fd = (uint32_t)prog_fd;
+    a.target_ifindex = (uint32_t)ifindex;
+    a.attach_type = ATTACH_TYPE_XDP;
+    return (int)bpf(CMD_LINK_CREATE, &a, sizeof(a));
+}
+
+// ---- FSXPROG image (flowsentryx_tpu/bpf/image.py layout) ------------
+
+constexpr uint64_t IMAGE_MAGIC = 0x31474F5250585346ULL;  // "FSXPROG1" LE
+
+struct ImageHeader {
+    uint64_t magic;
+    uint32_t version, n_maps, n_relocs, n_insns;
+} __attribute__((packed));
+
+struct ImageMapSpec {
+    char name[16];
+    uint32_t map_type, key_size, value_size, max_entries;
+} __attribute__((packed));
+
+struct ImageReloc {
+    uint32_t insn_slot, map_idx;
+} __attribute__((packed));
+
+struct LoadedProg {
+    int prog_fd = -1;
+    std::vector<int> map_fds;
+    std::vector<ImageMapSpec> map_specs;
+    std::string error;  // non-empty on failure (includes verifier log tail)
+
+    int map_fd(const std::string &name) const {
+        for (size_t i = 0; i < map_specs.size(); i++)
+            if (name == map_specs[i].name)
+                return map_fds[i];
+        return -1;
+    }
+    const ImageMapSpec *spec(const std::string &name) const {
+        for (size_t i = 0; i < map_specs.size(); i++)
+            if (name == map_specs[i].name)
+                return &map_specs[i];
+        return nullptr;
+    }
+};
+
+// Load an FSXPROG image: create maps, patch relocations, PROG_LOAD.
+// On verifier rejection, LoadedProg.error carries the log tail.
+inline LoadedProg load_image(const std::string &path) {
+    LoadedProg out;
+    FILE *f = std::fopen(path.c_str(), "rb");
+    if (!f) {
+        out.error = "open " + path + ": " + std::strerror(errno);
+        return out;
+    }
+    ImageHeader hdr{};
+    if (std::fread(&hdr, sizeof(hdr), 1, f) != 1 ||
+        hdr.magic != IMAGE_MAGIC || hdr.version != 1) {
+        out.error = "bad FSXPROG header in " + path;
+        std::fclose(f);
+        return out;
+    }
+    // Bound the untrusted counts BEFORE sizing allocations from them
+    // (a corrupt image must produce .error, not bad_alloc/terminate).
+    if (hdr.n_maps > 64 || hdr.n_relocs > 4096 ||
+        hdr.n_insns > 1'000'000) {
+        out.error = "implausible FSXPROG header counts in " + path;
+        std::fclose(f);
+        return out;
+    }
+    out.map_specs.resize(hdr.n_maps);
+    std::vector<ImageReloc> relocs(hdr.n_relocs);
+    std::vector<uint64_t> insns(hdr.n_insns);
+    bool ok =
+        std::fread(out.map_specs.data(), sizeof(ImageMapSpec), hdr.n_maps,
+                   f) == hdr.n_maps &&
+        std::fread(relocs.data(), sizeof(ImageReloc), hdr.n_relocs, f) ==
+            hdr.n_relocs &&
+        std::fread(insns.data(), 8, hdr.n_insns, f) == hdr.n_insns;
+    std::fclose(f);
+    if (!ok) {
+        out.error = "truncated FSXPROG image " + path;
+        return out;
+    }
+
+    // Every error return below must release created map fds so a
+    // retryable caller (try image A, then B) does not leak per attempt.
+    auto close_maps = [&out]() {
+        for (int mfd : out.map_fds)
+            ::close(mfd);
+        out.map_fds.clear();
+    };
+
+    for (const auto &m : out.map_specs) {
+        int fd = map_create(m.map_type, m.key_size, m.value_size,
+                            m.max_entries, m.name);
+        if (fd < 0) {
+            out.error = std::string("map_create ") + m.name + ": " +
+                        std::strerror(errno);
+            close_maps();
+            return out;
+        }
+        out.map_fds.push_back(fd);
+    }
+
+    // Patch each ld_imm64 relocation slot.  u64 LE layout: op=bits 0-7,
+    // dst=8-11, src=12-15, off=16-31, imm=32-63; set
+    // src=PSEUDO_MAP_FD(1), imm=fd.
+    for (const auto &r : relocs) {
+        if (r.insn_slot + 1 >= insns.size() ||
+            r.map_idx >= out.map_fds.size()) {
+            out.error = "bad relocation in image";
+            close_maps();
+            return out;
+        }
+        uint64_t slot = insns[r.insn_slot];
+        slot &= ~(0xFFFFFFFF00000000ULL | 0xF000ULL);
+        slot |= (uint64_t)1 << 12;
+        slot |= (uint64_t)(uint32_t)out.map_fds[r.map_idx] << 32;
+        insns[r.insn_slot] = slot;
+    }
+
+    static char log_buf[1 << 20];
+    ProgLoadAttr a{};
+    a.prog_type = 6;  // BPF_PROG_TYPE_XDP
+    a.insn_cnt = hdr.n_insns;
+    a.insns = (uint64_t)insns.data();
+    static const char lic[] = "GPL";
+    a.license = (uint64_t)lic;
+    a.log_level = 1;
+    a.log_size = sizeof(log_buf);
+    a.log_buf = (uint64_t)log_buf;
+    std::snprintf(a.prog_name, sizeof(a.prog_name), "fsx");
+    int fd = (int)bpf(CMD_PROG_LOAD, &a, sizeof(a));
+    if (fd < 0) {
+        std::string log(log_buf);
+        if (log.size() > 2000)
+            log = "..." + log.substr(log.size() - 2000);
+        out.error = std::string("PROG_LOAD: ") + std::strerror(errno) +
+                    "\nverifier log tail:\n" + log;
+        close_maps();
+        return out;
+    }
+    out.prog_fd = fd;
+    return out;
+}
+
+// ---- BPF ringbuf consumer (kernel mmap ABI; single consumer) --------
+//
+// Page 0: consumer pos (mapped RW, we advance it).  Page 1 onward
+// (mapped RO at offset PAGE): producer pos page, then the data area
+// mapped twice so records never wrap mid-read.  Record header: u32 len
+// with BUSY(1<<31)/DISCARD(1<<30) bits, u32 pgoff; stride rounds the
+// header+payload up to 8.  Mirrors flowsentryx_tpu/bpf/loader.py's
+// RingbufReader (the two implementations are cross-tested over the
+// same ring in tests/test_daemon.py).
+class RingbufConsumer {
+public:
+    bool open(int map_fd, uint32_t size_bytes) {
+        page_ = (size_t)::sysconf(_SC_PAGESIZE);
+        size_ = size_bytes;
+        cons_ = ::mmap(nullptr, page_, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       map_fd, 0);
+        if (cons_ == MAP_FAILED)
+            return false;
+        prod_ = ::mmap(nullptr, page_ + 2 * (size_t)size_, PROT_READ,
+                       MAP_SHARED, map_fd, (off_t)page_);
+        if (prod_ == MAP_FAILED) {
+            ::munmap(cons_, page_);
+            cons_ = nullptr;  // else the destructor double-unmaps
+            return false;
+        }
+        return true;
+    }
+
+    // Drain up to max_records; returns the number of records appended
+    // to out (records whose size != rec_size are skipped).
+    size_t drain(std::vector<uint8_t> &out, size_t rec_size,
+                 size_t max_records) {
+        auto *cons_pos = (volatile uint64_t *)cons_;
+        uint64_t pos = *cons_pos;
+        uint64_t prod = __atomic_load_n((uint64_t *)prod_, __ATOMIC_ACQUIRE);
+        size_t n = 0;
+        const uint8_t *data = (const uint8_t *)prod_ + page_;
+        while (pos < prod && n < max_records) {
+            uint32_t hdr = __atomic_load_n(
+                (const uint32_t *)(data + (pos & (size_ - 1))),
+                __ATOMIC_ACQUIRE);
+            if (hdr & (1u << 31))
+                break;  // BUSY: producer mid-commit
+            uint32_t len = hdr & ~((1u << 31) | (1u << 30));
+            if (!(hdr & (1u << 30)) && len == rec_size) {
+                const uint8_t *rec = data + (pos & (size_ - 1)) + 8;
+                out.insert(out.end(), rec, rec + len);
+                n++;
+            }
+            pos += (8 + len + 7) & ~7ULL;
+        }
+        __atomic_store_n((uint64_t *)cons_, pos, __ATOMIC_RELEASE);
+        return n;
+    }
+
+    ~RingbufConsumer() {
+        if (cons_ && cons_ != MAP_FAILED)
+            ::munmap(cons_, page_);
+        if (prod_ && prod_ != MAP_FAILED)
+            ::munmap(prod_, page_ + 2 * (size_t)size_);
+    }
+
+private:
+    void *cons_ = nullptr;
+    void *prod_ = nullptr;
+    size_t page_ = 0;
+    uint32_t size_ = 0;
+};
+
+}  // namespace fsxbpf
+
+#endif  // FSX_BPF_HPP

@@ -15,8 +15,12 @@
 //             "fake backend" of SURVEY.md §4) — drives integration tests
 //             and benches end-to-end over the real shm transport.
 //   --replay  stream fsx_flow_record arrays from a file (pcap-derived).
-//   --bpf     libbpf: real BPF ring + map (compiled only where libbpf
-//             exists; this image has no libbpf, so it is #ifdef-gated).
+//   --bpf     the real kernel seam (daemon/fsx_bpf.hpp, raw bpf(2), no
+//             libbpf needed): load the FSXPROG image of the assembled
+//             XDP fast path, push the config map, optionally attach to
+//             an interface and pin under /sys/fs/bpf, then drain the
+//             kernel feature ringbuf into the shm ring and apply
+//             engine verdicts to the blacklist map.
 //
 // Output: one JSON line on stdout at exit with counters; progress on
 // stderr.  The Python integration test asserts on the JSON.
@@ -33,6 +37,9 @@
 #include <unordered_map>
 #include <vector>
 
+#include <net/if.h>
+
+#include "fsx_bpf.hpp"
 #include "fsx_schema.h"
 #include "shm_ring.hpp"
 
@@ -60,6 +67,17 @@ struct Options {
     uint32_t n_attack_ips = 64;
     uint32_t n_benign_ips = 1024;
     uint64_t seed = 1;
+    // --bpf mode
+    std::string iface = "none";        // "none": load + drain, no attach
+    std::string prog_image = "kern/build/fsx_prog.img";
+    std::string pin_dir;               // e.g. /sys/fs/bpf/fsx ("" = off)
+    uint32_t limiter_kind = 0;         // FSX_LIMITER_*
+    uint64_t pps_threshold = 1000;     // fsx_kern.c:309 defaults
+    uint64_t bps_threshold = 125000000;
+    double window_s = 1.0;
+    double block_s = 10.0;
+    uint64_t bucket_rate_pps = 1000;
+    uint64_t bucket_burst = 2000;
 };
 
 [[noreturn]] void usage(const char *argv0) {
@@ -73,9 +91,188 @@ struct Options {
                  "  --duration S          stop after S seconds\n"
                  "  --attack-fraction F   sim attack share (default 0.8)\n"
                  "  --attack-ips N        sim attack pool (default 64)\n"
-                 "  --seed N              sim rng seed\n",
+                 "  --seed N              sim rng seed\n"
+                 "bpf mode (--bpf IFACE, or --bpf none to load without attach):\n"
+                 "  --prog-image PATH     FSXPROG image (default kern/build/fsx_prog.img;\n"
+                 "                        emit: python -m flowsentryx_tpu.bpf.image)\n"
+                 "  --pin DIR             pin prog+maps under DIR (bpffs, e.g. /sys/fs/bpf/fsx)\n"
+                 "  --limiter KIND        fixed|sliding|token (default fixed)\n"
+                 "  --pps-threshold N --bps-threshold N --window S --block S\n"
+                 "  --bucket-rate N --bucket-burst N\n",
                  argv0);
     std::exit(2);
+}
+
+// Per-CPU map lookups copy one value per POSSIBLE cpu into the user
+// buffer; undersizing it is a kernel write past the end (heap smash).
+// Parse list format ("0-3,5-7") by the highest id seen, and never
+// return less than the libc view of configured CPUs.
+uint32_t n_possible_cpus() {
+    long conf = ::sysconf(_SC_NPROCESSORS_CONF);
+    uint32_t best = conf > 0 ? (uint32_t)conf : 1;
+    FILE *f = std::fopen("/sys/devices/system/cpu/possible", "r");
+    if (!f)
+        return best;
+    char buf[256] = {0};
+    if (std::fgets(buf, sizeof(buf), f)) {
+        for (char *tok = std::strtok(buf, ","); tok;
+             tok = std::strtok(nullptr, ",")) {
+            const char *dash = std::strchr(tok, '-');
+            uint32_t hi = (uint32_t)std::strtoul(dash ? dash + 1 : tok,
+                                                 nullptr, 10);
+            if (hi + 1 > best)
+                best = hi + 1;
+        }
+    }
+    std::fclose(f);
+    return best;
+}
+
+// Aggregate the per-CPU stats map into one struct fsx_stats.
+fsx_stats read_stats(int stats_fd) {
+    fsx_stats total{};
+    uint32_t ncpu = n_possible_cpus();
+    std::vector<fsx_stats> per(ncpu);
+    uint32_t zero = 0;
+    if (fsxbpf::map_lookup(stats_fd, &zero, per.data()) == 0) {
+        for (const auto &s : per) {
+            total.allowed += s.allowed;
+            total.dropped_blacklist += s.dropped_blacklist;
+            total.dropped_rate += s.dropped_rate;
+            total.dropped_ml += s.dropped_ml;
+        }
+    }
+    return total;
+}
+
+// --bpf backend: the real kernel seam (jobs 1+2 of the header comment).
+int run_bpf(const Options &o) {
+    auto lp = fsxbpf::load_image(o.prog_image);
+    if (!lp.error.empty()) {
+        std::fprintf(stderr, "fsxd: bpf load failed: %s\n", lp.error.c_str());
+        return 1;
+    }
+    std::fprintf(stderr, "fsxd: program loaded through verifier (fd %d), %zu maps\n",
+                 lp.prog_fd, lp.map_fds.size());
+
+    // Push runtime policy into the config map (the capability the
+    // reference hard-coded at fsx_kern.c:308-310).
+    fsx_config cfg{};
+    cfg.limiter_kind = o.limiter_kind;
+    cfg.valid = 1;
+    cfg.pps_threshold = o.pps_threshold;
+    cfg.bps_threshold = o.bps_threshold;
+    cfg.window_ns = (uint64_t)(o.window_s * 1e9);
+    cfg.block_ns = (uint64_t)(o.block_s * 1e9);
+    cfg.bucket_rate_pps = o.bucket_rate_pps;
+    cfg.bucket_burst = o.bucket_burst;
+    uint32_t zero = 0;
+    if (fsxbpf::map_update(lp.map_fd("config_map"), &zero, &cfg) < 0) {
+        std::perror("fsxd: config_map update");
+        return 1;
+    }
+
+    int link_fd = -1;
+    if (o.iface != "none") {
+        unsigned ifindex = if_nametoindex(o.iface.c_str());
+        if (!ifindex) {
+            std::fprintf(stderr, "fsxd: unknown interface %s\n",
+                         o.iface.c_str());
+            return 1;
+        }
+        link_fd = fsxbpf::link_create_xdp(lp.prog_fd, (int)ifindex);
+        if (link_fd < 0) {
+            std::perror("fsxd: XDP link_create");
+            return 1;
+        }
+        std::fprintf(stderr, "fsxd: XDP attached to %s (ifindex %u)\n",
+                     o.iface.c_str(), ifindex);
+    }
+
+    if (!o.pin_dir.empty()) {
+        ::mkdir(o.pin_dir.c_str(), 0755);
+        if (fsxbpf::obj_pin(lp.prog_fd, o.pin_dir + "/prog") < 0)
+            std::perror("fsxd: pin prog");
+        for (size_t i = 0; i < lp.map_fds.size(); i++)
+            if (fsxbpf::obj_pin(lp.map_fds[i],
+                                o.pin_dir + "/" + lp.map_specs[i].name) < 0)
+                std::perror("fsxd: pin map");
+        std::fprintf(stderr, "fsxd: pinned under %s\n", o.pin_dir.c_str());
+    }
+
+    auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
+                                      sizeof(fsx_flow_record));
+    auto vring = fsx::ShmRing::create(o.verdict_ring, 1 << 14,
+                                      sizeof(fsx_verdict_record));
+
+    const fsxbpf::ImageMapSpec *rspec = lp.spec("feature_ring");
+    fsxbpf::RingbufConsumer rb;
+    if (!rspec || !rb.open(lp.map_fd("feature_ring"), rspec->max_entries)) {
+        std::fprintf(stderr, "fsxd: ringbuf mmap failed\n");
+        return 1;
+    }
+
+    int blacklist_fd = lp.map_fd("blacklist_map");
+    int stats_fd = lp.map_fd("stats_map");
+    uint64_t forwarded = 0, dropped_ring_full = 0, verdicts = 0;
+    std::vector<uint8_t> buf;
+    std::vector<fsx_verdict_record> vbatch(4096);
+    uint64_t t_start = now_ns(), next_report = t_start + 1'000'000'000ULL;
+
+    while (!g_stop) {
+        // 1. feature egress: kernel ringbuf → shm ring
+        buf.clear();
+        size_t n = rb.drain(buf, sizeof(fsx_flow_record), 4096);
+        if (n) {
+            uint64_t pushed = fring.produce(buf.data(), n);
+            dropped_ring_full += n - pushed;
+            forwarded += pushed;
+        }
+        // 2. verdict ingress: shm ring → blacklist map
+        uint64_t nv = vring.consume(vbatch.data(), vbatch.size());
+        for (uint64_t i = 0; i < nv; i++)
+            fsxbpf::map_update(blacklist_fd, &vbatch[i].saddr,
+                               &vbatch[i].until_ns);
+        verdicts += nv;
+
+        uint64_t t = now_ns();
+        if (o.duration_s > 0 &&
+            (t - t_start) > (uint64_t)(o.duration_s * 1e9))
+            break;
+        if (t >= next_report) {
+            fsx_stats s = read_stats(stats_fd);
+            std::fprintf(stderr,
+                         "fsxd: forwarded=%" PRIu64 " verdicts=%" PRIu64
+                         " allowed=%" PRIu64 " drop_bl=%" PRIu64
+                         " drop_rate=%" PRIu64 "\n",
+                         forwarded, verdicts, (uint64_t)s.allowed,
+                         (uint64_t)s.dropped_blacklist,
+                         (uint64_t)s.dropped_rate);
+            next_report = t + 1'000'000'000ULL;
+        }
+        if (n == 0 && nv == 0)
+            std::this_thread::sleep_for(std::chrono::microseconds(200));
+    }
+
+    // final verdict drain (mirrors the sim path's exit contract)
+    uint64_t extra = vring.consume(vbatch.data(), vbatch.size());
+    for (uint64_t i = 0; i < extra; i++)
+        fsxbpf::map_update(blacklist_fd, &vbatch[i].saddr,
+                           &vbatch[i].until_ns);
+    verdicts += extra;
+
+    fsx_stats s = read_stats(stats_fd);
+    std::printf("{\"produced\": %" PRIu64 ", \"verdicts\": %" PRIu64
+                ", \"dropped_ring_full\": %" PRIu64
+                ", \"allowed\": %" PRIu64 ", \"dropped_blacklist\": %" PRIu64
+                ", \"dropped_rate\": %" PRIu64 ", \"dropped_ml\": %" PRIu64
+                "}\n",
+                forwarded, verdicts, dropped_ring_full, (uint64_t)s.allowed,
+                (uint64_t)s.dropped_blacklist, (uint64_t)s.dropped_rate,
+                (uint64_t)s.dropped_ml);
+    if (link_fd >= 0)
+        ::close(link_fd);
+    return 0;
 }
 
 Options parse(int argc, char **argv) {
@@ -94,8 +291,29 @@ Options parse(int argc, char **argv) {
             o.replay_file = next();
         } else if (a == "--bpf") {
             o.mode = "bpf";
-            next();  // interface name (used by the libbpf build)
-        } else if (a == "--feature-ring")
+            o.iface = next();  // interface name, or "none" (no attach)
+        } else if (a == "--prog-image")
+            o.prog_image = next();
+        else if (a == "--pin")
+            o.pin_dir = next();
+        else if (a == "--limiter") {
+            std::string k = next();
+            o.limiter_kind = k == "sliding" ? FSX_LIMITER_SLIDING_WINDOW
+                             : k == "token" ? FSX_LIMITER_TOKEN_BUCKET
+                                            : FSX_LIMITER_FIXED_WINDOW;
+        } else if (a == "--pps-threshold")
+            o.pps_threshold = std::stoull(next());
+        else if (a == "--bps-threshold")
+            o.bps_threshold = std::stoull(next());
+        else if (a == "--window")
+            o.window_s = std::stod(next());
+        else if (a == "--block")
+            o.block_s = std::stod(next());
+        else if (a == "--bucket-rate")
+            o.bucket_rate_pps = std::stoull(next());
+        else if (a == "--bucket-burst")
+            o.bucket_burst = std::stoull(next());
+        else if (a == "--feature-ring")
             o.feature_ring = next();
         else if (a == "--verdict-ring")
             o.verdict_ring = next();
@@ -195,19 +413,8 @@ int main(int argc, char **argv) {
     std::signal(SIGINT, on_signal);
     std::signal(SIGTERM, on_signal);
 
-    if (o.mode == "bpf") {
-#ifdef FSX_HAVE_LIBBPF
-        // libbpf path: load kern/fsx_kern.o, attach XDP, drain the BPF
-        // feature ring into the shm ring, apply verdict-ring entries to
-        // blacklist_map via bpf_map_update_elem.  (Compiled only where
-        // libbpf headers exist; see daemon/README.md.)
-#else
-        std::fprintf(stderr,
-                     "fsxd: built without libbpf (FSX_HAVE_LIBBPF); "
-                     "--bpf unavailable. Use --sim or --replay.\n");
-        return 1;
-#endif
-    }
+    if (o.mode == "bpf")
+        return run_bpf(o);
 
     auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
                                       sizeof(fsx_flow_record));

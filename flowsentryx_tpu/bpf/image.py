@@ -1,0 +1,110 @@
+"""FSXPROG program image: the assembler→daemon hand-off format.
+
+A self-contained binary image of the assembled fsx program: map specs,
+relocation table, instructions.  The C++ daemon (daemon/fsx_bpf.hpp)
+loads it with raw bpf(2) syscalls — create maps, patch fds into the
+ld_imm64 relocation slots, PROG_LOAD — exactly the handshake libbpf
+performs on an ELF .o, minus the ELF/BTF container (which needs no
+compiler here; see docs/BPF_BUILD.md for the clang path on NIC hosts).
+
+Layout (little-endian):
+    u64 magic 'FSXPROG1'  u32 version  u32 n_maps  u32 n_relocs  u32 n_insns
+    maps[n_maps]:   char name[16], u32 map_type, u32 key_size,
+                    u32 value_size, u32 max_entries
+    relocs[n_relocs]: u32 insn_slot, u32 map_idx
+    insns:          n_insns * 8 bytes
+
+Regenerate with:  python -m flowsentryx_tpu.bpf.image [out.img]
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+from dataclasses import dataclass
+
+from flowsentryx_tpu.bpf import progs
+from flowsentryx_tpu.bpf.asm import Program
+
+MAGIC = int.from_bytes(b"FSXPROG1", "little")
+VERSION = 1
+_HDR = struct.Struct("<QIIII")
+_MAP = struct.Struct("<16sIIII")
+_REL = struct.Struct("<II")
+
+
+@dataclass(frozen=True)
+class ImageMap:
+    name: str
+    map_type: int
+    key_size: int
+    value_size: int
+    max_entries: int
+
+
+def emit(prog: Program | None = None,
+         sizes: progs.MapSizes = progs.MapSizes()) -> bytes:
+    """Serialize the fsx program (or a custom one) to an image blob."""
+    prog = prog or progs.build()
+    names = prog.map_names
+    specs = []
+    for name in names:
+        mtype, ks, vs, ent = progs.MAP_SPECS[name]
+        n = {"one": 1, "ips": sizes.max_track_ips,
+             "ring": sizes.ring_bytes}[ent]
+        specs.append(ImageMap(name, mtype, ks, vs, n))
+    out = [_HDR.pack(MAGIC, VERSION, len(specs), len(prog.relocs),
+                     len(prog.insns))]
+    for m in specs:
+        out.append(_MAP.pack(m.name.encode()[:15].ljust(16, b"\0"),
+                             m.map_type, m.key_size, m.value_size,
+                             m.max_entries))
+    idx = {n: i for i, n in enumerate(names)}
+    for r in prog.relocs:
+        out.append(_REL.pack(r.slot, idx[r.map_name]))
+    for insn in prog.insns:
+        out.append(insn.pack())
+    return b"".join(out)
+
+
+def parse(blob: bytes) -> tuple[list[ImageMap], list[tuple[int, int]], bytes]:
+    """Inverse of emit (used by tests to cross-check the C++ reader)."""
+    magic, ver, n_maps, n_relocs, n_insns = _HDR.unpack_from(blob, 0)
+    if magic != MAGIC or ver != VERSION:
+        raise ValueError("bad FSXPROG image")
+    off = _HDR.size
+    maps = []
+    for _ in range(n_maps):
+        nm, mt, ks, vs, me = _MAP.unpack_from(blob, off)
+        maps.append(ImageMap(nm.rstrip(b"\0").decode(), mt, ks, vs, me))
+        off += _MAP.size
+    relocs = []
+    for _ in range(n_relocs):
+        relocs.append(_REL.unpack_from(blob, off))
+        off += _REL.size
+    insns = blob[off: off + 8 * n_insns]
+    if len(insns) != 8 * n_insns:
+        raise ValueError("truncated FSXPROG image")
+    return maps, relocs, insns
+
+
+def main(argv: list[str]) -> int:
+    out = argv[1] if len(argv) > 1 else "kern/build/fsx_prog.img"
+    import pathlib
+
+    # Test-scale map sizing via flags: --track-ips N --ring-bytes N
+    kw = {}
+    for a in argv[2:]:
+        if a.startswith("--track-ips="):
+            kw["max_track_ips"] = int(a.split("=")[1])
+        elif a.startswith("--ring-bytes="):
+            kw["ring_bytes"] = int(a.split("=")[1])
+    blob = emit(sizes=progs.MapSizes(**kw))
+    pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(out).write_bytes(blob)
+    print(f"wrote {out}: {len(blob)} bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -191,10 +191,22 @@ class Map:
         attr = struct.pack("<QI", ctypes.addressof(pb), self.fd) + b"\0" * 108
         _bpf(CMD_OBJ_PIN, attr)
 
+    @staticmethod
+    def obj_get(path: str) -> int:
+        """Open a pinned BPF object (map or prog); returns its fd."""
+        return obj_get(path)
+
     def close(self) -> None:
         if self.fd >= 0:
             os.close(self.fd)
             self.fd = -1
+
+
+def obj_get(path: str) -> int:
+    """Open a pinned BPF object (map or prog) from bpffs; returns fd."""
+    pb = ctypes.create_string_buffer(path.encode())
+    attr = struct.pack("<QI", ctypes.addressof(pb), 0) + b"\0" * 116
+    return _bpf(CMD_OBJ_GET, attr)
 
 
 def map_create(map_type: int, key_size: int, value_size: int,

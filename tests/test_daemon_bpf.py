@@ -1,0 +1,157 @@
+"""fsxd --bpf: the real kernel seam, end-to-end across processes.
+
+The daemon loads the FSXPROG image of the hand-assembled fast path
+through the in-kernel verifier, pins the program and maps under bpffs,
+drains the kernel feature ringbuf into the shm ring, and applies
+engine verdicts from the verdict shm ring to the kernel blacklist map.
+This test plays the other two roles: the NIC (BPF_PROG_TEST_RUN with
+crafted packets against the pinned program) and the TPU engine (shm
+consumer + verdict producer).
+
+Covers VERDICT.md round-1 items 2 (the daemon's kernel-facing half) and
+3 (a verifier-accepted program) with live evidence rather than
+compile-gated stubs.  The reference's corresponding path was
+`bpftool prog load` typed by hand (/root/reference/TODO.md:282-289).
+"""
+
+from __future__ import annotations
+
+
+import os
+import pathlib
+import struct
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.bpf import loader
+
+pytestmark = pytest.mark.skipif(
+    not loader.bpf_available(), reason="bpf(2) not permitted in this container"
+)
+
+from flowsentryx_tpu.core import schema  # noqa: E402
+from flowsentryx_tpu.engine.shm import ShmRing  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FSXD = REPO / "daemon" / "build" / "fsxd"
+PIN_DIR = "/sys/fs/bpf/fsx_pytest"
+
+
+def _bpffs_ready() -> bool:
+    if os.path.isdir("/sys/fs/bpf") and os.access("/sys/fs/bpf", os.W_OK):
+        # a mounted bpffs accepts pins; probe cheaply
+        m = loader.map_create(loader.MAP_TYPE_ARRAY, 4, 8, 1, "probe")
+        try:
+            m.pin("/sys/fs/bpf/fsx_probe")
+            os.unlink("/sys/fs/bpf/fsx_probe")
+            return True
+        except (loader.BpfError, OSError):
+            subprocess.run(["mount", "-t", "bpf", "bpf", "/sys/fs/bpf"],
+                           capture_output=True)
+            try:
+                m.pin("/sys/fs/bpf/fsx_probe")
+                os.unlink("/sys/fs/bpf/fsx_probe")
+                return True
+            except (loader.BpfError, OSError):
+                return False
+        finally:
+            m.close()
+    return False
+
+
+obj_get = loader.obj_get
+
+
+def ip4(saddr: int, plen: int = 100) -> bytes:
+    eth = b"\x02" * 6 + b"\x04" * 6 + b"\x08\x00"
+    hdr = bytes([0x45, 0]) + struct.pack(">H", plen - 14) + b"\x00" * 4
+    hdr += bytes([64, 17]) + b"\x00\x00" + struct.pack("<I", saddr)
+    hdr += b"\x01\x02\x03\x04"
+    udp = struct.pack(">HHHH", 1234, 53, plen - 34, 0)
+    p = eth + hdr + udp
+    return p + b"X" * (plen - len(p))
+
+
+@pytest.fixture(scope="module")
+def fsxd_bin():
+    r = subprocess.run(["make", "-C", str(REPO / "daemon")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, f"daemon build failed:\n{r.stdout}\n{r.stderr}"
+    return FSXD
+
+
+@pytest.fixture(scope="module")
+def prog_image(tmp_path_factory):
+    out = tmp_path_factory.mktemp("img") / "fsx_prog.img"
+    r = subprocess.run(
+        ["python", "-m", "flowsentryx_tpu.bpf.image", str(out),
+         "--track-ips=1024", "--ring-bytes=16384"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0, r.stderr
+    return out
+
+
+def test_daemon_bpf_end_to_end(fsxd_bin, prog_image, tmp_path):
+    if not _bpffs_ready():
+        pytest.skip("bpffs not mountable in this container")
+    subprocess.run(["rm", "-rf", PIN_DIR], check=False)
+
+    fring_path = tmp_path / "fring"
+    vring_path = tmp_path / "vring"
+    proc = subprocess.Popen(
+        [str(fsxd_bin), "--bpf", "none", "--prog-image", str(prog_image),
+         "--pin", PIN_DIR, "--duration", "12",
+         "--feature-ring", str(fring_path), "--verdict-ring", str(vring_path),
+         "--pps-threshold", "5", "--window", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 5
+        while not os.path.exists(f"{PIN_DIR}/prog"):
+            assert time.time() < deadline, \
+                f"daemon never pinned:\n{proc.stderr.read() if proc.poll() else ''}"
+            time.sleep(0.1)
+        prog_fd = obj_get(f"{PIN_DIR}/prog")
+
+        # NIC role: flood from one source → kernel limiter blocks at 6
+        flood = [loader.prog_test_run(prog_fd, ip4(0xC0A80001))[0]
+                 for _ in range(10)]
+        assert flood == [2] * 5 + [1] * 5  # 5 PASS, then rate+blacklist
+
+        # benign sources
+        for i in range(5):
+            assert loader.prog_test_run(prog_fd, ip4(0x0A000100 + i))[0] == 2
+
+        # engine role, feature ingress: daemon must forward kernel
+        # ringbuf records into the shm ring
+        time.sleep(1.5)
+        ring = ShmRing(fring_path, schema.FLOW_RECORD_DTYPE)
+        arr = ring.consume(100)
+        assert len(arr) == 10  # 5 flood-allowed + 5 benign
+        assert {0x0A000100 + i for i in range(5)} <= set(arr["saddr"].tolist())
+
+        # engine role, verdict egress: ML-blacklist a benign source
+        vring = ShmRing(vring_path, schema.VERDICT_RECORD_DTYPE)
+        v = np.zeros(1, dtype=schema.VERDICT_RECORD_DTYPE)
+        v["saddr"] = 0x0A000100
+        v["until_ns"] = time.clock_gettime_ns(time.CLOCK_MONOTONIC) + int(5e9)
+        vring.produce(v)
+        deadline = time.time() + 3
+        while time.time() < deadline:
+            if loader.prog_test_run(prog_fd, ip4(0x0A000100))[0] == 1:
+                break
+            time.sleep(0.1)
+        assert loader.prog_test_run(prog_fd, ip4(0x0A000100))[0] == 1, \
+            "verdict never reached the kernel blacklist map"
+    finally:
+        proc.terminate()
+        out, err = proc.communicate(timeout=10)
+        subprocess.run(["rm", "-rf", PIN_DIR], check=False)
+    # exit JSON: the daemon observed the forwarding + verdict
+    import json
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["produced"] >= 10
+    assert stats["verdicts"] == 1
+    assert stats["dropped_rate"] >= 1
