@@ -33,6 +33,11 @@ def save_state(
     """Snapshot serving state.  Arrays are fetched from device (the one
     deliberate D2H of the engine's lifetime)."""
     path = Path(path)
+    # np.savez silently appends .npz to a suffix-less path; normalize so
+    # the returned path is the file actually written (same contract as
+    # models.logreg._npz_path).
+    if path.suffix != ".npz":
+        path = path.with_suffix(path.suffix + ".npz")
     np.savez_compressed(
         path,
         **{f"table_{k}": np.asarray(v) for k, v in table._asdict().items()},

@@ -84,6 +84,12 @@ class Engine:
         # be ~1e6 s, where f32 spacing is far too coarse for 1 s
         # windows — anchoring near the stream start keeps µs precision).
         self._t0_auto = t0_ns is None
+        # An explicit t0 must also anchor the sink (the auto-t0 and
+        # restore() paths already do this); otherwise a ShmVerdictSink
+        # stays at t0_ns=0 and emits until_ns values ~t0 in the past,
+        # so the daemon/kernel blacklist never fires.
+        if t0_ns is not None and hasattr(sink, "t0_ns"):
+            sink.t0_ns = t0_ns
         self.metrics = PipelineMetrics()
         self._inflight: list[_InFlight] = []
         self._blocked: set[int] = set()
@@ -188,6 +194,12 @@ class Engine:
                 if self.batcher.fill:
                     self._dispatch(self.batcher.take(), self.batcher.pop_seal_time())
                 break
+            if not sealed and not len(records) and not self._inflight:
+                # Idle link: back off instead of spinning poll() at 100%
+                # CPU (the daemon sleeps 200 µs in its analogous case).
+                # A fraction of the batch deadline keeps added latency
+                # well under the flush budget.
+                time.sleep(min(cfg_b.deadline_us / 4, 200) / 1e6)
 
         self._reap(0)
         wall = time.perf_counter() - t_start
