@@ -33,7 +33,11 @@
 
 namespace fsxbpf {
 
-constexpr long SYS_bpf_nr = 321;  // x86_64
+#ifdef SYS_bpf
+constexpr long SYS_bpf_nr = SYS_bpf;  // arch-correct (x86_64=321, aarch64=280)
+#else
+constexpr long SYS_bpf_nr = 321;  // x86_64 fallback for odd libcs
+#endif
 
 // bpf(2) commands (kernel uapi, stable ABI)
 enum {
@@ -244,7 +248,9 @@ inline LoadedProg load_image(const std::string &path) {
     // dst=8-11, src=12-15, off=16-31, imm=32-63; set
     // src=PSEUDO_MAP_FD(1), imm=fd.
     for (const auto &r : relocs) {
-        if (r.insn_slot + 1 >= insns.size() ||
+        // Compare in 64-bit: insn_slot=0xFFFFFFFF would wrap a u32
+        // `insn_slot + 1` to 0 and slip past the bound.
+        if ((uint64_t)r.insn_slot + 1 >= insns.size() ||
             r.map_idx >= out.map_fds.size()) {
             out.error = "bad relocation in image";
             close_maps();

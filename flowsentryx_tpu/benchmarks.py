@@ -124,8 +124,8 @@ def _source_quality(gen_spec: TrafficSpec, blocked: set[int]) -> dict:
     seed reproduces the exact IP pools, giving ground truth without
     retaining per-packet labels."""
     gen = TrafficGen(gen_spec)
-    attack = set(int(k) for k in gen._attack_ips)
-    benign = set(int(k) for k in gen._benign_ips)
+    attack = set(int(k) for k in gen.attack_ips)
+    benign = set(int(k) for k in gen.benign_ips)
     tp = len(blocked & attack)
     fp = len(blocked & benign)
     fn = len(attack - blocked)

@@ -89,16 +89,26 @@ def parse(blob: bytes) -> tuple[list[ImageMap], list[tuple[int, int]], bytes]:
 
 
 def main(argv: list[str]) -> int:
-    out = argv[1] if len(argv) > 1 else "kern/build/fsx_prog.img"
     import pathlib
 
-    # Test-scale map sizing via flags: --track-ips N --ring-bytes N
+    # Flags may appear anywhere; the first non-flag argument is the
+    # output path (so `... --track-ips=64` is never mistaken for a path).
+    out = None
     kw = {}
-    for a in argv[2:]:
+    for a in argv[1:]:
         if a.startswith("--track-ips="):
             kw["max_track_ips"] = int(a.split("=")[1])
         elif a.startswith("--ring-bytes="):
             kw["ring_bytes"] = int(a.split("=")[1])
+        elif a.startswith("--"):
+            print(f"unknown flag: {a}", file=sys.stderr)
+            return 2
+        elif out is not None:
+            print(f"multiple output paths: {out!r} and {a!r}", file=sys.stderr)
+            return 2
+        else:
+            out = a
+    out = out or "kern/build/fsx_prog.img"
     blob = emit(sizes=progs.MapSizes(**kw))
     pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
     pathlib.Path(out).write_bytes(blob)

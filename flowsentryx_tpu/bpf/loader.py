@@ -22,12 +22,19 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import platform
 import struct
 from dataclasses import dataclass
 
 from flowsentryx_tpu.bpf.asm import Program
 
-_SYS_BPF = 321  # x86_64
+_SYS_BPF = {  # bpf(2) syscall number is per-architecture
+    "x86_64": 321,
+    "aarch64": 280,
+    "riscv64": 280,
+    "s390x": 351,
+    "ppc64le": 361,
+}.get(platform.machine(), 321)
 _libc = ctypes.CDLL(None, use_errno=True)
 
 # ---- commands ----

@@ -13,12 +13,36 @@ the C++ side's release stores.
 from __future__ import annotations
 
 import mmap
+import platform
 import time
 from pathlib import Path
 
 import numpy as np
 
 from flowsentryx_tpu.core import schema
+
+# The cursor protocol below publishes with plain u64 loads/stores and
+# relies on the total-store-order guarantee of x86 (a numpy scalar store
+# is a single MOV; the record memcpy precedes the cursor store in
+# program order and TSO forbids store-store reordering).  On weakly
+# ordered ISAs (aarch64, riscv) that ordering is NOT guaranteed and a
+# consumer could observe the new cursor before the record bytes —
+# silent corruption.  Refuse loudly rather than corrupt quietly; the
+# C++ daemon side uses real release/acquire atomics and is portable.
+# Note: no i686 — x86-TSO holds there, but a numpy u64 store is two
+# 32-bit stores on 32-bit x86, so the single-MOV premise breaks.
+_TSO_ARCHS = {"x86_64", "AMD64"}
+
+
+def _require_tso() -> None:
+    m = platform.machine()
+    if m not in _TSO_ARCHS:
+        raise RuntimeError(
+            f"ShmRing's plain-store cursor protocol requires x86-TSO; "
+            f"machine is {m!r}. Port note: replace the cursor accesses "
+            f"with atomic release/acquire (e.g. via a tiny C extension) "
+            f"before enabling this transport on weakly ordered ISAs."
+        )
 
 
 class RingNotReady(Exception):
@@ -30,6 +54,7 @@ class ShmRing:
     """One mapped ring.  ``role`` is "consumer" or "producer"."""
 
     def __init__(self, path: str | Path, expect_record: np.dtype):
+        _require_tso()
         self.path = Path(path)
         with open(self.path, "r+b") as f:
             self._mm = mmap.mmap(f.fileno(), 0)

@@ -27,6 +27,14 @@
 })
 #else
 #define FSX_CINLINE static __always_inline
+/* TOOLCHAIN REQUIREMENT: a *fetch*-and-add (one that uses the return
+ * value) compiles to BPF_ATOMIC | BPF_FETCH, which needs clang >= 12 to
+ * emit and kernel >= 5.12 to verify (older verifiers reject the fetch
+ * form; plain BPF_XADD is ancient and fine).  The in-repo assembler
+ * (flowsentryx_tpu/bpf/progs.py) emits the same fetch form, so the
+ * runtime kernel floor is 5.12 either way.  On older kernels, fall back
+ * to a plain add and a separate racy read — acceptable only for the
+ * stats counters, not for the limiter window cursors. */
 #define fsx_atomic_add(p, v) __sync_fetch_and_add((p), (v))
 #endif
 

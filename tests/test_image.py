@@ -1,0 +1,54 @@
+"""The checked-in FSXPROG image must stay in lockstep with the assembler.
+
+Round-2 advisor finding: the committed image had been emitted at test
+scale (1024-entry maps, 16 KB ring), so a production ``fsxd --bpf``
+silently tracked only 1024 source IPs.  This pins the artifact to
+``image.emit()`` at deploy-scale defaults (MapSizes: 1M IPs, 4 MB ring).
+
+Pure userspace — no bpf(2) needed, runs everywhere.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from flowsentryx_tpu.bpf import image, progs
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+IMG = REPO / "kern" / "build" / "fsx_prog.img"
+
+
+def test_checked_in_image_matches_deploy_scale_emit():
+    assert IMG.exists(), "kern/build/fsx_prog.img missing — run python -m flowsentryx_tpu.bpf.image"
+    assert IMG.read_bytes() == image.emit(sizes=progs.MapSizes()), (
+        "checked-in image differs from image.emit() at deploy-scale "
+        "defaults — regenerate with: python -m flowsentryx_tpu.bpf.image "
+        "kern/build/fsx_prog.img"
+    )
+
+
+def test_deploy_scale_map_sizes():
+    maps, _, _ = image.parse(IMG.read_bytes())
+    by_name = {m.name: m for m in maps}
+    assert by_name["blacklist_map"].max_entries == 1 << 20
+    assert by_name["ip_state_map"].max_entries == 1 << 20
+    assert by_name["feature_ring"].max_entries == 1 << 22
+
+
+def test_cli_flag_anywhere(tmp_path):
+    """--track-ips must size the maps wherever it appears on the command
+    line, and never be mistaken for an output path (round-2 advisor:
+    flags were only parsed from argv[2:])."""
+    for order in (["{out}", "--track-ips=64"], ["--track-ips=64", "{out}"]):
+        out = tmp_path / f"t{order[0][:2]}.img"
+        rc = image.main(["image"] + [a.format(out=out) for a in order])
+        assert rc == 0
+        maps, _, _ = image.parse(out.read_bytes())
+        assert {m.name: m for m in maps}["blacklist_map"].max_entries == 64
+    assert not pathlib.Path("--track-ips=64").exists()  # no stray CWD file
+
+
+def test_cli_rejects_bad_args(tmp_path):
+    assert image.main(["image", "--frob=1"]) == 2
+    assert image.main(["image", str(tmp_path / "a"), str(tmp_path / "b")]) == 2
+    assert not (tmp_path / "a").exists() and not (tmp_path / "b").exists()
