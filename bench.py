@@ -143,10 +143,10 @@ def _setup(donate: bool, side: Sidecar):
               at_s=round(time.perf_counter() - T_START, 1))
     t0 = time.perf_counter()
     dev = jax.devices()[0]
+    init_s = round(time.perf_counter() - t0, 1)
     side.emit("device", backend=dev.platform, device_kind=dev.device_kind,
-              init_s=round(time.perf_counter() - t0, 1))
-    log(f"device: {dev.platform}/{dev.device_kind} "
-        f"(init {time.perf_counter() - t0:.1f}s)")
+              init_s=init_s)
+    log(f"device: {dev.platform}/{dev.device_kind} (init {init_s:.1f}s)")
 
     cfg = FsxConfig(
         table=TableConfig(capacity=TABLE_CAP), batch=BatchConfig(max_batch=B)
@@ -160,7 +160,7 @@ def _setup(donate: bool, side: Sidecar):
         schema.encode_raw(b, B, t0_ns=0)
         for b in make_raw_batches(16, B, n_ips=1 << 20)
     ]
-    return jax, schema, cfg, params, step, table, stats, raws
+    return jax, schema, cfg, params, step, table, stats, raws, init_s
 
 
 def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
@@ -170,7 +170,7 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
     as many as fit before the deadline; every chunk checkpoints to the
     sidecar so a mid-phase stall still leaves a measurable median."""
     deadline = time.perf_counter() + deadline_rel
-    jax, schema, cfg, params, step, table, stats, raws = _setup(True, side)
+    jax, schema, cfg, params, step, table, stats, raws, init_s = _setup(True, side)
     dev = jax.devices()[0]
 
     t0 = time.perf_counter()
@@ -183,7 +183,7 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
     result = {
         "mpps": 0.0, "chunk_mpps": [], "iters": 0,
         "compile_s": compile_s, "backend": dev.platform,
-        "device_kind": dev.device_kind,
+        "device_kind": dev.device_kind, "init_s": init_s,
     }
 
     # Probe chunk: small, times a single dispatch round trip.
@@ -238,7 +238,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     include that degradation plus the tunnel sync floor, both absent on
     locally attached hardware."""
     deadline = time.perf_counter() + deadline_rel
-    jax, schema, cfg, params, step, table, stats, raws = _setup(False, side)
+    jax, schema, cfg, params, step, table, stats, raws, init_s = _setup(False, side)
     dev = jax.devices()[0]
 
     table, stats, out = step(table, stats, params, raws[0])
@@ -279,6 +279,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     result = {
         "sync_floor_ms": sync_floor_ms,
         "n_lat_iters": len(lats),
+        "init_s": init_s,
         "stats": st.to_dict(),
     }
     if lats:  # an empty sample is "missing", never "0 ms" (a fake pass)
@@ -314,6 +315,10 @@ def _recover_sidecar(path: str) -> dict | None:
             return {**rec, "partial": False}
         if kind == "chunk":
             chunks.append(rec["mpps"])
+        elif kind == "init":
+            # Post-mortem trail: which init stage the child reached
+            # (import_jax vs devices_call) and when.
+            out.setdefault("init_stages", []).append(rec)
         elif kind in ("device", "compile", "sync_floor", "lat_partial"):
             out.update(rec)
     if chunks:
@@ -546,13 +551,16 @@ def main() -> int:
         # the final JSON always lands inside the budget ceiling.  Run on
         # the backend that actually produced the throughput number: if
         # TPU init wedged there, don't pay the wedge again here.
-        lat_cpu = (detail.get("backend") == "cpu" or forced_cpu
-                   or any(a.get("wedged") for a in init_attempts))
+        # backend unset means nothing measured — default the latency
+        # phase to CPU rather than paying a likely TPU wedge again.
+        lat_cpu = forced_cpu or detail.get("backend", "cpu") == "cpu"
         lat_budget = remaining() - 30
         if lat_budget > 45:
             lat = _run_phase("latency", lat_budget, force_cpu=lat_cpu,
                              init_deadline=None if lat_cpu
                              else min(240.0, 0.6 * lat_budget)) or {}
+            detail["latency_backend"] = "cpu" if lat_cpu else \
+                lat.get("backend", detail.get("backend"))
             # Copy only what the (possibly partial) phase measured; an
             # absent p50/p99 stays absent rather than becoming 0.0.
             for key, nd in (("p50_ms", 3), ("p99_ms", 3),

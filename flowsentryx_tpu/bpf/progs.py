@@ -103,7 +103,7 @@ ST_SIZE = 32
 FLAG_IPV6, FLAG_TCP_SYN, FLAG_TCP, FLAG_UDP, FLAG_ICMP = 1, 2, 4, 8, 16
 FSX_TCP_SYN = 0x02  # tcp header flags byte (kern/parsing.h:187)
 
-IPPROTO_ICMP, IPPROTO_TCP, IPPROTO_UDP = 1, 6, 17
+IPPROTO_ICMP, IPPROTO_TCP, IPPROTO_UDP, IPPROTO_ICMPV6 = 1, 6, 17, 58
 
 # ---- stack frame layout (r10-relative; eBPF allows [-512, 0)) ----
 S_KEY = -4          # u32: zero key, then saddr key for hash maps
@@ -287,6 +287,7 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     a.jmp_imm(BPF_JEQ, R1, IPPROTO_TCP, "tcp")
     a.jmp_imm(BPF_JEQ, R1, IPPROTO_UDP, "udp")
     a.jmp_imm(BPF_JEQ, R1, IPPROTO_ICMP, "icmp")
+    a.jmp_imm(BPF_JEQ, R1, IPPROTO_ICMPV6, "icmp")  # same 8 B fixed hdr
     a.ja("parsed")  # other L4: L3 info is enough (parsing.h:262-263)
 
     a.label("tcp")  # parsing.h:165-184
@@ -307,9 +308,9 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     a += stx(BPF_DW, R10, S_DPORT, R1)
     a.ja("parsed")
 
-    a.label("icmp")  # parsing.h:211-220
+    a.label("icmp")  # parsing.h:211-220 (v4) / :232-247 (v6, same size)
     a += mov64(R4, R5)
-    a += alu64_imm(BPF_ADD, R4, 8)  # sizeof(icmphdr)
+    a += alu64_imm(BPF_ADD, R4, 8)  # sizeof(icmphdr) == sizeof(icmp6hdr)
     a.jmp_reg(BPF_JGT, R4, R3, "drop")
 
     # ---- blacklist gate with TTL expiry (fsx_kern.c:222-233) ---------
@@ -678,7 +679,9 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     a += alu64_imm(BPF_OR, R3, FLAG_UDP)
     a.ja("fl_done")
     a.label("fl_chk_icmp")
-    a.jmp_imm(BPF_JNE, R1, IPPROTO_ICMP, "fl_done")
+    a.jmp_imm(BPF_JEQ, R1, IPPROTO_ICMP, "fl_icmp")
+    a.jmp_imm(BPF_JNE, R1, IPPROTO_ICMPV6, "fl_done")
+    a.label("fl_icmp")
     a += alu64_imm(BPF_OR, R3, FLAG_ICMP)
     a.label("fl_done")
     a += stx(BPF_B, R2, REC_FLAGS, R3)

@@ -170,7 +170,8 @@ static __always_inline void extract_features(
 		rec->flags = (pkt->is_ipv6 ? FSX_FLAG_IPV6 : 0)
 			| (pkt->l4_proto == IPPROTO_TCP ? FSX_FLAG_TCP : 0)
 			| (pkt->l4_proto == IPPROTO_UDP ? FSX_FLAG_UDP : 0)
-			| (pkt->l4_proto == IPPROTO_ICMP ? FSX_FLAG_ICMP : 0)
+			| (pkt->l4_proto == IPPROTO_ICMP
+			   || pkt->l4_proto == IPPROTO_ICMPV6 ? FSX_FLAG_ICMP : 0)
 			| ((pkt->tcp_flags & FSX_TCP_SYN) ? FSX_FLAG_TCP_SYN : 0);
 		rec->feat[0] = fs->dst_port;
 		rec->feat[1] = fsx_sat_u32(mean);

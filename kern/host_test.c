@@ -158,6 +158,33 @@ static void test_parse_ip6(void)
 	CHECK(pkt.saddr == (w[0] ^ w[1] ^ w[2] ^ w[3]), "ip6 fold");
 }
 
+static void test_parse_icmp6(void)
+{
+	unsigned char buf[128];
+	size_t off = build_eth(buf, 0x86DD);
+	unsigned char *ip6 = buf + off;
+
+	memset(ip6, 0, 40);
+	ip6[0] = 0x60;
+	ip6[6] = 58;                   /* next header: ICMPv6 */
+	ip6[7] = 64;
+	for (int i = 0; i < 16; i++)
+		ip6[8 + i] = 0x20 + i;
+	off += 40;
+	memset(buf + off, 0, 8);
+	buf[off] = 128;                /* echo request */
+	struct fsx_pkt pkt;
+
+	/* full icmp6 header present: parses with proto 58 */
+	CHECK(fsx_parse_packet(buf, buf + off + 8, &pkt) == 0, "icmp6 parses");
+	CHECK(pkt.l4_proto == IPPROTO_ICMPV6, "icmp6 proto 58");
+	CHECK(pkt.is_ipv6 == 1, "icmp6 is ipv6");
+	CHECK(pkt.sport == 0 && pkt.dport == 0, "icmp6 no ports");
+	/* truncated icmp6 header must refuse, not read OOB */
+	CHECK(fsx_parse_packet(buf, buf + off + 4, &pkt) < 0,
+	      "truncated icmp6 -> drop");
+}
+
 /* ---- limiter tests (mirror tests/test_ops.py semantics) ---------------- */
 
 static struct fsx_config mkcfg(void)
@@ -291,6 +318,7 @@ int main(void)
 	test_truncated_drops();
 	test_non_ip_passes();
 	test_parse_ip6();
+	test_parse_icmp6();
 	test_fixed_window();
 	test_sliding_window();
 	test_token_bucket();

@@ -30,6 +30,7 @@
 #include <netinet/tcp.h>      /* struct tcphdr */
 #include <netinet/udp.h>      /* struct udphdr */
 #include <netinet/ip_icmp.h>  /* struct icmphdr */
+#include <netinet/icmp6.h>    /* struct icmp6_hdr */
 #define fsx_htons(x) __builtin_bswap16(x)
 #define FSX_INLINE static inline
 typedef struct iphdr fsx_iphdr;
@@ -219,6 +220,32 @@ FSX_INLINE int fsx_parse_icmp(struct fsx_cursor *cur, void *data_end,
 	return 0;
 }
 
+#ifndef IPPROTO_ICMPV6
+#define IPPROTO_ICMPV6 58
+#endif
+
+/* Parse ICMPv6 (reference parity: parsing_helper.h:140-156 had this
+ * parser; the round-2 rebuild let proto 58 fall through unparsed).
+ * Both icmp6 header layouts are 8 fixed bytes: type, code, cksum,
+ * 4-byte body — same advance as v4 ICMP, kept as a distinct parser so
+ * the bounds check documents the right struct. */
+FSX_INLINE int fsx_parse_icmp6(struct fsx_cursor *cur, void *data_end,
+			       struct fsx_pkt *pkt)
+{
+#ifdef FSX_HOST_BUILD
+	if ((char *)cur->pos + sizeof(struct icmp6_hdr) > (char *)data_end)
+		return -1;
+	cur->pos = (char *)cur->pos + sizeof(struct icmp6_hdr);
+#else
+	if ((char *)cur->pos + sizeof(struct icmp6hdr) > (char *)data_end)
+		return -1;
+	cur->pos = (char *)cur->pos + sizeof(struct icmp6hdr);
+#endif
+	pkt->sport = 0;
+	pkt->dport = 0;
+	return 0;
+}
+
 /* Full L2→L4 parse.  Returns 0 on success (pkt filled), -1 on
  * truncation/malformed, 1 on non-IP (caller should XDP_PASS, matching
  * fsx_kern.c:128-131). */
@@ -257,6 +284,10 @@ FSX_INLINE int fsx_parse_packet(void *data, void *data_end,
 		break;
 	case IPPROTO_ICMP:
 		if (fsx_parse_icmp(&cur, data_end, pkt) < 0)
+			return -1;
+		break;
+	case IPPROTO_ICMPV6:
+		if (fsx_parse_icmp6(&cur, data_end, pkt) < 0)
 			return -1;
 		break;
 	default:
