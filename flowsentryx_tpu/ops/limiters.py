@@ -100,9 +100,15 @@ def sliding_window(
     prev_pps = jnp.where(rolled_one, st.win_pps, jnp.where(rolled_many, 0.0, st.prev_pps))
     prev_bps = jnp.where(rolled_one, st.win_bps, jnp.where(rolled_many, 0.0, st.prev_bps))
     rolled = rolled_one | rolled_many
-    # snap the new window start to the grid so overlap stays calibrated
-    n_windows = jnp.floor(elapsed / cfg.window_s)
-    start = jnp.where(rolled, st.win_start + n_windows * cfg.window_s, st.win_start)
+    # Window-start snapping mirrors the kernel limiter exactly
+    # (fsx_compute.h:95-113): one roll advances by one window (keeps the
+    # flow's phase); >= 2 idle windows snap to the ABSOLUTE grid
+    # (now - now % window), since prev is zeroed there anyway.  The
+    # randomized C<->JAX property suite (tests/test_limiter_prop.py)
+    # holds these trajectories together step by step.
+    start = jnp.where(
+        rolled_many, now - jnp.mod(now, cfg.window_s),
+        jnp.where(rolled_one, st.win_start + cfg.window_s, st.win_start))
     pps = jnp.where(rolled, d_pkts, st.win_pps + d_pkts)
     bps = jnp.where(rolled, d_bytes, st.win_bps + d_bytes)
 

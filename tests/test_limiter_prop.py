@@ -1,0 +1,248 @@
+"""Randomized C <-> JAX limiter equivalence (VERDICT r2 item 6).
+
+Drives >=1000 randomized aggregated-delta steps per limiter through BOTH
+implementations:
+
+* the kernel's integer limiters (``kern/fsx_compute.h``), via the
+  ``kern/prop_driver`` harness, which expands each aggregated delta into
+  per-packet calls (the kernel plane is per-packet);
+* the TPU plane's vectorized float limiters
+  (:mod:`flowsentryx_tpu.ops.limiters`), one aggregated transition per
+  step.
+
+Comparison is *step-synchronized*: the JAX limiter is re-seeded from the
+C trajectory's pre-state at every step (all steps evaluated in one
+vectorized call, the steps axis acting as the flow axis).  Divergence
+therefore cannot compound, and every step is an independent randomized
+test of the transition function.
+
+Exactness discipline: timestamps live on a 1/1024 s grid, which is
+dyadic (exact in f32 seconds) and whose ns rounding (+-0.5 ns) provably
+cannot flip a window-boundary comparison (boundaries are exact multiples
+of 976562.5 ns away).  Counters stay below 2^24 so f32 holds them
+exactly; the only permitted divergence is the sliding window's 1/1024
+fixed-point estimate and the token bucket's milli-token truncation, and
+each disagreement must be adjudicated to sit within that documented
+bound of the decision threshold.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+KERN = Path(__file__).resolve().parents[1] / "kern"
+TICK_S = 1.0 / 1024.0
+WINDOW_NS = 1_000_000_000
+
+PPS_THR = 300
+BPS_THR = 200_000
+RATE_PPS = 100
+BURST = 150
+
+N_STEPS = 1200
+
+
+def tick_to_ns(k: np.ndarray) -> np.ndarray:
+    """round(k * 976562.5) in exact integer arithmetic."""
+    return (k.astype(np.uint64) * 9765625 + 5) // 10
+
+
+@pytest.fixture(scope="module")
+def driver() -> Path:
+    r = subprocess.run(["make", "-C", str(KERN), "prop_driver"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return KERN / "prop_driver"
+
+
+def make_trace(seed: int) -> dict[str, np.ndarray]:
+    """Bursty random trace: mixed in-window, one-roll, and stale gaps.
+
+    IATs are >= 8 ticks so a 1024-tick window holds <= 128 steps; with
+    <= 65535 bytes/step the per-window byte sums stay f32-exact."""
+    rng = np.random.default_rng(seed)
+    kind_p = rng.random(N_STEPS)
+    iat_ticks = np.where(
+        kind_p < 0.70, rng.integers(8, 300, N_STEPS),        # in-window-ish
+        np.where(kind_p < 0.88, rng.integers(1024, 2048, N_STEPS),  # one roll
+                 rng.integers(2048, 8192, N_STEPS)))          # stale
+    ticks = np.cumsum(iat_ticks).astype(np.uint64)
+    n_pkts = rng.integers(1, 200, N_STEPS).astype(np.uint64)
+    n_bytes = np.minimum(n_pkts * rng.integers(40, 330, N_STEPS), 65535)
+    return {"ticks": ticks, "n_pkts": n_pkts,
+            "n_bytes": n_bytes.astype(np.uint64)}
+
+
+def run_c(driver: Path, kind: int, trace: dict[str, np.ndarray]) -> list[dict]:
+    lines = [f"{kind} {PPS_THR} {BPS_THR} {WINDOW_NS} {RATE_PPS} {BURST}",
+             str(N_STEPS)]
+    t_ns = tick_to_ns(trace["ticks"])
+    for n, b, t in zip(trace["n_pkts"], trace["n_bytes"], t_ns):
+        lines.append(f"{n} {b} {t}")
+    r = subprocess.run([str(driver)], input="\n".join(lines) + "\n",
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = [json.loads(l) for l in r.stdout.splitlines()]
+    assert len(out) == N_STEPS
+    return out
+
+
+def pre_states(posts: list[dict]) -> dict[str, np.ndarray]:
+    """C trajectory's pre-state per step (zeros, then post[i-1])."""
+    cols = {}
+    for f in ("win_start_ns", "win_pps", "win_bps", "prev_pps", "prev_bps",
+              "tokens_milli", "tok_ts_ns"):
+        v = np.array([0] + [p[f] for p in posts[:-1]], dtype=np.float64)
+        cols[f] = v
+    return cols
+
+
+def jax_window_args(trace, pre):
+    import jax.numpy as jnp
+
+    from flowsentryx_tpu.ops import limiters
+
+    st = limiters.WindowState(
+        jnp.asarray((pre["win_start_ns"] / 1e9).astype(np.float32)),
+        jnp.asarray(pre["win_pps"].astype(np.float32)),
+        jnp.asarray(pre["win_bps"].astype(np.float32)),
+        jnp.asarray(pre["prev_pps"].astype(np.float32)),
+        jnp.asarray(pre["prev_bps"].astype(np.float32)),
+    )
+    d_pkts = jnp.asarray(trace["n_pkts"].astype(np.float32))
+    d_bytes = jnp.asarray(trace["n_bytes"].astype(np.float32))
+    now = jnp.asarray((trace["ticks"].astype(np.float64) * TICK_S)
+                      .astype(np.float32))
+    return st, d_pkts, d_bytes, now
+
+
+def cfg():
+    from flowsentryx_tpu.core.config import LimiterConfig
+
+    return LimiterConfig(pps_threshold=float(PPS_THR),
+                         bps_threshold=float(BPS_THR), window_s=1.0,
+                         bucket_rate_pps=float(RATE_PPS),
+                         bucket_burst=float(BURST))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fixed_window_trace_equivalence(driver, seed):
+    """Fixed window must agree EXACTLY: integer counters, dyadic times,
+    no fixed-point anywhere."""
+    from flowsentryx_tpu.ops import limiters
+
+    trace = make_trace(seed)
+    posts = run_c(driver, 0, trace)
+    pre = pre_states(posts)
+    st, d_pkts, d_bytes, now = jax_window_args(trace, pre)
+    new, over = limiters.fixed_window(cfg(), st, d_pkts, d_bytes, now)
+
+    c_over = np.array([p["over"] for p in posts], bool)
+    np.testing.assert_array_equal(np.asarray(over), c_over)
+    np.testing.assert_array_equal(
+        np.asarray(new.win_pps), np.array([p["win_pps"] for p in posts], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(new.win_bps), np.array([p["win_bps"] for p in posts], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(new.win_start),
+        np.array([p["win_start_ns"] / 1e9 for p in posts], np.float32),
+        rtol=0, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_sliding_window_trace_equivalence(driver, seed):
+    """Sliding window: counters/state exact; decisions may diverge only
+    within the documented 1/1024 fixed-point bound of the threshold."""
+    from flowsentryx_tpu.ops import limiters
+
+    trace = make_trace(seed)
+    posts = run_c(driver, 1, trace)
+    pre = pre_states(posts)
+    st, d_pkts, d_bytes, now = jax_window_args(trace, pre)
+    new, over = limiters.sliding_window(cfg(), st, d_pkts, d_bytes, now)
+
+    # post-state counters are pure integer bookkeeping: exact
+    for jf, cf in ((new.win_pps, "win_pps"), (new.win_bps, "win_bps"),
+                   (new.prev_pps, "prev_pps"), (new.prev_bps, "prev_bps")):
+        np.testing.assert_array_equal(
+            np.asarray(jf), np.array([p[cf] for p in posts], np.float32), cf)
+    np.testing.assert_allclose(
+        np.asarray(new.win_start),
+        np.array([p["win_start_ns"] / 1e9 for p in posts], np.float32),
+        rtol=0, atol=1e-6)
+
+    # decisions: adjudicate each disagreement against the f64 estimate
+    c_over = np.array([p["over"] for p in posts], bool)
+    j_over = np.asarray(over)
+    dis = np.nonzero(c_over != j_over)[0]
+    # fixed-point error bound per dimension: prev/1024 (overlap
+    # quantization) + 2 (one >>10 truncation each in frac and in the
+    # prev*overlap product)
+    post_pps = np.array([p["win_pps"] for p in posts], np.float64)
+    post_bps = np.array([p["win_bps"] for p in posts], np.float64)
+    post_prev_pps = np.array([p["prev_pps"] for p in posts], np.float64)
+    post_prev_bps = np.array([p["prev_bps"] for p in posts], np.float64)
+    post_start = np.array([p["win_start_ns"] for p in posts], np.float64)
+    now_ns = tick_to_ns(trace["ticks"]).astype(np.float64)
+    frac = np.clip((now_ns - post_start) / WINDOW_NS, 0.0, 1.0)
+    est_pps = post_prev_pps * (1.0 - frac) + post_pps
+    est_bps = post_prev_bps * (1.0 - frac) + post_bps
+    for i in dis:
+        near_pps = abs(est_pps[i] - PPS_THR) <= post_prev_pps[i] / 1024 + 2
+        near_bps = abs(est_bps[i] - BPS_THR) <= post_prev_bps[i] / 1024 + 2
+        assert near_pps or near_bps, (
+            f"step {i}: C={c_over[i]} JAX={j_over[i]} but est "
+            f"({est_pps[i]:.1f} pps / {est_bps[i]:.1f} bps) is not within "
+            f"the fixed-point bound of either threshold")
+    # and they must not diverge often
+    assert len(dis) <= N_STEPS * 0.02, f"{len(dis)} disagreements"
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_token_bucket_trace_equivalence(driver, seed):
+    """Token bucket: decisions agree except within the milli-token
+    truncation bound of the exact balance; post-balance agrees to
+    <1 token when over (refused packets do not drain the C bucket),
+    tightly otherwise."""
+    import jax.numpy as jnp
+
+    from flowsentryx_tpu.ops import limiters
+
+    trace = make_trace(seed)
+    posts = run_c(driver, 2, trace)
+    pre = pre_states(posts)
+    bst = limiters.BucketState(
+        jnp.asarray((pre["tokens_milli"] / 1000.0).astype(np.float32)),
+        jnp.asarray((pre["tok_ts_ns"] / 1e9).astype(np.float32)),
+    )
+    d_pkts = jnp.asarray(trace["n_pkts"].astype(np.float32))
+    now = jnp.asarray((trace["ticks"].astype(np.float64) * TICK_S)
+                      .astype(np.float32))
+    new, over = limiters.token_bucket(cfg(), bst, d_pkts, now)
+
+    c_over = np.array([p["over"] for p in posts], bool)
+    j_over = np.asarray(over)
+
+    # f64 reference balance after refill, from the shared pre-state
+    now_ns = tick_to_ns(trace["ticks"]).astype(np.float64)
+    elapsed = np.minimum(now_ns - pre["tok_ts_ns"], 1e12)
+    bal = np.minimum(pre["tokens_milli"] / 1000.0 + elapsed * RATE_PPS / 1e9,
+                     BURST)
+    d = trace["n_pkts"].astype(np.float64)
+    dis = np.nonzero(c_over != j_over)[0]
+    for i in dis:
+        assert abs(bal[i] - d[i]) <= 0.01, (
+            f"step {i}: C={c_over[i]} JAX={j_over[i]} with balance "
+            f"{bal[i]:.4f} vs demand {d[i]} — outside truncation bound")
+    assert len(dis) <= N_STEPS * 0.02, f"{len(dis)} disagreements"
+
+    j_tokens = np.asarray(new.tokens, np.float64)
+    c_tokens = np.array([p["tokens_milli"] for p in posts], np.float64) / 1000.0
+    tol = np.where(c_over, 1.0, 0.005)
+    assert (np.abs(j_tokens - c_tokens) <= tol).all(), (
+        np.abs(j_tokens - c_tokens).max())
