@@ -173,3 +173,81 @@ def run_suite(scale: float = 1.0, names: list[str] | None = None) -> list[dict]:
             continue
         results.append(run_scenario(sb))
     return results
+
+
+def run_scaling(
+    device_counts: tuple[int, ...] = (1, 2, 4, 8),
+    capacity: int = 1 << 20,
+    batch: int = 16384,
+    iters: int = 20,
+) -> dict:
+    """Step-time vs mesh size at full table capacity (VERDICT r2 item 4).
+
+    Runs the engine's actual serving steps — the plain fused raw step at
+    one device, the IP-hash-sharded ``make_sharded_raw_step`` beyond —
+    over identical synthetic traffic, and reports per-mesh-size compile
+    and steady-state step times.  On virtual CPU devices (tests/CI) the
+    interesting signal is that the collective pattern (one ``all_gather``
+    + three ``psum`` per step) does not SERIALIZE as the mesh grows: the
+    host has one core, so healthy scaling shows roughly flat-or-better
+    step time, while a serialized/deadlocked pattern would grow ~n×.
+    """
+    import jax
+
+    from flowsentryx_tpu import parallel as par
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    results = []
+    for n in device_counts:
+        if n > len(jax.devices()):
+            results.append({"devices": n, "skipped": "not enough devices"})
+            continue
+        cfg = _cfg(LimiterConfig(), capacity, batch)
+        spec = get_model(cfg.model.name)
+        params = spec.init()
+        if n == 1:
+            step = fused.make_jitted_raw_step(cfg, spec.classify_batch)
+            table = jax.device_put(schema.make_table(capacity))
+        else:
+            mesh = par.make_mesh(n)
+            step = par.make_sharded_raw_step(cfg, spec.classify_batch, mesh)
+            table = par.make_sharded_table(cfg, mesh)
+        stats = jax.device_put(schema.make_stats())
+
+        gen = TrafficGen(TrafficSpec(scenario=Scenario.MIXED_L34_1M,
+                                     rate_pps=1e7, seed=42))
+        raws = [schema.encode_raw(gen.next_records(batch), batch, t0_ns=0)
+                for _ in range(4)]
+
+        t0 = time.perf_counter()
+        table, stats, out = step(table, stats, params, raws[0])
+        jax.block_until_ready(out.verdict)
+        compile_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for i in range(iters):
+            table, stats, out = step(table, stats, params, raws[i % len(raws)])
+        jax.block_until_ready(out.verdict)
+        dt = (time.perf_counter() - t0) / iters
+        results.append({
+            "devices": n,
+            "compile_s": round(compile_s, 2),
+            "step_ms": round(dt * 1e3, 2),
+            "records_per_s": round(batch / dt, 0),
+            "mpps": round(batch / dt / 1e6, 3),
+        })
+    base = next((r for r in results if r.get("devices") == 1 and "step_ms" in r),
+                None)
+    return {
+        "capacity": capacity,
+        "batch": batch,
+        "iters": iters,
+        "backend": jax.devices()[0].platform,
+        "collectives_per_step": {"all_gather": 1, "psum": 3},
+        "results": results,
+        "serialization_ratio_8x": round(
+            results[-1]["step_ms"] / base["step_ms"], 2)
+        if base and "step_ms" in results[-1] else None,
+    }

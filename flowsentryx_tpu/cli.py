@@ -174,6 +174,12 @@ def _cmd_bench(args: argparse.Namespace) -> int:
             print(json.dumps(result), flush=True)
         return 0
 
+    if args.scaling:
+        from flowsentryx_tpu import benchmarks
+
+        print(json.dumps(benchmarks.run_scaling()), flush=True)
+        return 0
+
     bench = Path(__file__).resolve().parents[1] / "bench.py"
     if not bench.exists():
         print("fsx bench requires a source checkout (bench.py not found "
@@ -240,6 +246,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="packet-count multiplier for --scenarios")
     b.add_argument("--only", action="append",
                    help="substring filter on scenario names (repeatable)")
+    b.add_argument("--scaling", action="store_true",
+                   help="step-time vs 1/2/4/8-device mesh at 1M-row capacity")
     b.set_defaults(fn=_cmd_bench)
 
     return p

@@ -63,14 +63,29 @@ class Engine:
         donate: bool | None = None,
         readback_depth: int = 8,
         t0_ns: int | None = None,
+        mesh: Any | None = None,
     ):
         self.cfg = cfg
         self.source = source
         self.sink = sink
         spec = get_model(cfg.model.name)
         self.params = params if params is not None else spec.init()
-        self.step = fused.make_jitted_raw_step(cfg, spec.classify_batch, donate=donate)
-        self.table = jax.device_put(schema.make_table(cfg.table.capacity))
+        # Mesh spanning >1 device: serve through the IP-hash-sharded
+        # multi-device step (parallel/step.py) — state rows live
+        # sharded across the mesh, the wire batch enters replicated.
+        self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        if self.mesh is not None:
+            from flowsentryx_tpu import parallel as par
+
+            self.step = par.make_sharded_raw_step(
+                cfg, spec.classify_batch, self.mesh, donate=donate
+            )
+            self.table = par.make_sharded_table(cfg, self.mesh)
+        else:
+            self.step = fused.make_jitted_raw_step(
+                cfg, spec.classify_batch, donate=donate
+            )
+            self.table = jax.device_put(schema.make_table(cfg.table.capacity))
         self.stats = jax.device_put(schema.make_stats())
         self.readback_depth = readback_depth
         # A wire buffer may be reused only after its batch is off the
@@ -150,6 +165,10 @@ class Engine:
                 f"checkpoint capacity {table.capacity} != configured "
                 f"{self.cfg.table.capacity}"
             )
+        if self.mesh is not None:
+            from flowsentryx_tpu import parallel as par
+
+            table = par.shard_table(table, self.mesh)
         self.table, self.stats = table, stats
         self.batcher.t0_ns = t0_ns
         self._t0_auto = False

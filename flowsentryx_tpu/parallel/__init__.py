@@ -1,3 +1,8 @@
 from flowsentryx_tpu.parallel import mesh, step  # noqa: F401
 from flowsentryx_tpu.parallel.mesh import make_mesh  # noqa: F401
-from flowsentryx_tpu.parallel.step import make_sharded_step, shard_table  # noqa: F401
+from flowsentryx_tpu.parallel.step import (  # noqa: F401
+    make_sharded_raw_step,
+    make_sharded_step,
+    make_sharded_table,
+    shard_table,
+)

@@ -133,3 +133,32 @@ def make_sharded_step(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_sharded_raw_step(
+    cfg: FsxConfig,
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    donate: bool | None = None,
+):
+    """Sharded step over the RAW ring wire format — the multi-device
+    twin of :func:`~flowsentryx_tpu.ops.fused.make_jitted_raw_step`,
+    with the same ``step(table, stats, params, raw)`` signature, so the
+    serving :class:`~flowsentryx_tpu.engine.engine.Engine` swaps it in
+    whenever its mesh spans more than one device.
+
+    The wire buffer enters replicated (one contiguous H2D transfer; at
+    48 B/record the batch is tiny next to the sharded state) and decodes
+    on device inside the jit; everything downstream is the shard-mapped
+    step above.
+    """
+    from flowsentryx_tpu.core import schema
+
+    if donate is None:
+        donate = fused.donation_supported()
+    base = make_sharded_step(cfg, classify_batch, mesh, donate=False)
+
+    def step(table, stats, params, raw):
+        return base(table, stats, params, schema.decode_raw(raw))
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -188,6 +188,40 @@ class TestEngineLoop:
         assert rep.stats["dropped_rate"] == 0
         assert rep.stats["allowed"] > rep.records * 0.9
 
+    def test_meshed_engine_matches_single_device(self):
+        """Engine(mesh=8 devices) serves through the IP-hash-sharded
+        step (VERDICT r2 item 4) and reproduces the single-device run
+        bit-for-bit: same stats, same blocked set, same batch count."""
+        from flowsentryx_tpu.parallel import make_mesh
+
+        def run(mesh):
+            cfg = small_cfg(batch=512, cap=1 << 12, pps_threshold=200.0,
+                            bps_threshold=1e9)
+            src = TrafficSource(
+                TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                            n_attack_ips=32, attack_fraction=0.8, seed=7),
+                total=512 * 24,
+            )
+            sink = CollectSink()
+            eng = Engine(cfg, src, sink, readback_depth=4, mesh=mesh)
+            rep = eng.run()
+            return rep, eng
+
+        rep_s, _ = run(None)
+        rep_m, eng_m = run(make_mesh(8))
+        assert eng_m.mesh is not None  # really served sharded
+        assert rep_m.stats == rep_s.stats
+        assert rep_m.blocked_sources == rep_s.blocked_sources
+        assert rep_m.batches == rep_s.batches == 24
+
+    def test_meshed_engine_single_device_mesh_falls_back(self):
+        from flowsentryx_tpu.parallel import make_mesh
+
+        cfg = small_cfg(batch=128)
+        eng = Engine(cfg, TrafficSource(TrafficSpec(seed=9), total=128),
+                     NullSink(), mesh=make_mesh(1))
+        assert eng.mesh is None  # 1-device mesh -> plain fused step
+
     def test_max_batches_bound(self):
         cfg = small_cfg(batch=128)
         src = TrafficSource(TrafficSpec(seed=9))  # unbounded
