@@ -153,11 +153,18 @@ def _setup(donate: bool, side: Sidecar):
     )
     spec = get_model(cfg.model.name)
     params = spec.init()
-    step = fused.make_jitted_raw_step(cfg, spec.classify_batch, donate=donate)
+    # Production hot path: the COMPACT 16 B/record wire format in
+    # bit-exact "model" quantization (core/schema.py) — 3× fewer
+    # host→device bytes than the 48 B ring record, which is the
+    # bandwidth-critical hop at 10 Mpps (480 → 160 MB/s).
+    quant = schema.model_quant_args(params)
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=donate, **quant
+    )
     table = jax.device_put(schema.make_table(cfg.table.capacity))
     stats = jax.device_put(schema.make_stats())
     raws = [
-        schema.encode_raw(b, B, t0_ns=0)
+        schema.encode_compact(b, B, t0_ns=0, **quant)
         for b in make_raw_batches(16, B, n_ips=1 << 20)
     ]
     return jax, schema, cfg, params, step, table, stats, raws, init_s
@@ -480,6 +487,8 @@ def main() -> int:
         "target_p99_ms": 1.0,
         "batch": B,
         "table_capacity": TABLE_CAP,
+        "wire_format": "compact16",  # 16 B/record, bit-exact model quant
+        "bytes_per_record": 16,
         "budget_s": BUDGET_S,
     }
     try:

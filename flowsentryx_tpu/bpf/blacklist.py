@@ -1,0 +1,119 @@
+"""Operator-editable blacklist: manual block/unblock against the live map.
+
+The reference specifies user-space blacklist management — add/remove
+IPs, clear the table, pretty-print — as a planned capability
+(reference README.md:70-74,142-147); nothing was built.  Here it is a
+thin, dependency-free layer over the pinned ``blacklist_map`` that
+``fsxd --bpf --pin DIR`` leaves in bpffs: the same raw-``bpf(2)``
+:class:`~flowsentryx_tpu.bpf.loader.Map` the kernel program reads on
+every packet, so an operator ``fsx block`` takes effect on the next
+packet from that source.
+
+Key space: the kernel folds every source to a u32 read as a
+little-endian load of the wire bytes (kern/parsing.h:83-86) — IPv4 keys
+are the four address octets verbatim, IPv6 keys are the XOR of the four
+address words.  The fold is not invertible for v6, so listings show the
+key in hex alongside its v4 dotted form.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from dataclasses import dataclass
+
+from flowsentryx_tpu.bpf import loader
+
+#: Default bpffs directory fsxd pins under (daemon/fsxd.cpp --pin).
+DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
+
+#: Matches the kernel image's map spec (bpf/progs.py MAPS table).
+KEY_SIZE = 4
+VALUE_SIZE = 8
+
+
+def fold_ip(ip: str) -> int:
+    """Fold a textual IPv4/IPv6 address to the kernel's u32 key.
+
+    Mirrors the data plane exactly: the XDP program reads the wire
+    source address with a native little-endian u32 load (IPv4) or XORs
+    the four address words (IPv6, kern/parsing.h fsx_fold_ip6).
+    """
+    try:
+        wire = socket.inet_pton(socket.AF_INET, ip)
+        return struct.unpack("<I", wire)[0]
+    except OSError:
+        pass
+    wire = socket.inet_pton(socket.AF_INET6, ip)  # raises on junk
+    w = struct.unpack("<4I", wire)
+    return w[0] ^ w[1] ^ w[2] ^ w[3]
+
+
+def key_to_v4(key: int) -> str:
+    """Dotted-quad view of a key (exact for v4 sources; for v6 it is
+    the fold, shown only as a convenience)."""
+    return socket.inet_ntoa(struct.pack("<I", key))
+
+
+def ktime_ns() -> int:
+    """The kernel program compares against bpf_ktime_get_ns(), which
+    reads CLOCK_MONOTONIC."""
+    return time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+
+
+@dataclass
+class Entry:
+    key: int           # folded u32 source
+    until_ns: int      # blocked-until, CLOCK_MONOTONIC ns
+    remaining_s: float  # negative = expired, pending lazy delete
+
+    def to_json(self) -> dict:
+        return {
+            "key": f"0x{self.key:08x}",
+            "v4": key_to_v4(self.key),
+            "remaining_s": round(self.remaining_s, 3),
+        }
+
+
+def open_map(pin_dir: str = DEFAULT_PIN_DIR) -> loader.Map:
+    """Open the pinned blacklist map left by ``fsxd --pin`` (or
+    ``bpf/loader.py`` pinning)."""
+    fd = loader.obj_get(f"{pin_dir}/blacklist_map")
+    return loader.Map(fd, loader.MAP_TYPE_LRU_HASH, KEY_SIZE, VALUE_SIZE,
+                      0, "blacklist_map")
+
+
+def block(m: loader.Map, ip: str, ttl_s: float = 10.0) -> Entry:
+    """Blacklist ``ip`` for ``ttl_s`` seconds (reference default 10 s,
+    fsx_kern.c:308-310); the XDP program drops its next packet."""
+    until = ktime_ns() + int(ttl_s * 1e9)
+    m.update(struct.pack("<I", fold_ip(ip)), struct.pack("<Q", until))
+    return Entry(fold_ip(ip), until, ttl_s)
+
+
+def unblock(m: loader.Map, ip: str) -> bool:
+    """Remove ``ip``; returns False if it was not blacklisted."""
+    return m.delete(struct.pack("<I", fold_ip(ip)))
+
+
+def clear(m: loader.Map) -> int:
+    """Delete every entry; returns how many were removed."""
+    n = 0
+    for kb in m.keys():
+        n += m.delete(kb)
+    return n
+
+
+def entries(m: loader.Map) -> list[Entry]:
+    now = ktime_ns()
+    out = []
+    for kb in m.keys():
+        vb = m.lookup(kb)
+        if vb is None:  # raced a delete/expiry
+            continue
+        (key,) = struct.unpack("<I", kb)
+        (until,) = struct.unpack("<Q", vb)
+        out.append(Entry(key, until, (until - now) / 1e9))
+    out.sort(key=lambda e: -e.remaining_s)
+    return out

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -43,6 +44,55 @@ def _cmd_version(args: argparse.Namespace) -> int:
     import flowsentryx_tpu
 
     print(json.dumps({"version": flowsentryx_tpu.__version__}))
+    return 0
+
+
+def _cmd_block(args: argparse.Namespace) -> int:
+    """Manually blacklist a source (reference README.md:70-74: "Block
+    specified IP addresses")."""
+    from flowsentryx_tpu.bpf import blacklist
+
+    m = blacklist.open_map(args.pin)
+    try:
+        e = blacklist.block(m, args.ip, ttl_s=args.ttl)
+        print(json.dumps({"blocked": args.ip, **e.to_json()}))
+    finally:
+        m.close()
+    return 0
+
+
+def _cmd_unblock(args: argparse.Namespace) -> int:
+    from flowsentryx_tpu.bpf import blacklist
+
+    m = blacklist.open_map(args.pin)
+    try:
+        removed = blacklist.unblock(m, args.ip)
+        print(json.dumps({"unblocked": args.ip, "was_present": removed}))
+    finally:
+        m.close()
+    return 0 if removed else 1
+
+
+def _cmd_blacklist(args: argparse.Namespace) -> int:
+    """Pretty-print (or clear) the live blacklist — the reference's
+    planned "display network statistics" surface (README.md:142-147)."""
+    from flowsentryx_tpu.bpf import blacklist
+
+    m = blacklist.open_map(args.pin)
+    try:
+        if args.clear:
+            print(json.dumps({"cleared": blacklist.clear(m)}))
+            return 0
+        entries = [e.to_json() for e in blacklist.entries(m)]
+        if args.json:
+            print(json.dumps({"entries": entries}))
+        else:
+            print(f"{'key':>10}  {'v4 view':>15}  remaining")
+            for e in entries:
+                print(f"{e['key']:>10}  {e['v4']:>15}  {e['remaining_s']:.1f}s")
+            print(f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}")
+    finally:
+        m.close()
     return 0
 
 
@@ -165,6 +215,16 @@ def _cmd_bench(args: argparse.Namespace) -> int:
     import subprocess
     import sys as _sys
 
+    if args.scenarios or args.scaling:
+        # The axon TPU plugin registers itself regardless of
+        # JAX_PLATFORMS, so honor the env var through the config API
+        # (the route tests/conftest.py uses for the virtual CPU mesh).
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+
     if args.scenarios:
         from flowsentryx_tpu import benchmarks
 
@@ -208,6 +268,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=_cmd_version)
+
+    # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
+    # construction never imports the bpf loader (lazy-import rule).
+    DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
+
+    blk = sub.add_parser("block", help="manually blacklist a source IP")
+    blk.add_argument("ip", help="IPv4 or IPv6 address")
+    blk.add_argument("--ttl", type=float, default=10.0,
+                     help="seconds until expiry (default 10, as the "
+                          "kernel's rate-limit blocks)")
+    blk.add_argument("--pin", default=DEFAULT_PIN_DIR,
+                     help=f"bpffs pin dir (default {DEFAULT_PIN_DIR})")
+    blk.set_defaults(fn=_cmd_block)
+
+    ublk = sub.add_parser("unblock", help="remove a source from the blacklist")
+    ublk.add_argument("ip")
+    ublk.add_argument("--pin", default=DEFAULT_PIN_DIR)
+    ublk.set_defaults(fn=_cmd_unblock)
+
+    bl = sub.add_parser("blacklist", help="show or clear the live blacklist")
+    bl.add_argument("--pin", default=DEFAULT_PIN_DIR)
+    bl.add_argument("--json", action="store_true")
+    bl.add_argument("--clear", action="store_true",
+                    help="delete every entry")
+    bl.set_defaults(fn=_cmd_blacklist)
 
     s = sub.add_parser("serve", help="run the serving engine")
     s.add_argument("--config", help="JSON config file")

@@ -2,10 +2,22 @@
 
 SURVEY.md §7.2's "micro-batcher (size- and deadline-triggered, e.g.
 2048 vectors or 200 µs)".  Records accumulate in a preallocated
-``[B+1, 12]`` uint32 wire buffer (:func:`schema.encode_raw` layout) so a
-flush is metadata-row update + hand-off — no per-flush allocation or
-repacking.  Double-buffered: the engine can have one buffer in flight on
-device while the next fills.
+wire buffer so a flush is metadata-row update + hand-off — no per-flush
+allocation or repacking.  Double-buffered: the engine can have one
+buffer in flight on device while the next fills.
+
+Two wire formats (core/schema.py):
+
+* ``raw48`` — records copied verbatim as ``[B+1, 12]`` u32
+  (:func:`schema.encode_raw` layout); full fidelity, 48 B/record.
+* ``compact16`` — records quantized on the way in as ``[B+1, 4]`` u32
+  (:func:`schema.encode_compact` layout); 3× fewer bytes over the
+  host→device hop, which is the bandwidth-critical seam at 10 Mpps.
+  With ``model``-mode quantizer kwargs the classifier's scores are
+  bit-identical to raw48 (the wire carries the model's own input
+  quantization).  The compact ts field is a µs delta from the batch
+  base, so ``deadline_us`` must stay under its 65 ms range — enforced
+  here rather than silently saturating.
 """
 
 from __future__ import annotations
@@ -34,18 +46,39 @@ class MicroBatcher:
     latency: batcher residency counts).
     """
 
-    def __init__(self, cfg: BatchConfig, t0_ns: int = 0, n_buffers: int = 4):
+    def __init__(
+        self,
+        cfg: BatchConfig,
+        t0_ns: int = 0,
+        n_buffers: int = 4,
+        wire: str = schema.WIRE_RAW48,
+        quant: dict | None = None,
+    ):
         self.cfg = cfg
         self.t0_ns = t0_ns
         self.n_buffers = max(2, n_buffers)
+        self.wire = wire
+        self.quant = dict(quant or {})
+        if wire == schema.WIRE_COMPACT16:
+            if cfg.deadline_us > 60_000:
+                raise ValueError(
+                    "compact16 ts field spans 65 ms; deadline_us "
+                    f"{cfg.deadline_us} would saturate record deltas"
+                )
+            words = schema.COMPACT_RECORD_WORDS
+        elif wire == schema.WIRE_RAW48:
+            words = schema.RECORD_WORDS
+        else:
+            raise ValueError(f"unknown wire format {wire!r}")
         b = cfg.max_batch
         self._bufs = [
-            np.zeros((b + 1, schema.RECORD_WORDS), np.uint32)
+            np.zeros((b + 1, words), np.uint32)
             for _ in range(self.n_buffers)
         ]
         self._cur = 0
         self.fill = 0
         self._first_add_t: float | None = None
+        self._base_ns = 0  # compact16: batch base timestamp
         self._seal_times: list[float] = []
         self.batches_emitted = 0
         self.records_emitted = 0
@@ -58,15 +91,23 @@ class MicroBatcher:
         out: list[np.ndarray] = []
         pos = 0
         b = self.cfg.max_batch
+        compact = self.wire == schema.WIRE_COMPACT16
         while pos < len(records):
             if self.fill == 0:
                 self._first_add_t = time.perf_counter()
+                if compact:
+                    self._base_ns = int(records["ts_ns"][pos])
             take = min(b - self.fill, len(records) - pos)
             chunk = records[pos : pos + take]
             buf = self._bufs[self._cur]
-            buf[self.fill : self.fill + take] = (
-                chunk.view(np.uint32).reshape(take, schema.RECORD_WORDS)
-            )
+            if compact:
+                buf[self.fill : self.fill + take] = schema.compact_pack(
+                    chunk, self._base_ns, **self.quant
+                )
+            else:
+                buf[self.fill : self.fill + take] = (
+                    chunk.view(np.uint32).reshape(take, schema.RECORD_WORDS)
+                )
             self.fill += take
             pos += take
             if self.fill == b:
@@ -97,8 +138,13 @@ class MicroBatcher:
         b = self.cfg.max_batch
         meta = buf[b]
         meta[0] = self.fill
-        meta[1] = self.t0_ns & 0xFFFFFFFF
-        meta[2] = (self.t0_ns >> 32) & 0xFFFFFFFF
+        if self.wire == schema.WIRE_COMPACT16:
+            base_rel_us = max(0, self._base_ns - self.t0_ns) // 1000
+            meta[1] = base_rel_us & 0xFFFFFFFF
+            meta[2] = (base_rel_us >> 32) & 0xFFFFFFFF
+        else:
+            meta[1] = self.t0_ns & 0xFFFFFFFF
+            meta[2] = (self.t0_ns >> 32) & 0xFFFFFFFF
         # tail rows beyond fill are stale from an earlier batch; they are
         # masked by n_valid on device, so no need to zero them.
         self.batches_emitted += 1

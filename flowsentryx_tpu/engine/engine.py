@@ -64,6 +64,7 @@ class Engine:
         readback_depth: int = 8,
         t0_ns: int | None = None,
         mesh: Any | None = None,
+        wire: str = schema.WIRE_COMPACT16,
     ):
         self.cfg = cfg
         self.source = source
@@ -73,7 +74,31 @@ class Engine:
         # Mesh spanning >1 device: serve through the IP-hash-sharded
         # multi-device step (parallel/step.py) — state rows live
         # sharded across the mesh, the wire batch enters replicated.
+        # (The sharded step speaks raw48; compact is single-device for
+        # now, so a mesh overrides the wire choice.)
         self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        if self.mesh is not None:
+            wire = schema.WIRE_RAW48
+        self.wire = wire
+        # compact16 quantizes features on the way into the batcher with
+        # the model's own input observer when the artifact exposes one
+        # (bit-exact scores vs raw48 for identity-transform artifacts;
+        # ±1 output quant step for log1p ones), else the minifloat
+        # fallback (≤6.25 % per-feature error) — announced, since it
+        # changes borderline scores vs the raw48 wire.
+        quant = (
+            schema.wire_quant_for(self.params)
+            if wire == schema.WIRE_COMPACT16 else None
+        )
+        if quant is not None and quant.get("feat_mode") == "minifloat":
+            import sys
+
+            print(
+                "fsx engine: params expose no input observer; compact16 "
+                "wire uses minifloat feature quantization (<=6.25% "
+                "relative error). Pass wire='raw48' for full fidelity.",
+                file=sys.stderr,
+            )
         if self.mesh is not None:
             from flowsentryx_tpu import parallel as par
 
@@ -81,6 +106,11 @@ class Engine:
                 cfg, spec.classify_batch, self.mesh, donate=donate
             )
             self.table = par.make_sharded_table(cfg, self.mesh)
+        elif wire == schema.WIRE_COMPACT16:
+            self.step = fused.make_jitted_compact_step(
+                cfg, spec.classify_batch, donate=donate, **quant
+            )
+            self.table = jax.device_put(schema.make_table(cfg.table.capacity))
         else:
             self.step = fused.make_jitted_raw_step(
                 cfg, spec.classify_batch, donate=donate
@@ -91,7 +121,8 @@ class Engine:
         # A wire buffer may be reused only after its batch is off the
         # in-flight queue: keep more buffers than in-flight batches.
         self.batcher = MicroBatcher(
-            cfg.batch, t0_ns=t0_ns or 0, n_buffers=readback_depth + 2
+            cfg.batch, t0_ns=t0_ns or 0, n_buffers=readback_depth + 2,
+            wire=wire, quant=quant,
         )
         # t0 anchors the device clock (f32 seconds).  None = auto: take
         # the first record's kernel timestamp, which is the documented

@@ -452,3 +452,103 @@ def test_struct_offsets_match_generated_header(tmp_path):
         progs.CFG_SIZE, progs.IPS_SIZE, progs.FS_SIZE, progs.REC_SIZE,
         progs.CFG_BLOCK_NS, progs.IPS_TOKENS_MILLI, progs.FS_DST_PORT,
     ]
+
+
+# ---- operator blacklist management (fsx block / unblock / blacklist) --
+
+
+class TestBlacklistCli:
+    """The manual-blacklist surface (reference README.md:70-74,142-147)
+    against a real pinned map, end to end through the CLI entry points."""
+
+    @pytest.fixture()
+    def pin_dir(self, tmp_path):
+        import os
+        import subprocess as sp
+
+        d = f"/sys/fs/bpf/fsx_blk_{os.getpid()}"
+        if not (os.path.isdir("/sys/fs/bpf")
+                and os.access("/sys/fs/bpf", os.W_OK)):
+            sp.run(["mount", "-t", "bpf", "bpf", "/sys/fs/bpf"],
+                   capture_output=True)
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            pytest.skip("bpffs not mounted/writable")
+        m = loader.map_create(loader.MAP_TYPE_LRU_HASH, 4, 8, 128,
+                              "blacklist_map")
+        try:
+            m.pin(d + "/blacklist_map")
+        except (loader.BpfError, OSError):
+            m.close()
+            pytest.skip("bpffs pinning unavailable")
+        m.close()
+        yield d
+        os.unlink(d + "/blacklist_map")
+        os.rmdir(d)
+
+    def test_block_show_unblock_roundtrip(self, pin_dir):
+        from flowsentryx_tpu.bpf import blacklist
+
+        m = blacklist.open_map(pin_dir)
+        try:
+            blacklist.block(m, "10.1.2.3", ttl_s=30.0)
+            blacklist.block(m, "2001:db8::1", ttl_s=30.0)
+            ents = blacklist.entries(m)
+            assert len(ents) == 2
+            keys = {e.key for e in ents}
+            assert blacklist.fold_ip("10.1.2.3") in keys
+            assert blacklist.fold_ip("2001:db8::1") in keys
+            for e in ents:
+                assert 25.0 < e.remaining_s <= 30.0
+            assert blacklist.unblock(m, "10.1.2.3") is True
+            assert blacklist.unblock(m, "10.1.2.3") is False
+            assert len(blacklist.entries(m)) == 1
+            assert blacklist.clear(m) == 1
+            assert blacklist.entries(m) == []
+        finally:
+            m.close()
+
+    def test_blocked_ip_drops_in_kernel(self, pin_dir, fsx):
+        """An operator `fsx block` must take effect on the very next
+        packet: write via the blacklist module into the LIVE program's
+        map (the same map object the XDP prog reads)."""
+        from flowsentryx_tpu.bpf import blacklist
+
+        saddr = 0x0A0500FF
+        ip = blacklist.key_to_v4(saddr)
+        blacklist.block(fsx.maps["blacklist_map"], ip, ttl_s=60.0)
+        assert fsx.run(ip4_pkt(saddr)) == XDP_DROP
+        assert fsx.stats()["dropped_blacklist"] == 1
+        blacklist.unblock(fsx.maps["blacklist_map"], ip)
+        assert fsx.run(ip4_pkt(saddr)) == XDP_PASS
+
+    def test_fold_matches_kernel_fold_v6(self, fsx):
+        """fold_ip must agree with the kernel's fsx_fold_ip6 on the
+        wire: blacklist a v6 address via the CLI fold, then send the
+        matching v6 packet."""
+        from flowsentryx_tpu.bpf import blacklist
+
+        ip = "2001:db8:0:1::42"
+        import socket as so
+        wire = so.inet_pton(so.AF_INET6, ip)
+        words = struct.unpack("<4I", wire)
+        blacklist.block(fsx.maps["blacklist_map"], ip, ttl_s=60.0)
+        assert fsx.run(ip6_pkt(words)) == XDP_DROP
+
+    def test_cli_commands(self, pin_dir, capsys):
+        import json as js
+
+        from flowsentryx_tpu import cli
+
+        assert cli.main(["block", "192.0.2.7", "--ttl", "45",
+                         "--pin", pin_dir]) == 0
+        out = js.loads(capsys.readouterr().out)
+        assert out["blocked"] == "192.0.2.7" and out["v4"] == "192.0.2.7"
+        assert cli.main(["blacklist", "--pin", pin_dir, "--json"]) == 0
+        out = js.loads(capsys.readouterr().out)
+        assert len(out["entries"]) == 1
+        assert out["entries"][0]["v4"] == "192.0.2.7"
+        assert cli.main(["unblock", "192.0.2.7", "--pin", pin_dir]) == 0
+        assert js.loads(capsys.readouterr().out)["was_present"] is True
+        assert cli.main(["unblock", "192.0.2.7", "--pin", pin_dir]) == 1

@@ -104,6 +104,31 @@ class TestBatcher:
         [raw] = mb.add(buf)
         np.testing.assert_array_equal(raw, schema.encode_raw(buf, 64, t0_ns=7))
 
+    def test_compact_wire_equals_encode_compact(self):
+        """compact16 batcher output == schema.encode_compact (same
+        quantizer, same metadata row)."""
+        from flowsentryx_tpu.models import logreg
+
+        params = logreg.golden_params()
+        quant = schema.model_quant_args(params)
+        t0 = 1_000_000
+        mb = MicroBatcher(BatchConfig(max_batch=64, deadline_us=10**4),
+                          t0_ns=t0, wire=schema.WIRE_COMPACT16, quant=quant)
+        gen = TrafficGen(TrafficSpec(seed=9))
+        buf = gen.next_records(64)
+        [comp] = mb.add(buf)
+        assert comp.shape == (65, schema.COMPACT_RECORD_WORDS)
+        np.testing.assert_array_equal(
+            comp, schema.encode_compact(buf, 64, t0_ns=t0, **quant)
+        )
+
+    def test_compact_wire_rejects_long_deadline(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="65 ms"):
+            MicroBatcher(BatchConfig(max_batch=64, deadline_us=100_000),
+                         wire=schema.WIRE_COMPACT16)
+
     def test_buffer_reuse_masks_stale_tail(self):
         """A short batch reusing a buffer that previously held a full one
         must mask the stale tail via n_valid."""

@@ -162,3 +162,126 @@ class TestFusedStep:
         batch = build_batch([(7001, 50, 100, 0.5, ML_COLD)])
         table, stats, out = step(table, stats, params, batch)
         assert (np.asarray(out.verdict)[:50] == int(Verdict.DROP_RATE)).all()
+
+
+class TestCompactWire:
+    """The 16 B/record host→device wire format (schema.encode_compact):
+    verdict/score parity with the 48 B path and field fidelity."""
+
+    def _records(self, rng, n=512, feat_hi=1 << 28):
+        from flowsentryx_tpu.core import schema
+
+        buf = np.zeros(n, dtype=schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = rng.integers(1, 1 << 12, n).astype(np.uint32)
+        buf["pkt_len"] = rng.integers(64, 9000, n)
+        buf["ts_ns"] = 5_000_000_000 + np.sort(
+            rng.integers(0, 60_000, n)
+        ).astype(np.uint64) * 1000
+        buf["flags"] = rng.integers(0, 32, n)
+        buf["feat"] = np.where(
+            rng.random((n, 8)) < 0.5,
+            rng.integers(0, 4096, (n, 8)),
+            rng.integers(0, feat_hi, (n, 8)),
+        ).astype(np.uint32)
+        return buf
+
+    def test_model_mode_bit_exact_verdicts(self, rng):
+        import jax
+
+        from flowsentryx_tpu.core import schema
+
+        buf = self._records(rng)
+        n = len(buf)
+        spec = get_model(CFG.model.name)
+        params = spec.init()
+        qa = schema.model_quant_args(params)
+        t0 = 4_999_000_000
+        raw = schema.encode_raw(buf, n, t0)
+        comp = schema.encode_compact(buf, n, t0, **qa)
+
+        sr = jax.jit(fused.make_raw_step(CFG, spec.classify_batch))
+        sc = jax.jit(fused.make_compact_step(CFG, spec.classify_batch, **qa))
+        tb, st = make_table(CFG.table.capacity), make_stats()
+        _, _, o_r = sr(tb, st, params, raw)
+        _, _, o_c = sc(tb, st, params, comp)
+        # "model" wire quantization == the classifier's own input
+        # observer, so scores must be IDENTICAL, not merely close
+        np.testing.assert_array_equal(
+            np.asarray(o_r.score), np.asarray(o_c.score)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(o_r.verdict), np.asarray(o_c.verdict)
+        )
+
+    def test_field_fidelity(self, rng):
+        import jax
+
+        from flowsentryx_tpu.core import schema
+
+        buf = self._records(rng)
+        n = len(buf)
+        t0 = 4_999_000_000
+        full = schema.decode_records(buf, n, t0)
+        comp = schema.encode_compact(buf, n, t0, feat_mode="minifloat")
+        dec = jax.jit(
+            lambda r: schema.decode_compact(r, feat_mode="minifloat")
+        )(comp)
+        assert (np.asarray(dec.key)[:n] == buf["saddr"]).all()
+        # pkt_len: 8-byte units, round-to-nearest
+        assert np.abs(np.asarray(dec.pkt_len)[:n] - buf["pkt_len"]).max() <= 4
+        # ts: µs wire resolution + f32 recombination ≪ 1 s windows
+        assert np.abs(
+            np.asarray(dec.ts)[:n] - np.asarray(full.ts)[:n]
+        ).max() < 5e-5
+        # flags round-trip
+        assert (
+            np.asarray(schema.compact_flags(comp))[:n] == buf["flags"]
+        ).all()
+        assert np.asarray(dec.valid).sum() == n
+
+    def test_minifloat_relative_error_bound(self):
+        from flowsentryx_tpu.core import schema
+
+        f = np.concatenate([
+            np.arange(0, 1 << 16, dtype=np.uint32),
+            np.random.default_rng(3).integers(
+                0, 0xFFFFFFFF, 200_000
+            ).astype(np.uint32),
+            np.array([0xFFFFFFFF, 0, 1, 7, 8, 15, 16], np.uint32),
+        ])
+        q = schema.quantize_feat_minifloat(f)
+        assert q.max() <= 255
+        qf = q.astype(np.int64)
+        val = np.where(qf < 8, qf, (8 + qf % 8) * (2.0 ** (qf // 8 - 1)))
+        rel = np.abs(val - f) / np.maximum(f, 1)
+        assert rel.max() <= 0.0625 + 1e-9
+
+    def test_log1p_artifact_roundtrip(self, rng):
+        import jax
+
+        from flowsentryx_tpu.core import schema
+        from flowsentryx_tpu.models import logreg
+
+        params = logreg.make_params(
+            w_int8=[10, -80, 106, -9, -85, -52, 106, -45],
+            bias=0.1, w_scale=0.01, in_scale=22.18 / 255.0,
+            out_scale=0.05, out_zp=90, log1p=True,
+        )
+        qa = schema.model_quant_args(params)
+        assert qa["log1p"] is True
+        buf = self._records(rng)
+        n = len(buf)
+        raw = schema.encode_raw(buf, n, 4_999_000_000)
+        comp = schema.encode_compact(buf, n, 4_999_000_000, **qa)
+        dec_full = jax.jit(lambda r: schema.decode_raw(r))(raw)
+        dec_comp = jax.jit(lambda r: schema.decode_compact(r, **qa))(comp)
+        s_full = np.asarray(
+            logreg.classify_batch(params, dec_full.feat)
+        )[:n]
+        s_comp = np.asarray(
+            logreg.classify_batch(params, dec_comp.feat)
+        )[:n]
+        # log-domain wire step == the model's own observer step; scores
+        # agree except for ±1-ulp rounding at quant boundaries
+        assert (s_full == s_comp).mean() > 0.99
+        assert np.abs(s_full - s_comp).max() <= 1.5 / 256.0
