@@ -42,8 +42,10 @@ public:
         std::memset(r.base_, 0, sizeof(fsx_shm_ring_hdr));
         r.hdr()->capacity = capacity;
         r.hdr()->record_size = record_size;
-        std::atomic_thread_fence(std::memory_order_release);
-        r.hdr()->magic = FSX_SHM_MAGIC;  // publish last
+        // publish last with release ordering (a release STORE rather
+        // than a fence: identical cross-process semantics, and TSAN
+        // can model it — fences are unsupported under -fsanitize=thread)
+        __atomic_store_n(&r.hdr()->magic, FSX_SHM_MAGIC, __ATOMIC_RELEASE);
         return r;
     }
 
@@ -57,7 +59,10 @@ public:
             throw std::runtime_error("ring file too small: " + path);
         }
         ShmRing r(fd, (size_t)st.st_size);
-        if (r.hdr()->magic != FSX_SHM_MAGIC)
+        // acquire pairs with create()'s release store: once magic is
+        // observed, capacity/record_size reads below it are ordered
+        if (__atomic_load_n(&r.hdr()->magic, __ATOMIC_ACQUIRE)
+            != FSX_SHM_MAGIC)
             throw std::runtime_error("bad ring magic in " + path);
         return r;
     }

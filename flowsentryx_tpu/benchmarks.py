@@ -141,18 +141,35 @@ def _source_quality(gen_spec: TrafficSpec, blocked: set[int]) -> dict:
     }
 
 
+def _serving_params():
+    """The repo's trained artifact when present (artifacts/, the analog
+    of the reference's checked-in src/model_weights.pth), else None →
+    the model's default init (the reference's golden weights — a
+    near-constant benign predictor, see MODEL_METRICS.json analysis)."""
+    from pathlib import Path
+
+    from flowsentryx_tpu.models import logreg
+
+    p = Path(__file__).resolve().parents[1] / "artifacts" / "logreg_int8.npz"
+    if p.exists():
+        return logreg.load_params(str(p)), p.name
+    return None, "golden (default init)"
+
+
 def run_scenario(sb: ScenarioBench) -> dict:
     sink = CollectSink()
     src = TrafficSource(sb.traffic, total=sb.packets)
+    params, params_src = _serving_params()
     # Deep readback queue: verdicts land in bulk every 32 batches,
     # amortizing the per-fetch sync cost (writeback delay of ~32 batch
     # periods is well inside the blacklist-TTL tolerance).
-    eng = Engine(sb.cfg, src, sink, readback_depth=32)
+    eng = Engine(sb.cfg, src, sink, params=params, readback_depth=32)
     t0 = time.perf_counter()
     rep = eng.run()
     wall = time.perf_counter() - t0
     out = {
         "scenario": sb.name,
+        "params": params_src,
         "packets": rep.records,
         "batches": rep.batches,
         "wall_s": round(wall, 3),

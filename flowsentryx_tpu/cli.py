@@ -176,10 +176,18 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     if args.epochs < 1:
         raise SystemExit("--epochs must be >= 1")
-    if args.data:
+    if args.data == "fixture":
+        # the documented CICIDS-calibrated stand-in (train/fixture.py);
+        # --synthetic sets its size (default: the real cleaned-set size)
+        from flowsentryx_tpu.train import fixture
+
+        n = args.synthetic if args.synthetic is not None else fixture.N_CLEANED
+        X, y = fixture.cicids_fixture(n=n, seed=args.seed)
+    elif args.data:
         X, y = data.load_csvs(args.data)
     else:
-        X, y = data.synthetic_dataset(args.synthetic, seed=args.seed)
+        n = args.synthetic if args.synthetic is not None else 50_000
+        X, y = data.synthetic_dataset(n, seed=args.seed)
     Xtr, Xte, ytr, yte = data.train_test_split(X, y)
 
     out: dict = {"model": args.model, "train_n": len(Xtr), "test_n": len(Xte)}
@@ -314,9 +322,12 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train a model, export the artifact")
     t.add_argument("--model", default="logreg_int8",
                    choices=["logreg_int8", "mlp"])
-    t.add_argument("--data", help="CSV glob (CICIDS2017/CICDDoS2019 format)")
-    t.add_argument("--synthetic", type=int, default=50_000,
-                   help="synthetic dataset size when no --data")
+    t.add_argument("--data",
+                   help="CSV glob (CICIDS2017/CICDDoS2019 format), or "
+                        "'fixture' for the CICIDS-calibrated stand-in")
+    t.add_argument("--synthetic", type=int, default=None,
+                   help="dataset size for synthetic/fixture data "
+                        "(default 50000 synthetic; full 2.52M fixture)")
     t.add_argument("--epochs", type=int, default=200)
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--out", help="artifact output path (.npz)")

@@ -98,6 +98,21 @@ class MicroBatcher:
                 if compact:
                     self._base_ns = int(records["ts_ns"][pos])
             take = min(b - self.fill, len(records) - pos)
+            if compact:
+                # The compact ts field is a u16 µs delta from the batch
+                # base: a batch may not SPAN more than ~65 ms of record
+                # time (slow replays / post-stall backlogs would
+                # otherwise saturate deltas and inflate apparent rates).
+                # Seal early at the span boundary instead.
+                span_ok = (
+                    records["ts_ns"][pos : pos + take].astype(np.int64)
+                    - self._base_ns
+                ) < 65_000_000
+                if not span_ok.all():
+                    take = max(int(span_ok.argmin()), 0)
+                    if take == 0:
+                        out.append(self._seal())
+                        continue
             chunk = records[pos : pos + take]
             buf = self._bufs[self._cur]
             if compact:

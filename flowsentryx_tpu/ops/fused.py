@@ -105,7 +105,8 @@ def flow_step(
     # 2. limiter transition on aggregated deltas (needs a slot: only
     #    tracked flows carry limiter state)
     dec = limiters.apply_limiter(
-        lim, win, bucket, fa.rep_pkts, fa.rep_bytes, fa.rep_ts
+        lim, win, bucket, fa.rep_pkts, fa.rep_bytes, fa.rep_ts,
+        is_new=asg.inserted,
     )
     over_rate = asg.tracked & dec.over_limit & ~already_blocked
 

@@ -125,16 +125,23 @@ def token_bucket(
     st: BucketState,
     d_pkts: jnp.ndarray,
     now: jnp.ndarray,
+    is_new: jnp.ndarray | None = None,
 ) -> tuple[BucketState, jnp.ndarray]:
     """Token bucket: ``bucket_rate_pps`` tokens/s, depth ``bucket_burst``.
 
-    A fresh slot (tokens=0, tok_ts=0) refills to a full bucket on first
-    touch because ``now`` seconds have "elapsed" — new flows start with
-    full burst allowance, the conventional semantics.  Over-limit flows
-    drain to 0 and stay flagged until refill catches up (packet-count
-    based; the byte dimension is governed by the window limiters)."""
+    ``is_new`` marks freshly-claimed slots, which start with a FULL
+    bucket — the conventional semantics, and the kernel twin's implicit
+    behavior (fsx_compute.h: a zeroed map entry sees a boot-relative
+    ``now``, so its clamped refill fills the bucket).  The explicit flag
+    matters here because the engine anchors its clock at the first
+    record (now ≈ 0 at stream start), where "elapsed since tok_ts=0"
+    refills almost nothing.  Over-limit flows drain to 0 and stay
+    flagged until refill catches up (packet-count based; the byte
+    dimension is governed by the window limiters)."""
     refill = (now - st.tok_ts) * cfg.bucket_rate_pps
     tokens = jnp.minimum(cfg.bucket_burst, st.tokens + refill)
+    if is_new is not None:
+        tokens = jnp.where(is_new, jnp.float32(cfg.bucket_burst), tokens)
     over = tokens < d_pkts
     tokens = jnp.maximum(tokens - d_pkts, 0.0)
     return BucketState(tokens, now), over
@@ -147,17 +154,20 @@ def apply_limiter(
     d_pkts: jnp.ndarray,
     d_bytes: jnp.ndarray,
     now: jnp.ndarray,
+    is_new: jnp.ndarray | None = None,
 ) -> LimiterDecision:
     """Dispatch on the (static) configured limiter kind.
 
     The branch is resolved at trace time — each config compiles to a
-    program containing only its own limiter's ops."""
+    program containing only its own limiter's ops.  ``is_new`` marks
+    freshly-claimed table slots (full-bucket init; window limiters
+    start correctly from zeroed state)."""
     if cfg.kind is LimiterKind.FIXED_WINDOW:
         window, over = fixed_window(cfg, window, d_pkts, d_bytes, now)
     elif cfg.kind is LimiterKind.SLIDING_WINDOW:
         window, over = sliding_window(cfg, window, d_pkts, d_bytes, now)
     elif cfg.kind is LimiterKind.TOKEN_BUCKET:
-        bucket, over = token_bucket(cfg, bucket, d_pkts, now)
+        bucket, over = token_bucket(cfg, bucket, d_pkts, now, is_new)
     else:  # pragma: no cover
         raise ValueError(f"unknown limiter kind {cfg.kind}")
     return LimiterDecision(window, bucket, over)

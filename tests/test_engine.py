@@ -129,6 +129,31 @@ class TestBatcher:
             MicroBatcher(BatchConfig(max_batch=64, deadline_us=100_000),
                          wire=schema.WIRE_COMPACT16)
 
+    def test_compact_wire_seals_at_ts_span_boundary(self):
+        """A compact batch may not span >65 ms of RECORD time (u16 us
+        delta field); slow streams must seal early, not saturate."""
+        mb = MicroBatcher(BatchConfig(max_batch=64, deadline_us=10**4),
+                          wire=schema.WIRE_COMPACT16,
+                          quant=dict(feat_mode="minifloat"))
+        gen = TrafficGen(TrafficSpec(seed=4, rate_pps=1e4))  # 100 us gaps
+        buf = gen.next_records(64)  # spans ~6.4 ms: fits one batch
+        assert len(mb.add(buf)) == 1
+        slow = gen.next_records(64)
+        slow["ts_ns"] = slow["ts_ns"][0] + np.arange(64, dtype=np.uint64) * 2_000_000
+        sealed = mb.add(slow)  # 2 ms spacing -> 126 ms span: must split
+        total = sum(int(s[-1, 0]) for s in sealed) + mb.fill
+        assert total == 64
+        assert len(sealed) >= 1
+        for s in sealed:
+            n = int(s[-1, 0])
+            dts = (s[:n, 3] >> 16).astype(np.int64)
+            assert dts.max() < 65_000  # no saturated deltas
+        # drain the remainder and check it too
+        rest = mb.take()
+        if rest is not None:
+            n = int(rest[-1, 0])
+            assert ((rest[:n, 3] >> 16).astype(np.int64) < 65_000).all()
+
     def test_buffer_reuse_masks_stale_tail(self):
         """A short batch reusing a buffer that previously held a full one
         must mask the stale tail via n_valid."""

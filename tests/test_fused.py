@@ -285,3 +285,20 @@ class TestCompactWire:
         # agree except for ±1-ulp rounding at quant boundaries
         assert (s_full == s_comp).mean() > 0.99
         assert np.abs(s_full - s_comp).max() <= 1.5 / 256.0
+
+
+def test_token_bucket_fresh_flow_gets_full_burst():
+    """A new flow at stream start (engine-anchored clock, now ≈ 0) must
+    begin with a FULL bucket — the kernel twin's implicit semantics
+    (boot-relative clock ⇒ clamped refill fills fresh entries).  Caught
+    live: benign single-packet sources were rate-dropped at t≈0."""
+    cfg = FsxConfig(
+        limiter=LimiterConfig(kind=LimiterKind.TOKEN_BUCKET,
+                              bucket_rate_pps=10.0, bucket_burst=20.0),
+        table=TableConfig(capacity=1 << 12),
+    )
+    step, table, stats, params = make_env(cfg)
+    # 5 packets at t=0.0005s from a brand-new source: within burst → PASS
+    batch = build_batch([(4242, 5, 100, 0.0005, ML_COLD)])
+    table, stats, out = step(table, stats, params, batch)
+    assert (np.asarray(out.verdict)[:5] == int(Verdict.PASS)).all()

@@ -187,3 +187,47 @@ class TestMlpArtifact:
             np.asarray(logreg.classify_batch(g, jnp.asarray(X))),
             np.asarray(logreg.classify_batch(loaded, jnp.asarray(X))),
         )
+
+
+class TestFixture:
+    """CICIDS-calibrated fixture (train/fixture.py): the documented
+    stand-in behind MODEL_METRICS.json."""
+
+    def test_real_calibration_points(self):
+        from flowsentryx_tpu.train import fixture
+
+        X, y = fixture.cicids_fixture(n=200_000, seed=1)
+        assert X.shape == (200_000, 8) and X.dtype == np.float32
+        # real label rate (model.ipynb describe(): label mean 0.1688914)
+        assert abs(y.mean() - fixture.LABEL_RATE) < 0.005
+        # real destination_port quartiles reproduced by the sampler
+        dport = X[:, 0]
+        assert abs(np.median(dport) - 80.0) < 25.0
+        assert np.percentile(dport, 25) <= 120.0
+        assert dport.max() <= 65535.0
+        # IATs bounded by the real flow_duration max (1.2e8 us)
+        assert X[:, 5:8].max() <= 1.2e8
+        # variance column really is std^2
+        np.testing.assert_allclose(X[:, 3], X[:, 2] ** 2, rtol=1e-5)
+
+    def test_learnable_and_pipeline_roundtrip(self):
+        from flowsentryx_tpu.train import data, evaluate, fixture, qat
+        from flowsentryx_tpu.models import logreg
+
+        X, y = fixture.cicids_fixture(n=30_000, seed=2)
+        Xtr, Xte, ytr, yte = data.train_test_split(X, y)
+        res = qat.train_logreg_qat(Xtr, ytr, epochs=120)
+        m = evaluate.evaluate_model(
+            logreg.classify_batch_int8_matmul, res.params, Xte, yte
+        )
+        # the class structure must be learnable well above base rate...
+        assert m["f1"] > 0.7
+        # ...while the fixture stays hard enough to be non-trivial
+        assert m["f1"] < 0.999
+
+    def test_provenance_block(self):
+        from flowsentryx_tpu.train import fixture
+
+        p = fixture.provenance()
+        assert p["kind"] == "synthetic-calibrated-fixture"
+        assert "not" in p["synthetic_assumptions"].lower()
