@@ -74,11 +74,7 @@ class Engine:
         # Mesh spanning >1 device: serve through the IP-hash-sharded
         # multi-device step (parallel/step.py) — state rows live
         # sharded across the mesh, the wire batch enters replicated.
-        # (The sharded step speaks raw48; compact is single-device for
-        # now, so a mesh overrides the wire choice.)
         self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
-        if self.mesh is not None:
-            wire = schema.WIRE_RAW48
         self.wire = wire
         # compact16 quantizes features on the way into the batcher with
         # the model's own input observer when the artifact exposes one
@@ -102,9 +98,15 @@ class Engine:
         if self.mesh is not None:
             from flowsentryx_tpu import parallel as par
 
-            self.step = par.make_sharded_raw_step(
-                cfg, spec.classify_batch, self.mesh, donate=donate
-            )
+            if wire == schema.WIRE_COMPACT16:
+                self.step = par.make_sharded_compact_step(
+                    cfg, spec.classify_batch, self.mesh, donate=donate,
+                    **quant,
+                )
+            else:
+                self.step = par.make_sharded_raw_step(
+                    cfg, spec.classify_batch, self.mesh, donate=donate
+                )
             self.table = par.make_sharded_table(cfg, self.mesh)
         elif wire == schema.WIRE_COMPACT16:
             self.step = fused.make_jitted_compact_step(

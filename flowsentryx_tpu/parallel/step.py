@@ -135,6 +135,21 @@ def make_sharded_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def _make_sharded_wire_step(cfg, classify_batch, mesh, donate, decode):
+    """Shared wrapper: replicated wire buffer → on-device ``decode`` →
+    the shard-mapped step.  The wire enters as ONE contiguous H2D
+    transfer (tiny next to the sharded state); all field extraction
+    fuses into the jit."""
+    if donate is None:
+        donate = fused.donation_supported()
+    base = make_sharded_step(cfg, classify_batch, mesh, donate=False)
+
+    def step(table, stats, params, raw):
+        return base(table, stats, params, decode(raw))
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def make_sharded_raw_step(
     cfg: FsxConfig,
     classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
@@ -145,20 +160,31 @@ def make_sharded_raw_step(
     twin of :func:`~flowsentryx_tpu.ops.fused.make_jitted_raw_step`,
     with the same ``step(table, stats, params, raw)`` signature, so the
     serving :class:`~flowsentryx_tpu.engine.engine.Engine` swaps it in
-    whenever its mesh spans more than one device.
-
-    The wire buffer enters replicated (one contiguous H2D transfer; at
-    48 B/record the batch is tiny next to the sharded state) and decodes
-    on device inside the jit; everything downstream is the shard-mapped
-    step above.
-    """
+    whenever its mesh spans more than one device."""
     from flowsentryx_tpu.core import schema
 
-    if donate is None:
-        donate = fused.donation_supported()
-    base = make_sharded_step(cfg, classify_batch, mesh, donate=False)
+    return _make_sharded_wire_step(cfg, classify_batch, mesh, donate,
+                                   schema.decode_raw)
 
-    def step(table, stats, params, raw):
-        return base(table, stats, params, schema.decode_raw(raw))
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+def make_sharded_compact_step(
+    cfg: FsxConfig,
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    donate: bool | None = None,
+    **quant,
+):
+    """Sharded step over the COMPACT 16 B wire format — the multi-device
+    twin of :func:`~flowsentryx_tpu.ops.fused.make_jitted_compact_step`.
+    ``**quant`` are the wire-quantizer kwargs
+    (:func:`~flowsentryx_tpu.core.schema.wire_quant_for`); the batch
+    enters replicated and dequantizes on device before the shard-mapped
+    step, so the multi-chip engine keeps the 3× wire-byte saving."""
+    import functools
+
+    from flowsentryx_tpu.core import schema
+
+    return _make_sharded_wire_step(
+        cfg, classify_batch, mesh, donate,
+        functools.partial(schema.decode_compact, **quant),
+    )

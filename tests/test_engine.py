@@ -260,6 +260,8 @@ class TestEngineLoop:
         rep_s, _ = run(None)
         rep_m, eng_m = run(make_mesh(8))
         assert eng_m.mesh is not None  # really served sharded
+        # the mesh path keeps the compact16 wire (sharded compact step)
+        assert eng_m.wire == schema.WIRE_COMPACT16
         assert rep_m.stats == rep_s.stats
         assert rep_m.blocked_sources == rep_s.blocked_sources
         assert rep_m.batches == rep_s.batches == 24
