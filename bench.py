@@ -193,12 +193,60 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
         "device_kind": dev.device_kind, "init_s": init_s,
     }
 
-    # Probe chunk: small, times a single dispatch round trip.
+    # Transport + device capability diagnostics FIRST, before the e2e
+    # chunks below consume the link's burst budget: the dev tunnel
+    # meters H2D in tiers (measured: ~150 MB burst at 1.3-1.6 GB/s,
+    # then ~250 MB/s, then ~25 MB/s with dispatch penalties; idle
+    # restores it), so diagnostics taken after 500 MB of chunks would
+    # describe the drained tunnel, not the chip.
+    #   device_mpps — device-resident step rate, no H2D in the loop:
+    #   the chip's actual feature→verdict capability (what a local-PCIe
+    #   deployment sees; production never binds on 16 B/record wire).
+    if remaining() > 30 and time.perf_counter() + 20 < deadline:
+        big = np.concatenate([np.ascontiguousarray(r).reshape(-1)
+                              for r in raws])
+        jax.block_until_ready(jax.device_put(big[:1024]))  # warm path
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(big))
+        result["h2d_mbps"] = round(big.nbytes / (time.perf_counter() - t0)
+                                   / 1e6, 1)
+
+        dev_feeds = [jax.device_put(r) for r in raws]
+        jax.block_until_ready(dev_feeds)
+        iters = 200
+        t0 = time.perf_counter()
+        for i in range(iters):
+            table, stats, out = step(table, stats, params,
+                                     dev_feeds[i % len(dev_feeds)])
+        jax.block_until_ready(out.verdict)
+        dt = (time.perf_counter() - t0) / iters
+        result["device_mpps"] = round(B / dt / 1e6, 2)
+        del dev_feeds
+        side.emit("transport", h2d_mbps=result["h2d_mbps"],
+                  device_mpps=result["device_mpps"])
+        log(f"device-resident: {result['device_mpps']:.1f} Mpps, "
+            f"link {result['h2d_mbps']:.0f} MB/s")
+
+    # Explicit H2D prefetch: device_put is async, so enqueueing the
+    # next wire buffers keeps the transfer engine ahead of the compute
+    # stream (the step consumes buffers whose transfer already started).
+    # Depth 3 bounds host memory pinned in flight.
+    PREFETCH = 3
+
+    def feed(k: int):
+        return jax.device_put(raws[k % len(raws)])
+
+    # Probe chunk: small, times a single dispatch round trip.  The
+    # pre-staged transfers complete before the clock starts so they
+    # can't inflate the probe.
     probe_iters = 10 if dev.platform != "cpu" else 3
     k = 0
+    pre = [feed(i) for i in range(PREFETCH)]
+    jax.block_until_ready(pre)
     t0 = time.perf_counter()
     for _ in range(probe_iters):
-        table, stats, out = step(table, stats, params, raws[k % len(raws)])
+        pre.append(feed(k + PREFETCH))
+        table, stats, out = step(table, stats, params, pre.pop(0))
         k += 1
     jax.block_until_ready(out.verdict)
     dt = time.perf_counter() - t0
@@ -219,7 +267,8 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
             break
         t0 = time.perf_counter()
         for _ in range(chunk_iters):
-            table, stats, out = step(table, stats, params, raws[k % len(raws)])
+            pre.append(feed(k + PREFETCH))
+            table, stats, out = step(table, stats, params, pre.pop(0))
             k += 1
         jax.block_until_ready(out.verdict)
         dt = time.perf_counter() - t0
@@ -231,51 +280,19 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
         log(f"chunk: {mpps:.2f} Mpps ({chunk_iters} iters)")
 
     # Median over steady-state chunks (exclude the probe when real
-    # chunks exist: the probe is tiny and noisy).
+    # chunks exist: the probe is tiny and noisy).  The max chunk is
+    # reported separately as burst_mpps: under the tunnel's tiered
+    # throttle the first chunks run from burst credit at link speed,
+    # later ones at the metered sustained rate — the median is the
+    # honest sustained number, the max shows the burst regime a
+    # local-PCIe deployment would sustain continuously.
     steady = result["chunk_mpps"][1:] or result["chunk_mpps"]
     result["mpps"] = float(np.median(steady))
-
-    # Transport diagnostics: the dev tunnel's H2D bandwidth swings by
-    # >50× between sessions (measured 1.5 GB/s to 25 MB/s for the same
-    # transfer), and at the low end it — not the TPU — bounds the e2e
-    # number above.  Record (a) the link's current bandwidth and (b)
-    # the device-resident step rate (the chip's actual feature→verdict
-    # capability; production PCIe at ≥16 GB/s never binds at 16 B/rec),
-    # so a transport-limited run is distinguishable from a compute
-    # limit.  ~5 s extra, readback-free until the final sync.
-    if remaining() > 20 and time.perf_counter() + 15 < deadline:
-        # One multi-MB transfer so transfer time dominates the fixed
-        # per-call dispatch cost (small probes under-report fast links).
-        big = np.concatenate([np.ascontiguousarray(r).reshape(-1)
-                              for r in raws])
-        jax.block_until_ready(jax.device_put(big[:1024]))  # warm path
-        t0 = time.perf_counter()
-        jax.block_until_ready(jax.device_put(big))
-        bw = big.nbytes / (time.perf_counter() - t0)
-        result["h2d_mbps"] = round(bw / 1e6, 1)
-
-        dev_feeds = [jax.device_put(r) for r in raws]
-        jax.block_until_ready(dev_feeds)
-        table, stats, out = step(table, stats, params, dev_feeds[0])
-        jax.block_until_ready(out.verdict)
-        iters = 200
-        t0 = time.perf_counter()
-        for i in range(iters):
-            table, stats, out = step(table, stats, params,
-                                     dev_feeds[i % len(dev_feeds)])
-        jax.block_until_ready(out.verdict)
-        dt = (time.perf_counter() - t0) / iters
-        result["device_mpps"] = round(B / dt / 1e6, 2)
+    result["burst_mpps"] = float(np.max(steady))
+    if "device_mpps" in result:
         result["transport_limited"] = bool(
             result["device_mpps"] > 2 * result["mpps"]
         )
-        side.emit("transport", h2d_mbps=result["h2d_mbps"],
-                  device_mpps=result["device_mpps"])
-        log(f"device-resident: {result['device_mpps']:.1f} Mpps, "
-            f"link {result['h2d_mbps']:.0f} MB/s"
-            + (" (TRANSPORT-LIMITED e2e)" if result["transport_limited"]
-               else ""))
-
     side.emit("result", **result)
     return result
 
@@ -594,7 +611,8 @@ def main() -> int:
                 device_kind=tput.get("device_kind"),
                 throughput_partial=tput.get("partial", False),
             )
-            for k in ("h2d_mbps", "device_mpps", "transport_limited"):
+            for k in ("h2d_mbps", "device_mpps", "transport_limited",
+                      "burst_mpps"):
                 if k in tput:
                     detail[k] = tput[k]
             log(f"throughput: {mpps:.2f} Mpps median over {tput.get('chunk_mpps')}")
