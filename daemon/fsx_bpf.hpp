@@ -317,7 +317,12 @@ public:
     }
 
     // Drain up to max_records; returns the number of records appended
-    // to out (records whose size != rec_size are skipped).
+    // to out.  Records whose size != rec_size are skipped and counted
+    // in `skipped` — a nonzero value means the loaded image's emit
+    // format disagrees with the configured record size (e.g. --compact
+    // against a 48 B image), which would otherwise silently starve the
+    // ML plane.
+    uint64_t skipped = 0;
     size_t drain(std::vector<uint8_t> &out, size_t rec_size,
                  size_t max_records) {
         auto *cons_pos = (volatile uint64_t *)cons_;
@@ -332,10 +337,14 @@ public:
             if (hdr & (1u << 31))
                 break;  // BUSY: producer mid-commit
             uint32_t len = hdr & ~((1u << 31) | (1u << 30));
-            if (!(hdr & (1u << 30)) && len == rec_size) {
-                const uint8_t *rec = data + (pos & (size_ - 1)) + 8;
-                out.insert(out.end(), rec, rec + len);
-                n++;
+            if (!(hdr & (1u << 30))) {
+                if (len == rec_size) {
+                    const uint8_t *rec = data + (pos & (size_ - 1)) + 8;
+                    out.insert(out.end(), rec, rec + len);
+                    n++;
+                } else {
+                    skipped++;
+                }
             }
             pos += (8 + len + 7) & ~7ULL;
         }

@@ -78,6 +78,7 @@ struct Options {
     double block_s = 10.0;
     uint64_t bucket_rate_pps = 1000;
     uint64_t bucket_burst = 2000;
+    bool compact = false;              // 16 B kernel-quantized records
 };
 
 [[noreturn]] void usage(const char *argv0) {
@@ -98,7 +99,9 @@ struct Options {
                  "  --pin DIR             pin prog+maps under DIR (bpffs, e.g. /sys/fs/bpf/fsx)\n"
                  "  --limiter KIND        fixed|sliding|token (default fixed)\n"
                  "  --pps-threshold N --bps-threshold N --window S --block S\n"
-                 "  --bucket-rate N --bucket-burst N\n",
+                 "  --bucket-rate N --bucket-burst N\n"
+                 "  --compact             16 B kernel-quantized records (the image\n"
+                 "                        must be emitted with --compact too)\n",
                  argv0);
     std::exit(2);
 }
@@ -200,8 +203,10 @@ int run_bpf(const Options &o) {
         std::fprintf(stderr, "fsxd: pinned under %s\n", o.pin_dir.c_str());
     }
 
+    const size_t rec_size = o.compact ? sizeof(fsx_compact_record)
+                                      : sizeof(fsx_flow_record);
     auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
-                                      sizeof(fsx_flow_record));
+                                      rec_size);
     auto vring = fsx::ShmRing::create(o.verdict_ring, 1 << 14,
                                       sizeof(fsx_verdict_record));
 
@@ -215,6 +220,7 @@ int run_bpf(const Options &o) {
     int blacklist_fd = lp.map_fd("blacklist_map");
     int stats_fd = lp.map_fd("stats_map");
     uint64_t forwarded = 0, dropped_ring_full = 0, verdicts = 0;
+    bool size_warned = false;
     std::vector<uint8_t> buf;
     std::vector<fsx_verdict_record> vbatch(4096);
     uint64_t t_start = now_ns(), next_report = t_start + 1'000'000'000ULL;
@@ -222,11 +228,20 @@ int run_bpf(const Options &o) {
     while (!g_stop) {
         // 1. feature egress: kernel ringbuf → shm ring
         buf.clear();
-        size_t n = rb.drain(buf, sizeof(fsx_flow_record), 4096);
+        size_t n = rb.drain(buf, rec_size, 4096);
         if (n) {
             uint64_t pushed = fring.produce(buf.data(), n);
             dropped_ring_full += n - pushed;
             forwarded += pushed;
+        }
+        if (rb.skipped && !size_warned) {
+            size_warned = true;
+            std::fprintf(stderr,
+                         "fsxd: WARNING: kernel ring records do not match "
+                         "the configured %zu-byte size — the loaded image's "
+                         "emit format disagrees with %s (records are being "
+                         "dropped)\n",
+                         rec_size, o.compact ? "--compact" : "48 B default");
         }
         // 2. verdict ingress: shm ring → blacklist map
         uint64_t nv = vring.consume(vbatch.data(), vbatch.size());
@@ -284,7 +299,9 @@ Options parse(int argc, char **argv) {
                 usage(argv[0]);
             return argv[i];
         };
-        if (a == "--sim")
+        if (a == "--compact")
+            o.compact = true;
+        else if (a == "--sim")
             o.mode = "sim";
         else if (a == "--replay") {
             o.mode = "replay";
@@ -415,6 +432,11 @@ int main(int argc, char **argv) {
 
     if (o.mode == "bpf")
         return run_bpf(o);
+    if (o.compact) {
+        std::fprintf(stderr, "fsxd: --compact requires --bpf (the sim/"
+                             "replay generators emit 48 B records)\n");
+        return 2;
+    }
 
     auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
                                       sizeof(fsx_flow_record));

@@ -43,9 +43,13 @@ class ImageMap:
 
 
 def emit(prog: Program | None = None,
-         sizes: progs.MapSizes = progs.MapSizes()) -> bytes:
-    """Serialize the fsx program (or a custom one) to an image blob."""
-    prog = prog or progs.build()
+         sizes: progs.MapSizes = progs.MapSizes(),
+         compact: bool = False) -> bytes:
+    """Serialize the fsx program (or a custom one) to an image blob.
+    ``compact`` assembles the 16 B kernel-quantized emit variant
+    (progs.build(compact=True)); the daemon must then be started with
+    --compact so ring record sizes agree."""
+    prog = prog or progs.build(compact=compact)
     names = prog.map_names
     specs = []
     for name in names:
@@ -95,11 +99,14 @@ def main(argv: list[str]) -> int:
     # output path (so `... --track-ips=64` is never mistaken for a path).
     out = None
     kw = {}
+    compact = False
     for a in argv[1:]:
         if a.startswith("--track-ips="):
             kw["max_track_ips"] = int(a.split("=")[1])
         elif a.startswith("--ring-bytes="):
             kw["ring_bytes"] = int(a.split("=")[1])
+        elif a == "--compact":
+            compact = True
         elif a.startswith("--"):
             print(f"unknown flag: {a}", file=sys.stderr)
             return 2
@@ -109,7 +116,7 @@ def main(argv: list[str]) -> int:
         else:
             out = a
     out = out or "kern/build/fsx_prog.img"
-    blob = emit(sizes=progs.MapSizes(**kw))
+    blob = emit(sizes=progs.MapSizes(**kw), compact=compact)
     pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
     pathlib.Path(out).write_bytes(blob)
     print(f"wrote {out}: {len(blob)} bytes")

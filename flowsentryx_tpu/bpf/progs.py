@@ -44,7 +44,7 @@ from flowsentryx_tpu.bpf.isa import (
     R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10,
     XDP_DROP, XDP_MD_DATA, XDP_MD_DATA_END, XDP_PASS,
     alu64, alu64_imm, atomic_add64, call, endian_be, exit_,
-    ld_imm64, ldx, mov64, mov64_imm, mov32_imm, st_imm, stx,
+    ld_imm64, ldx, mov64, mov64_imm, mov32_imm, neg64, st_imm, stx,
 )
 
 # ---- struct offsets (must match kern/fsx_schema.h; asserted by
@@ -119,6 +119,11 @@ S_IS6 = -184        # u64 slot: ipv6 indicator (== FLAG_IPV6 when set)
 S_FEAT = -224       # 8 x u32: derived features            [-224, -192)
 S_CTX = -232        # u64 slot: ctx pointer
 S_N = -240          # u64 slot: flow pkt_count snapshot (n)
+S_CW1 = -244        # u32: compact record word1 (feat 0-3, minifloat)
+S_CW2 = -248        # u32: compact record word2 (feat 4-7, minifloat)
+S_CW3 = -252        # u32: compact record word3 (len8|flags|ts16)
+
+COMPACT_REC_SIZE = 16  # struct fsx_compact_record
 
 
 @dataclass(frozen=True)
@@ -191,7 +196,77 @@ def _emit_isqrt_fn(a: Asm) -> None:
     a += exit_()
 
 
-def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
+def _bool_nonzero(a: Asm, dst: int, src: int) -> None:
+    """dst = (src != 0) ? 1 : 0, branch-free: top bit of src|-src."""
+    a += mov64(dst, src)
+    a += neg64(dst)
+    a += alu64(BPF_OR, dst, src)
+    a += alu64_imm(BPF_RSH, dst, 63)
+
+
+def _emit_minifloat_inline(a: Asm) -> None:
+    """Inline BRANCH-FREE e5m3 minifloat: r0 = mf(r1), r1 u32-valued.
+
+    Mirrors fsx_compute.h fsx_minifloat8 (itself in lockstep with
+    schema.quantize_feat_minifloat, tests/test_kern.py).  Branch-free
+    on purpose: the quantizer runs 8× per emitted record AFTER the two
+    isqrt calls, and a branchy version multiplies the verifier's
+    surviving-state count past the 1M-insn analysis budget (observed);
+    straight-line ALU costs ~45 insns and exactly one state.
+    Clobbers r0, r2-r5; preserves r1.
+    """
+    # big = (f >= 8)  →  R3
+    a += mov64(R2, R1)
+    a += alu64_imm(BPF_RSH, R2, 3)
+    _bool_nonzero(a, R3, R2)
+    # bit length: t=R2, bl=R4
+    a += mov64(R2, R1)
+    a += mov64_imm(R4, 0)
+    for s in (16, 8, 4, 2, 1):
+        a += mov64(R5, R2)
+        a += alu64_imm(BPF_RSH, R5, s)
+        _bool_nonzero(a, R0, R5)           # m = (t >= 2^s)
+        a += mov64(R5, R0)
+        if s > 1:
+            a += alu64_imm(BPF_LSH, R5, s.bit_length() - 1)  # m*s
+        a += alu64(BPF_ADD, R4, R5)        # bl += m*s
+        a += alu64(BPF_RSH, R2, R5)        # t >>= m*s
+    a += alu64(BPF_ADD, R4, R2)            # residual top bit
+    # e = (bl - 4) * big   (zero when f < 8; bl-4 may be "negative"
+    # as u64 then, but the multiply by big==0 erases it)
+    a += alu64_imm(BPF_SUB, R4, 4)
+    a += alu64(BPF_MUL, R4, R3)
+    # m0 = (e != 0) → R5 ; sh = (e-1)*m0 → R2
+    _bool_nonzero(a, R5, R4)
+    a += mov64(R2, R4)
+    a += alu64_imm(BPF_SUB, R2, 1)
+    a += alu64(BPF_MUL, R2, R5)
+    # r = ((f >> sh) + m0) >> m0   (mantissa in [8,16]; = f when e==0)
+    a += mov64(R0, R1)
+    a += alu64(BPF_RSH, R0, R2)
+    a += alu64(BPF_ADD, R0, R5)
+    a += alu64(BPF_RSH, R0, R5)
+    # carry: c = (r == 16); e += c; r -= 8c
+    a += mov64(R2, R0)
+    a += alu64_imm(BPF_XOR, R2, 16)
+    _bool_nonzero(a, R5, R2)               # (r != 16)
+    a += mov64_imm(R2, 1)
+    a += alu64(BPF_SUB, R2, R5)            # c = (r == 16)
+    a += alu64(BPF_ADD, R4, R2)
+    a += alu64_imm(BPF_LSH, R2, 3)
+    a += alu64(BPF_SUB, R0, R2)
+    # q_big = 8*e + r ; q = big ? q_big : f
+    a += alu64_imm(BPF_LSH, R4, 3)
+    a += alu64(BPF_ADD, R4, R0)
+    a += alu64(BPF_MUL, R4, R3)
+    a += mov64_imm(R2, 1)
+    a += alu64(BPF_SUB, R2, R3)
+    a += alu64(BPF_MUL, R2, R1)
+    a += alu64(BPF_ADD, R4, R2)
+    a += mov64(R0, R4)
+
+
+def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot path, kept whole
     """Assemble the full fsx fast path (see module docstring)."""
     a = Asm("fsx")
 
@@ -651,20 +726,9 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     a += ldx(BPF_H, R1, R6, FS_DST_PORT)
     a += stx(BPF_W, R10, S_FEAT + 0, R1)
 
-    # ---- ringbuf emit (fsx_kern.c:146-184) ---------------------------
-    a.ld_map(R1, "feature_ring")
-    a += mov64_imm(R2, REC_SIZE)
-    a += mov64_imm(R3, 0)
-    a += call(FN_ringbuf_reserve)
-    a.jmp_imm(BPF_JEQ, R0, 0, "allowed")  # ring full: fail open
-    a += mov64(R2, R0)  # r2 = rec
-    a += stx(BPF_DW, R2, REC_TS_NS, R7)
-    a += ldx(BPF_DW, R1, R10, S_SADDR)
-    a += stx(BPF_W, R2, REC_SADDR, R1)
-    a += stx(BPF_H, R2, REC_PKT_LEN, R9)
-    a += ldx(BPF_DW, R1, R10, S_L4)
-    a += stx(BPF_B, R2, REC_IP_PROTO, R1)
     # flags byte: ipv6 | tcp | udp | icmp | tcp_syn (fsx_kern.c:170-174)
+    # — computed into R3 BEFORE any ringbuf reserve (shared by both
+    # emit variants; the compact one folds it into word 3)
     a += ldx(BPF_DW, R3, R10, S_IS6)  # FLAG_IPV6 == 1 == is6
     a += ldx(BPF_DW, R1, R10, S_L4)
     a.jmp_imm(BPF_JNE, R1, IPPROTO_TCP, "fl_chk_udp")
@@ -684,14 +748,79 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     a.label("fl_icmp")
     a += alu64_imm(BPF_OR, R3, FLAG_ICMP)
     a.label("fl_done")
-    a += stx(BPF_B, R2, REC_FLAGS, R3)
-    # copy the 8 derived features
-    for i in range(8):
-        a += ldx(BPF_W, R1, R10, S_FEAT + 4 * i)
-        a += stx(BPF_W, R2, REC_FEAT + 4 * i, R1)
-    a += mov64(R1, R2)
-    a += mov64_imm(R2, 0)
-    a += call(FN_ringbuf_submit)
+
+    if not compact:
+        # ---- 48 B ringbuf emit (fsx_kern.c:146-184) ------------------
+        a += stx(BPF_DW, R10, S_VAL64, R3)  # park flags across reserve
+        a.ld_map(R1, "feature_ring")
+        a += mov64_imm(R2, REC_SIZE)
+        a += mov64_imm(R3, 0)
+        a += call(FN_ringbuf_reserve)
+        a.jmp_imm(BPF_JEQ, R0, 0, "allowed")  # ring full: fail open
+        a += mov64(R2, R0)  # r2 = rec
+        a += stx(BPF_DW, R2, REC_TS_NS, R7)
+        a += ldx(BPF_DW, R1, R10, S_SADDR)
+        a += stx(BPF_W, R2, REC_SADDR, R1)
+        a += stx(BPF_H, R2, REC_PKT_LEN, R9)
+        a += ldx(BPF_DW, R1, R10, S_L4)
+        a += stx(BPF_B, R2, REC_IP_PROTO, R1)
+        a += ldx(BPF_DW, R3, R10, S_VAL64)
+        a += stx(BPF_B, R2, REC_FLAGS, R3)
+        # copy the 8 derived features
+        for i in range(8):
+            a += ldx(BPF_W, R1, R10, S_FEAT + 4 * i)
+            a += stx(BPF_W, R2, REC_FEAT + 4 * i, R1)
+        a += mov64(R1, R2)
+        a += mov64_imm(R2, 0)
+        a += call(FN_ringbuf_submit)
+    else:
+        # ---- 16 B compact emit (fsx_kern.c FSX_EMIT_COMPACT twin) ----
+        # word 3 first (uses flags in R3 + len in R9 + ts in R7), all
+        # BEFORE reserve — a BPF-to-BPF call (fn_minifloat) must never
+        # execute while a ringbuf reference is held.
+        a += alu64_imm(BPF_AND, R3, 0x1F)
+        a += alu64_imm(BPF_LSH, R3, 11)
+        a += mov64(R1, R9)              # len8, round-to-nearest, sat
+        a += alu64_imm(BPF_ADD, R1, 4)
+        a += alu64_imm(BPF_RSH, R1, 3)
+        a.jmp_imm(BPF_JLE, R1, 2047, "cw3_len_ok")
+        a += mov64_imm(R1, 2047)
+        a.label("cw3_len_ok")
+        a += alu64(BPF_OR, R3, R1)
+        a += mov64(R1, R7)              # ts16 = (now/1000) & 0xFFFF
+        a += alu64_imm(BPF_DIV, R1, 1000)
+        a += alu64_imm(BPF_AND, R1, 0xFFFF)
+        a += alu64_imm(BPF_LSH, R1, 16)
+        a += alu64(BPF_OR, R3, R1)
+        a += stx(BPF_W, R10, S_CW3, R3)
+        # words 1/2: four minifloat-quantized features each (R6 is free
+        # after the derive block; the inline quantizer clobbers r0,r2-r5)
+        for word_slot, base in ((S_CW1, 0), (S_CW2, 16)):
+            a += mov64_imm(R6, 0)
+            for i in range(4):
+                a += ldx(BPF_W, R1, R10, S_FEAT + base + 4 * i)
+                _emit_minifloat_inline(a)
+                if i:
+                    a += alu64_imm(BPF_LSH, R0, 8 * i)
+                a += alu64(BPF_OR, R6, R0)
+            a += stx(BPF_W, R10, word_slot, R6)
+        a.ld_map(R1, "feature_ring")
+        a += mov64_imm(R2, COMPACT_REC_SIZE)
+        a += mov64_imm(R3, 0)
+        a += call(FN_ringbuf_reserve)
+        a.jmp_imm(BPF_JEQ, R0, 0, "allowed")  # ring full: fail open
+        a += mov64(R2, R0)
+        a += ldx(BPF_DW, R1, R10, S_SADDR)
+        a += stx(BPF_W, R2, 0, R1)
+        a += ldx(BPF_W, R1, R10, S_CW1)
+        a += stx(BPF_W, R2, 4, R1)
+        a += ldx(BPF_W, R1, R10, S_CW2)
+        a += stx(BPF_W, R2, 8, R1)
+        a += ldx(BPF_W, R1, R10, S_CW3)
+        a += stx(BPF_W, R2, 12, R1)
+        a += mov64(R1, R2)
+        a += mov64_imm(R2, 0)
+        a += call(FN_ringbuf_submit)
 
     # ---- exits -------------------------------------------------------
     a.label("allowed")  # fsx_kern.c:275-276
@@ -719,10 +848,11 @@ def build() -> Program:  # noqa: C901 — one linear hot path, kept whole
     return a.assemble()
 
 
-def load(sizes: MapSizes = MapSizes()) -> tuple[int, dict[str, loader.Map]]:
+def load(sizes: MapSizes = MapSizes(), compact: bool = False,
+         ) -> tuple[int, dict[str, loader.Map]]:
     """Create maps, load the program through the verifier; returns
     (prog_fd, maps).  Caller owns the fds."""
     maps = create_maps(sizes)
-    prog = build()
+    prog = build(compact=compact)
     fd = loader.prog_load(prog, map_fds={k: m.fd for k, m in maps.items()})
     return fd, maps

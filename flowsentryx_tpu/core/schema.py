@@ -641,6 +641,28 @@ def compact_flags(raw):
     return (raw[:-1, 3] >> np.uint32(11)) & np.uint32(0x1F)
 
 
+#: One KERNEL-emitted compact record (struct fsx_compact_record): the
+#: same four words as a compact wire row, except word 3's ts field is
+#: the kernel's (ktime_ns/1000) & 0xFFFF — a wrapped µs stamp the host
+#: unwraps (:func:`unwrap_kernel_ts16`) and rebases per batch.
+COMPACT_RECORD_DTYPE = np.dtype(
+    [("w0", "<u4"), ("w1", "<u4"), ("w2", "<u4"), ("w3", "<u4")]
+)
+assert COMPACT_RECORD_DTYPE.itemsize == COMPACT_RECORD_SIZE
+
+
+def unwrap_kernel_ts16(w3: np.ndarray, now_ns: int) -> np.ndarray:
+    """Recover absolute kernel-clock timestamps (ns, u64) from the
+    wrapped 16-bit µs stamps of kernel-emitted compact records.
+
+    Valid while records are drained within 65.5 ms of emission (ring
+    sizing + drain cadence enforce this; a staler record lands up to
+    n·65.5 ms late — bounded skew, never corruption)."""
+    now_us = np.uint64(now_ns // 1000)
+    ts16 = (w3 >> np.uint32(16)).astype(np.uint64)
+    return (now_us - ((now_us - ts16) & np.uint64(0xFFFF))) * np.uint64(1000)
+
+
 def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch:
     """Decode ``FLOW_RECORD_DTYPE`` entries into a padded :class:`FeatureBatch`.
 

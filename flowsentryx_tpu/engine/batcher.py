@@ -85,6 +85,50 @@ class MicroBatcher:
 
     # -- triggers -----------------------------------------------------------
 
+    def add_precompact(self, records: np.ndarray) -> list[np.ndarray]:
+        """Append KERNEL-quantized compact records
+        (``schema.COMPACT_RECORD_DTYPE``, from a compact-emit data
+        plane): features pass through untouched; only word 3's wrapped
+        µs stamp is unwrapped against the host clock and rebased to the
+        batch base.  Requires ``wire="compact16"``."""
+        if self.wire != schema.WIRE_COMPACT16:
+            raise ValueError("add_precompact requires the compact16 wire")
+        out: list[np.ndarray] = []
+        if not len(records):
+            return out
+        now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        ts_ns = schema.unwrap_kernel_ts16(records["w3"], now)
+        pos = 0
+        b = self.cfg.max_batch
+        while pos < len(records):
+            if self.fill == 0:
+                self._first_add_t = time.perf_counter()
+                self._base_ns = int(ts_ns[pos])
+            take = min(b - self.fill, len(records) - pos)
+            span_ok = (ts_ns[pos : pos + take].astype(np.int64)
+                       - self._base_ns) < 65_000_000
+            if not span_ok.all():
+                take = max(int(span_ok.argmin()), 0)
+                if take == 0:
+                    out.append(self._seal())
+                    continue
+            chunk = records[pos : pos + take]
+            dt_us = np.clip(
+                (ts_ns[pos : pos + take].astype(np.int64) - self._base_ns)
+                // 1000, 0, 65535,
+            ).astype(np.uint32)
+            buf = self._bufs[self._cur]
+            rows = buf[self.fill : self.fill + take]
+            rows[:, 0] = chunk["w0"]
+            rows[:, 1] = chunk["w1"]
+            rows[:, 2] = chunk["w2"]
+            rows[:, 3] = (chunk["w3"] & np.uint32(0xFFFF)) | (dt_us << 16)
+            self.fill += take
+            pos += take
+            if self.fill == b:
+                out.append(self._seal())
+        return out
+
     def add(self, records: np.ndarray) -> list[np.ndarray]:
         """Append records; returns the (possibly several) wire buffers
         completed by this addition."""

@@ -75,6 +75,13 @@ class Engine:
         # multi-device step (parallel/step.py) — state rows live
         # sharded across the mesh, the wire batch enters replicated.
         self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        # A compact-emit data plane (fsxd --compact) delivers records
+        # the KERNEL already quantized to the minifloat wire: the
+        # engine must speak compact16/minifloat end to end, whatever
+        # was requested.
+        self.precompact = bool(getattr(source, "precompact", False))
+        if self.precompact:
+            wire = schema.WIRE_COMPACT16
         self.wire = wire
         # compact16 quantizes features on the way into the batcher with
         # the model's own input observer when the artifact exposes one
@@ -82,11 +89,25 @@ class Engine:
         # ±1 output quant step for log1p ones), else the minifloat
         # fallback (≤6.25 % per-feature error) — announced, since it
         # changes borderline scores vs the raw48 wire.
-        quant = (
-            schema.wire_quant_for(self.params)
-            if wire == schema.WIRE_COMPACT16 else None
-        )
-        if quant is not None and quant.get("feat_mode") == "minifloat":
+        if self.precompact:
+            quant = dict(feat_mode="minifloat")
+            if hasattr(self.params, "in_scale"):
+                import sys
+
+                print(
+                    "fsx engine: compact-emit data plane delivers "
+                    "kernel-quantized minifloat features (<=6.25% "
+                    "relative error); the artifact's own input observer "
+                    "is bypassed. Serve a 48B plane for bit-exact "
+                    "model-mode quantization.",
+                    file=sys.stderr,
+                )
+        elif wire == schema.WIRE_COMPACT16:
+            quant = schema.wire_quant_for(self.params)
+        else:
+            quant = None
+        if (not self.precompact and quant is not None
+                and quant.get("feat_mode") == "minifloat"):
             import sys
 
             print(
@@ -230,12 +251,23 @@ class Engine:
             with self.metrics.fill.time():
                 records = self.source.poll(cfg_b.max_batch - self.batcher.fill)
                 if self._t0_auto and len(records):
-                    t0 = int(records["ts_ns"][0])
+                    if self.precompact:
+                        t0 = int(schema.unwrap_kernel_ts16(
+                            records["w3"][:1],
+                            time.clock_gettime_ns(time.CLOCK_MONOTONIC),
+                        )[0])
+                    else:
+                        t0 = int(records["ts_ns"][0])
                     self.batcher.t0_ns = t0
                     if hasattr(self.sink, "t0_ns"):
                         self.sink.t0_ns = t0  # sinks translate s -> abs ns
                     self._t0_auto = False
-                sealed = self.batcher.add(records) if len(records) else []
+                if not len(records):
+                    sealed = []
+                elif self.precompact:
+                    sealed = self.batcher.add_precompact(records)
+                else:
+                    sealed = self.batcher.add(records)
                 if not sealed and self.batcher.flush_due():
                     took = self.batcher.take()
                     sealed = [took] if took is not None else []

@@ -128,10 +128,30 @@ class ShmRing:
 
 
 class ShmRingSource:
-    """RecordSource over the daemon's feature ring."""
+    """RecordSource over the daemon's feature ring.
+
+    The record format is read off the ring header: 48 B rings carry
+    full-fidelity ``FLOW_RECORD_DTYPE`` records, 16 B rings carry
+    KERNEL-quantized ``COMPACT_RECORD_DTYPE`` records (a compact-emit
+    data plane / ``fsxd --compact``); ``precompact`` tells the engine
+    which batcher path to use."""
 
     def __init__(self, path: str | Path, timeout_s: float = 10.0):
-        self.ring = ShmRing.wait_for(path, schema.FLOW_RECORD_DTYPE, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        try:
+            self.ring = ShmRing.wait_for(
+                path, schema.FLOW_RECORD_DTYPE,
+                max(0.01, deadline - time.monotonic()),
+            )
+        except ValueError:
+            # size mismatch: re-open expecting the compact record
+            self.ring = ShmRing.wait_for(
+                path, schema.COMPACT_RECORD_DTYPE,
+                max(0.01, deadline - time.monotonic()),
+            )
+        self.precompact = (
+            self.ring.record_size == schema.COMPACT_RECORD_SIZE
+        )
 
     def poll(self, max_records: int) -> np.ndarray:
         return self.ring.consume(max_records)

@@ -67,6 +67,39 @@ FSX_CINLINE __u32 fsx_isqrt_u64(__u64 x)
 	return (__u32)r;
 }
 
+/* u8 "e5m3" minifloat encode for the compact 16 B wire record
+ * (core/schema.py quantize_feat_minifloat — kept in exact lockstep,
+ * tested by tests/test_kern.py): values <= 7 verbatim; above, q =
+ * 8*(e+1) + m with feat ~= (8+m)*2^(e-1), round-to-nearest, covering
+ * the full u64-saturated-to-u32 range with <= 6.25 % relative error.
+ * Integer-only (no FPU in eBPF, fsx_kern_ml.c:3-6); the bit-length
+ * scan is a fixed 6-step ladder the verifier unrolls. */
+FSX_CINLINE __u32 fsx_minifloat8(__u64 f)
+{
+	__u32 bl = 0, e;
+	__u64 t = f, r;
+
+	if (f < 8)
+		return (__u32)f;
+	if (t >= (1ULL << 32)) { bl += 32; t >>= 32; }
+	if (t >= (1ULL << 16)) { bl += 16; t >>= 16; }
+	if (t >= (1ULL << 8))  { bl += 8;  t >>= 8; }
+	if (t >= (1ULL << 4))  { bl += 4;  t >>= 4; }
+	if (t >= (1ULL << 2))  { bl += 2;  t >>= 2; }
+	if (t >= (1ULL << 1))  { bl += 1;  t >>= 1; }
+	bl += (__u32)t;             /* residual top bit */
+	e = bl - 4;                 /* f in [8*2^e, 16*2^e) */
+	r = e > 0 ? ((f >> (e - 1)) + 1) >> 1 : f;  /* mantissa in [8,16] */
+	if (r == 16) {
+		e += 1;
+		r = 8;
+	}
+	{
+		__u32 q = (e + 1) * 8 + (__u32)(r - 8);
+		return q > 255 ? 255 : q;
+	}
+}
+
 /* Fixed window (fsx_kern.c:243-263 semantics; window reset seeds with
  * THIS packet — the reference seeded 0, SURVEY.md §7.5). */
 FSX_CINLINE int fsx_limiter_fixed_window(

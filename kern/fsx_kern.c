@@ -99,7 +99,9 @@ static __always_inline void extract_features(
 {
 	__u32 fkey = pkt->saddr ^ ((__u32)pkt->dport << 16);
 	struct fsx_flow_stats *fs, zero = {};
+#ifndef FSX_EMIT_COMPACT
 	struct fsx_flow_record *rec;
+#endif
 
 	fs = bpf_map_lookup_elem(&flow_stats_map, &fkey);
 	if (!fs) {
@@ -143,15 +145,12 @@ static __always_inline void extract_features(
 	if (n_now > 16 && (n_now & 15))
 		return;
 
-	rec = bpf_ringbuf_reserve(&feature_ring, sizeof(*rec), 0);
-	if (!rec)
-		return;         /* ring full: TPU plane lags; fail open */
-
+	/* All-integer feature derivation (no FPU in eBPF,
+	 * fsx_kern_ml.c:3-6), SHARED by both emit formats below — one
+	 * copy, so the wire formats can never skew against each other.
+	 * Values beyond u32 saturate at the emit sites — the model's
+	 * input quantization clips far below 2^32 anyway. */
 	{
-		/* All-integer feature derivation (no FPU in eBPF,
-		 * fsx_kern_ml.c:3-6); the host casts u32 → f32.  Values
-		 * beyond u32 saturate — the model's input quantization
-		 * clips far below 2^32 anyway. */
 		__u64 n = fs->pkt_count;
 		__u64 mean = fs->byte_sum / n;
 		__u64 var = fs->byte_sq_sum / n > mean * mean
@@ -162,17 +161,49 @@ static __always_inline void extract_features(
 		__u64 iat_var = fs->iat_sq_sum_us2 / iat_n > iat_mean_sq
 			? fs->iat_sq_sum_us2 / iat_n - iat_mean_sq : 0;
 		__u64 iat_max_us = fs->iat_max_ns / 1000;
-
-		rec->ts_ns = now;
-		rec->saddr = pkt->saddr;
-		rec->pkt_len = (__u16)bytes;
-		rec->ip_proto = pkt->l4_proto;
-		rec->flags = (pkt->is_ipv6 ? FSX_FLAG_IPV6 : 0)
+		__u8 fl = (pkt->is_ipv6 ? FSX_FLAG_IPV6 : 0)
 			| (pkt->l4_proto == IPPROTO_TCP ? FSX_FLAG_TCP : 0)
 			| (pkt->l4_proto == IPPROTO_UDP ? FSX_FLAG_UDP : 0)
 			| (pkt->l4_proto == IPPROTO_ICMP
 			   || pkt->l4_proto == IPPROTO_ICMPV6 ? FSX_FLAG_ICMP : 0)
 			| ((pkt->tcp_flags & FSX_TCP_SYN) ? FSX_FLAG_TCP_SYN : 0);
+
+#ifdef FSX_EMIT_COMPACT
+		/* Compact 16 B records: features quantized IN KERNEL to the
+		 * u8 e5m3 minifloat the host decoder shares (fsx_compute.h
+		 * fsx_minifloat8 == schema.quantize_feat_minifloat, lockstep-
+		 * tested) — 3x less ring + host->device traffic, zero host-
+		 * side quantization work.  Layout: struct fsx_compact_record
+		 * (fsx_schema.h).  Saturate to u32 BEFORE quantizing, exactly
+		 * like the 48 B path's feat[] fields. */
+		struct fsx_compact_record *crec;
+		__u32 len8 = (__u32)((bytes + 4) >> 3);
+
+		crec = bpf_ringbuf_reserve(&feature_ring, sizeof(*crec), 0);
+		if (!crec)
+			return; /* ring full: TPU plane lags; fail open */
+		crec->w0_saddr = pkt->saddr;
+		crec->w1_feat_lo = fsx_minifloat8(fs->dst_port)
+			| fsx_minifloat8(fsx_sat_u32(mean)) << 8
+			| fsx_minifloat8(fsx_isqrt_u64(var)) << 16
+			| fsx_minifloat8(fsx_sat_u32(var)) << 24;
+		crec->w2_feat_hi = fsx_minifloat8(fsx_sat_u32(mean))
+			| fsx_minifloat8(fsx_sat_u32(iat_mean_us)) << 8
+			| fsx_minifloat8(fsx_isqrt_u64(iat_var)) << 16
+			| fsx_minifloat8(fsx_sat_u32(iat_max_us)) << 24;
+		crec->w3_len_flags_ts = (len8 > 2047 ? 2047 : len8)
+			| ((__u32)fl & 0x1F) << 11
+			| (__u32)((now / 1000) & 0xFFFF) << 16;
+		bpf_ringbuf_submit(crec, 0);
+#else
+		rec = bpf_ringbuf_reserve(&feature_ring, sizeof(*rec), 0);
+		if (!rec)
+			return;  /* ring full: TPU plane lags; fail open */
+		rec->ts_ns = now;
+		rec->saddr = pkt->saddr;
+		rec->pkt_len = (__u16)bytes;
+		rec->ip_proto = pkt->l4_proto;
+		rec->flags = fl;
 		rec->feat[0] = fs->dst_port;
 		rec->feat[1] = fsx_sat_u32(mean);
 		rec->feat[2] = fsx_isqrt_u64(var);
@@ -181,8 +212,9 @@ static __always_inline void extract_features(
 		rec->feat[5] = fsx_sat_u32(iat_mean_us);
 		rec->feat[6] = fsx_isqrt_u64(iat_var);
 		rec->feat[7] = fsx_sat_u32(iat_max_us);
+		bpf_ringbuf_submit(rec, 0);
+#endif
 	}
-	bpf_ringbuf_submit(rec, 0);
 }
 
 /* ---- the XDP program (successor of fsx(), fsx_kern.c:97-347) ---- */

@@ -310,6 +310,30 @@ static void test_struct_sizes(void)
 	CHECK(sizeof(struct fsx_config) == 56, "config 56B");
 }
 
+static void test_minifloat(void)
+{
+	int bad = 0;
+
+	/* small values verbatim; decode(q) within 6.25% everywhere */
+	for (__u64 f = 0; f < 8; f++)
+		if (fsx_minifloat8(f) != (__u32)f)
+			bad++;
+	CHECK(bad == 0, "minifloat: 0..7 verbatim");
+	bad = 0;
+	for (__u64 f = 8; f < (1ULL << 33); f = f + f / 64 + 1) {
+		__u32 q = fsx_minifloat8(f);
+		__u64 dec = q < 8 ? q : (8ULL + q % 8) << (q / 8 - 1);
+		__u64 err = dec > f ? dec - f : f - dec;
+		if (err * 16 > f)   /* > 6.25% relative */
+			bad++;
+		if (q > 255)
+			bad++;
+	}
+	CHECK(bad == 0, "minifloat: <=6.25% rel err over full range");
+	CHECK(fsx_minifloat8(0xFFFFFFFFFFFFFFFFULL) == 255,
+	      "minifloat: saturates at 255");
+}
+
 int main(void)
 {
 	test_parse_udp4();
@@ -325,6 +349,7 @@ int main(void)
 	test_token_bucket_subms_refill();
 	test_isqrt();
 	test_struct_sizes();
+	test_minifloat();
 
 	if (failures) {
 		printf("\n%d FAILURES\n", failures);

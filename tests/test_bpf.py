@@ -92,8 +92,8 @@ def saddr_key(saddr: int) -> bytes:
 class Fsx:
     """One loaded program instance + its maps + ring reader."""
 
-    def __init__(self, sizes: progs.MapSizes = SMALL):
-        self.fd, self.maps = progs.load(sizes)
+    def __init__(self, sizes: progs.MapSizes = SMALL, compact: bool = False):
+        self.fd, self.maps = progs.load(sizes, compact=compact)
         self.ring = loader.RingbufReader(self.maps["feature_ring"])
 
     def push_config(self, **limiter_kw) -> None:
@@ -117,6 +117,13 @@ class Fsx:
         if not recs:
             return np.zeros(0, dtype=schema.FLOW_RECORD_DTYPE)
         return np.frombuffer(b"".join(recs), dtype=schema.FLOW_RECORD_DTYPE)
+
+    def compact_records(self) -> np.ndarray:
+        """[n, 4] u32 words from a compact-emit program's ring."""
+        recs = self.ring.read()
+        if not recs:
+            return np.zeros((0, 4), np.uint32)
+        return np.frombuffer(b"".join(recs), dtype=np.uint32).reshape(-1, 4)
 
 
 @pytest.fixture()
@@ -552,3 +559,76 @@ class TestBlacklistCli:
         assert cli.main(["unblock", "192.0.2.7", "--pin", pin_dir]) == 0
         assert js.loads(capsys.readouterr().out)["was_present"] is True
         assert cli.main(["unblock", "192.0.2.7", "--pin", pin_dir]) == 1
+
+
+# ---- compact 16 B emission (kernel-quantized wire) -------------------
+
+
+class TestCompactEmit:
+    """build(compact=True): the kernel quantizes features to the u8
+    minifloat wire in-program — verifier-accepted, and every emitted
+    word must match the Python quantizer applied to the flow-stats map
+    state (the same lockstep schema.quantize_feat_minifloat)."""
+
+    @pytest.fixture()
+    def cfsx(self):
+        f = Fsx(compact=True)
+        f.push_config()
+        return f
+
+    def test_record_fields(self, cfsx):
+        t0 = ktime_ns()
+        assert cfsx.run(ip4_pkt(0x01010101, proto=17, dport=53,
+                                plen=100)) == XDP_PASS
+        w = cfsx.compact_records()
+        assert w.shape == (1, 4)
+        assert w[0, 0] == 0x01010101
+        # w3: len8 (round-to-nearest eighth), flags, wrapped ts16
+        assert int(w[0, 3]) & 0x7FF == (100 + 4) >> 3
+        assert (int(w[0, 3]) >> 11) & 0x1F == schema.FLAG_UDP
+        ts16 = int(w[0, 3]) >> 16
+        now16 = (ktime_ns() // 1000) & 0xFFFF
+        assert ((now16 - ts16) & 0xFFFF) < 50_000  # emitted just now
+        assert t0 > 0
+
+    def test_feature_quantization_lockstep(self, cfsx):
+        """Quantized features == quantize_feat_minifloat(mirror(map))
+        over a multi-packet flow with real kernel timestamps."""
+        saddr, dport = 0x0F000002, 8080
+        rng = np.random.default_rng(11)
+        dport_be = ((dport & 0xFF) << 8) | (dport >> 8)
+        fkey = (saddr ^ (dport_be << 16)) & 0xFFFFFFFF
+        for i in range(10):
+            plen = int(rng.integers(60, 1400))
+            assert cfsx.run(ip4_pkt(saddr, proto=17, dport=dport,
+                                    plen=plen)) == XDP_PASS
+            fs = _read_flow_stats(cfsx, fkey)
+            w = cfsx.compact_records()
+            assert w.shape == (1, 4)
+            exp = schema.quantize_feat_minifloat(
+                np.array(_derive_mirror(fs), np.uint32)
+            )
+            got = [
+                (int(w[0, 1]) >> (8 * j)) & 0xFF for j in range(4)
+            ] + [
+                (int(w[0, 2]) >> (8 * j)) & 0xFF for j in range(4)
+            ]
+            assert got == exp.tolist(), f"packet {i}: {got} != {exp}"
+
+    def test_limiters_still_block(self, cfsx):
+        """The compact variant shares the whole fast path: flooding a
+        source must still rate-limit + blacklist it."""
+        saddr = 0x0C0C0C0C
+        results = [cfsx.run(ip4_pkt(saddr)) for _ in range(1105)]
+        assert XDP_DROP in results
+        st = cfsx.stats()
+        assert st["dropped_rate"] >= 1 and st["dropped_blacklist"] >= 1
+
+    def test_ipv6_compact(self, cfsx):
+        words = (0x11111111, 0x22222222, 0x33333333, 0x44444444)
+        assert cfsx.run(ip6_pkt(words)) == XDP_PASS
+        w = cfsx.compact_records()
+        fold = words[0] ^ words[1] ^ words[2] ^ words[3]
+        assert w[0, 0] == fold
+        fl = (int(w[0, 3]) >> 11) & 0x1F
+        assert fl & schema.FLAG_IPV6 and fl & schema.FLAG_UDP

@@ -155,3 +155,61 @@ def test_daemon_bpf_end_to_end(fsxd_bin, prog_image, tmp_path):
     assert stats["produced"] >= 10
     assert stats["verdicts"] == 1
     assert stats["dropped_rate"] >= 1
+
+
+@pytest.fixture(scope="module")
+def compact_prog_image(tmp_path_factory):
+    out = tmp_path_factory.mktemp("imgc") / "fsx_prog_c.img"
+    r = subprocess.run(
+        ["python", "-m", "flowsentryx_tpu.bpf.image", str(out),
+         "--track-ips=1024", "--ring-bytes=16384", "--compact"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0, r.stderr
+    return out
+
+
+def test_daemon_bpf_compact_end_to_end(fsxd_bin, compact_prog_image, tmp_path):
+    """fsxd --compact with a compact-emit image: 16 B kernel-quantized
+    records arrive in the shm ring and the ShmRingSource auto-detects
+    the format for the engine's precompact path."""
+    if not _bpffs_ready():
+        pytest.skip("bpffs not mountable in this container")
+    subprocess.run(["rm", "-rf", PIN_DIR], check=False)
+
+    fring_path = tmp_path / "fring_c"
+    vring_path = tmp_path / "vring_c"
+    proc = subprocess.Popen(
+        [str(fsxd_bin), "--bpf", "none", "--compact",
+         "--prog-image", str(compact_prog_image),
+         "--pin", PIN_DIR, "--duration", "10",
+         "--feature-ring", str(fring_path), "--verdict-ring", str(vring_path),
+         "--pps-threshold", "1000", "--window", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 5
+        while not os.path.exists(f"{PIN_DIR}/prog"):
+            assert time.time() < deadline, \
+                f"daemon never pinned:\n{proc.stderr.read() if proc.poll() else ''}"
+            time.sleep(0.1)
+        prog_fd = obj_get(f"{PIN_DIR}/prog")
+
+        for i in range(8):
+            assert loader.prog_test_run(prog_fd, ip4(0x0A000200 + i))[0] == 2
+
+        time.sleep(1.5)
+        from flowsentryx_tpu.engine.shm import ShmRingSource
+
+        src = ShmRingSource(fring_path, timeout_s=3)
+        assert src.precompact  # auto-detected 16 B records
+        arr = src.poll(100)
+        assert len(arr) == 8
+        assert {0x0A000200 + i for i in range(8)} == set(arr["w0"].tolist())
+        # every record carries the UDP flag in word 3
+        assert ((arr["w3"] >> 11) & 0x1F == schema.FLAG_UDP).all()
+    finally:
+        proc.send_signal(2)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        subprocess.run(["rm", "-rf", PIN_DIR], check=False)

@@ -52,3 +52,14 @@ def test_cli_rejects_bad_args(tmp_path):
     assert image.main(["image", "--frob=1"]) == 2
     assert image.main(["image", str(tmp_path / "a"), str(tmp_path / "b")]) == 2
     assert not (tmp_path / "a").exists() and not (tmp_path / "b").exists()
+
+
+def test_checked_in_compact_image_fresh():
+    img = REPO / "kern" / "build" / "fsx_prog_compact.img"
+    assert img.exists(), ("kern/build/fsx_prog_compact.img missing — run "
+                          "python -m flowsentryx_tpu.bpf.image --compact")
+    assert img.read_bytes() == image.emit(sizes=progs.MapSizes(),
+                                          compact=True), (
+        "checked-in compact image is stale — regenerate with: python -m "
+        "flowsentryx_tpu.bpf.image --compact kern/build/fsx_prog_compact.img"
+    )

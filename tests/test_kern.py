@@ -70,3 +70,42 @@ def test_flow_record_feature_u32_roundtrip():
         np.asarray(b.feat[0]), [53, 1400, 37, 1369, 1400, 1000000, 999, 4000000]
     )
     assert float(b.feat[1, 3]) == float(np.float32(0xFFFFFFFF))
+
+
+def test_minifloat_c_python_lockstep(tmp_path):
+    """kern/fsx_compute.h fsx_minifloat8 must agree EXACTLY with
+    schema.quantize_feat_minifloat — the kernel-side emitter and the
+    host decoder share the compact wire's feature code space."""
+    from flowsentryx_tpu.core import schema
+
+    driver = tmp_path / "mf.c"
+    driver.write_text(
+        '#define FSX_HOST_BUILD 1\n'
+        '#include <stdio.h>\n#include "fsx_schema.h"\n'
+        '#include "fsx_compute.h"\n'
+        'int main(void){unsigned long long f;\n'
+        ' while (scanf("%llu", &f) == 1) printf("%u\\n", fsx_minifloat8(f));\n'
+        ' return 0;}\n'
+    )
+    exe = tmp_path / "mf"
+    r = subprocess.run(
+        ["gcc", "-O2", "-I", str(KERN), str(driver), "-o", str(exe)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    rng = np.random.default_rng(5)
+    vals = np.concatenate([
+        np.arange(0, 4096, dtype=np.uint64),
+        (np.uint64(1) << rng.integers(3, 32, 3000).astype(np.uint64))
+        + rng.integers(0, 1 << 16, 3000).astype(np.uint64),
+        rng.integers(0, 0xFFFFFFFF, 5000).astype(np.uint64),
+        np.array([0xFFFFFFFF], np.uint64),
+    ])
+    out = subprocess.run(
+        [str(exe)], input="\n".join(str(int(v)) for v in vals) + "\n",
+        capture_output=True, text=True,
+    )
+    c_q = np.array([int(x) for x in out.stdout.split()], np.uint32)
+    py_q = schema.quantize_feat_minifloat(vals.astype(np.uint32))
+    np.testing.assert_array_equal(c_q, py_q)
