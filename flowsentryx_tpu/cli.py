@@ -96,6 +96,19 @@ def _cmd_blacklist(args: argparse.Namespace) -> int:
     return 0
 
 
+def _honor_jax_platform() -> None:
+    """Some TPU plugins force-register themselves regardless of
+    JAX_PLATFORMS; honor an explicit env request through the config API
+    (the route tests/conftest.py uses for the virtual CPU mesh).  Called
+    by the jax-using subcommands before any backend initializes — the
+    others stay free of the multi-second jax import."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def _load_cfg(args: argparse.Namespace):
     from flowsentryx_tpu.core.config import DEFAULT_CONFIG, FsxConfig
 
@@ -112,6 +125,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
+    _honor_jax_platform()
     cfg = _load_cfg(args)
     if args.feature_ring:
         from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
@@ -120,16 +134,35 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         sink = (
             ShmVerdictSink(args.verdict_ring) if args.verdict_ring else NullSink()
         )
+    elif args.records:
+        import numpy as np
+
+        from flowsentryx_tpu.core import schema
+        from flowsentryx_tpu.engine import ArraySource
+
+        source = ArraySource(np.frombuffer(
+            Path(args.records).read_bytes(), schema.FLOW_RECORD_DTYPE
+        ))
+        sink = NullSink()
     else:
         source = TrafficSource(
             TrafficSpec(scenario=Scenario(args.scenario), rate_pps=args.rate),
             total=args.packets or None,
         )
         sink = NullSink()
-    eng = Engine(cfg, source, sink)
+    mesh = None
+    if args.mesh and args.mesh > 1:
+        from flowsentryx_tpu.parallel import make_mesh
+
+        mesh = make_mesh(args.mesh)
+    eng = Engine(cfg, source, sink, mesh=mesh)
+    if args.restore:
+        eng.restore(args.restore)
     rep = eng.run(
         max_batches=args.batches or None, max_seconds=args.seconds or None
     )
+    if args.checkpoint:
+        eng.checkpoint(args.checkpoint)
     print(json.dumps(rep._asdict(), indent=2))
     return 0
 
@@ -163,7 +196,57 @@ def _cmd_status(args: argparse.Namespace) -> int:
             "consumed": tail,
             "backlog": head - tail,
         }
+
+    if args.pin:
+        # live kernel counters off the pinned maps (the reference's
+        # planned "display network statistics", README.md:143-146)
+        import struct as _struct
+
+        from flowsentryx_tpu.bpf import blacklist, loader
+
+        kern: dict = {}
+        try:
+            fd = loader.obj_get(f"{args.pin}/stats_map")
+            m = loader.Map(fd, loader.MAP_TYPE_PERCPU_ARRAY, 4, 32,
+                           1, "stats_map")
+            tot = [0, 0, 0, 0]
+            for v in m.lookup_percpu(b"\x00\x00\x00\x00"):
+                for i, x in enumerate(_struct.unpack("<4Q", v)):
+                    tot[i] += x
+            m.close()
+            kern["stats"] = dict(zip(
+                ("allowed", "dropped_blacklist", "dropped_rate",
+                 "dropped_ml"), tot))
+        except OSError as e:
+            kern["stats"] = {"error": str(e)}
+        try:
+            bm = blacklist.open_map(args.pin)
+            kern["blacklist_entries"] = len(blacklist.entries(bm))
+            bm.close()
+        except OSError as e:
+            kern["blacklist_entries"] = {"error": str(e)}
+        out["kernel"] = kern
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_pcap(args: argparse.Namespace) -> int:
+    """Convert a capture to flow records (kernel-mirror parsing +
+    streaming features).  The output file holds raw fsx_flow_record
+    structs — consumable by ``fsxd --replay``, ``fsx serve --records``,
+    and the training pipeline."""
+    from flowsentryx_tpu.engine import pcap
+
+    tracker = pcap.FlowTracker(emit_all=args.emit_all)
+    rec = pcap.pcap_to_records(args.pcap, emit_all=args.emit_all,
+                               limit=args.limit or None, tracker=tracker)
+    Path(args.out).write_bytes(rec.tobytes())
+    print(json.dumps({
+        "packets_emitted": int(len(rec)),
+        "flows": len(tracker.flows),  # (saddr, dport) flow keys
+        "out": args.out,
+        "bytes": len(rec) * rec.dtype.itemsize,
+    }))
     return 0
 
 
@@ -174,6 +257,7 @@ def _cmd_train(args: argparse.Namespace) -> int:
     without it, trains on the synthetic labeled set."""
     from flowsentryx_tpu.train import data, evaluate, qat
 
+    _honor_jax_platform()
     if args.epochs < 1:
         raise SystemExit("--epochs must be >= 1")
     if args.data == "fixture":
@@ -224,14 +308,7 @@ def _cmd_bench(args: argparse.Namespace) -> int:
     import sys as _sys
 
     if args.scenarios or args.scaling:
-        # The axon TPU plugin registers itself regardless of
-        # JAX_PLATFORMS, so honor the env var through the config API
-        # (the route tests/conftest.py uses for the virtual CPU mesh).
-        plat = os.environ.get("JAX_PLATFORMS")
-        if plat:
-            import jax
-
-            jax.config.update("jax_platforms", plat)
+        _honor_jax_platform()
 
     if args.scenarios:
         from flowsentryx_tpu import benchmarks
@@ -306,18 +383,37 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--config", help="JSON config file")
     s.add_argument("--feature-ring", help="daemon shm feature ring path")
     s.add_argument("--verdict-ring", help="daemon shm verdict ring path")
+    s.add_argument("--records",
+                   help="replay a raw fsx_flow_record file (fsx pcap output)")
     s.add_argument("--scenario", default="syn_benign_mix",
                    help="synthetic scenario when no ring is given")
     s.add_argument("--rate", type=float, default=1e6, help="synthetic pps")
     s.add_argument("--packets", type=int, default=0, help="stop after N records")
     s.add_argument("--batches", type=int, default=0, help="stop after N batches")
     s.add_argument("--seconds", type=float, default=0, help="stop after S seconds")
+    s.add_argument("--mesh", type=int, default=0,
+                   help="serve sharded over an N-device mesh (N>1)")
+    s.add_argument("--checkpoint", help="save table+stats here on exit")
+    s.add_argument("--restore", help="resume from a checkpoint file")
     s.set_defaults(fn=_cmd_serve)
 
     st = sub.add_parser("status", help="inspect the shm transport")
     st.add_argument("--feature-ring", default="/tmp/fsx_feature_ring")
     st.add_argument("--verdict-ring", default="/tmp/fsx_verdict_ring")
+    st.add_argument("--pin",
+                    help="also read kernel stats/blacklist off this "
+                         "bpffs pin dir (e.g. /sys/fs/bpf/fsx)")
     st.set_defaults(fn=_cmd_status)
+
+    pc = sub.add_parser("pcap", help="convert a capture to flow records")
+    pc.add_argument("pcap", help="classic-pcap capture file")
+    pc.add_argument("out", help="output file (raw fsx_flow_record structs)")
+    pc.add_argument("--emit-all", action="store_true",
+                    help="emit every packet (default: kernel gating — "
+                         "every packet while young, then every 16th)")
+    pc.add_argument("--limit", type=int, default=0,
+                    help="stop after N emitted records")
+    pc.set_defaults(fn=_cmd_pcap)
 
     t = sub.add_parser("train", help="train a model, export the artifact")
     t.add_argument("--model", default="logreg_int8",
@@ -355,8 +451,6 @@ def main(argv: list[str] | None = None) -> int:
         return args.fn(args)
     except BrokenPipeError:
         # stdout went away (e.g. piped to `head`); standard CLI etiquette.
-        import os
-
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
 

@@ -206,6 +206,24 @@ def test_daemon_bpf_compact_end_to_end(fsxd_bin, compact_prog_image, tmp_path):
         assert {0x0A000200 + i for i in range(8)} == set(arr["w0"].tolist())
         # every record carries the UDP flag in word 3
         assert ((arr["w3"] >> 11) & 0x1F == schema.FLAG_UDP).all()
+
+        # operator surface: fsx status --pin reads live kernel counters
+        import json as js
+
+        from flowsentryx_tpu import cli
+
+        import io
+        import contextlib
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            assert cli.main(["status", "--feature-ring", str(fring_path),
+                             "--verdict-ring", str(vring_path),
+                             "--pin", PIN_DIR]) == 0
+        status = js.loads(out.getvalue())
+        assert status["feature_ring"]["record_size"] == 16
+        assert status["kernel"]["stats"]["allowed"] >= 8
+        assert status["kernel"]["blacklist_entries"] == 0
     finally:
         proc.send_signal(2)
         try:
