@@ -140,9 +140,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.core import schema
         from flowsentryx_tpu.engine import ArraySource
 
-        source = ArraySource(np.frombuffer(
+        arr = np.frombuffer(
             Path(args.records).read_bytes(), schema.FLOW_RECORD_DTYPE
-        ))
+        )
+        if args.packets:
+            arr = arr[: args.packets]
+        source = ArraySource(arr)
         sink = NullSink()
     else:
         source = TrafficSource(
@@ -204,19 +207,22 @@ def _cmd_status(args: argparse.Namespace) -> int:
 
         from flowsentryx_tpu.bpf import blacklist, loader
 
+        # layout derived from the same schema the C struct is
+        # generated from — adding a counter there updates this view
+        names = [n for n, _ in schema.KERNEL_STATS_FIELDS]
+        vsize = 8 * len(names)
+        fmt = f"<{len(names)}Q"
         kern: dict = {}
         try:
             fd = loader.obj_get(f"{args.pin}/stats_map")
-            m = loader.Map(fd, loader.MAP_TYPE_PERCPU_ARRAY, 4, 32,
+            m = loader.Map(fd, loader.MAP_TYPE_PERCPU_ARRAY, 4, vsize,
                            1, "stats_map")
-            tot = [0, 0, 0, 0]
+            tot = [0] * len(names)
             for v in m.lookup_percpu(b"\x00\x00\x00\x00"):
-                for i, x in enumerate(_struct.unpack("<4Q", v)):
+                for i, x in enumerate(_struct.unpack(fmt, v)):
                     tot[i] += x
             m.close()
-            kern["stats"] = dict(zip(
-                ("allowed", "dropped_blacklist", "dropped_rate",
-                 "dropped_ml"), tot))
+            kern["stats"] = dict(zip(names, tot))
         except OSError as e:
             kern["stats"] = {"error": str(e)}
         try:
@@ -260,6 +266,34 @@ def _cmd_train(args: argparse.Namespace) -> int:
     _honor_jax_platform()
     if args.epochs < 1:
         raise SystemExit("--epochs must be >= 1")
+
+    if args.model == "multiclass":
+        # needs subtype labels — the calibrated fixture provides them
+        # (CSV datasets are binary-labeled); handled before the generic
+        # loader so no dataset is built just to be discarded.
+        if args.data not in (None, "fixture"):
+            raise SystemExit(
+                "multiclass training needs subtype labels; use "
+                "--data fixture (CSV datasets are binary-labeled)")
+        from flowsentryx_tpu.models import multiclass
+        from flowsentryx_tpu.train import fixture as fx
+
+        n = args.synthetic if args.synthetic is not None else 200_000
+        X, _, y_class = fx.cicids_fixture(n=n, seed=args.seed,
+                                          return_classes=True)
+        Xtr, Xte, ytr, yte = data.train_test_split(X, y_class)
+        params, losses = qat.train_multiclass(
+            Xtr, ytr, epochs=args.epochs, seed=args.seed)
+        out = {
+            "model": args.model, "train_n": len(Xtr), "test_n": len(Xte),
+            "final_loss": float(losses[-1]),
+            "test": evaluate.multiclass_report(params, Xte, yte),
+        }
+        if args.out:
+            out["artifact"] = multiclass.save_params(params, args.out)
+        print(json.dumps(out, indent=2))
+        return 0
+
     if args.data == "fixture":
         # the documented CICIDS-calibrated stand-in (train/fixture.py);
         # --synthetic sets its size (default: the real cleaned-set size)
@@ -417,7 +451,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="train a model, export the artifact")
     t.add_argument("--model", default="logreg_int8",
-                   choices=["logreg_int8", "mlp"])
+                   choices=["logreg_int8", "mlp", "multiclass"])
     t.add_argument("--data",
                    help="CSV glob (CICIDS2017/CICDDoS2019 format), or "
                         "'fixture' for the CICIDS-calibrated stand-in")

@@ -164,8 +164,10 @@ class FlowTracker:
                   - (mean * mean & ((1 << 64) - 1)), 0)
         iat_n = max(n - 1, 1)
         iat_mean_us = (fs["iat_sum_ns"] // iat_n) // 1000
-        iat_var = max(fs["iat_sq_sum_us2"] // iat_n
-                      - iat_mean_us * iat_mean_us, 0)
+        # the kernel squares in u64 (wraps past 2^32 us means — ~71 min
+        # idle gaps); mirror the wrap or long-idle flows diverge
+        iat_mean_sq = (iat_mean_us * iat_mean_us) & ((1 << 64) - 1)
+        iat_var = max(fs["iat_sq_sum_us2"] // iat_n - iat_mean_sq, 0)
         return [
             fs["dst_port"], sat(mean), math.isqrt(var), sat(var),
             sat(mean), sat(iat_mean_us), math.isqrt(iat_var),
@@ -185,9 +187,8 @@ def pcap_to_records(path: str | Path, emit_all: bool = False,
     ``tracker`` to inspect per-flow state (e.g. flow counts) after."""
     import sys
 
-    tracker = tracker if tracker is not None else FlowTracker(
-        emit_all=emit_all)
-    tracker.emit_all = emit_all
+    if tracker is None:
+        tracker = FlowTracker(emit_all=emit_all)
     rows: list[tuple] = []
     dropped_truncated = 0
     for ts_ns, frame, orig in read_pcap(path):

@@ -1,0 +1,138 @@
+"""Per-attack-class expert heads — the EP extension point, realized.
+
+SURVEY.md §2.3's expert-parallelism row notes the reference has a
+single binary model and tells the rebuild to "leave [an] extension
+point for per-attack-class expert heads".  This family IS that
+extension: a shared trunk feeding one softmax head per attack class,
+so a verdict carries attribution (which kind of attack), not just a
+drop bit.
+
+Serving contract: :func:`classify_batch` returns the BINARY attack
+probability ``1 - P(benign)`` — the same ``[B, 8] → [B]`` scalar
+contract every registered family speaks, so the engine serves this
+model unchanged (`ModelConfig.name = "multiclass"`), and
+:func:`attack_class` adds the attribution on demand (operator
+tooling, per-class stats, future per-class blocking policy).
+
+Same feature transform as the MLP family (symmetric log compression),
+bfloat16 trunk for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flowsentryx_tpu.core.schema import NUM_FEATURES
+
+#: Class 0 MUST be benign (classify_batch's binary contract relies on it).
+ATTACK_CLASSES: tuple[str, ...] = (
+    "benign", "volumetric_flood", "syn_flood", "slow_attack"
+)
+NUM_CLASSES = len(ATTACK_CLASSES)
+
+
+class MulticlassParams(NamedTuple):
+    w1: jnp.ndarray  # [8, H]
+    b1: jnp.ndarray  # [H]
+    w2: jnp.ndarray  # [H, H]
+    b2: jnp.ndarray  # [H]
+    w3: jnp.ndarray  # [H, C]   — the per-class expert heads
+    b3: jnp.ndarray  # [C]
+
+
+def init_params(
+    key: jax.Array, hidden: int = 32, dtype: jnp.dtype = jnp.bfloat16
+) -> MulticlassParams:
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def he(k, fan_in, shape):
+        return (jax.random.normal(k, shape)
+                * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+    return MulticlassParams(
+        w1=he(k1, NUM_FEATURES, (NUM_FEATURES, hidden)),
+        b1=jnp.zeros((hidden,), dtype),
+        w2=he(k2, hidden, (hidden, hidden)),
+        b2=jnp.zeros((hidden,), dtype),
+        w3=he(k3, hidden, (hidden, NUM_CLASSES)),
+        b3=jnp.zeros((NUM_CLASSES,), dtype),
+    )
+
+
+def logits(params: MulticlassParams, x: jnp.ndarray) -> jnp.ndarray:
+    """``[B, 8] → [B, C]`` — shared trunk, one logit per class.  Same
+    symmetric log compression as the MLP family (models/mlp.py): part
+    of the feature contract, applied identically at train and serve."""
+    x = jnp.sign(x) * jnp.log1p(jnp.abs(x))
+    h = jax.nn.relu(x.astype(params.w1.dtype) @ params.w1 + params.b1)
+    h = jax.nn.relu(h @ params.w2 + params.b2)
+    return (h @ params.w3 + params.b3).astype(jnp.float32)
+
+
+def class_probs(params: MulticlassParams, x: jnp.ndarray) -> jnp.ndarray:
+    """``[B, C]`` softmax class probabilities."""
+    return jax.nn.softmax(logits(params, x), axis=-1)
+
+
+def classify_batch(params: MulticlassParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Binary serving contract: P(any attack) = 1 - P(benign)."""
+    return 1.0 - class_probs(params, x)[:, 0]
+
+
+def attack_class(params: MulticlassParams, x: jnp.ndarray) -> jnp.ndarray:
+    """``[B]`` int32 argmax class ids (0 = benign; see ATTACK_CLASSES)."""
+    return jnp.argmax(logits(params, x), axis=-1).astype(jnp.int32)
+
+
+def loss_fn(params: MulticlassParams, x: jnp.ndarray,
+            y_class: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer class labels."""
+    lg = logits(params, x)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, y_class.astype(jnp.int32)[:, None], axis=1
+    ))
+
+
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+def _npz_path(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_params(params: MulticlassParams, path: str) -> str:
+    """Persist as .npz (bf16 stored as f32 with the dtype recorded,
+    like the sibling families).  Returns the actual path written."""
+    path = _npz_path(path)
+    np.savez(
+        path,
+        **{f: np.asarray(getattr(params, f)).astype(np.float32)
+           for f in params._fields},
+        dtype=str(params.w1.dtype),
+        family="multiclass",
+        schema_version=ARTIFACT_SCHEMA_VERSION,
+    )
+    return path
+
+
+def load_params(path: str) -> MulticlassParams:
+    with np.load(_npz_path(path), allow_pickle=False) as z:
+        fam = str(z["family"]) if "family" in z else ""
+        if fam != "multiclass":
+            raise ValueError(f"{path}: not a multiclass artifact")
+        version = int(z["schema_version"]) if "schema_version" in z else 0
+        if version != ARTIFACT_SCHEMA_VERSION:
+            raise ValueError(
+                f"multiclass artifact schema version {version} != "
+                f"{ARTIFACT_SCHEMA_VERSION}"
+            )
+        dtype = jnp.dtype(str(z["dtype"]))
+        return MulticlassParams(
+            **{f: jnp.asarray(z[f], dtype)
+               for f in MulticlassParams._fields}
+        )

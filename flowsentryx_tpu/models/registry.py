@@ -85,6 +85,20 @@ register_model(
         classify_batch=_pallas_score,
     )
 )
+from flowsentryx_tpu.models import multiclass as _multiclass  # noqa: E402
+
+register_model(
+    ModelSpec(
+        # Per-attack-class expert heads (SURVEY §2.3 EP row): binary
+        # serving contract = 1 - P(benign); attribution via
+        # multiclass.attack_class.
+        name="multiclass",
+        init=lambda key=None, **kw: _multiclass.init_params(
+            key if key is not None else jax.random.PRNGKey(0), **kw
+        ),
+        classify_batch=_multiclass.classify_batch,
+    )
+)
 register_model(
     ModelSpec(
         name="mlp",

@@ -55,3 +55,44 @@ def evaluate_model(
         ]
     )
     return confusion(scores, y, threshold)
+
+
+def multiclass_report(
+    params,
+    X: np.ndarray,
+    y_class: np.ndarray,
+    batch: int = 65536,
+) -> dict:
+    """Per-class precision/recall/F1 + confusion matrix + the binary
+    view (1 - P(benign) vs attack/benign) for the expert-heads family
+    (models/multiclass.py)."""
+    from flowsentryx_tpu.models import multiclass
+
+    probs = np.concatenate([
+        np.asarray(multiclass.class_probs(params, X[s : s + batch]))
+        for s in range(0, len(X), batch)
+    ])
+    preds = probs.argmax(axis=1)  # argmax(probs) == argmax(logits)
+    C = multiclass.NUM_CLASSES
+    conf = np.zeros((C, C), np.int64)  # [true, pred]
+    np.add.at(conf, (y_class.astype(np.int64), preds.astype(np.int64)), 1)
+    per_class = {}
+    f1s = []
+    for c, name in enumerate(multiclass.ATTACK_CLASSES):
+        tp = int(conf[c, c])
+        fp = int(conf[:, c].sum() - tp)
+        fn = int(conf[c].sum() - tp)
+        p = tp / (tp + fp) if tp + fp else 0.0
+        r = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        per_class[name] = {"precision": round(p, 4), "recall": round(r, 4),
+                           "f1": round(f1, 4), "support": int(conf[c].sum())}
+        f1s.append(f1)
+    binary = confusion(1.0 - probs[:, 0],
+                       (y_class != 0).astype(np.float32))
+    return {
+        "per_class": per_class,
+        "macro_f1": round(float(np.mean(f1s)), 4),
+        "confusion": conf.tolist(),
+        "binary": binary,
+    }

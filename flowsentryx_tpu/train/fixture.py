@@ -82,33 +82,56 @@ def _benign(rng: np.random.Generator, n: int) -> np.ndarray:
     return X
 
 
-def _attack(rng: np.random.Generator, n: int) -> np.ndarray:
-    """DoS/DDoS flow features: mostly volumetric floods (fixed small
-    frames, µs IATs, low variance), plus a slow-attack minority
-    (Slowloris-style: sparse, long idle gaps)."""
+#: Attack subtype ids — aligned with models.multiclass.ATTACK_CLASSES
+#: (0 benign, 1 volumetric, 2 syn, 3 slow).
+CLASS_BENIGN, CLASS_VOLUMETRIC, CLASS_SYN, CLASS_SLOW = 0, 1, 2, 3
+
+
+def _attack(rng: np.random.Generator, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """DoS/DDoS flow features + subtype labels: volumetric floods
+    (fixed small frames, µs IATs, low variance), SYN floods (minimal
+    TCP frames on service ports, µs-ms IATs), and a slow-attack
+    minority (Slowloris-style: sparse, long idle gaps).  NOTE on
+    separability: the 8 flow features carry no protocol bits, so
+    syn-vs-volumetric attribution rests on frame-size/IAT signatures
+    only — the per-class eval reports that confusion honestly."""
     X = np.zeros((n, NUM_FEATURES), np.float32)
+    cls = rng.choice(
+        [CLASS_VOLUMETRIC, CLASS_SYN, CLASS_SLOW], n, p=[0.60, 0.25, 0.15]
+    ).astype(np.int32)
+    vol, syn, slow = (cls == CLASS_VOLUMETRIC), (cls == CLASS_SYN), \
+        (cls == CLASS_SLOW)
+    nv, ny, ns = int(vol.sum()), int(syn.sum()), int(slow.sum())
+
     X[:, Feature.DST_PORT] = np.where(
         rng.random(n) < 0.85,
         rng.choice([80.0, 443.0, 53.0], n),  # floods hit a service port
         _dport(rng, n),
     )
-    slow = rng.random(n) < 0.15
-    fast = ~slow
-    nf, ns = int(fast.sum()), int(slow.sum())
-    # volumetric: constant-size small packets → tiny std/var
-    mean_len = np.where(fast, rng.uniform(54.0, 120.0, n),
-                        rng.uniform(60.0, 400.0, n))
-    std_len = np.where(fast, rng.uniform(0.0, 4.0, n),
-                       rng.uniform(0.0, 60.0, n))
+    # frame sizes: volumetric small-ish constant; SYN minimal TCP
+    # (54-74 B, near-zero variance); slow: small but varied
+    mean_len = np.empty(n)
+    std_len = np.empty(n)
+    mean_len[vol] = rng.uniform(54.0, 120.0, nv)
+    std_len[vol] = rng.uniform(0.0, 4.0, nv)
+    mean_len[syn] = rng.uniform(54.0, 74.0, ny)
+    std_len[syn] = rng.uniform(0.0, 1.0, ny)
+    mean_len[slow] = rng.uniform(60.0, 400.0, ns)
+    std_len[slow] = rng.uniform(0.0, 60.0, ns)
     X[:, Feature.PKT_LEN_MEAN] = mean_len
     X[:, Feature.PKT_LEN_STD] = std_len
     X[:, Feature.PKT_LEN_VAR] = std_len**2
     X[:, Feature.AVG_PKT_SIZE] = mean_len * rng.uniform(1.0, 1.1, n)
+
     iat_mean = np.empty(n)
     iat_max = np.empty(n)
-    if nf:
-        iat_mean[fast] = _lognormal(rng, nf, 50.0, 1.5, 1e6)
-        iat_max[fast] = iat_mean[fast] * rng.uniform(1.0, 20.0, nf)
+    if nv:
+        iat_mean[vol] = _lognormal(rng, nv, 50.0, 1.5, 1e6)
+        iat_max[vol] = iat_mean[vol] * rng.uniform(1.0, 20.0, nv)
+    if ny:
+        # handshake-rate floods: slower per flow than raw volumetric
+        iat_mean[syn] = _lognormal(rng, ny, 800.0, 1.2, 1e6)
+        iat_max[syn] = iat_mean[syn] * rng.uniform(1.0, 10.0, ny)
     if ns:
         iat_mean[slow] = _lognormal(rng, ns, 5.0e6, 1.0, 1.2e8)
         iat_max[slow] = np.minimum(
@@ -119,20 +142,28 @@ def _attack(rng: np.random.Generator, n: int) -> np.ndarray:
         iat_mean * rng.lognormal(-0.5, 0.6, n), 1.2e8
     )
     X[:, Feature.FWD_IAT_MAX] = iat_max
-    return X
+    return X, cls
 
 
 def cicids_fixture(
-    n: int = N_CLEANED, seed: int = 42
-) -> tuple[np.ndarray, np.ndarray]:
-    """``(X [n,8] f32, y [n] f32)`` with the real 16.89 % label rate."""
+    n: int = N_CLEANED, seed: int = 42, return_classes: bool = False
+):
+    """``(X [n,8] f32, y [n] f32)`` with the real 16.89 % label rate;
+    with ``return_classes`` additionally ``y_class [n] i32`` (attack
+    subtype ids aligned with models.multiclass.ATTACK_CLASSES)."""
     rng = np.random.default_rng(seed)
     n_attack = int(round(n * LABEL_RATE))
-    X = np.concatenate([_benign(rng, n - n_attack), _attack(rng, n_attack)])
+    Xa, cls_a = _attack(rng, n_attack)
+    X = np.concatenate([_benign(rng, n - n_attack), Xa])
     y = np.concatenate([
         np.zeros(n - n_attack, np.float32), np.ones(n_attack, np.float32)
     ])
+    y_class = np.concatenate([
+        np.full(n - n_attack, CLASS_BENIGN, np.int32), cls_a
+    ])
     order = rng.permutation(n)
+    if return_classes:
+        return X[order], y[order], y_class[order]
     return X[order], y[order]
 
 

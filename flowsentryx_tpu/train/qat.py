@@ -253,3 +253,41 @@ def train_mlp(
             params, opt_state, loss = step(params, opt_state, X[idx], y[idx])
         losses.append(float(loss))
     return params, np.asarray(losses, np.float32)
+
+
+def train_multiclass(
+    X: np.ndarray,
+    y_class: np.ndarray,
+    epochs: int = 60,
+    batch_size: int = 4096,
+    lr: float = 1e-3,
+    hidden: int = 32,
+    seed: int = 0,
+):
+    """Minibatch Adam for the per-attack-class expert heads
+    (models/multiclass.py — the SURVEY §2.3 EP extension point)."""
+    from flowsentryx_tpu.models import multiclass
+
+    X = jnp.asarray(X, jnp.float32)
+    y_class = jnp.asarray(y_class, jnp.int32)
+    params = multiclass.init_params(jax.random.PRNGKey(seed), hidden=hidden)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(multiclass.loss_fn)(params, xb, yb)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n = len(X)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(epochs):
+        order = rng.permutation(n)
+        for s in range(0, n, batch_size):
+            idx = order[s : s + batch_size]
+            params, opt_state, loss = step(params, opt_state,
+                                           X[idx], y_class[idx])
+        losses.append(float(loss))
+    return params, np.asarray(losses, np.float32)
