@@ -161,9 +161,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     eng = Engine(cfg, source, sink, mesh=mesh)
     if args.restore:
         eng.restore(args.restore)
-    rep = eng.run(
-        max_batches=args.batches or None, max_seconds=args.seconds or None
-    )
+    import contextlib
+
+    if args.profile:
+        # device+host trace viewable in TensorBoard / Perfetto
+        # (SURVEY.md §5.1: jax.profiler traces for the rebuild)
+        import jax
+
+        ctx = jax.profiler.trace(args.profile)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        rep = eng.run(
+            max_batches=args.batches or None,
+            max_seconds=args.seconds or None,
+        )
     if args.checkpoint:
         eng.checkpoint(args.checkpoint)
     print(json.dumps(rep._asdict(), indent=2))
@@ -208,10 +220,12 @@ def _cmd_status(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.bpf import blacklist, loader
 
         # layout derived from the same schema the C struct is
-        # generated from — adding a counter there updates this view
+        # generated from — field names AND types
+        _STRUCT_CH = {"u64": "Q", "u32": "I", "u16": "H", "u8": "B"}
         names = [n for n, _ in schema.KERNEL_STATS_FIELDS]
-        vsize = 8 * len(names)
-        fmt = f"<{len(names)}Q"
+        fmt = "<" + "".join(_STRUCT_CH[t] for _, t in
+                            schema.KERNEL_STATS_FIELDS)
+        vsize = _struct.calcsize(fmt)
         kern: dict = {}
         try:
             fd = loader.obj_get(f"{args.pin}/stats_map")
@@ -428,6 +442,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--mesh", type=int, default=0,
                    help="serve sharded over an N-device mesh (N>1)")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
+    s.add_argument("--profile",
+                   help="write a jax.profiler trace to this directory")
     s.add_argument("--restore", help="resume from a checkpoint file")
     s.set_defaults(fn=_cmd_serve)
 
@@ -457,7 +473,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "'fixture' for the CICIDS-calibrated stand-in")
     t.add_argument("--synthetic", type=int, default=None,
                    help="dataset size for synthetic/fixture data "
-                        "(default 50000 synthetic; full 2.52M fixture)")
+                        "(default 50000 synthetic; full 2.52M fixture; "
+                        "200000 for multiclass)")
     t.add_argument("--epochs", type=int, default=200)
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--out", help="artifact output path (.npz)")
