@@ -220,7 +220,7 @@ int run_bpf(const Options &o) {
     int blacklist_fd = lp.map_fd("blacklist_map");
     int stats_fd = lp.map_fd("stats_map");
     uint64_t forwarded = 0, dropped_ring_full = 0, verdicts = 0;
-    bool size_warned = false;
+    bool size_warned = false, first_interval_done = false;
     std::vector<uint8_t> buf;
     std::vector<fsx_verdict_record> vbatch(4096);
     uint64_t t_start = now_ns(), next_report = t_start + 1'000'000'000ULL;
@@ -258,11 +258,29 @@ int run_bpf(const Options &o) {
             fsx_stats s = read_stats(stats_fd);
             std::fprintf(stderr,
                          "fsxd: forwarded=%" PRIu64 " verdicts=%" PRIu64
+                         " skipped=%" PRIu64
                          " allowed=%" PRIu64 " drop_bl=%" PRIu64
                          " drop_rate=%" PRIu64 "\n",
-                         forwarded, verdicts, (uint64_t)s.allowed,
+                         forwarded, verdicts, rb.skipped, (uint64_t)s.allowed,
                          (uint64_t)s.dropped_blacklist,
                          (uint64_t)s.dropped_rate);
+            // A record-size mismatch drops EVERY drained record: the
+            // deployment looks alive (kernel counters move) while the
+            // ML plane starves.  The first interval that drains
+            // anything decides: 100% skips means misconfiguration, not
+            // traffic — fail fast instead of warning once and running
+            // forever.
+            if (!first_interval_done && forwarded + rb.skipped > 0) {
+                first_interval_done = true;
+                if (forwarded == 0 && rb.skipped > 0) {
+                    std::fprintf(stderr,
+                                 "fsxd: FATAL: 100%% of kernel records "
+                                 "skipped (record-size mismatch between "
+                                 "the loaded image and %s); exiting\n",
+                                 o.compact ? "--compact" : "the 48 B default");
+                    return 2;
+                }
+            }
             next_report = t + 1'000'000'000ULL;
         }
         if (n == 0 && nv == 0)
@@ -279,10 +297,12 @@ int run_bpf(const Options &o) {
     fsx_stats s = read_stats(stats_fd);
     std::printf("{\"produced\": %" PRIu64 ", \"verdicts\": %" PRIu64
                 ", \"dropped_ring_full\": %" PRIu64
+                ", \"skipped\": %" PRIu64
                 ", \"allowed\": %" PRIu64 ", \"dropped_blacklist\": %" PRIu64
                 ", \"dropped_rate\": %" PRIu64 ", \"dropped_ml\": %" PRIu64
                 "}\n",
-                forwarded, verdicts, dropped_ring_full, (uint64_t)s.allowed,
+                forwarded, verdicts, dropped_ring_full, rb.skipped,
+                (uint64_t)s.allowed,
                 (uint64_t)s.dropped_blacklist, (uint64_t)s.dropped_rate,
                 (uint64_t)s.dropped_ml);
     if (link_fd >= 0)

@@ -582,6 +582,19 @@ def encode_compact(
     if n:
         rec = buf[:n]
         base_ns = int(rec["ts_ns"].min())
+        span_ns = int(rec["ts_ns"].max()) - base_ns
+        if span_ns >= 65_536_000:  # dt_us 65535 is still exact; clip starts here
+            # The MicroBatcher seals early at this boundary; direct
+            # callers get a loud signal instead of silent saturation
+            # (clipped deltas would distort on-device IAT/rate math).
+            import warnings
+
+            warnings.warn(
+                f"encode_compact: record span {span_ns / 1e6:.1f} ms "
+                "exceeds the 65.535 ms compact ts range; deltas beyond "
+                "it saturate (use the MicroBatcher or split the batch)",
+                stacklevel=2,
+            )
         out[:n] = compact_pack(rec, base_ns, feat_mode=feat_mode,
                                in_scale=in_scale, in_zp=in_zp, log1p=log1p)
     base_rel_us = max(0, (base_ns - int(t0_ns))) // 1000

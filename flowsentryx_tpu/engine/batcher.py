@@ -82,6 +82,10 @@ class MicroBatcher:
         self._seal_times: list[float] = []
         self.batches_emitted = 0
         self.records_emitted = 0
+        #: Drains whose preceding poll gap made 16-bit kernel-ts unwrap
+        #: ambiguous (see add_precompact) — surfaced in the engine report.
+        self.ts_wrap_risk_polls = 0
+        self._last_poll_t: float | None = None
 
     # -- triggers -----------------------------------------------------------
 
@@ -96,6 +100,20 @@ class MicroBatcher:
         out: list[np.ndarray] = []
         if not len(records):
             return out
+        # Staleness heuristic (unwrap_kernel_ts16 aliases silently): the
+        # 16-bit µs stamp wraps every 65.536 ms, so any record emitted
+        # more than one wrap before this drain is shifted forward by
+        # n*65.5 ms with no way to detect it per record.  What IS
+        # observable is the drain-opportunity cadence: if the gap since
+        # the previous poll (the engine notes empty polls via
+        # :meth:`note_poll`; traffic lulls therefore do NOT count)
+        # approached the wrap period — engine stall, GC pause — records
+        # drained now may have sat in the ring longer than one wrap, so
+        # their unwraps are at risk.  Count it so post-stall timing skew
+        # is visible in the engine report instead of silently biasing
+        # batch bases and limiter windows.
+        if self.note_poll() > 0.050:
+            self.ts_wrap_risk_polls += 1
         now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
         ts_ns = schema.unwrap_kernel_ts16(records["w3"], now)
         pos = 0
@@ -172,6 +190,17 @@ class MicroBatcher:
             if self.fill == b:
                 out.append(self._seal())
         return out
+
+    def note_poll(self) -> float:
+        """Record a drain opportunity (a source poll, empty or not) and
+        return the gap since the previous one — the cadence input to
+        ``add_precompact``'s wrap-risk heuristic.  The engine calls this
+        on empty polls so a mere traffic lull is not mistaken for a
+        drain stall."""
+        t = time.perf_counter()
+        gap = 0.0 if self._last_poll_t is None else t - self._last_poll_t
+        self._last_poll_t = t
+        return gap
 
     def flush_due(self) -> bool:
         """Deadline trigger: something pending for longer than deadline_us."""

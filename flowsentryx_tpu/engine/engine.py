@@ -38,6 +38,10 @@ class EngineReport(NamedTuple):
     stages_ms: dict
     blocked_sources: int
     table: dict           # live-table summary (pallas single-pass scan)
+    #: Precompact drains at risk of 16-bit kernel-ts unwrap aliasing
+    #: (drain-gap > 50 ms; see MicroBatcher.add_precompact).  Always 0
+    #: outside compact-emit serving.
+    ts_wrap_risk_polls: int = 0
 
 
 class _InFlight(NamedTuple):
@@ -64,7 +68,7 @@ class Engine:
         readback_depth: int = 8,
         t0_ns: int | None = None,
         mesh: Any | None = None,
-        wire: str = schema.WIRE_COMPACT16,
+        wire: str | None = None,
     ):
         self.cfg = cfg
         self.source = source
@@ -82,6 +86,16 @@ class Engine:
         self.precompact = bool(getattr(source, "precompact", False))
         if self.precompact:
             wire = schema.WIRE_COMPACT16
+        elif wire is None:
+            # Default wire: compact16 only when it is bit-exact (the
+            # artifact exposes an input observer, so the wire carries
+            # the model's own quantization); raw48 otherwise.  A model
+            # without an observer must not be silently degraded to
+            # minifloat-quantized features by a constructor default —
+            # callers opt into that by passing wire="compact16".
+            wire = (schema.WIRE_COMPACT16
+                    if hasattr(self.params, "in_scale")
+                    else schema.WIRE_RAW48)
         self.wire = wire
         # compact16 quantizes features on the way into the batcher with
         # the model's own input observer when the artifact exposes one
@@ -264,6 +278,11 @@ class Engine:
                     self._t0_auto = False
                 if not len(records):
                     sealed = []
+                    if self.precompact:
+                        # A drain opportunity with no records: note it so
+                        # the wrap-risk heuristic keys on drain cadence,
+                        # not traffic cadence (a lull is not a stall).
+                        self.batcher.note_poll()
                 elif self.precompact:
                     sealed = self.batcher.add_precompact(records)
                 else:
@@ -304,4 +323,5 @@ class Engine:
             stages_ms=self.metrics.to_dict(),
             blocked_sources=len(self._blocked),
             table=table_sum,
+            ts_wrap_risk_polls=self.batcher.ts_wrap_risk_polls,
         )
