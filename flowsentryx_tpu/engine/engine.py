@@ -42,6 +42,10 @@ class EngineReport(NamedTuple):
     #: (drain-gap > 50 ms; see MicroBatcher.add_precompact).  Always 0
     #: outside compact-emit serving.
     ts_wrap_risk_polls: int = 0
+    #: Packets fail-opened because their flow overflowed owner routing
+    #: in the sharded step (adversarial hash skew; parallel/step.py
+    #: module docstring).  Always 0 single-device.
+    route_drop: int = 0
 
 
 class _InFlight(NamedTuple):
@@ -177,6 +181,7 @@ class Engine:
         self._inflight: list[_InFlight] = []
         self._blocked: set[int] = set()
         self._device_now = 0.0  # newest stream time seen in reaped outputs
+        self._route_drop = 0    # routing-overflow fail-opens (sharded step)
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -207,6 +212,13 @@ class Engine:
                 jnp.concatenate([g.out.block_until for g in group])
             )
             now = float(np.asarray(group[-1].out.now))
+            # routing-overflow fail-opens (sharded step): one extra
+            # scalar fetch per reap GROUP keeps the counter visible to
+            # operators without a per-batch readback
+            self._route_drop += int(np.asarray(
+                jnp.sum(jnp.stack([jnp.asarray(g.out.route_drop)
+                                   for g in group]))
+            ))
         upd = extract_updates(keys, untils)
         self.sink.apply(upd)
         self._blocked.update(upd.key.tolist())
@@ -324,4 +336,5 @@ class Engine:
             blocked_sources=len(self._blocked),
             table=table_sum,
             ts_wrap_risk_polls=self.batcher.ts_wrap_risk_polls,
+            route_drop=self._route_drop,
         )

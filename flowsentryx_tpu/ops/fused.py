@@ -28,6 +28,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from flowsentryx_tpu.core.config import FsxConfig
 from flowsentryx_tpu.core.schema import GlobalStats, IpTableState, Verdict
@@ -42,6 +43,13 @@ class StepOutput(NamedTuple):
     now: jnp.ndarray       # [] f32 newest valid timestamp in the batch —
     #                        the device-clock reading the host side (stats,
     #                        expiry math) uses without re-reducing anything
+    # numpy scalar default, NOT jnp: a module-level concrete jax.Array
+    # would initialize a backend at import and poison axon dispatch
+    # (see agg.INVALID_KEY note).
+    route_drop: Any = np.uint32(0)  # [] packets fail-opened because their
+    #                        flow overflowed owner routing (sharded step
+    #                        only; always 0 single-device — see
+    #                        parallel/step.py module docstring)
 
 
 class FlowDecision(NamedTuple):
@@ -175,26 +183,45 @@ def ml_flow_verdict(
     )
 
 
+#: Verdict classes in the order :func:`count_verdicts` /
+#: :func:`update_stats_from_counts` use — one slot per GlobalStats
+#: packet counter.
+STAT_VERDICT_ORDER = (
+    Verdict.PASS, Verdict.DROP_BLACKLIST, Verdict.DROP_RATE, Verdict.DROP_ML,
+)
+
+
+def count_verdicts(verdict: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """``[4]`` uint32 packet counts in :data:`STAT_VERDICT_ORDER`."""
+    return jnp.stack([
+        jnp.sum(valid & (verdict == int(code))).astype(jnp.uint32)
+        for code in STAT_VERDICT_ORDER
+    ])
+
+
+def update_stats_from_counts(
+    stats: GlobalStats, counts: jnp.ndarray
+) -> GlobalStats:
+    """Fold a ``[4]`` count vector (:data:`STAT_VERDICT_ORDER`) plus one
+    batch into the u64 counters — shared by the single-device step
+    (local counts) and the sharded step (psum'd counts)."""
+    from flowsentryx_tpu.core.schema import u64_add
+
+    return GlobalStats(
+        allowed=u64_add(stats.allowed, counts[0]),
+        dropped_blacklist=u64_add(stats.dropped_blacklist, counts[1]),
+        dropped_rate=u64_add(stats.dropped_rate, counts[2]),
+        dropped_ml=u64_add(stats.dropped_ml, counts[3]),
+        batches=u64_add(stats.batches, jnp.uint32(1)),
+    )
+
+
 def update_stats(
     stats: GlobalStats, verdict: jnp.ndarray, valid: jnp.ndarray
 ) -> GlobalStats:
     """Per-packet counters (successor of the reference's racy
     allowed/dropped bumps, ``fsx_kern.c:210,332,342``)."""
-
-    def count(code: Verdict) -> jnp.ndarray:
-        return jnp.sum(valid & (verdict == int(code))).astype(jnp.uint32)
-
-    from flowsentryx_tpu.core.schema import u64_add
-
-    return GlobalStats(
-        allowed=u64_add(stats.allowed, count(Verdict.PASS)),
-        dropped_blacklist=u64_add(
-            stats.dropped_blacklist, count(Verdict.DROP_BLACKLIST)
-        ),
-        dropped_rate=u64_add(stats.dropped_rate, count(Verdict.DROP_RATE)),
-        dropped_ml=u64_add(stats.dropped_ml, count(Verdict.DROP_ML)),
-        batches=u64_add(stats.batches, jnp.uint32(1)),
-    )
+    return update_stats_from_counts(stats, count_verdicts(verdict, valid))
 
 
 def make_step(

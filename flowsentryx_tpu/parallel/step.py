@@ -1,4 +1,4 @@
-"""Multi-device fused step: IP-hash-sharded state + DP scoring.
+"""Multi-device fused step: IP-hash-sharded state + owner-routed flows.
 
 This is the scale-out analog of SURVEY.md §2.3's parallelism table:
 
@@ -7,13 +7,28 @@ This is the scale-out analog of SURVEY.md §2.3's parallelism table:
   given by the *top* hash bits, its slot within the owner's shard by
   the *low* bits — ownership and probing use disjoint bits, and a key's
   owner never changes, so limiter state never migrates between devices.
-* **Data parallelism** — classifier scoring splits the packet batch
-  across the same axis; an ``all_gather`` (ICI) rebuilds the full score
-  vector.
-* **Collectives** — one ``all_gather`` for scores + one ``psum`` for
-  verdicts/writebacks per step.  Flow ownership is disjoint, so a sum
-  over devices *is* the global verdict vector (non-owners contribute
-  PASS=0).
+* **Data parallelism** — each device parses, scores, and locally
+  aggregates its ``B/n`` slice of the packet batch (sort, classifier
+  matmul, and segment ops all shrink with the mesh).
+* **Flow routing** — local per-flow partial aggregates are routed to
+  their owner device with one ``all_to_all`` (ICI); the owner merges
+  partials (a flow's packets may land on several devices' slices),
+  runs the table+limiter+ML core once per flow, and routes per-flow
+  verdicts back with a second ``all_to_all``.  Nothing per-flow is
+  replicated — this is what makes the step *scale* instead of merely
+  not serialize (the round-3 design re-sorted the full batch on every
+  device, so per-device work stayed O(B) no matter the mesh size).
+* **Collectives per step** — 2 ``all_to_all`` (flow partials out,
+  verdicts back) + 1 ``pmax`` (batch clock) + 1 ``psum`` (stat counts).
+
+Routing capacity: each device sends at most ``C ≈ 2·(B/n)/n`` flows to
+each owner — 2× the uniform-hash expectation.  Overflow (possible only
+under adversarial hash skew: ownership is a public unsalted hash, so a
+spoofed-source flood *could* aim every flow at one owner) is handled
+fail-open, the framework-wide discipline (SURVEY.md §5.3): overflowed
+flows PASS this batch, skip their limiter update, and are counted in
+``StepOutput.route_drop`` — visible, bounded, and backstopped by the
+in-kernel limiter, which stands alone by design.
 
 Everything runs under ``jax.shard_map`` over a
 :func:`~flowsentryx_tpu.parallel.mesh.make_mesh` mesh; the same code
@@ -30,7 +45,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flowsentryx_tpu.core.config import FsxConfig
-from flowsentryx_tpu.core.schema import GlobalStats, IpTableState, Verdict, make_table
+from flowsentryx_tpu.core.schema import (
+    GlobalStats, IpTableState, Verdict, make_table,
+)
 from flowsentryx_tpu.ops import agg, fused, hashtable
 
 
@@ -62,7 +79,7 @@ def make_sharded_step(
     if donate is None:
         donate = fused.donation_supported()
     axis = mesh.axis_names[0]
-    n_dev = mesh.devices.size
+    n_dev = int(mesh.devices.size)
     k_bits = n_dev.bit_length() - 1  # n_dev = 2**k_bits (validated by make_mesh)
     if cfg.table.capacity % n_dev:
         raise ValueError("table capacity must divide by device count")
@@ -71,12 +88,6 @@ def make_sharded_step(
 
     def device_step(table_shard, stats, params, batch):
         d = jax.lax.axis_index(axis)
-
-        # replicated aggregation (cheap; avoids a shuffle of raw packets)
-        fa = agg.aggregate(batch.key, batch.pkt_len, batch.ts, batch.valid)
-        now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
-
-        # --- DP scoring: each device scores B/n_dev packets, ICI gather ----
         b = batch.feat.shape[0]
         if b % n_dev:
             raise ValueError(
@@ -84,46 +95,143 @@ def make_sharded_step(
                 "(pad the batch; decode_records already pads to a static size)"
             )
         local_b = b // n_dev
-        feat_local = jax.lax.dynamic_slice_in_dim(batch.feat, d * local_b, local_b)
-        score_local = classify_batch(params, feat_local)
-        score = jax.lax.all_gather(score_local, axis, tiled=True)  # [B]
-        ml_flow = fused.ml_flow_verdict(cfg, score, batch.valid, fa.inv)
+        # Per-source→owner routing capacity: 2× the uniform-hash
+        # expectation, floored so tiny test batches don't route at
+        # capacity 1 (module docstring: overflow is fail-open+counted).
+        C = min(local_b, max(64, -(-2 * local_b // n_dev)))
 
-        # --- hash ownership: top k bits pick the device --------------------
+        def sl(x):
+            return jax.lax.dynamic_slice_in_dim(x, d * local_b, local_b)
+
+        key_l, len_l = sl(batch.key), sl(batch.pkt_len)
+        ts_l, valid_l = sl(batch.ts), sl(batch.valid)
+        feat_l = jax.lax.dynamic_slice_in_dim(batch.feat, d * local_b, local_b)
+
+        # --- local slice work: classifier + per-flow aggregation -----------
+        score_l = classify_batch(params, feat_l)                 # [local_b]
+        fa = agg.aggregate(key_l, len_l, ts_l, valid_l)
+        mal_l = (score_l > cfg.model.threshold) & valid_l
+        ml_l = (jnp.zeros((local_b,), jnp.int32)
+                .at[fa.inv].max(mal_l.astype(jnp.int32)))        # per local flow
+        now = jax.lax.pmax(jnp.max(jnp.where(valid_l, ts_l, 0.0)), axis)
+
+        # --- route local flow partials to their owner ----------------------
         h1 = hashtable.hash_u32(fa.rep_key)
-        owner = (h1 >> (32 - k_bits)).astype(jnp.int32) if k_bits else jnp.zeros_like(h1, jnp.int32)
-        mine = fa.rep_valid & (owner == d)
+        owner = ((h1 >> (32 - k_bits)).astype(jnp.int32) if k_bits
+                 else jnp.zeros_like(h1, jnp.int32))
+        # rank of each flow within its owner bucket: one small sort by
+        # owner + a cummax gives position-within-run
+        owner_s = jnp.where(fa.rep_valid, owner, n_dev)
+        order = jnp.argsort(owner_s)                             # stable
+        so = owner_s[order]
+        idx = jnp.arange(local_b, dtype=jnp.int32)
+        heads = jnp.concatenate([jnp.ones((1,), bool), so[1:] != so[:-1]])
+        run_start = jax.lax.cummax(jnp.where(heads, idx, 0))
+        rank = jnp.zeros((local_b,), jnp.int32).at[order].set(idx - run_start)
 
+        routed = fa.rep_valid & (rank < C)
+        overflow = fa.rep_valid & ~routed
+        flat = jnp.where(routed, owner * C + rank, n_dev * C)    # park tail
+
+        def scatter_send(vals, fill):
+            ext = jnp.full((n_dev * C + 1,), fill, vals.dtype)
+            ext = ext.at[flat].set(jnp.where(routed, vals, fill))
+            return ext[: n_dev * C]
+
+        bits = jax.lax.bitcast_convert_type
+        send = jnp.stack(
+            [
+                scatter_send(fa.rep_key, agg.INVALID_KEY),
+                scatter_send(bits(fa.rep_pkts, jnp.uint32), jnp.uint32(0)),
+                scatter_send(bits(fa.rep_bytes, jnp.uint32), jnp.uint32(0)),
+                scatter_send(bits(fa.rep_ts, jnp.uint32), jnp.uint32(0)),
+                scatter_send(ml_l.astype(jnp.uint32), jnp.uint32(0)),
+            ],
+            axis=1,
+        ).reshape(n_dev, C, 5)
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
+        r = recv.reshape(n_dev * C, 5)                           # [R, 5]
+
+        # --- owner side: merge per-source partials, run the flow core ------
+        # A flow's packets may have landed on several source devices;
+        # each contributed one partial (≤ n_dev duplicates per key).
+        r_key = r[:, 0]
+        order2 = jnp.argsort(r_key)                              # INVALID→tail
+        sk = r_key[order2]
+        heads2 = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+        seg = (jnp.cumsum(heads2) - 1).astype(jnp.int32)
+        rn = n_dev * C
+        fvalid = sk != agg.INVALID_KEY
+
+        def seg_sum(v):
+            return jax.ops.segment_sum(
+                jnp.where(fvalid, v[order2], 0.0), seg, num_segments=rn)
+
+        def seg_max(v, fill):
+            return jax.ops.segment_max(
+                jnp.where(fvalid, v[order2], fill), seg, num_segments=rn)
+
+        m_pkts = seg_sum(bits(r[:, 1], jnp.float32))
+        m_bytes = seg_sum(bits(r[:, 2], jnp.float32))
+        m_ts = seg_max(bits(r[:, 3], jnp.float32), -jnp.inf)
+        m_ml = seg_max(r[:, 4].astype(jnp.float32), 0.0) > 0
+        m_key = jax.ops.segment_max(sk, seg, num_segments=rn)
+        m_valid = m_pkts > 0
+        m_key = jnp.where(m_valid, m_key, agg.INVALID_KEY)
+        m_ts = jnp.where(m_valid, m_ts, 0.0)
+        inv2 = jnp.zeros((rn,), jnp.int32).at[order2].set(seg)   # entry→flow
+
+        mfa = agg.FlowAgg(rep_key=m_key, rep_pkts=m_pkts, rep_bytes=m_bytes,
+                          rep_ts=m_ts, rep_valid=m_valid, inv=inv2)
         new_shard, dec = fused.flow_step(
-            local_cfg, table_shard, fa, mine, ml_flow, now
+            local_cfg, table_shard, mfa, m_valid, m_ml, now
         )
 
-        # --- combine disjoint per-owner decisions (PASS=0 identity) --------
-        flow_verdict = jax.lax.psum(
-            jnp.where(mine, dec.flow_verdict, 0), axis
+        # --- route per-flow verdicts back to the source devices ------------
+        back = jax.lax.all_to_all(
+            dec.flow_verdict[inv2].reshape(n_dev, C), axis,
+            split_axis=0, concat_axis=0,
+        )  # back[o, c] = verdict of my local flow with (owner o, rank c)
+        rep_verdict = jnp.where(
+            routed,
+            back[jnp.clip(owner, 0, n_dev - 1), jnp.clip(rank, 0, C - 1)],
+            int(Verdict.PASS),  # overflow: fail-open this batch (counted)
         )
-        newly = jax.lax.psum(
-            jnp.where(mine & dec.newly_blocked, 1, 0), axis
-        ).astype(bool)
-        block_until = jax.lax.psum(
-            jnp.where(mine & dec.newly_blocked, dec.new_blocked_until, 0.0), axis
-        )
+        verdict_l = jnp.where(valid_l, rep_verdict[fa.inv], int(Verdict.PASS))
 
-        verdict = jnp.where(batch.valid, flow_verdict[fa.inv], int(Verdict.PASS))
-        new_stats = fused.update_stats(stats, verdict, batch.valid)
+        # --- stats: local counts, one psum ---------------------------------
+        route_drop_l = jnp.sum(
+            jnp.where(valid_l, overflow[fa.inv].astype(jnp.uint32),
+                      jnp.uint32(0))
+        )
+        counts = jax.lax.psum(
+            jnp.concatenate([
+                fused.count_verdicts(verdict_l, valid_l),
+                route_drop_l[None].astype(jnp.uint32),
+            ]),
+            axis,
+        )
+        new_stats = fused.update_stats_from_counts(stats, counts[:4])
 
         out = fused.StepOutput(
-            verdict=verdict,
-            score=score,
-            block_key=jnp.where(newly, fa.rep_key, agg.INVALID_KEY),
-            block_until=block_until,
+            verdict=verdict_l,                                    # P(axis)→[B]
+            score=score_l,                                        # P(axis)→[B]
+            block_key=jnp.where(dec.newly_blocked, m_key,
+                                agg.INVALID_KEY),                 # owner-side
+            block_until=jnp.where(dec.newly_blocked,
+                                  dec.new_blocked_until, 0.0),
             now=now,
+            route_drop=counts[4],
         )
         return new_shard, new_stats, out
 
     table_specs = IpTableState(*([P(axis)] * len(IpTableState._fields)))
     stats_specs = GlobalStats(*([P()] * len(GlobalStats._fields)))
-    out_specs = fused.StepOutput(*([P()] * len(fused.StepOutput._fields)))
+    out_specs = fused.StepOutput(
+        verdict=P(axis), score=P(axis),
+        block_key=P(axis), block_until=P(axis),
+        now=P(), route_drop=P(),
+    )
 
     sharded = jax.shard_map(
         device_step,

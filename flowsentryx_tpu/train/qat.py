@@ -45,7 +45,14 @@ class Observer(NamedTuple):
     momentum: float = 0.9
 
     def update(self, x: jnp.ndarray) -> "Observer":
-        blo, bhi = x.min(), x.max()
+        return self.update_minmax(x.min(), x.max())
+
+    def update_minmax(self, blo: jnp.ndarray, bhi: jnp.ndarray) -> "Observer":
+        """Momentum update from a precomputed batch range — the seam the
+        data-parallel trainer uses: each device contributes its shard's
+        min/max, ``pmin``/``pmax`` merge them into the GLOBAL batch
+        range, and this update then runs identically (replicated) on
+        every device, so observers never diverge across the mesh."""
         fresh = ~jnp.isfinite(self.lo)
         m = self.momentum
         return Observer(
@@ -211,6 +218,129 @@ def convert(state: QatState, log_features: bool = True) -> LogRegParams:
         out_scale=float(out_s),
         out_zp=int(out_zp),
         log1p=log_features,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Data-parallel QAT over a device mesh
+# ---------------------------------------------------------------------------
+
+
+def train_logreg_qat_dp(
+    X: np.ndarray,
+    y: np.ndarray,
+    mesh,
+    epochs: int = 200,
+    lr: float = 0.05,
+    warmup_fraction: float = 0.5,
+    log_features: bool = True,
+    optimizer: optax.GradientTransformation | None = None,
+) -> TrainResult:
+    """:func:`train_logreg_qat` sharded over a ``jax.sharding.Mesh``.
+
+    Same full-batch semantics, data-parallel: each device holds an
+    ``N/n`` shard of the training set; per epoch it computes its
+    shard's loss terms and gradients, which ``psum`` into the exact
+    full-batch sums (the loss is summed BCE, so data parallelism is
+    lossless up to float reassociation).  The interesting correctness
+    question is the **observers**: min/max ranges are NOT additive, so
+    each device contributes its shard's range and ``pmin``/``pmax``
+    merge them into the global batch range *before* the momentum
+    update, which then runs replicated — observers stay bit-identical
+    across the mesh and match the single-device trainer (asserted in
+    tests/test_train.py).  Ragged ``N`` is zero-padded and masked out
+    of loss, gradients, and ranges.
+    """
+    axis = mesh.axis_names[0]
+    n_dev = int(mesh.devices.size)
+    from jax.sharding import PartitionSpec as P
+
+    X = jnp.asarray(X, jnp.float32)
+    if log_features:
+        X = jnp.log1p(X)
+    y = jnp.asarray(y, jnp.float32)
+    n = X.shape[0]
+    pad = (-n) % n_dev
+    mask = jnp.concatenate([jnp.ones((n,), bool), jnp.zeros((pad,), bool)])
+    X = jnp.concatenate([X, jnp.zeros((pad, X.shape[1]), jnp.float32)])
+    y = jnp.concatenate([y, jnp.zeros((pad,), jnp.float32)])
+    opt = optimizer or optax.adagrad(lr)
+
+    w0 = jnp.zeros((NUM_FEATURES,), jnp.float32)
+    b0 = jnp.float32(0.0)
+    state = QatState(
+        w=w0, b=b0,
+        obs_in=fresh_observer(), obs_out=fresh_observer(),
+        opt_state=opt.init((w0, b0)),
+    )
+
+    def device_epoch(state: QatState, X_l, y_l, m_l, quantize: bool):
+        # Observer updates run PRIMAL-ONLY, before autodiff: pmin/pmax
+        # have no differentiation rule, and none is needed — fake-quant's
+        # straight-through estimator blocks every gradient path through
+        # the quant params, so computing them outside value_and_grad is
+        # gradient-identical to the single-device trainer (which updates
+        # observers inside the differentiated forward).
+        x = X_l
+        blo = jax.lax.pmin(jnp.min(jnp.where(m_l[:, None], x, jnp.inf)), axis)
+        bhi = jax.lax.pmax(jnp.max(jnp.where(m_l[:, None], x, -jnp.inf)), axis)
+        obs_in = state.obs_in.update_minmax(blo, bhi)
+        in_s, in_zp = obs_in.quint8_qparams()
+        xq = fake_quant(x, in_s, in_zp, 0, 255) if quantize else x
+        wq = (fake_quant(state.w, _weight_scale(state.w), jnp.float32(0.0),
+                         -127, 127) if quantize else state.w)
+        yl = xq @ wq + state.b
+        ylo = jax.lax.pmin(jnp.min(jnp.where(m_l, yl, jnp.inf)), axis)
+        yhi = jax.lax.pmax(jnp.max(jnp.where(m_l, yl, -jnp.inf)), axis)
+        obs_out = state.obs_out.update_minmax(ylo, yhi)
+        out_s, out_zp = obs_out.quint8_qparams()
+
+        def loss_fn(wb):
+            w, b = wb
+            x = X_l
+            if quantize:
+                x = fake_quant(x, in_s, in_zp, 0, 255)
+                w = fake_quant(w, _weight_scale(w), jnp.float32(0.0),
+                               -127, 127)
+            yl = x @ w + b
+            if quantize:
+                yl = fake_quant(yl, out_s, out_zp, 0, 255)
+            p = jax.nn.sigmoid(yl)
+            eps = 1e-7  # BCE on probabilities, summed (BCELoss(sum))
+            losses = -(y_l * jnp.log(p + eps)
+                       + (1 - y_l) * jnp.log(1 - p + eps))
+            return jax.lax.psum(jnp.sum(jnp.where(m_l, losses, 0.0)), axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)((state.w, state.b))
+        # shard_map AD leaves each device with d(local loss)/dw; the
+        # full-batch gradient is their sum
+        grads = jax.lax.psum(grads, axis)
+        updates, opt_state = opt.update(grads, state.opt_state)
+        w, b = optax.apply_updates((state.w, state.b), updates)
+        return QatState(w, b, obs_in, obs_out, opt_state), loss
+
+    state_specs = jax.tree.map(lambda _: P(), state,
+                               is_leaf=lambda x: x is None)
+    epochs_jit = {}
+    for quantize in (False, True):
+        epochs_jit[quantize] = jax.jit(jax.shard_map(
+            partial(device_epoch, quantize=quantize),
+            mesh=mesh,
+            in_specs=(state_specs, P(axis), P(axis), P(axis)),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        ))
+
+    n_warm = int(epochs * warmup_fraction)
+    losses = np.zeros(epochs, np.float32)
+    for e in range(epochs):
+        if e == n_warm:  # phase switch: fresh optimizer (see train_logreg_qat)
+            state = state._replace(opt_state=opt.init((state.w, state.b)))
+        state, loss = epochs_jit[e >= n_warm](state, X, y, mask)
+        losses[e] = float(loss)
+
+    return TrainResult(
+        state=state, losses=losses, params=convert(state, log_features)
     )
 
 

@@ -141,6 +141,56 @@ class TestQat:
         assert m["f1"] > 0.9, m
 
 
+class TestQatDataParallel:
+    """train_logreg_qat_dp: the meshed twin of the full-batch trainer.
+
+    Full-batch DP is lossless up to float reassociation (loss is summed
+    BCE), and observers merge via pmin/pmax of shard ranges — so the DP
+    run must reproduce the single-device run to reassociation tolerance,
+    with input observers bit-identical."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import jax
+
+        from flowsentryx_tpu.parallel import make_mesh
+
+        assert len(jax.devices()) >= 8
+        rng = np.random.default_rng(0)
+        n = 203  # deliberately ragged: exercises the pad+mask path
+        X = rng.lognormal(3, 2, (n, 8)).astype(np.float32)
+        w_true = np.array([1.0, -1.0, 0.5, 0, 0, 2.0, -0.5, 0.0])
+        y = ((np.log1p(X) @ w_true) > 2.0).astype(np.float32)
+        r1 = qat.train_logreg_qat(X, y, epochs=30)
+        r8 = qat.train_logreg_qat_dp(X, y, make_mesh(8), epochs=30)
+        return r1, r8
+
+    def test_observers_merge_exactly(self, pair):
+        r1, r8 = pair
+        # input ranges are pure min/max over the (identical) full batch:
+        # pmin/pmax of shard ranges must be bit-identical to the
+        # single-device jnp.min/jnp.max
+        np.testing.assert_array_equal(np.asarray(r1.state.obs_in.lo),
+                                      np.asarray(r8.state.obs_in.lo))
+        np.testing.assert_array_equal(np.asarray(r1.state.obs_in.hi),
+                                      np.asarray(r8.state.obs_in.hi))
+        # output ranges depend on the (reassociation-perturbed) weights
+        np.testing.assert_allclose(np.asarray(r1.state.obs_out.hi),
+                                   np.asarray(r8.state.obs_out.hi),
+                                   rtol=1e-2)
+
+    def test_converged_artifact_matches(self, pair):
+        r1, r8 = pair
+        assert np.abs(r1.params.w_int8.astype(int)
+                      - np.asarray(r8.params.w_int8).astype(int)).max() <= 1
+        np.testing.assert_allclose(float(np.asarray(r8.params.in_scale)),
+                                   float(r1.params.in_scale), rtol=1e-6)
+        np.testing.assert_allclose(float(np.asarray(r8.params.out_scale)),
+                                   float(r1.params.out_scale), rtol=1e-2)
+        np.testing.assert_allclose(r8.losses, r1.losses, rtol=1e-2)
+        assert np.isfinite(r8.losses).all()
+
+
 class TestEvaluate:
     def test_confusion_exact(self):
         scores = np.array([0.9, 0.1, 0.8, 0.3])
