@@ -122,6 +122,8 @@ S_N = -240          # u64 slot: flow pkt_count snapshot (n)
 S_CW1 = -244        # u32: compact record word1 (feat 0-3, minifloat)
 S_CW2 = -248        # u32: compact record word2 (feat 4-7, minifloat)
 S_CW3 = -252        # u32: compact record word3 (len8|flags|ts16)
+S_SADDR6 = -272     # 16B: full IPv6 source (exact-blacklist key)
+#                     [-272, -256); only initialized/read on v6 paths
 
 COMPACT_REC_SIZE = 16  # struct fsx_compact_record
 
@@ -139,6 +141,9 @@ MAP_SPECS = {
     # name -> (map_type, key_size, value_size, max_entries selector)
     "config_map": (loader.MAP_TYPE_ARRAY, 4, CFG_SIZE, "one"),
     "blacklist_map": (loader.MAP_TYPE_LRU_HASH, 4, 8, "ips"),
+    # exact 128-bit v6 blacklist (kern/fsx_kern.c blacklist_v6;
+    # reference parity with src/fsx_struct.h:9's __u128 key)
+    "blacklist_v6": (loader.MAP_TYPE_LRU_HASH, 16, 8, "ips"),
     "ip_state_map": (loader.MAP_TYPE_LRU_HASH, 4, IPS_SIZE, "ips"),
     "flow_stats_map": (loader.MAP_TYPE_LRU_HASH, 4, FS_SIZE, "ips"),
     "stats_map": (loader.MAP_TYPE_PERCPU_ARRAY, 4, ST_SIZE, "one"),
@@ -344,13 +349,18 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a.jmp_reg(BPF_JGT, R5, R3, "drop")
     a += ldx(BPF_B, R1, R4, 6)  # nexthdr
     a += stx(BPF_DW, R10, S_L4, R1)
-    # fsx_fold_ip6 (parsing.h:82-85): XOR of the four saddr words
+    # full 128-bit source → stack (exact-blacklist key, parsing.h
+    # fsx_pkt.saddr6) while folding (parsing.h:82-85, XOR of the words)
     a += ldx(BPF_W, R1, R4, 8)
+    a += stx(BPF_W, R10, S_SADDR6 + 0, R1)
     a += ldx(BPF_W, R0, R4, 12)
+    a += stx(BPF_W, R10, S_SADDR6 + 4, R0)
     a += alu64(BPF_XOR, R1, R0)
     a += ldx(BPF_W, R0, R4, 16)
+    a += stx(BPF_W, R10, S_SADDR6 + 8, R0)
     a += alu64(BPF_XOR, R1, R0)
     a += ldx(BPF_W, R0, R4, 20)
+    a += stx(BPF_W, R10, S_SADDR6 + 12, R0)
     a += alu64(BPF_XOR, R1, R0)
     a += stx(BPF_DW, R10, S_SADDR, R1)
     a += st_imm(BPF_DW, R10, S_IS6, 1)
@@ -388,8 +398,31 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64_imm(BPF_ADD, R4, 8)  # sizeof(icmphdr) == sizeof(icmp6hdr)
     a.jmp_reg(BPF_JGT, R4, R3, "drop")
 
-    # ---- blacklist gate with TTL expiry (fsx_kern.c:222-233) ---------
+    # ---- blacklist gate with TTL expiry (fsx_kern.c:222-233).
+    # v6 checks the EXACT 128-bit map first (reference blacklist_v6
+    # parity, src/fsx_kern.c:159-166); both then fall through to the
+    # folded map, which carries the TPU plane's ML verdicts. ----------
     a.label("parsed")
+    a += ldx(BPF_DW, R1, R10, S_IS6)
+    a.jmp_imm(BPF_JEQ, R1, 0, "bl_fold")  # v4: no exact-v6 gate
+    a.ld_map(R1, "blacklist_v6")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_SADDR6)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "bl_fold")
+    a += ldx(BPF_DW, R1, R0, 0)  # *until
+    a.jmp_reg(BPF_JGE, R7, R1, "bl6_expired")
+    a += ldx(BPF_DW, R1, R8, ST_DROPPED_BLACKLIST)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += stx(BPF_DW, R8, ST_DROPPED_BLACKLIST, R1)
+    a.ja("drop_counted")
+    a.label("bl6_expired")  # TTL passed: delete, continue
+    a.ld_map(R1, "blacklist_v6")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_SADDR6)
+    a += call(FN_map_delete_elem)
+
+    a.label("bl_fold")
     a += ldx(BPF_DW, R1, R10, S_SADDR)
     a += stx(BPF_W, R10, S_KEY, R1)
     a.ld_map(R1, "blacklist_map")
@@ -565,11 +598,25 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)
     a.ja("features")
 
-    # ---- over threshold: blacklist + drop (fsx_kern.c:260-268) -------
+    # ---- over threshold: blacklist + drop (fsx_kern.c:260-268).
+    # v6 sources insert into the EXACT map (the full source is on the
+    # stack right now) — never the fold, which could block an innocent
+    # colliding source. ------------------------------------------------
     a.label("over")
     a += ldx(BPF_DW, R1, R6, CFG_BLOCK_NS)
     a += alu64(BPF_ADD, R1, R7)  # until = now + block_ns
     a += stx(BPF_DW, R10, S_VAL64, R1)
+    a += ldx(BPF_DW, R1, R10, S_IS6)
+    a.jmp_imm(BPF_JEQ, R1, 0, "over_v4")
+    a.ld_map(R1, "blacklist_v6")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, S_SADDR6)
+    a += mov64(R3, R10)
+    a += alu64_imm(BPF_ADD, R3, S_VAL64)
+    a += mov64_imm(R4, 0)  # BPF_ANY
+    a += call(FN_map_update_elem)
+    a.ja("over_counted")
+    a.label("over_v4")
     a.ld_map(R1, "blacklist_map")
     a += mov64(R2, R10)
     a += alu64_imm(BPF_ADD, R2, S_KEY)
@@ -577,6 +624,7 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64_imm(BPF_ADD, R3, S_VAL64)
     a += mov64_imm(R4, 0)  # BPF_ANY
     a += call(FN_map_update_elem)
+    a.label("over_counted")
     a += ldx(BPF_DW, R1, R8, ST_DROPPED_RATE)
     a += alu64_imm(BPF_ADD, R1, 1)
     a += stx(BPF_DW, R8, ST_DROPPED_RATE, R1)

@@ -49,10 +49,11 @@ def _cmd_version(args: argparse.Namespace) -> int:
 
 def _cmd_block(args: argparse.Namespace) -> int:
     """Manually blacklist a source (reference README.md:70-74: "Block
-    specified IP addresses")."""
+    specified IP addresses").  v6 addresses block EXACTLY (the 16-byte
+    blacklist_v6) — never by their 32-bit fold."""
     from flowsentryx_tpu.bpf import blacklist
 
-    m = blacklist.open_map(args.pin)
+    m = blacklist.open_map_for(args.ip, args.pin)
     try:
         e = blacklist.block(m, args.ip, ttl_s=args.ttl)
         print(json.dumps({"blocked": args.ip, **e.to_json()}))
@@ -64,7 +65,7 @@ def _cmd_block(args: argparse.Namespace) -> int:
 def _cmd_unblock(args: argparse.Namespace) -> int:
     from flowsentryx_tpu.bpf import blacklist
 
-    m = blacklist.open_map(args.pin)
+    m = blacklist.open_map_for(args.ip, args.pin)
     try:
         removed = blacklist.unblock(m, args.ip)
         print(json.dumps({"unblocked": args.ip, "was_present": removed}))
@@ -80,19 +81,30 @@ def _cmd_blacklist(args: argparse.Namespace) -> int:
 
     m = blacklist.open_map(args.pin)
     try:
+        m6 = blacklist.open_v6_map(args.pin)
+    except OSError:
+        m6 = None  # pin dir from a pre-v6-map image
+    try:
         if args.clear:
-            print(json.dumps({"cleared": blacklist.clear(m)}))
+            n = blacklist.clear(m) + (blacklist.clear(m6) if m6 else 0)
+            print(json.dumps({"cleared": n}))
             return 0
         entries = [e.to_json() for e in blacklist.entries(m)]
+        if m6 is not None:
+            entries += [e.to_json() for e in blacklist.entries(m6)]
         if args.json:
             print(json.dumps({"entries": entries}))
         else:
-            print(f"{'key':>10}  {'v4 view':>15}  remaining")
+            print(f"{'key':>10}  {'source':>40}  remaining")
             for e in entries:
-                print(f"{e['key']:>10}  {e['v4']:>15}  {e['remaining_s']:.1f}s")
+                src = e.get("addr") or e.get("v4")
+                key = "exact-v6" if e.get("exact") else e["key"]
+                print(f"{key:>10}  {src:>40}  {e['remaining_s']:.1f}s")
             print(f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'}")
     finally:
         m.close()
+        if m6 is not None:
+            m6.close()
     return 0
 
 

@@ -54,6 +54,29 @@ class FlowAgg(NamedTuple):
     inv: jnp.ndarray        # [B] int32: packet -> segment index
 
 
+class KeySegments(NamedTuple):
+    """Sort-based grouping of a key vector — the one copy of the
+    sort → run-heads → segment-ids pattern this module and the
+    owner-routed sharded step (parallel/step.py) both build on."""
+
+    order: jnp.ndarray   # [B] int: argsort permutation (stable)
+    sorted_key: jnp.ndarray  # [B]: keys in sorted order
+    heads: jnp.ndarray   # [B] bool: True at each run start (sorted order)
+    seg: jnp.ndarray     # [B] int32: segment id per sorted position
+    inv: jnp.ndarray     # [B] int32: original position -> segment id
+
+
+def segment_by_key(k: jnp.ndarray) -> KeySegments:
+    """Group equal keys into contiguous segments via one stable sort."""
+    order = jnp.argsort(k)  # stable; INVALID_KEY pads sort to the tail
+    sk = k[order]
+    heads = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    seg = (jnp.cumsum(heads) - 1).astype(jnp.int32)
+    inv = jnp.zeros(k.shape, jnp.int32).at[order].set(seg)
+    return KeySegments(order=order, sorted_key=sk, heads=heads, seg=seg,
+                       inv=inv)
+
+
 def aggregate(
     key: jnp.ndarray,
     pkt_len: jnp.ndarray,
@@ -70,10 +93,8 @@ def aggregate(
     key = jnp.where(key == 0, jnp.uint32(0xFFFFFFFE), key)
     k = jnp.where(valid, key, INVALID_KEY)
 
-    order = jnp.argsort(k)  # stable; invalids sort to the tail
-    sk = k[order]
-    heads = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
-    seg = jnp.cumsum(heads) - 1  # [B] segment id in sorted order
+    ks = segment_by_key(k)
+    order, sk, seg = ks.order, ks.sorted_key, ks.seg
 
     sv = valid[order]
     pkts = jax.ops.segment_sum(sv.astype(jnp.float32), seg, num_segments=b)
@@ -91,8 +112,7 @@ def aggregate(
     rep_key = jnp.where(rep_valid, rep_key, INVALID_KEY)
     ts_max = jnp.where(rep_valid, ts_max, 0.0)
 
-    # packet -> segment mapping in ORIGINAL order
-    inv = jnp.zeros((b,), jnp.int32).at[order].set(seg.astype(jnp.int32))
+    inv = ks.inv  # packet -> segment mapping in ORIGINAL order
 
     return FlowAgg(
         rep_key=rep_key,

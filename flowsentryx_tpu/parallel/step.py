@@ -121,13 +121,11 @@ def make_sharded_step(
                  else jnp.zeros_like(h1, jnp.int32))
         # rank of each flow within its owner bucket: one small sort by
         # owner + a cummax gives position-within-run
-        owner_s = jnp.where(fa.rep_valid, owner, n_dev)
-        order = jnp.argsort(owner_s)                             # stable
-        so = owner_s[order]
+        ko = agg.segment_by_key(jnp.where(fa.rep_valid, owner, n_dev))
         idx = jnp.arange(local_b, dtype=jnp.int32)
-        heads = jnp.concatenate([jnp.ones((1,), bool), so[1:] != so[:-1]])
-        run_start = jax.lax.cummax(jnp.where(heads, idx, 0))
-        rank = jnp.zeros((local_b,), jnp.int32).at[order].set(idx - run_start)
+        run_start = jax.lax.cummax(jnp.where(ko.heads, idx, 0))
+        rank = (jnp.zeros((local_b,), jnp.int32)
+                .at[ko.order].set(idx - run_start))
 
         routed = fa.rep_valid & (rank < C)
         overflow = fa.rep_valid & ~routed
@@ -155,21 +153,18 @@ def make_sharded_step(
         # --- owner side: merge per-source partials, run the flow core ------
         # A flow's packets may have landed on several source devices;
         # each contributed one partial (≤ n_dev duplicates per key).
-        r_key = r[:, 0]
-        order2 = jnp.argsort(r_key)                              # INVALID→tail
-        sk = r_key[order2]
-        heads2 = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
-        seg = (jnp.cumsum(heads2) - 1).astype(jnp.int32)
+        ks = agg.segment_by_key(r[:, 0])
+        seg, sk = ks.seg, ks.sorted_key
         rn = n_dev * C
         fvalid = sk != agg.INVALID_KEY
 
         def seg_sum(v):
             return jax.ops.segment_sum(
-                jnp.where(fvalid, v[order2], 0.0), seg, num_segments=rn)
+                jnp.where(fvalid, v[ks.order], 0.0), seg, num_segments=rn)
 
         def seg_max(v, fill):
             return jax.ops.segment_max(
-                jnp.where(fvalid, v[order2], fill), seg, num_segments=rn)
+                jnp.where(fvalid, v[ks.order], fill), seg, num_segments=rn)
 
         m_pkts = seg_sum(bits(r[:, 1], jnp.float32))
         m_bytes = seg_sum(bits(r[:, 2], jnp.float32))
@@ -179,7 +174,7 @@ def make_sharded_step(
         m_valid = m_pkts > 0
         m_key = jnp.where(m_valid, m_key, agg.INVALID_KEY)
         m_ts = jnp.where(m_valid, m_ts, 0.0)
-        inv2 = jnp.zeros((rn,), jnp.int32).at[order2].set(seg)   # entry→flow
+        inv2 = ks.inv                                            # entry→flow
 
         mfa = agg.FlowAgg(rep_key=m_key, rep_pkts=m_pkts, rep_bytes=m_bytes,
                           rep_ts=m_ts, rep_valid=m_valid, inv=inv2)

@@ -46,15 +46,35 @@ struct {
 } config_map SEC(".maps");
 
 /* Blacklist: key = folded source addr, value = blocked-until (ktime ns).
- * One map serves v4 and v6 via the 32-bit fold (the reference kept two,
- * fsx_kern.c:64-80).  Written by this program (rate limit) AND by the
- * daemon (TPU verdict ingress) — the north star's plugin seam. */
+ * Serves v4 exactly and v6 approximately via the 32-bit fold; written by
+ * this program (v4 rate limit) AND by the daemon (TPU verdict ingress,
+ * whose whole data plane keys on the fold) — the north star's plugin
+ * seam. */
 struct {
 	__uint(type, BPF_MAP_TYPE_LRU_HASH);
 	__uint(max_entries, FSX_MAX_TRACK_IPS);
 	__type(key, __u32);
 	__type(value, __u64);
 } blacklist_map SEC(".maps");
+
+/* EXACT IPv6 blacklist: key = full 128-bit source (reference parity:
+ * src/fsx_struct.h:9 __u128 + blacklist_v6, src/fsx_kern.c:66-72,
+ * 159-176).  The kernel rate limiter and `fsx block <v6addr>` write
+ * HERE for v6 sources, so a block can never hit an innocent source
+ * that merely shares a 32-bit fold with an attacker.  The folded map
+ * is still consulted for v6 (it carries the TPU plane's ML verdicts,
+ * which live in the folded key space by design — approximate, and
+ * documented as such in bpf/blacklist.py). */
+struct fsx_v6key {
+	__u32 addr[4];
+};
+
+struct {
+	__uint(type, BPF_MAP_TYPE_LRU_HASH);
+	__uint(max_entries, FSX_MAX_TRACK_IPS);
+	__type(key, struct fsx_v6key);
+	__type(value, __u64);
+} blacklist_v6 SEC(".maps");
 
 /* Per-source-IP limiter state (successor of ip_stats_map, fsx_kern.c:88-94). */
 struct {
@@ -252,7 +272,22 @@ int fsx(struct xdp_md *ctx)
 	if (rc > 0)
 		return XDP_PASS;    /* non-IP (fsx_kern.c:130) */
 
-	/* 1. blacklist gate with TTL expiry (fsx_kern.c:189-216) */
+	/* 1. blacklist gate with TTL expiry (fsx_kern.c:189-216).
+	 * v6 checks the EXACT 128-bit map first (fsx_kern.c:159-166
+	 * parity), then both fall through to the folded map (ML-verdict
+	 * ingress from the TPU plane). */
+	if (pkt.is_ipv6) {
+		__u64 *until = bpf_map_lookup_elem(&blacklist_v6,
+						   pkt.saddr6);
+
+		if (until) {
+			if (now < *until) {
+				stats->dropped_blacklist++;
+				return XDP_DROP;
+			}
+			bpf_map_delete_elem(&blacklist_v6, pkt.saddr6);
+		}
+	}
 	{
 		__u64 *until = bpf_map_lookup_elem(&blacklist_map, &pkt.saddr);
 
@@ -293,9 +328,19 @@ int fsx(struct xdp_md *ctx)
 		if (over) {
 			__u64 until = now + cfg->block_ns;
 
-			/* fsx_kern.c:317-325: insert + drop this packet */
-			bpf_map_update_elem(&blacklist_map, &pkt.saddr,
-					    &until, BPF_ANY);
+			/* fsx_kern.c:317-325: insert + drop this packet.
+			 * v6 sources go in the EXACT map (the full source
+			 * is in hand right now), matching the reference's
+			 * blacklist_v6 insert — never the fold, which
+			 * could block an innocent colliding source. */
+			if (pkt.is_ipv6)
+				bpf_map_update_elem(&blacklist_v6,
+						    pkt.saddr6, &until,
+						    BPF_ANY);
+			else
+				bpf_map_update_elem(&blacklist_map,
+						    &pkt.saddr, &until,
+						    BPF_ANY);
 			stats->dropped_rate++;
 			return XDP_DROP;
 		}

@@ -156,6 +156,8 @@ static void test_parse_ip6(void)
 	__u32 w[4];
 	memcpy(w, ip6 + 8, 16);
 	CHECK(pkt.saddr == (w[0] ^ w[1] ^ w[2] ^ w[3]), "ip6 fold");
+	/* full source captured for the EXACT v6 blacklist key */
+	CHECK(memcmp(pkt.saddr6, ip6 + 8, 16) == 0, "ip6 exact saddr6");
 }
 
 static void test_parse_icmp6(void)

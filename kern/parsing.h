@@ -60,6 +60,10 @@ struct fsx_cursor {
 struct fsx_pkt {
 	__u32 saddr;      /* IPv4 source, or 32-bit fold of IPv6 source */
 	__u32 daddr;
+	__u32 saddr6[4];  /* full 128-bit IPv6 source (zero for IPv4) —
+			   * the EXACT blacklist key, reference parity with
+			   * src/fsx_struct.h:9's __u128 (the fold alone
+			   * could block an innocent fold-colliding source) */
 	__u16 sport;      /* 0 for non-TCP/UDP */
 	__u16 dport;
 	__u16 l3_proto;   /* ETH_P_IP / ETH_P_IPV6 (host order) */
@@ -148,10 +152,12 @@ FSX_INLINE int fsx_parse_ip6(struct fsx_cursor *cur, void *data_end,
 		return -1;
 	__builtin_memcpy(&ip6, cur->pos, sizeof(ip6));
 #ifdef FSX_HOST_BUILD
+	__builtin_memcpy(pkt->saddr6, &ip6.ip6_src, 16);
 	pkt->saddr = fsx_fold_ip6((const __u32 *)&ip6.ip6_src);
 	pkt->daddr = fsx_fold_ip6((const __u32 *)&ip6.ip6_dst);
 	pkt->l4_proto = ip6.ip6_nxt;
 #else
+	__builtin_memcpy(pkt->saddr6, &ip6.saddr, 16);
 	pkt->saddr = fsx_fold_ip6((const __u32 *)&ip6.saddr);
 	pkt->daddr = fsx_fold_ip6((const __u32 *)&ip6.daddr);
 	pkt->l4_proto = ip6.nexthdr;
