@@ -253,8 +253,19 @@ def _cmd_status(args: argparse.Namespace) -> int:
             kern["stats"] = {"error": str(e)}
         try:
             bm = blacklist.open_map(args.pin)
-            kern["blacklist_entries"] = len(blacklist.entries(bm))
+            n = len(blacklist.entries(bm))
             bm.close()
+            # v6 blocks live exclusively in the exact-match v6 map; a
+            # status that counted only the folded map would report 0
+            # while dropped_blacklist climbs under a v6 flood.  Images
+            # predating the v6 map simply have no pinned map: count 0.
+            try:
+                bm6 = blacklist.open_v6_map(args.pin)
+                n += len(blacklist.entries(bm6))
+                bm6.close()
+            except OSError:
+                pass
+            kern["blacklist_entries"] = n
         except OSError as e:
             kern["blacklist_entries"] = {"error": str(e)}
         out["kernel"] = kern

@@ -22,6 +22,7 @@ from flowsentryx_tpu.engine.batcher import MicroBatcher  # noqa: F401
 from flowsentryx_tpu.engine.engine import Engine, EngineReport  # noqa: F401
 from flowsentryx_tpu.engine.sources import (  # noqa: F401
     ArraySource,
+    PacedSource,
     RecordSource,
     TrafficSource,
 )

@@ -51,6 +51,7 @@ class EngineReport(NamedTuple):
 class _InFlight(NamedTuple):
     out: Any            # StepOutput of device futures
     t_enqueue: float    # when the batch's first record entered the batcher
+    n_records: int      # valid records in the batch (wire meta row)
 
 
 class Engine:
@@ -178,6 +179,16 @@ class Engine:
         if t0_ns is not None and hasattr(sink, "t0_ns"):
             sink.t0_ns = t0_ns
         self.metrics = PipelineMetrics()
+        #: Optional per-batch reap hook ``(n_records, t_done) -> None``,
+        #: called after a batch's verdicts are fetched AND sunk.  Batches
+        #: are reaped in record-FIFO order, so a caller pairing this with
+        #: :class:`~flowsentryx_tpu.engine.sources.PacedSource` can pop
+        #: ``n_records`` scheduled arrival times per call and obtain
+        #: exact per-record arrival→verdict-sunk latencies (the latency
+        #: bench's measurement; batch-level ``metrics.e2e`` conflates
+        #: queueing with readback-group policy, which is fine for
+        #: throughput mode but not for judging the 1 ms budget).
+        self.on_reap = None
         self._inflight: list[_InFlight] = []
         self._blocked: set[int] = set()
         self._device_now = 0.0  # newest stream time seen in reaped outputs
@@ -186,11 +197,12 @@ class Engine:
     # -- pipeline stages ----------------------------------------------------
 
     def _dispatch(self, raw: np.ndarray, t_enqueue: float) -> None:
+        n_records = int(raw[self.cfg.batch.max_batch, 0])
         with self.metrics.dispatch.time():
             self.table, self.stats, out = self.step(
                 self.table, self.stats, self.params, raw
             )
-        self._inflight.append(_InFlight(out, t_enqueue))
+        self._inflight.append(_InFlight(out, t_enqueue, n_records))
 
     def _reap(self, down_to: int) -> None:
         """Fetch + sink verdicts until only ``down_to`` batches remain
@@ -226,6 +238,8 @@ class Engine:
         t_done = time.perf_counter()
         for g in group:
             self.metrics.e2e.add(t_done - g.t_enqueue)
+            if self.on_reap is not None:
+                self.on_reap(g.n_records, t_done)
 
     # -- checkpoint/resume (SURVEY.md §5.4: the map-pinning analog) ---------
 

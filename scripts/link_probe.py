@@ -1,0 +1,49 @@
+"""One-shot axon-tunnel health probe: prints ONE JSON line.
+
+Measures the two transport axes that gate the e2e benchmark
+(BENCH_EVIDENCE_r03.json showed them degrading independently):
+
+* ``h2d_mbps``   — host->device bandwidth on a 24 MB transfer (small
+  enough not to drain the tunnel's metered burst budget, large enough
+  to amortize the per-transfer RPC cost);
+* ``dispatch_ms`` — per-iteration cost of a 100-deep async dispatch
+  chain (the RPC path that collapsed ~100x in the degraded r03 window).
+
+Used by bench.py's probe phase and by the round's link monitor
+(artifacts/link_monitor_*.jsonl).  Runs in its own process because the
+first D2H readback permanently degrades a process's dispatch rate on
+the tunnel (bench.py module docstring).
+"""
+import json
+import sys
+import time
+
+out = {"ts": time.time()}
+try:
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    out["init_s"] = round(time.perf_counter() - t0, 1)
+    out["backend"] = dev.platform
+    out["device_kind"] = dev.device_kind
+
+    big = np.zeros(24 * 1024 * 1024, np.uint8)
+    jax.block_until_ready(jax.device_put(big[:1024]))  # warm the path
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(big))
+    out["h2d_mbps"] = round(big.nbytes / (time.perf_counter() - t0) / 1e6, 1)
+
+    f = jax.jit(lambda x: jnp.tanh(x @ x))
+    x = jax.device_put(jnp.ones((1024, 1024), jnp.bfloat16))
+    jax.block_until_ready(f(x))
+    t0 = time.perf_counter()
+    for _ in range(100):
+        y = f(x)
+    jax.block_until_ready(y)
+    out["dispatch_ms"] = round((time.perf_counter() - t0) / 100 * 1e3, 3)
+except Exception as e:  # noqa: BLE001 — a probe must never crash the caller
+    out["error"] = f"{type(e).__name__}: {e}"
+print(json.dumps(out), flush=True)

@@ -396,3 +396,84 @@ class TestPallasModelFamily:
         rep_b = Engine(cfg_p, TrafficSource(spec, total=1024), CollectSink()).run()
         assert rep_a.stats == rep_b.stats
         assert rep_a.table == rep_b.table
+
+
+class TestPacedLatency:
+    """Per-record arrival→verdict-sunk latency measurement: the
+    open-loop PacedSource + Engine.on_reap pair the latency bench is
+    built on (VERDICT r3 weak #2/#6: batch-level e2e conflates queueing
+    with readback-group policy)."""
+
+    def _pool(self, n=2048, seed=3):
+        return TrafficGen(TrafficSpec(seed=seed)).next_records(n)
+
+    def test_paced_source_open_loop_schedule(self):
+        from flowsentryx_tpu.engine import PacedSource
+
+        src = PacedSource(self._pool(), rate_pps=1e6, total=5000)
+        got = 0
+        import time
+
+        t0 = time.perf_counter()
+        while not src.exhausted():
+            got += len(src.poll(512))
+        wall = time.perf_counter() - t0
+        assert got == 5000
+        # Scheduled times advance at exactly the offered spacing
+        # (diff of RELATIVE times: absolute perf_counter values on a
+        # long-uptime host have ulp > 1e-12).
+        st = src.pop_scheduled(5000) - src.t_start
+        assert np.allclose(np.diff(st), 1e-6, atol=1e-9)
+        # Open loop: 5000 records at 1 Mpps are scheduled across 5 ms;
+        # the wall clock must cover at least the schedule span.
+        assert wall >= 4e-3
+
+    def test_paced_source_stamps_scheduled_ts(self):
+        from flowsentryx_tpu.engine import PacedSource
+
+        src = PacedSource(self._pool(), rate_pps=1e5, total=100)
+        recs = []
+        while not src.exhausted():
+            r = src.poll(64)
+            if len(r):
+                recs.append(r)
+        ts = np.concatenate([r["ts_ns"] for r in recs]).astype(np.int64)
+        assert np.array_equal(np.diff(ts), np.full(99, 10_000))  # 10 µs
+
+    def test_per_record_reap_latencies(self):
+        """Every offered record gets exactly one latency sample; FIFO
+        pairing of scheduled times with reap callbacks is exact."""
+        from flowsentryx_tpu.engine import PacedSource
+
+        cfg = small_cfg(batch=128)
+        total = 128 * 6
+        src = PacedSource(self._pool(), rate_pps=5e5, total=total)
+        eng = Engine(cfg, src, CollectSink(), readback_depth=0)
+        lats: list[float] = []
+
+        def on_reap(n, t_done):
+            lats.extend(t_done - src.pop_scheduled(n))
+
+        eng.on_reap = on_reap
+        rep = eng.run()
+        assert rep.records == total
+        assert len(lats) == total
+        assert src.popped == total  # every record accounted for
+        lats_a = np.array(lats)
+        assert (lats_a > 0).all()
+        # CPU backend, tiny batches: sanity bound, not a perf claim.
+        assert np.percentile(lats_a, 50) < 5.0
+
+    def test_reap_hook_counts_match_depth(self):
+        """readback_depth=1 defers exactly one batch; the hook still
+        sees every record exactly once by end of run."""
+        from flowsentryx_tpu.engine import PacedSource
+
+        cfg = small_cfg(batch=64)
+        total = 64 * 5
+        src = PacedSource(self._pool(), rate_pps=2e5, total=total)
+        eng = Engine(cfg, src, CollectSink(), readback_depth=1)
+        seen = []
+        eng.on_reap = lambda n, t: seen.append(n)
+        eng.run()
+        assert sum(seen) == total
