@@ -40,6 +40,16 @@ flowsentryx_tpu/ops/fused.py:donation_supported):
   full speed), so the donated steady-state throughput phase is a
   compute-only epoch that reports before exiting.
 
+Because the tunnel's capability swings >50x within a day, the run is
+GATED on transport state: a cheap probe subprocess measures H2D
+bandwidth and dispatch rate first, and while the link is degraded the
+bench sleeps/retries across its budget (keeping a reserve so the final
+attempt always happens), labels the run ``link_state``, and records
+every probe.  ``artifacts/link_baseline.json`` persists the best
+capability ever observed; ``transport_limited`` is judged against that
+persisted baseline, never against numbers taken through the same
+degraded path.
+
 Usage: ``python bench.py`` prints exactly ONE JSON line on stdout;
 progress chatter goes to stderr.  (``--phase=...`` runs a single phase —
 used internally via subprocess.)
@@ -84,6 +94,93 @@ def remaining() -> float:
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# -- link-state awareness (VERDICT r3 next #1/#8) ---------------------------
+#
+# The axon tunnel's capability swings >50x within a day (see
+# BENCH_EVIDENCE_r03.json and artifacts/link_monitor_r04.jsonl); a run
+# taken in a degraded window measures the tunnel, not the pipeline.  So:
+# probe transport FIRST in a throwaway subprocess, and if the link is
+# degraded, sleep/retry across the budget instead of burning the run —
+# keeping a reserve large enough that the final attempt always happens.
+# Every probe is recorded in the output (`link_probes`), and the run is
+# labeled `link_state` against fixed criteria, not against itself.
+#
+# `artifacts/link_baseline.json` persists the best capability ever
+# observed; `transport_limited` compares the measured e2e rate against
+# that persisted healthy baseline (a tunnel whose entire dispatch path
+# degrades uniformly must NOT read as "not transport limited").
+
+from pathlib import Path
+
+from flowsentryx_tpu.core import linkhealth  # light: no accelerator import
+
+HEALTHY_H2D_MBPS = linkhealth.HEALTHY_H2D_MBPS
+HEALTHY_DISPATCH_MS = 1.0  # legacy fallback only (_probe_state)
+LINK_BASELINE_PATH = Path(__file__).parent / "artifacts" / "link_baseline.json"
+PROBE_SCRIPT = Path(__file__).parent / "scripts" / "link_probe.py"
+
+
+def _load_link_baseline() -> dict:
+    try:
+        return json.loads(LINK_BASELINE_PATH.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _update_link_baseline(**obs) -> dict:
+    """Fold run observations into the persisted best-ever capability.
+    Higher is better except dispatch_ms_best."""
+    bl = _load_link_baseline()
+    changed = False
+    for k, v in obs.items():
+        if v is None:
+            continue
+        best = bl.get(k)
+        better = (best is None or v < best) if k == "dispatch_ms_best" \
+            else (best is None or v > best)
+        if better:
+            bl[k] = v
+            changed = True
+    if changed:
+        bl["updated"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        try:
+            LINK_BASELINE_PATH.parent.mkdir(parents=True, exist_ok=True)
+            LINK_BASELINE_PATH.write_text(json.dumps(bl, indent=2) + "\n")
+        except OSError as e:  # read-only checkout: keep the run alive
+            log(f"link baseline not persisted: {e}")
+    return bl
+
+
+def _probe_link(timeout_s: float = 120.0) -> dict:
+    """Run scripts/link_probe.py in a throwaway subprocess (the first
+    D2H readback permanently degrades a process's dispatch rate on the
+    tunnel, so probes must never share a process with a phase)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, str(PROBE_SCRIPT)],
+            capture_output=True, timeout=timeout_s,
+        )
+        lines = r.stdout.decode(errors="replace").strip().splitlines()
+        return json.loads(lines[-1]) if lines else {"error": "no output"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"probe timeout after {timeout_s:.0f}s"}
+    except (OSError, json.JSONDecodeError, IndexError) as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _probe_state(p: dict) -> str:
+    # The probe self-labels: it compiles and times the REAL fused step
+    # (trivial-dispatch health provably diverges from step-dispatch
+    # health on this tunnel — see scripts/link_probe.py).
+    if p.get("state"):
+        return p["state"]
+    if p.get("error") or "h2d_mbps" not in p:
+        return "wedged"
+    healthy = (p["h2d_mbps"] >= HEALTHY_H2D_MBPS
+               and p.get("dispatch_ms", 1e9) <= HEALTHY_DISPATCH_MS)
+    return "healthy" if healthy else "degraded"
 
 
 class Sidecar:
@@ -298,20 +395,122 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
 
 
 def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
-    """Undonated per-batch round trips (feature → verdict readback) +
-    cumulative verdict stats.  Readbacks degrade the axon session, which
-    is why this runs in its own subprocess — the measured p50/p99
-    include that degradation plus the tunnel sync floor, both absent on
-    locally attached hardware."""
+    """The latency mode (VERDICT r3 next #2): decompose the <1 ms
+    feature→verdict budget AND measure real per-record latency under
+    deadline-triggered small batches at fixed offered loads.
+
+    Four sub-measurements, ordered so the dispatch-degrading first D2H
+    readback (module docstring) happens only after the compute timings:
+
+    1. ``step_ms[B]`` — isolated on-device step time per batch size,
+       device-resident feeds, amortized over a dispatch chain with one
+       ``block_until_ready`` at the end (which does NOT trigger the
+       tunnel's readback degradation; ``np.asarray`` does).
+    2. ``micro`` — host fill (encode_compact) and one-wire-buffer H2D
+       time for the decomposition batch.
+    3. ``sync_floor_ms`` — the tunnel's fixed RPC round-trip cost,
+       measured on a 32-byte readback; everything after this line runs
+       in the degraded-dispatch regime, which is why it comes late.
+    4. ``paced`` — per-record arrival→verdict-sunk latency through the
+       REAL engine (open-loop PacedSource at fixed offered loads,
+       readback_depth 0-1, 200 µs deadline batches): p99 = f(batch,
+       depth, load), queueing included.
+    """
     deadline = time.perf_counter() + deadline_rel
-    jax, schema, cfg, params, step, table, stats, raws, init_s = _setup(False, side)
+    side.emit("init", stage="import_jax",
+              at_s=round(time.perf_counter() - T_START, 1))
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    side.emit("init", stage="devices_call",
+              at_s=round(time.perf_counter() - T_START, 1))
+    t0 = time.perf_counter()
     dev = jax.devices()[0]
+    init_s = round(time.perf_counter() - t0, 1)
+    side.emit("device", backend=dev.platform, device_kind=dev.device_kind,
+              init_s=init_s)
+    log(f"device: {dev.platform}/{dev.device_kind} (init {init_s:.1f}s)")
 
-    table, stats, out = step(table, stats, params, raws[0])
-    jax.block_until_ready(out.verdict)
-    side.emit("compile", compile_s=0)
+    small = B == 1024 or dev.platform == "cpu"  # --smoke / CPU fallback
+    sizes = [256, 1024] if small else [1024, 2048, 16384]
+    decomp_b = 1024 if small else 2048
 
-    # sync floor: trivial 32-byte compute+readback round trip
+    spec = get_model("logreg_int8")
+    params = spec.init()
+    quant = schema.model_quant_args(params)
+    result: dict = {
+        "backend": dev.platform, "device_kind": dev.device_kind,
+        "init_s": init_s, "step_ms": {}, "paced": [],
+    }
+
+    # -- 1. isolated on-device step time per batch size --------------------
+    for size in sizes:
+        if time.perf_counter() + 25 > deadline:
+            break
+        cfg = FsxConfig(table=TableConfig(capacity=TABLE_CAP),
+                        batch=BatchConfig(max_batch=size))
+        step = fused.make_jitted_compact_step(
+            cfg, spec.classify_batch, donate=False, **quant
+        )
+        table = jax.device_put(schema.make_table(TABLE_CAP))
+        stats = jax.device_put(schema.make_stats())
+        feeds = [
+            jax.device_put(schema.encode_compact(b, size, t0_ns=0, **quant))
+            for b in make_raw_batches(4, size, n_ips=1 << 14)
+        ]
+        jax.block_until_ready(feeds)
+        t0 = time.perf_counter()
+        table, stats, out = step(table, stats, params, feeds[0])
+        jax.block_until_ready(out.verdict)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(5):
+            table, stats, out = step(table, stats, params, feeds[i % 4])
+        jax.block_until_ready(out.verdict)
+        per = (time.perf_counter() - t0) / 5
+        iters = max(20, min(1000, int(3.0 / max(per, 1e-6))))
+        t0 = time.perf_counter()
+        for i in range(iters):
+            table, stats, out = step(table, stats, params, feeds[i % 4])
+        jax.block_until_ready(out.verdict)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        result["step_ms"][str(size)] = round(ms, 4)
+        side.emit("steptime", batch=size, step_ms=round(ms, 4), iters=iters,
+                  compile_s=round(compile_s, 1))
+        log(f"steptime B={size}: {ms:.3f} ms/step ({iters} iters, "
+            f"compile {compile_s:.1f}s)")
+
+    # -- 2. host fill + single-buffer H2D for the decomposition batch ------
+    raw = make_raw_batches(1, decomp_b, n_ips=1 << 14)[0]
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        wire = schema.encode_compact(raw, decomp_b, t0_ns=0, **quant)
+    fill_ms = (time.perf_counter() - t0) / reps * 1e3
+    jax.block_until_ready(jax.device_put(wire))  # warm the transfer path
+    h2d = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(wire))
+        h2d.append(time.perf_counter() - t0)
+    result["micro"] = {
+        "batch": decomp_b,
+        "fill_ms": round(fill_ms, 4),
+        "h2d_ms": round(float(np.median(h2d)) * 1e3, 4),
+        "wire_bytes": int(wire.nbytes),
+    }
+    side.emit("micro", **result["micro"])
+    log(f"micro B={decomp_b}: fill {fill_ms:.3f} ms, "
+        f"h2d {result['micro']['h2d_ms']:.3f} ms ({wire.nbytes} B)")
+
+    # -- 3. tunnel RPC floor (degrades this process's dispatch from here) --
     import jax.numpy as jnp
 
     f = jax.jit(lambda x: x + 1)
@@ -323,35 +522,135 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
         np.asarray(f(x))
         floors.append(time.perf_counter() - t0)
     sync_floor_ms = float(np.median(floors) * 1e3)
+    result["sync_floor_ms"] = round(sync_floor_ms, 2)
     side.emit("sync_floor", sync_floor_ms=round(sync_floor_ms, 1))
-    log(f"sync floor: {sync_floor_ms:.0f} ms")
+    log(f"sync floor: {sync_floor_ms:.1f} ms")
 
-    lat_iters = 40 if dev.platform != "cpu" else 15
-    lats = []
-    for i in range(lat_iters):
-        if time.perf_counter() + 3 * (lats[-1] if lats else 0.2) > deadline:
-            log(f"latency: deadline after {len(lats)} iters")
-            break
-        t1 = time.perf_counter()
-        table, stats, out = step(table, stats, params, raws[i % len(raws)])
-        np.asarray(out.verdict)
+    # verdict D2H for the decomposition batch (includes the floor once)
+    cfg = FsxConfig(table=TableConfig(capacity=TABLE_CAP),
+                    batch=BatchConfig(max_batch=decomp_b))
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=False, **quant
+    )
+    table = jax.device_put(schema.make_table(TABLE_CAP))
+    stats = jax.device_put(schema.make_stats())
+    feed = jax.device_put(wire)
+    table, stats, out = step(table, stats, params, feed)
+    np.asarray(out.block_key)
+    d2h = []
+    for _ in range(reps):
+        table, stats, out = step(table, stats, params, feed)
+        jax.block_until_ready(out.block_key)
+        t0 = time.perf_counter()
         np.asarray(out.block_key)
-        lats.append(time.perf_counter() - t1)
-        if len(lats) % 10 == 0:
-            side.emit("lat_partial", n_lat_iters=len(lats),
-                      p50_ms=round(float(np.percentile(np.array(lats) * 1e3, 50)), 2))
+        np.asarray(out.block_until)
+        d2h.append(time.perf_counter() - t0)
+    result["micro"]["d2h_ms"] = round(float(np.median(d2h)) * 1e3, 4)
+    side.emit("micro", **result["micro"])
 
-    st = schema.GlobalStats(*stats)
-    result = {
-        "sync_floor_ms": sync_floor_ms,
-        "n_lat_iters": len(lats),
-        "init_s": init_s,
-        "stats": st.to_dict(),
-    }
-    if lats:  # an empty sample is "missing", never "0 ms" (a fake pass)
-        lats_ms = np.array(lats) * 1e3
-        result["p50_ms"] = float(np.percentile(lats_ms, 50))
-        result["p99_ms"] = float(np.percentile(lats_ms, 99))
+    # -- 4. paced per-record latency through the real engine ---------------
+    from flowsentryx_tpu.engine import Engine, NullSink, PacedSource
+
+    pool = make_raw_batches(1, 1 << 14, n_ips=1 << 13)[0]
+    if small:
+        loads = [0.02, 0.05]
+        grid = [(sizes[0], 0), (sizes[0], 1)]
+    else:
+        loads = [0.25, 1.0, 5.0, 10.0]
+        grid = [(1024, 0), (2048, 0), (2048, 1)]
+    engines: dict = {}
+
+    def run_paced(bsz: int, depth: int, load: float,
+                  auto: bool = False) -> dict | None:
+        rate = load * 1e6
+        total = int(max(min(rate * 2.0, 2e6), 1))
+        eng = engines.get(bsz)
+        src = PacedSource(pool, rate_pps=rate, total=total)
+        if eng is None:
+            cfg = FsxConfig(
+                table=TableConfig(capacity=TABLE_CAP),
+                batch=BatchConfig(max_batch=bsz, deadline_us=200),
+            )
+            eng = Engine(cfg, src, NullSink(), params=params,
+                         donate=False, readback_depth=depth,
+                         wire=schema.WIRE_COMPACT16)
+            engines[bsz] = eng
+            # Compile OUTSIDE the paced run: the open-loop clock
+            # starts at the first poll, so seconds of XLA compile
+            # inside the run would read as seconds of queueing.
+            warm = schema.encode_compact(pool[:bsz], bsz, t0_ns=0, **quant)
+            eng.table, eng.stats, wout = eng.step(
+                eng.table, eng.stats, eng.params, warm)
+            jax.block_until_ready(wout.verdict)
+        eng.reset_stream(src, readback_depth=depth)
+        lats: list = []
+        eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
+            t - s.pop_scheduled(n)
+        )
+        t0 = time.perf_counter()
+        eng.run(max_seconds=6.0)
+        wall = time.perf_counter() - t0
+        if not lats:
+            return None
+        a = np.asarray(lats) * 1e3
+        rec = {
+            "batch": bsz, "depth": depth, "load_mpps": load,
+            "n": len(lats),
+            "p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "achieved_mpps": round(len(lats) / wall / 1e6, 4),
+            # consumed == reaped (lats), not merely released by the
+            # source: a run stopped by the wall cap can leave a batcher
+            # residue that was offered but never classified.
+            "offered_all_consumed": bool(len(lats) >= total),
+        }
+        if auto:
+            rec["auto_load"] = True
+        result["paced"].append(rec)
+        side.emit("paced", **rec)
+        log(f"paced B={bsz} d={depth} {load}Mpps"
+            + (" (auto)" if auto else "") +
+            f": p50={rec['p50_ms']:.1f} p99={rec['p99_ms']:.1f} "
+            f"({rec['n']} recs, achieved {rec['achieved_mpps']:.2f}Mpps)")
+        return rec
+
+    for bsz, depth in grid:
+        for load in loads:
+            if time.perf_counter() + 20 > deadline:
+                log("paced grid: deadline reached; stopping early")
+                break
+            run_paced(bsz, depth, load)
+        else:
+            continue
+        break
+
+    # Auto tier: when none of a config's fixed loads were sustainable
+    # (this transport drains slower than the lowest offered load —
+    # every p99 above measured backlog, not latency), add one run at
+    # 0.5x the config's measured drain rate: the queueing-free
+    # operating point, so the grid always contains a latency number
+    # that means latency.
+    drain: dict = {}
+    for r in result["paced"]:
+        key = (r["batch"], r["depth"])
+        drain[key] = max(drain.get(key, 0.0), r["achieved_mpps"])
+    for (bsz, depth), a in sorted(drain.items()):
+        sustained = [r for r in result["paced"]
+                     if (r["batch"], r["depth"]) == (bsz, depth)
+                     and r["achieved_mpps"] >= 0.8 * r["load_mpps"]]
+        if sustained or a <= 0:
+            continue
+        if time.perf_counter() + 20 > deadline:
+            break
+        run_paced(bsz, depth, max(round(0.5 * a, 4), 1e-4), auto=True)
+
+    # Cumulative verdict stats across the paced engine runs (the
+    # drop-attribution block prior rounds' evidence files carry).
+    if engines:
+        eng = next(iter(engines.values()))
+        result["stats"] = schema.GlobalStats(
+            *(np.asarray(s) for s in eng.stats)).to_dict()
+
     side.emit("result", **result)
     return result
 
@@ -385,6 +684,12 @@ def _recover_sidecar(path: str) -> dict | None:
             # Post-mortem trail: which init stage the child reached
             # (import_jax vs devices_call) and when.
             out.setdefault("init_stages", []).append(rec)
+        elif kind == "steptime":
+            out.setdefault("step_ms", {})[str(rec["batch"])] = rec["step_ms"]
+        elif kind == "micro":
+            out["micro"] = rec
+        elif kind == "paced":
+            out.setdefault("paced", []).append(rec)
         elif kind in ("device", "compile", "sync_floor", "lat_partial"):
             out.update(rec)
     if chunks:
@@ -537,6 +842,15 @@ def main() -> int:
         if a.startswith("--phase="):
             return _child_main(a.split("=", 1)[1])
 
+    # Persistent XLA compilation cache, inherited by every phase child
+    # and probe: the fused step costs ~6-9 s to compile per process;
+    # cached it loads in <1 s, which is what makes repeated probing and
+    # window-retry affordable inside the budget.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        str(Path(__file__).parent / ".jax_cache"),
+    )
+
     detail = {
         "metric": "mpps_classified",
         "value": 0.0,
@@ -551,40 +865,108 @@ def main() -> int:
         "budget_s": BUDGET_S,
     }
     try:
-        # Throughput gets the lion's share; latency runs in what's left.
-        tput_budget = max(0.0, min(0.70 * BUDGET_S, remaining() - 30))
-        if tput_budget < 30:
-            raise RuntimeError(
-                f"budget {BUDGET_S:.0f}s too small to run the throughput phase")
-
-        # Attempt 1: TPU, with device init bounded separately (the axon
-        # tunnel can wedge inside jax.devices() indefinitely — round-2
-        # post-mortem).  Attempt 2: one retry in a fresh subprocess with
-        # a shorter init deadline.  Fallback: a forced-CPU run, clearly
-        # labeled — a measured CPU number beats another 0.0.
         forced_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+
+        # -- healthy-window gate (VERDICT r3 next #1) -----------------------
+        # Probe the tunnel before committing the run.  On a degraded
+        # link, sleep/retry while enough budget remains for a full
+        # throughput+latency pass; the LAST probe's state labels the
+        # run either way (never burn the whole budget waiting: a
+        # degraded measurement with link_state recorded beats none).
+        # The probe compiles and times the REAL fused step — r04 showed
+        # trivial-dispatch health diverging 100x from step-dispatch
+        # health, so only a miniature of the actual pipeline is a
+        # trustworthy gate.
+        link_state = "unprobed"
+        probes: list = []
+        probe_e2e: float | None = None
+
+        def probe_until_healthy(last_resort_s: float) -> None:
+            nonlocal link_state, probe_e2e
+            backoff = 45.0
+            while True:
+                # A wedged probe may burn its whole timeout: cap it so
+                # it can never eat into the last-resort reserve (the
+                # reserve exists to guarantee the final full pass).
+                probe_to = min(240.0, remaining() - last_resort_s)
+                if probe_to < 30.0:
+                    if probes:
+                        return
+                    probe_to = 30.0  # always probe at least once
+                p = _probe_link(timeout_s=probe_to)
+                p["at_s"] = round(time.perf_counter() - T_START, 1)
+                p["state"] = _probe_state(p)
+                probes.append({k: p[k] for k in
+                               ("at_s", "state", "step_ms", "h2d_mbps",
+                                "e2e_mpps", "dispatch_ms", "init_s",
+                                "error") if k in p})
+                link_state = p["state"]
+                log(f"link probe at {p['at_s']:.0f}s: {link_state} "
+                    f"(step {p.get('step_ms')} ms, h2d {p.get('h2d_mbps')} "
+                    f"MB/s, e2e {p.get('e2e_mpps')} Mpps)")
+                _update_link_baseline(
+                    h2d_mbps_best=p.get("h2d_mbps"),
+                    dispatch_ms_best=p.get("dispatch_ms"),
+                    probe_e2e_mpps_best=p.get("e2e_mpps"),
+                )
+                if link_state == "healthy":
+                    probe_e2e = p.get("e2e_mpps")
+                    return
+                if remaining() - backoff < last_resort_s:
+                    log("no healthy window left in budget; "
+                        "running on the degraded link (labeled)")
+                    return
+                log(f"degraded link; retrying in {backoff:.0f}s "
+                    f"({remaining():.0f}s budget left)")
+                time.sleep(backoff)
+                backoff = min(backoff * 1.5, 180.0)
+
+        # Attempt structure: up to two WINDOW attempts (probe-gate, run
+        # the phase, and if the probe said healthy but the phase's own
+        # transport numbers show the window closed mid-run — flap —
+        # re-gate and re-run with what's left), each with the existing
+        # wedged-init retry inside.  Fallback: a forced-CPU run, clearly
+        # labeled — a measured CPU number beats another 0.0.
         init_attempts = []
         tput: dict = {}
         if not forced_cpu:
-            init_dl1 = min(300.0, 0.5 * tput_budget)
-            t = _run_phase("throughput", tput_budget,
-                           init_deadline=init_dl1) or {}
-            init_attempts.append(
-                {"deadline_s": round(init_dl1),
-                 "wedged": bool(t.get("init_wedged")),
-                 "init_s": t.get("init_s")})
-            if t.get("init_wedged") and remaining() > 240:
-                init_dl2 = min(150.0, 0.4 * remaining())
-                t2 = _run_phase(
-                    "throughput",
-                    max(60.0, min(tput_budget, remaining() - 150)),
-                    init_deadline=init_dl2) or {}
+            for window_attempt in (1, 2):
+                if PROBE_SCRIPT.exists():
+                    probe_until_healthy(
+                        last_resort_s=430.0 if window_attempt == 1 else 250.0)
+                tput_budget = max(60.0, min(0.55 * remaining(),
+                                            remaining() - 220))
+                init_dl1 = min(300.0, 0.5 * tput_budget)
+                t = _run_phase("throughput", tput_budget,
+                               init_deadline=init_dl1) or {}
                 init_attempts.append(
-                    {"deadline_s": round(init_dl2),
-                     "wedged": bool(t2.get("init_wedged")),
-                     "init_s": t2.get("init_s")})
-                t = t2
-            tput = t
+                    {"deadline_s": round(init_dl1),
+                     "wedged": bool(t.get("init_wedged")),
+                     "init_s": t.get("init_s")})
+                if t.get("init_wedged") and remaining() > 240:
+                    init_dl2 = min(150.0, 0.4 * remaining())
+                    t2 = _run_phase(
+                        "throughput",
+                        max(60.0, min(tput_budget, remaining() - 150)),
+                        init_deadline=init_dl2) or {}
+                    init_attempts.append(
+                        {"deadline_s": round(init_dl2),
+                         "wedged": bool(t2.get("init_wedged")),
+                         "init_s": t2.get("init_s")})
+                    t = t2
+                if t.get("mpps", 0) and t["mpps"] > tput.get("mpps", 0):
+                    tput = t
+                flapped = bool(
+                    link_state == "healthy" and probe_e2e
+                    and t.get("mpps") and t["mpps"] < 0.3 * probe_e2e
+                )
+                if flapped:
+                    detail["window_flaps"] = detail.get("window_flaps", 0) + 1
+                    if window_attempt == 1 and remaining() > 300:
+                        log(f"window flapped mid-run ({t['mpps']:.1f} vs "
+                            f"probe {probe_e2e:.1f} Mpps); re-gating")
+                        continue
+                break
         if not tput.get("mpps") and remaining() > 90:
             # TPU never produced a number (or cpu was requested):
             # labeled CPU fallback so the round records real data.
@@ -599,6 +981,10 @@ def main() -> int:
                 tput = cpu_t
         if init_attempts:
             detail["tpu_init_attempts"] = init_attempts
+        if probes:
+            detail["link_probes"] = probes
+            detail["link_state"] = link_state
+            detail["healthy_link_criteria"] = linkhealth.criteria()
 
         if tput and tput.get("mpps"):
             mpps = tput["mpps"]
@@ -611,10 +997,25 @@ def main() -> int:
                 device_kind=tput.get("device_kind"),
                 throughput_partial=tput.get("partial", False),
             )
-            for k in ("h2d_mbps", "device_mpps", "transport_limited",
-                      "burst_mpps"):
+            for k in ("h2d_mbps", "device_mpps", "burst_mpps"):
                 if k in tput:
                     detail[k] = tput[k]
+            # transport_limited vs the PERSISTED healthy baseline (r3
+            # weak #5: a uniformly degraded tunnel must not read as
+            # "not transport limited" just because its same-run
+            # device-resident number degraded too).
+            if tput.get("backend") != "cpu":
+                bl = _update_link_baseline(
+                    h2d_mbps_best=tput.get("h2d_mbps"),
+                    device_mpps_best=tput.get("device_mpps"),
+                    e2e_mpps_best=mpps,
+                )
+                best_dev = bl.get("device_mpps_best")
+                if best_dev:
+                    detail["transport_limited"] = bool(
+                        mpps < TARGET_MPPS and best_dev > 2 * mpps
+                    )
+                    detail["device_mpps_healthy_baseline"] = best_dev
             log(f"throughput: {mpps:.2f} Mpps median over {tput.get('chunk_mpps')}")
         else:
             detail["error"] = "throughput phase produced no chunks"
@@ -633,17 +1034,80 @@ def main() -> int:
                              else min(240.0, 0.6 * lat_budget)) or {}
             detail["latency_backend"] = "cpu" if lat_cpu else \
                 lat.get("backend", detail.get("backend"))
-            # Copy only what the (possibly partial) phase measured; an
-            # absent p50/p99 stays absent rather than becoming 0.0.
-            for key, nd in (("p50_ms", 3), ("p99_ms", 3),
-                            ("sync_floor_ms", 1), ("n_lat_iters", 0)):
-                if lat.get(key) is not None:
-                    detail[key] = round(lat[key], nd) if nd else lat[key]
-            if lat.get("p99_ms") is not None:
+            latd: dict = {}
+            for key in ("step_ms", "micro", "sync_floor_ms", "paced"):
+                if lat.get(key):
+                    latd[key] = lat[key]
+            if lat.get("sync_floor_ms") is not None:
+                detail["sync_floor_ms"] = round(lat["sync_floor_ms"], 2)
+
+            # Budget decomposition (r3 next #2): fill + H2D + compute +
+            # D2H for the decomposition batch, with tunnel-independent
+            # transfer times modeled at the persisted healthy link rate
+            # and the tunnel RPC floor reported separately.
+            micro = lat.get("micro") or {}
+            comp_ms = (lat.get("step_ms") or {}).get(str(micro.get("batch")))
+            if micro and comp_ms is not None:
+                bl = _load_link_baseline()
+                healthy = bl.get("h2d_mbps_best") or HEALTHY_H2D_MBPS
+                d2h_bytes = micro["batch"] * 8  # block_key u32 + until f32
+                h2d_healthy = micro["wire_bytes"] / (healthy * 1e6) * 1e3
+                d2h_healthy = d2h_bytes / (healthy * 1e6) * 1e3
+                floor = lat.get("sync_floor_ms") or 0.0
+                total = (micro["fill_ms"] + h2d_healthy + comp_ms
+                         + d2h_healthy)
+                latd["budget"] = {
+                    "batch": micro["batch"],
+                    "fill_ms": micro["fill_ms"],
+                    "h2d_ms_measured": micro.get("h2d_ms"),
+                    "h2d_ms_at_healthy_link": round(h2d_healthy, 4),
+                    "compute_ms": comp_ms,
+                    "d2h_ms_measured": micro.get("d2h_ms"),
+                    "d2h_ms_net_floor": round(max(
+                        0.0, (micro.get("d2h_ms") or 0.0) - floor), 4),
+                    "d2h_ms_at_healthy_link": round(d2h_healthy, 4),
+                    "total_ms_net_of_tunnel": round(total, 4),
+                    "sub_ms_budget": bool(total < 1.0),
+                    "tunnel_rpc_floor_ms": round(floor, 2),
+                    "healthy_link_mbps": healthy,
+                }
+                log(f"latency budget B={micro['batch']}: "
+                    f"{total:.3f} ms net of tunnel "
+                    f"(floor {floor:.1f} ms separately)")
+            if latd:
+                detail["latency"] = latd
+
+            # Headline p50/p99: the canonical latency config — depth 0
+            # and SUSTAINED (achieved >= 0.8x offered, so the number is
+            # latency, not backlog), at the highest sustained load;
+            # fallback: the lowest-load depth-0 run, unsustained,
+            # labeled by its achieved rate.
+            paced = lat.get("paced") or []
+            canon = [r for r in paced if r["depth"] == 0
+                     and r["achieved_mpps"] >= 0.8 * r["load_mpps"]]
+            if canon:
+                canon.sort(key=lambda r: (-r["load_mpps"], r["batch"]))
+            else:
+                canon = sorted((r for r in paced if r["depth"] == 0),
+                               key=lambda r: (r["batch"], r["load_mpps"]))
+            if canon:
+                r0 = canon[0]
+                detail["p50_ms"] = r0["p50_ms"]
+                detail["p99_ms"] = r0["p99_ms"]
+                detail["n_lat_records"] = r0["n"]
+                detail["latency_config"] = {
+                    "batch": r0["batch"], "depth": 0,
+                    "load_mpps": r0["load_mpps"],
+                    "achieved_mpps": r0["achieved_mpps"],
+                    "sustained": bool(
+                        r0["achieved_mpps"] >= 0.8 * r0["load_mpps"]),
+                }
+                floor = lat.get("sync_floor_ms") or 0.0
                 detail["p99_minus_floor_ms"] = round(
-                    max(0.0, lat["p99_ms"] - lat.get("sync_floor_ms", 0.0)), 3)
-                log(f"latency: p50={lat.get('p50_ms', 0):.1f}ms "
-                    f"p99={lat['p99_ms']:.1f}ms")
+                    max(0.0, r0["p99_ms"] - floor), 3)
+                log(f"latency: p50={r0['p50_ms']:.1f}ms "
+                    f"p99={r0['p99_ms']:.1f}ms "
+                    f"(B={r0['batch']} depth=0 {r0['load_mpps']}Mpps)")
             if lat.get("stats") is not None:
                 detail["stats"] = lat["stats"]
             if lat:

@@ -1,0 +1,43 @@
+"""Transport-health criteria shared by the benchmark and its probe.
+
+ONE source of truth for what "healthy" means for the TPU link, so the
+thresholds the probe actually applies (scripts/link_probe.py labels its
+own output) are the same numbers the benchmark records in
+``healthy_link_criteria`` — a run's recorded criteria must never
+misstate the criteria that gated it.
+
+Derivation (BENCH_EVIDENCE_r03.json + artifacts/link_monitor_r04.jsonl):
+healthy step dispatch is 0.06-0.5 ms (degrades ~100x to 7-14 ms);
+250 MB/s H2D x 16 B/record = 15.6 Mpps, comfortably over the 10 Mpps
+north star; the e2e go/no-go of 12 Mpps keeps ~20 % headroom.  No
+accelerator import here — the bench parent process must stay light.
+"""
+
+#: Max acceptable device-resident fused-step time (ms, B=16384).
+HEALTHY_STEP_MS = 1.0
+#: Min acceptable host->device bandwidth (MB/s).
+HEALTHY_H2D_MBPS = 250.0
+#: Go/no-go: min mini-e2e rate (Mpps) for a window worth benchmarking.
+HEALTHY_E2E_MPPS = 12.0
+
+
+def classify(step_ms: float | None, h2d_mbps: float | None,
+             e2e_mpps: float | None) -> str:
+    """``healthy`` / ``degraded`` from probe measurements; the e2e
+    mini-loop is authoritative when present (it composes both axes)."""
+    if e2e_mpps is not None:
+        return "healthy" if e2e_mpps >= HEALTHY_E2E_MPPS else "degraded"
+    if step_ms is None or h2d_mbps is None:
+        return "degraded"
+    ok = step_ms <= HEALTHY_STEP_MS and h2d_mbps >= HEALTHY_H2D_MBPS
+    return "healthy" if ok else "degraded"
+
+
+def criteria() -> dict:
+    """The machine-readable block benchmark artifacts embed."""
+    return {
+        "probe_e2e_mpps_min": HEALTHY_E2E_MPPS,
+        "probe_step_ms_max": HEALTHY_STEP_MS,
+        "h2d_mbps_min": HEALTHY_H2D_MBPS,
+        "probe": "scripts/link_probe.py (real fused-step mini-loop)",
+    }

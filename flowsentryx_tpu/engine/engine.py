@@ -241,6 +241,59 @@ class Engine:
             if self.on_reap is not None:
                 self.on_reap(g.n_records, t_done)
 
+    # -- stream rebinding ---------------------------------------------------
+
+    def reset_stream(
+        self,
+        source: RecordSource,
+        sink: VerdictSink | None = None,
+        readback_depth: int | None = None,
+        t0_ns: int | None = None,
+    ) -> None:
+        """Rebind the engine to a new record stream WITHOUT recompiling.
+
+        The jitted step is the expensive part of an Engine (~seconds of
+        XLA compile per batch shape); the stream plumbing around it is
+        cheap.  Benchmarks and restarted feeds reuse one engine across
+        many runs by swapping the source/sink and resetting the
+        batcher, metrics, and in-flight queue.  Device state (table,
+        stats) deliberately persists — it is the engine's long-lived
+        flow memory, surviving stream restarts just like the kernel
+        maps survive a daemon reconnect; use :meth:`restore` to reset
+        it.  Because that memory holds t0-relative stream-seconds
+        (last-seen, blacklist expiry), the clock EPOCH persists with
+        it: ``t0_ns=None`` keeps the current anchor (re-anchoring to a
+        new stream's first record would time-shift every persisted
+        expiry — the same invariant :meth:`restore` protects).
+        Per-stream report counters (metrics, blocked set, route drops)
+        reset; ``_device_now`` survives, being a high-water mark on the
+        persisting clock.  Must not be called with batches in flight."""
+        if self._inflight:
+            raise RuntimeError("reset_stream with batches in flight")
+        self.source = source
+        if sink is not None:
+            self.sink = sink
+        if readback_depth is not None:
+            self.readback_depth = readback_depth
+        quant = self.batcher.quant or None
+        keep_t0 = self.batcher.t0_ns if t0_ns is None else t0_ns
+        self.batcher = MicroBatcher(
+            self.cfg.batch,
+            t0_ns=keep_t0,
+            n_buffers=self.readback_depth + 2,
+            wire=self.wire,
+            quant=quant,
+        )
+        if t0_ns is not None:
+            self._t0_auto = False
+            if hasattr(self.sink, "t0_ns"):
+                self.sink.t0_ns = t0_ns
+        elif not self._t0_auto and hasattr(self.sink, "t0_ns"):
+            self.sink.t0_ns = keep_t0  # a swapped-in sink needs the anchor
+        self.metrics = PipelineMetrics()
+        self._blocked = set()
+        self._route_drop = 0
+
     # -- checkpoint/resume (SURVEY.md §5.4: the map-pinning analog) ---------
 
     def checkpoint(self, path) -> str:

@@ -1,0 +1,101 @@
+"""Tunnel diagnosis: which RPC path is degraded, exactly?
+
+Compares, in one process (order chosen so each measurement cannot
+poison the next):
+
+  a. async-dispatch chain cost of a trivial jitted fn (tanh matmul);
+  b. device-resident fused-step loop, donate=False;
+  c. device-resident fused-step loop, donate=True (the bench's shape);
+  d. H2D bandwidth, 1 MB and 24 MB transfers.
+
+Motivated by the r04 observation that a simple-chain probe read
+"healthy" (0.02 ms dispatch, 343 MB/s) seconds before the real
+pipeline measured 7 ms/step and 20 MB/s: if (b) is fast and (c) slow,
+donation bookkeeping is the degraded path; if both are slow, dispatch
+of large-argument-tree executables is; if only (d) is slow, it's pure
+bandwidth metering.  Prints ONE JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+out = {"ts": time.time()}
+t0 = time.perf_counter()
+import jax
+import jax.numpy as jnp
+
+dev = jax.devices()[0]
+out["backend"] = dev.platform
+out["init_s"] = round(time.perf_counter() - t0, 1)
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+from flowsentryx_tpu.models import get_model
+from flowsentryx_tpu.ops import fused
+
+B = 16384
+CAP = 1 << 16  # small table: the probe must not drain the link filling HBM
+
+
+def bench_loop(step, feeds, table, stats, params, iters):
+    t0 = time.perf_counter()
+    for i in range(iters):
+        table, stats, o = step(table, stats, params, feeds[i % len(feeds)])
+    jax.block_until_ready(o.verdict)
+    return (time.perf_counter() - t0) / iters
+
+
+# a. trivial-chain dispatch
+f = jax.jit(lambda x: jnp.tanh(x @ x))
+x = jax.device_put(jnp.ones((1024, 1024), jnp.bfloat16))
+jax.block_until_ready(f(x))
+t0 = time.perf_counter()
+for _ in range(100):
+    y = f(x)
+jax.block_until_ready(y)
+out["tanh_chain_ms"] = round((time.perf_counter() - t0) / 100 * 1e3, 3)
+
+cfg = FsxConfig(table=TableConfig(capacity=CAP), batch=BatchConfig(max_batch=B))
+spec = get_model(cfg.model.name)
+params = spec.init()
+quant = schema.model_quant_args(params)
+rng = np.random.default_rng(0)
+raw = np.zeros(B, dtype=schema.FLOW_RECORD_DTYPE)
+raw["saddr"] = rng.integers(1, 1 << 15, B).astype(np.uint32)
+raw["pkt_len"] = rng.integers(64, 1500, B)
+raw["ts_ns"] = np.arange(B) * 100
+raw["feat"] = rng.integers(0, 1 << 20, (B, schema.NUM_FEATURES))
+wire = schema.encode_compact(raw, B, t0_ns=0, **quant)
+
+for donate in (False, True):
+    tag = "donated" if donate else "undonated"
+    t0 = time.perf_counter()
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=donate, **quant
+    )
+    table = jax.device_put(schema.make_table(CAP))
+    stats = jax.device_put(schema.make_stats())
+    feeds = [jax.device_put(wire) for _ in range(4)]
+    jax.block_until_ready(feeds)
+    table, stats, o = step(table, stats, params, feeds[0])
+    jax.block_until_ready(o.verdict)
+    out[f"compile_{tag}_s"] = round(time.perf_counter() - t0, 1)
+    per = bench_loop(step, feeds, table, stats, params, 20)
+    iters = max(20, min(300, int(2.0 / max(per, 1e-6))))
+    per = bench_loop(step, feeds, table, stats, params, iters)
+    out[f"step_{tag}_ms"] = round(per * 1e3, 3)
+    out[f"step_{tag}_mpps"] = round(B / per / 1e6, 1)
+
+for mb, n in (("h2d_1mb_mbps", 1 << 20), ("h2d_24mb_mbps", 24 << 20)):
+    buf = np.zeros(n, np.uint8)
+    jax.block_until_ready(jax.device_put(buf[:1024]))
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(buf))
+    out[mb] = round(n / (time.perf_counter() - t0) / 1e6, 1)
+
+print(json.dumps(out), flush=True)

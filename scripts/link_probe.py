@@ -1,40 +1,58 @@
 """One-shot axon-tunnel health probe: prints ONE JSON line.
 
-Measures the two transport axes that gate the e2e benchmark
-(BENCH_EVIDENCE_r03.json showed them degrading independently):
+Measures the axes that gate the e2e benchmark, in escalating cost
+order, skipping the expensive ones when a cheap one already shows the
+tunnel degraded:
 
-* ``h2d_mbps``   — host->device bandwidth on a 24 MB transfer (small
-  enough not to drain the tunnel's metered burst budget, large enough
-  to amortize the per-transfer RPC cost);
-* ``dispatch_ms`` — per-iteration cost of a 100-deep async dispatch
-  chain (the RPC path that collapsed ~100x in the degraded r03 window).
+* ``dispatch_ms`` — async-dispatch chain of a trivial jitted fn.  This
+  alone is NOT a valid health signal: r04 measurements show windows
+  where trivial dispatch is 0.02 ms while the real fused step costs
+  7 ms (the tunnel degrades large-argument-tree dispatches ~100x
+  without touching small ones).  Kept for exactly that comparison.
+* ``step_ms`` / ``step_mpps`` — device-resident loop of the REAL fused
+  compact step (B=16384, 64K-row table), the bench's hot path; no link
+  traffic in the loop.  THE dispatch-health signal.
+* ``h2d_mbps`` — host->device bandwidth on an 8 MB transfer.
+* ``e2e_mpps`` — the real step fed by per-iteration device_put of the
+  16 B/record compact wire (prefetch 3), i.e. a miniature of the
+  benchmark's steady-state loop.  Only runs when step+h2d look
+  healthy (on a degraded link it would take ~20 s and drain the
+  link's recovery).  THE go/no-go number for the 10 Mpps target.
 
-Used by bench.py's probe phase and by the round's link monitor
-(artifacts/link_monitor_*.jsonl).  Runs in its own process because the
-first D2H readback permanently degrades a process's dispatch rate on
-the tunnel (bench.py module docstring).
+Uses the persistent XLA compilation cache (``.jax_cache/``) so repeat
+probes skip the ~6 s fused-step compile.  Runs in its own process
+because the first D2H readback permanently degrades a process's
+dispatch rate on the tunnel (bench.py module docstring).
 """
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from flowsentryx_tpu.core import linkhealth
+
+B = 16384
+CAP = 1 << 16  # small table: probing must not drain the link filling HBM
 
 out = {"ts": time.time()}
 try:
     t0 = time.perf_counter()
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"),
+    )
     dev = jax.devices()[0]
     out["init_s"] = round(time.perf_counter() - t0, 1)
     out["backend"] = dev.platform
     out["device_kind"] = dev.device_kind
-
-    big = np.zeros(24 * 1024 * 1024, np.uint8)
-    jax.block_until_ready(jax.device_put(big[:1024]))  # warm the path
-    t0 = time.perf_counter()
-    jax.block_until_ready(jax.device_put(big))
-    out["h2d_mbps"] = round(big.nbytes / (time.perf_counter() - t0) / 1e6, 1)
 
     f = jax.jit(lambda x: jnp.tanh(x @ x))
     x = jax.device_put(jnp.ones((1024, 1024), jnp.bfloat16))
@@ -44,6 +62,76 @@ try:
         y = f(x)
     jax.block_until_ready(y)
     out["dispatch_ms"] = round((time.perf_counter() - t0) / 100 * 1e3, 3)
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    cfg = FsxConfig(table=TableConfig(capacity=CAP),
+                    batch=BatchConfig(max_batch=B))
+    spec = get_model(cfg.model.name)
+    params = spec.init()
+    quant = schema.model_quant_args(params)
+    rng = np.random.default_rng(0)
+    raw = np.zeros(B, dtype=schema.FLOW_RECORD_DTYPE)
+    raw["saddr"] = rng.integers(1, 1 << 15, B).astype(np.uint32)
+    raw["pkt_len"] = rng.integers(64, 1500, B)
+    raw["ts_ns"] = np.arange(B) * 100
+    raw["feat"] = rng.integers(0, 1 << 20, (B, schema.NUM_FEATURES))
+    wire = schema.encode_compact(raw, B, t0_ns=0, **quant)
+
+    t0 = time.perf_counter()
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=False, **quant
+    )
+    table = jax.device_put(schema.make_table(CAP))
+    stats = jax.device_put(schema.make_stats())
+    feeds = [jax.device_put(wire) for _ in range(4)]
+    jax.block_until_ready(feeds)
+    table, stats, o = step(table, stats, params, feeds[0])
+    jax.block_until_ready(o.verdict)
+    out["compile_s"] = round(time.perf_counter() - t0, 1)
+
+    def loop(iters, feed):
+        nonlocal_table = table
+        nonlocal_stats = stats
+        t0 = time.perf_counter()
+        for i in range(iters):
+            nonlocal_table, nonlocal_stats, o = step(
+                nonlocal_table, nonlocal_stats, params, feed(i))
+        jax.block_until_ready(o.verdict)
+        return (time.perf_counter() - t0) / iters
+
+    per = loop(10, lambda i: feeds[i % 4])
+    if per < 2e-3:
+        per = loop(50, lambda i: feeds[i % 4])
+    out["step_ms"] = round(per * 1e3, 3)
+    out["step_mpps"] = round(B / per / 1e6, 1)
+
+    buf = np.zeros(8 << 20, np.uint8)
+    jax.block_until_ready(jax.device_put(buf[:1024]))
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(buf))
+    out["h2d_mbps"] = round(buf.nbytes / (time.perf_counter() - t0) / 1e6, 1)
+
+    if (out["step_ms"] <= linkhealth.HEALTHY_STEP_MS
+            and out["h2d_mbps"] >= 0.5 * linkhealth.HEALTHY_H2D_MBPS):
+        pre = [jax.device_put(wire) for _ in range(3)]
+        jax.block_until_ready(pre)
+        t0 = time.perf_counter()
+        for i in range(20):
+            pre.append(jax.device_put(wire))
+            table, stats, o = step(table, stats, params, pre.pop(0))
+        jax.block_until_ready(o.verdict)
+        per = (time.perf_counter() - t0) / 20
+        out["e2e_mpps"] = round(B / per / 1e6, 2)
+        out["state"] = linkhealth.classify(
+            out["step_ms"], out["h2d_mbps"], out["e2e_mpps"])
+    else:
+        out["state"] = linkhealth.classify(
+            out.get("step_ms"), out.get("h2d_mbps"), None)
 except Exception as e:  # noqa: BLE001 — a probe must never crash the caller
     out["error"] = f"{type(e).__name__}: {e}"
+    out["state"] = "wedged"
 print(json.dumps(out), flush=True)

@@ -477,3 +477,31 @@ class TestPacedLatency:
         eng.on_reap = lambda n, t: seen.append(n)
         eng.run()
         assert sum(seen) == total
+
+    def test_reset_stream_reuses_compiled_step(self):
+        """One engine, two paced runs: state persists, stream plumbing
+        resets, per-record accounting stays exact across rebinds."""
+        from flowsentryx_tpu.engine import PacedSource
+
+        cfg = small_cfg(batch=64)
+        src1 = PacedSource(self._pool(), rate_pps=2e5, total=64 * 3)
+        eng = Engine(cfg, src1, CollectSink(), readback_depth=0)
+        step_obj = eng.step
+        rep1 = eng.run()
+        t0_anchor = eng.batcher.t0_ns
+        src2 = PacedSource(self._pool(seed=9), rate_pps=2e5, total=64 * 4)
+        lats = []
+        eng.reset_stream(src2, readback_depth=1)
+        eng.on_reap = lambda n, t: lats.extend(t - src2.pop_scheduled(n))
+        rep2 = eng.run()
+        assert eng.step is step_obj  # no recompile
+        assert rep2.records == 64 * 4
+        assert len(lats) == 64 * 4
+        # table state persisted across the rebind (flow memory), while
+        # batch counters restarted
+        assert rep2.batches == 4
+        assert rep1.batches == 3
+        # the clock epoch persists with the flow memory: re-anchoring
+        # would time-shift every persisted expiry (engine.reset_stream)
+        assert eng.batcher.t0_ns == t0_anchor
+        assert eng._t0_auto is False

@@ -281,3 +281,61 @@ class TestFixture:
         p = fixture.provenance()
         assert p["kind"] == "synthetic-calibrated-fixture"
         assert "not" in p["synthetic_assumptions"].lower()
+
+
+class TestStress:
+    """Off-assumption stress harness (train/stress.py, VERDICT r3 #3)."""
+
+    def test_fixture_v1_has_no_syn_subtype(self):
+        from flowsentryx_tpu.train import fixture, stress
+
+        X, y, c = stress.fixture_variant("v1", 20_000, seed=3)
+        assert (c == fixture.CLASS_SYN).sum() == 0
+        assert (c == fixture.CLASS_VOLUMETRIC).sum() > 0
+        assert (c == fixture.CLASS_SLOW).sum() > 0
+        # label rate matches the published calibration either way
+        assert abs(y.mean() - fixture.LABEL_RATE) < 0.01
+        X2, _, c2 = stress.fixture_variant("v2", 20_000, seed=3)
+        assert (c2 == fixture.CLASS_SYN).sum() > 0
+        assert X.shape == X2.shape
+
+    def test_perturb_touches_one_column_only(self):
+        from flowsentryx_tpu.core.schema import Feature
+        from flowsentryx_tpu.train import stress
+
+        X, _, _ = stress.fixture_variant("v2", 1000, seed=1)
+        Xp = stress.perturb(X, int(Feature.PKT_LEN_MEAN), scale=2.0)
+        assert np.allclose(Xp[:, int(Feature.PKT_LEN_MEAN)],
+                           X[:, int(Feature.PKT_LEN_MEAN)] * 2.0)
+        other = [i for i in range(X.shape[1])
+                 if i != int(Feature.PKT_LEN_MEAN)]
+        assert np.array_equal(Xp[:, other], X[:, other])
+        # shifts clamp at zero: magnitudes never go negative
+        Xs = stress.perturb(X, int(Feature.FWD_IAT_MEAN), shift=-1e9)
+        assert (Xs[:, int(Feature.FWD_IAT_MEAN)] >= 0).all()
+
+    def test_cross_fixture_table_shape_and_gap(self):
+        from flowsentryx_tpu.train import stress
+
+        t = stress.cross_fixture_table(n_train=8000, n_eval=8000, epochs=40)
+        for tv in ("train_v1", "train_v2"):
+            assert set(t[tv]) == {"eval_v1", "eval_v2",
+                                  "f1_gap_in_minus_cross"}
+            for ev in ("eval_v1", "eval_v2"):
+                assert 0.0 <= t[tv][ev]["f1"] <= 1.0
+                assert "subtype_recall" in t[tv][ev]
+        # v2 eval carries the syn subtype breakdown
+        assert "syn" in t["train_v1"]["eval_v2"]["subtype_recall"]
+        assert "syn" not in t["train_v1"]["eval_v1"]["subtype_recall"]
+
+    def test_perturbation_sweep_reports_worst_case(self):
+        from flowsentryx_tpu.train import stress
+
+        X, y, _ = stress.fixture_variant("v2", 8000, seed=2)
+        params = stress.train_binary(X, y, epochs=40)
+        sweep = stress.perturbation_sweep(params, X, y)
+        assert len(sweep["features"]) == 8
+        for row in sweep["features"].values():
+            assert set(row) == {"scale_0.5", "scale_2.0", "shift_-2std",
+                                "shift_+2std", "std"}
+        assert sweep["worst_case"]["f1"] <= sweep["baseline_f1"] + 1e-9
