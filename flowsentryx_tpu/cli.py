@@ -170,7 +170,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.parallel import make_mesh
 
         mesh = make_mesh(args.mesh)
-    eng = Engine(cfg, source, sink, mesh=mesh)
+    params = None
+    if args.artifact:
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        params = load_artifact(cfg.model.name, args.artifact)
+    eng = Engine(cfg, source, sink, params=params, mesh=mesh)
     if args.restore:
         eng.restore(args.restore)
     import contextlib
@@ -452,6 +457,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("serve", help="run the serving engine")
     s.add_argument("--config", help="JSON config file")
+    s.add_argument("--artifact",
+                   help="trained model artifact (.npz) to serve; default is "
+                        "the embedded golden params — the REFERENCE's "
+                        "artifact, a near-constant benign predictor (see "
+                        "MODEL_METRICS.json); serve "
+                        "artifacts/logreg_int8.npz for a working detector")
     s.add_argument("--feature-ring", help="daemon shm feature ring path")
     s.add_argument("--verdict-ring", help="daemon shm verdict ring path")
     s.add_argument("--records",

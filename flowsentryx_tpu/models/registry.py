@@ -46,6 +46,25 @@ def registered_models() -> list[str]:
     return sorted(_REGISTRY)
 
 
+def load_artifact(name: str, path: str):
+    """Load a trained artifact (.npz) as the params for model ``name``.
+
+    Dispatches on model family — every family persists via its own
+    ``save_params``/``load_params`` pair (versioned npz schema).  This
+    is how serving swaps the embedded golden params (the reference's
+    artifact, a near-constant benign predictor — see
+    MODEL_METRICS.json analysis) for a retrained one."""
+    from flowsentryx_tpu.models import logreg, mlp, multiclass
+
+    if name.startswith("logreg"):
+        return logreg.load_params(path)
+    if name == "mlp":
+        return mlp.load_params(path)
+    if name == "multiclass":
+        return multiclass.load_params(path)
+    raise KeyError(f"no artifact loader for model family {name!r}")
+
+
 # -- built-ins ---------------------------------------------------------------
 
 from flowsentryx_tpu.models import logreg as _logreg  # noqa: E402

@@ -277,6 +277,15 @@ def main() -> int:  # pragma: no cover - exercised by the committed artifact
     import sys
     import time
 
+    # Force the CPU backend: this image's sitecustomize force-registers
+    # the axon TPU platform and overrides JAX_PLATFORMS from the
+    # environment; training the stress models over a possibly-degraded
+    # dev tunnel is both slow and pointless (the artifact is about
+    # model quality, not device placement).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     from flowsentryx_tpu.train.fixture import provenance
 
     t0 = time.time()

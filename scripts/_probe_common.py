@@ -1,0 +1,41 @@
+"""Shared fixture for the transport probes (link_probe / link_diag).
+
+One copy of the record-synthesis + fused-step construction both probe
+scripts time, so they measure the same pipeline by construction — a
+wire-schema or step-signature change lands here once.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_step_fixture(B: int, cap: int, donate: bool = False):
+    """``(step, table, stats, params, wire, quant)`` — the real compact
+    serving step over a ``cap``-row table with one encoded wire batch of
+    flood-mix records (mirrors bench.make_raw_batches statistics)."""
+    import jax
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    cfg = FsxConfig(table=TableConfig(capacity=cap),
+                    batch=BatchConfig(max_batch=B))
+    spec = get_model(cfg.model.name)
+    params = spec.init()
+    quant = schema.model_quant_args(params)
+    rng = np.random.default_rng(0)
+    raw = np.zeros(B, dtype=schema.FLOW_RECORD_DTYPE)
+    raw["saddr"] = rng.integers(1, 1 << 15, B).astype(np.uint32)
+    raw["pkt_len"] = rng.integers(64, 1500, B)
+    raw["ts_ns"] = np.arange(B) * 100
+    raw["ip_proto"] = rng.choice([1, 6, 17], B)
+    raw["feat"] = rng.integers(0, 1 << 20, (B, schema.NUM_FEATURES))
+    wire = schema.encode_compact(raw, B, t0_ns=0, **quant)
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=donate, **quant
+    )
+    table = jax.device_put(schema.make_table(cap))
+    stats = jax.device_put(schema.make_stats())
+    return step, table, stats, params, wire, quant

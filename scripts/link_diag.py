@@ -33,10 +33,7 @@ dev = jax.devices()[0]
 out["backend"] = dev.platform
 out["init_s"] = round(time.perf_counter() - t0, 1)
 
-from flowsentryx_tpu.core import schema
-from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
-from flowsentryx_tpu.models import get_model
-from flowsentryx_tpu.ops import fused
+from _probe_common import make_step_fixture
 
 B = 16384
 CAP = 1 << 16  # small table: the probe must not drain the link filling HBM
@@ -60,26 +57,11 @@ for _ in range(100):
 jax.block_until_ready(y)
 out["tanh_chain_ms"] = round((time.perf_counter() - t0) / 100 * 1e3, 3)
 
-cfg = FsxConfig(table=TableConfig(capacity=CAP), batch=BatchConfig(max_batch=B))
-spec = get_model(cfg.model.name)
-params = spec.init()
-quant = schema.model_quant_args(params)
-rng = np.random.default_rng(0)
-raw = np.zeros(B, dtype=schema.FLOW_RECORD_DTYPE)
-raw["saddr"] = rng.integers(1, 1 << 15, B).astype(np.uint32)
-raw["pkt_len"] = rng.integers(64, 1500, B)
-raw["ts_ns"] = np.arange(B) * 100
-raw["feat"] = rng.integers(0, 1 << 20, (B, schema.NUM_FEATURES))
-wire = schema.encode_compact(raw, B, t0_ns=0, **quant)
-
 for donate in (False, True):
     tag = "donated" if donate else "undonated"
     t0 = time.perf_counter()
-    step = fused.make_jitted_compact_step(
-        cfg, spec.classify_batch, donate=donate, **quant
-    )
-    table = jax.device_put(schema.make_table(CAP))
-    stats = jax.device_put(schema.make_stats())
+    step, table, stats, params, wire, quant = make_step_fixture(
+        B, CAP, donate=donate)
     feeds = [jax.device_put(wire) for _ in range(4)]
     jax.block_until_ready(feeds)
     table, stats, o = step(table, stats, params, feeds[0])

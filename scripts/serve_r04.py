@@ -1,0 +1,251 @@
+"""SERVE_r04: sustained kernel-path serving artifact (VERDICT r3 next #7).
+
+tests/test_daemon_bpf.py proves the kernel↔daemon↔engine seam works
+once; this harness records it under SUSTAINED load for minutes:
+
+    BPF_PROG_TEST_RUN flood driver (this script, the "NIC role")
+      → real in-kernel XDP program (compact 16 B emit variant)
+      → kernel BPF ringbuf → fsxd drain (daemon/fsxd.cpp run_bpf)
+      → shm feature ring → fsx serve engine (micro-batch → fused step
+        → verdicts) → shm verdict ring → fsxd → kernel blacklist map.
+
+Recorded: offered packets (syscall count × repeat), kernel per-CPU
+verdict stats, records forwarded through both rings, verdict
+round-trips applied to the kernel map, ring-full drops at the shm seam
+(the kernel ringbuf fails open silently by design — its loss shows up
+as offered/16 vs forwarded), and the engine's own report.
+
+The engine runs on CPU (JAX_PLATFORMS=cpu) so this artifact measures
+the KERNEL-PATH plumbing independent of the axon tunnel's state; TPU
+compute rates are bench.py's job (BENCH_r04 / link_baseline.json).
+
+Usage: sudo python scripts/serve_r04.py [duration_s] — writes
+SERVE_r04.json at the repo root.  Maps pin under /sys/fs/bpf/fsx_serve.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from flowsentryx_tpu.bpf import loader  # noqa: E402
+
+PIN = "/sys/fs/bpf/fsx_serve"
+DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 150.0
+N_ATTACK = 64          # flood sources
+N_BENIGN = 64          # background sources
+REPEAT = 2048          # kernel runs per PROG_TEST_RUN syscall
+
+
+def eth(proto=0x0800):
+    return b"\xff" * 6 + b"\x00" * 6 + struct.pack(">H", proto)
+
+
+def udp_pkt(saddr: int, plen: int = 120, dport: int = 443) -> bytes:
+    ihl = 5
+    hdr = bytes([0x40 | ihl, 0]) + struct.pack(">H", plen - 14)
+    hdr += b"\x00\x00\x00\x00" + bytes([64, 17]) + b"\x00\x00"
+    hdr += struct.pack("<I", saddr)
+    hdr += b"\x01\x02\x03\x04"
+    l4 = struct.pack(">HHHH", 1234, dport, plen - 14 - ihl * 4, 0)
+    pkt = eth() + hdr + l4
+    return pkt + b"X" * max(0, plen - len(pkt))
+
+
+def main() -> int:
+    t_wall0 = time.time()
+    # 1. fresh compact image with the production-default map sizes
+    img = tempfile.mktemp(prefix="fsx_serve_", suffix=".img")
+    r = subprocess.run(
+        [sys.executable, "-m", "flowsentryx_tpu.bpf.image", img, "--compact"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0, r.stderr
+
+    subprocess.run(["make", "-C", str(REPO / "daemon"), "-q"], check=False)
+    subprocess.run(["rm", "-rf", PIN], check=False)
+    fring = tempfile.mktemp(prefix="fsx_fring_")
+    vring = tempfile.mktemp(prefix="fsx_vring_")
+
+    # 2. daemon: kernel seam owner.  pps threshold sized BETWEEN the
+    # two flood tiers the driver offers (~14 kpps "loud" sources vs
+    # ~3.5 kpps "quiet" ones): the kernel limiter autonomously blocks
+    # the loud tier while the quiet tier is left for the ML plane —
+    # so the artifact shows BOTH kernel-limiter drops and ML verdict
+    # round-trips, each attributable.
+    fsxd = subprocess.Popen(
+        [str(REPO / "daemon/build/fsxd"), "--bpf", "none", "--compact",
+         "--prog-image", img, "--pin", PIN,
+         "--duration", str(DURATION + 20),
+         "--feature-ring", fring, "--verdict-ring", vring,
+         "--pps-threshold", "8000", "--window", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    serve = None
+    out: dict = {
+        "round": 4,
+        "purpose": ("Sustained kernel-path serving: PROG_TEST_RUN flood -> "
+                    "in-kernel XDP (compact emit) -> ringbuf -> fsxd -> shm "
+                    "-> engine -> verdict ring -> fsxd -> kernel blacklist "
+                    "map, for minutes at max sim rate (VERDICT r3 next #7)"),
+        "duration_s": DURATION,
+        "engine_backend": "cpu (decoupled from axon tunnel state; TPU rates "
+                          "are bench.py's artifact)",
+        "analysis": {
+            "offered_rate": ("PROG_TEST_RUN is a single-core syscall loop "
+                             "(~4 us/packet in-kernel incl. map ops): the "
+                             "~0.4 Mpps offered rate measures the DRIVER, "
+                             "not XDP line rate (which needs a NIC)"),
+            "benign_blocking": (
+                "benign sources are eventually ML-blocked too: their FIRST "
+                "1-2 packets carry no length variance and sparse IATs — "
+                "indistinguishable from a slow attack at that flow age "
+                "(the slow-attack confusion MODEL_METRICS_r04.json "
+                "quantifies). Once mature (3+ varied frames), benign "
+                "records score benign ('allowed' > 0); a k-record vote "
+                "before first block is the policy lever, at the cost of "
+                "k records of attack latency"),
+        },
+    }
+    try:
+        deadline = time.time() + 10
+        while not os.path.exists(f"{PIN}/prog"):
+            if fsxd.poll() is not None:
+                print(fsxd.stderr.read(), file=sys.stderr)
+                raise RuntimeError("fsxd died before pinning")
+            assert time.time() < deadline, "daemon never pinned"
+            time.sleep(0.1)
+        prog_fd = loader.obj_get(f"{PIN}/prog")
+
+        # 3. engine on the shm rings (CPU; small table for 1-core jit)
+        cfgf = tempfile.mktemp(prefix="fsx_cfg_", suffix=".json")
+        Path(cfgf).write_text(json.dumps({
+            "table": {"capacity": 65536},
+            "batch": {"max_batch": 2048, "deadline_us": 2000},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        serve = subprocess.Popen(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "serve",
+             "--config", cfgf, "--feature-ring", fring,
+             "--verdict-ring", vring, "--seconds", str(DURATION + 10),
+             "--artifact", str(REPO / "artifacts/logreg_int8.npz")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=str(REPO), env=env)
+
+        # 4. flood driver at max PROG_TEST_RUN rate
+        t0 = time.perf_counter()
+        offered = 0
+        syscalls = 0
+        attack = [udp_pkt(0xC0A80000 + i, plen=80) for i in range(N_ATTACK)]
+        # benign frames VARY in size per flow (web-like mix): a
+        # constant-size one-packet-per-2s flow is correctly scored as
+        # slowloris-shaped by the model — realistic background traffic
+        # needs length variance, which drives PKT_LEN_STD/VAR
+        benign = [[udp_pkt(0x0A000000 + i, plen=pl, dport=443 if i % 3
+                           else 8000 + i)
+                   for pl in (120, 600, 1400)]
+                  for i in range(N_BENIGN)]
+        k = 0
+        while time.perf_counter() - t0 < DURATION:
+            i = k % N_ATTACK
+            # two flood tiers: the first quarter of sources run 4x
+            # louder (kernel-limiter territory); the rest sit under the
+            # rate threshold, detectable only by their ML features
+            rep = REPEAT * 4 if i < N_ATTACK // 4 else REPEAT
+            loader.prog_test_run(prog_fd, attack[i], repeat=rep)
+            offered += rep
+            syscalls += 1
+            if k % 2 == 0:
+                # benign minority at repeat=1: the kernel stamps REAL
+                # inter-arrival times, so with 64 rotating sources each
+                # benign flow sees ~1-2 s gaps and normal frames —
+                # features the model should pass (a repeat-burst benign
+                # driver would hand the kernel genuine µs IATs and be
+                # correctly flagged as flood behavior)
+                b = benign[(k // 2) % N_BENIGN][(k // 2) % 3]
+                loader.prog_test_run(prog_fd, b, repeat=1)
+                offered += 1
+                syscalls += 1
+            k += 1
+        drive_wall = time.perf_counter() - t0
+        out["offered_packets"] = offered
+        out["prog_test_run_syscalls"] = syscalls
+        out["offered_mpps"] = round(offered / drive_wall / 1e6, 3)
+        out["drive_wall_s"] = round(drive_wall, 1)
+
+        # 5. kernel-side truth: per-CPU stats + both blacklist maps
+        st = subprocess.run(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "status",
+             "--pin", PIN], capture_output=True, text=True, cwd=str(REPO))
+        out["kernel"] = json.loads(st.stdout).get("kernel", {})
+
+        bl = subprocess.run(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "blacklist",
+             "--pin", PIN], capture_output=True, text=True, cwd=str(REPO))
+        try:
+            out["blacklist"] = json.loads(bl.stdout)
+        except json.JSONDecodeError:
+            out["blacklist"] = {"raw": bl.stdout[-500:]}
+    finally:
+        # 6. orderly teardown: daemon first (it drains the verdict ring
+        # on exit), then the engine
+        try:
+            fsxd_out, fsxd_err = fsxd.communicate(timeout=40)
+        except subprocess.TimeoutExpired:
+            fsxd.kill()
+            fsxd_out, fsxd_err = fsxd.communicate()
+        if serve is not None:
+            try:
+                s_out, s_err = serve.communicate(timeout=40)
+            except subprocess.TimeoutExpired:
+                serve.kill()
+                s_out, s_err = serve.communicate()
+            try:
+                out["engine_report"] = json.loads(s_out)
+            except json.JSONDecodeError:
+                out["engine_error"] = (s_err or s_out)[-800:]
+
+        # daemon periodic stats: keep first/last lines + totals
+        lines = [ln for ln in fsxd_err.splitlines() if "forwarded=" in ln]
+        if lines:
+            out["fsxd_first_report"] = lines[0]
+            out["fsxd_last_report"] = lines[-1]
+            m = re.search(
+                r"forwarded=(\d+) verdicts=(\d+) skipped=(\d+)", lines[-1])
+            if m:
+                fwd, ver, skip = map(int, m.groups())
+                out["forwarded_records"] = fwd
+                out["verdict_roundtrips_applied"] = ver
+                out["skipped_records"] = skip
+                if "drive_wall_s" in out:
+                    out["forwarded_mrps"] = round(
+                        fwd / out["drive_wall_s"] / 1e6, 3)
+        tail = [ln for ln in fsxd_err.splitlines()
+                if "ring_full" in ln or "final" in ln]
+        if tail:
+            out["fsxd_tail"] = tail[-3:]
+        out["wall_s"] = round(time.time() - t_wall0, 1)
+        Path(REPO / "SERVE_r04.json").write_text(
+            json.dumps(out, indent=2) + "\n")
+        print(json.dumps({k: out.get(k) for k in
+                          ("offered_mpps", "forwarded_records",
+                           "verdict_roundtrips_applied", "wall_s")}))
+        subprocess.run(["rm", "-rf", PIN], check=False)
+        for f in (img, fring, vring):
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

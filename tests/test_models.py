@@ -224,3 +224,45 @@ class TestMulticlass:
         eng = Engine(cfg, src, CollectSink())
         rep = eng.run()
         assert rep.records == 2048  # untrained params: behavior only
+
+
+class TestArtifactLoader:
+    def test_load_artifact_dispatches_by_family(self, tmp_path):
+        import numpy as np
+
+        from flowsentryx_tpu.models import logreg, multiclass
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        p = logreg.golden_params()
+        path = logreg.save_params(p, str(tmp_path / "lr"))
+        for fam in ("logreg_int8", "logreg_float", "logreg_int8_pallas"):
+            q = load_artifact(fam, path)
+            np.testing.assert_array_equal(np.asarray(q.w_int8),
+                                          np.asarray(p.w_int8))
+        import jax
+
+        mp = multiclass.init_params(jax.random.PRNGKey(0))
+        mpath = multiclass.save_params(mp, str(tmp_path / "mc"))
+        q = load_artifact("multiclass", mpath)
+        np.testing.assert_array_equal(np.asarray(q.w1), np.asarray(mp.w1))
+        import pytest
+
+        with pytest.raises(KeyError):
+            load_artifact("nope", path)
+
+    def test_served_artifact_beats_golden_on_flood(self):
+        """The committed retrained artifact (what `fsx serve --artifact`
+        deploys) must actually flag flood features the golden params
+        miss — the operational point of the flag."""
+        import numpy as np
+
+        from flowsentryx_tpu.models import logreg
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        art = load_artifact("logreg_int8", "artifacts/logreg_int8.npz")
+        flood = np.array([[443, 80, 1, 1, 80, 50, 10, 200]], np.float32)
+        benign = np.array([[80, 900, 300, 90000, 950, 2e5, 1e5, 2e6]],
+                          np.float32)
+        s_f = float(logreg.classify_batch_int8_matmul(art, flood)[0])
+        s_b = float(logreg.classify_batch_int8_matmul(art, benign)[0])
+        assert s_f > 0.5 > s_b
