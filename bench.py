@@ -117,7 +117,6 @@ from pathlib import Path
 from flowsentryx_tpu.core import linkhealth  # light: no accelerator import
 
 HEALTHY_H2D_MBPS = linkhealth.HEALTHY_H2D_MBPS
-HEALTHY_DISPATCH_MS = 1.0  # legacy fallback only (_probe_state)
 LINK_BASELINE_PATH = Path(__file__).parent / "artifacts" / "link_baseline.json"
 PROBE_SCRIPT = Path(__file__).parent / "scripts" / "link_probe.py"
 
@@ -176,11 +175,11 @@ def _probe_state(p: dict) -> str:
     # health on this tunnel — see scripts/link_probe.py).
     if p.get("state"):
         return p["state"]
-    if p.get("error") or "h2d_mbps" not in p:
-        return "wedged"
-    healthy = (p["h2d_mbps"] >= HEALTHY_H2D_MBPS
-               and p.get("dispatch_ms", 1e9) <= HEALTHY_DISPATCH_MS)
-    return "healthy" if healthy else "degraded"
+    # No self-label: never infer health from trivial-dispatch numbers
+    # (they provably diverge ~100x from real fused-step health on this
+    # tunnel) — a probe that failed to classify itself is not evidence
+    # of a healthy window.
+    return "wedged" if p.get("error") else "degraded"
 
 
 class Sidecar:
@@ -589,6 +588,10 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
             eng.table, eng.stats, wout = eng.step(
                 eng.table, eng.stats, eng.params, warm)
             jax.block_until_ready(wout.verdict)
+            # Zero the counters the warmup batch just bumped, so the
+            # summed drop-attribution block reconciles exactly against
+            # the paced runs' record counts.
+            eng.stats = jax.device_put(schema.make_stats())
         eng.reset_stream(src, readback_depth=depth)
         lats: list = []
         eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
@@ -654,9 +657,14 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     # Cumulative verdict stats across the paced engine runs (the
     # drop-attribution block prior rounds' evidence files carry).
     if engines:
-        eng = next(iter(engines.values()))
-        result["stats"] = schema.GlobalStats(
-            *(np.asarray(s) for s in eng.stats)).to_dict()
+        # Sum across ALL batch-size engines — with a two-batch grid a
+        # single engine's counters silently omit the other's verdicts.
+        totals: dict = {}
+        for eng in engines.values():
+            for k, v in schema.GlobalStats(
+                    *(np.asarray(s) for s in eng.stats)).to_dict().items():
+                totals[k] = totals.get(k, 0) + v
+        result["stats"] = totals
 
     side.emit("result", **result)
     return result

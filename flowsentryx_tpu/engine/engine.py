@@ -293,6 +293,11 @@ class Engine:
         self.metrics = PipelineMetrics()
         self._blocked = set()
         self._route_drop = 0
+        # A reap hook is per-stream plumbing: every current caller binds
+        # it as a closure over the previous stream's source, so keeping
+        # it across a rebind would yield silently wrong latencies (or a
+        # mid-run pop_scheduled ValueError).  Callers re-attach.
+        self.on_reap = None
 
     # -- checkpoint/resume (SURVEY.md §5.4: the map-pinning analog) ---------
 

@@ -498,9 +498,13 @@ class TestPacedLatency:
         assert rep2.records == 64 * 4
         assert len(lats) == 64 * 4
         # table state persisted across the rebind (flow memory), while
-        # batch counters restarted
-        assert rep2.batches == 4
-        assert rep1.batches == 3
+        # batch counters restarted.  Counts may exceed the record/batch
+        # quotient by a deadline split (at 2e5 pps a 64-record batch
+        # takes 320 us to fill, so a slow-host scheduling hiccup can
+        # flush a partial batch) — but a NON-restarted counter would
+        # carry rep1's batches too, which the upper bounds exclude.
+        assert 4 <= rep2.batches <= 6
+        assert 3 <= rep1.batches <= 5
         # the clock epoch persists with the flow memory: re-anchoring
         # would time-shift every persisted expiry (engine.reset_stream)
         assert eng.batcher.t0_ns == t0_anchor
