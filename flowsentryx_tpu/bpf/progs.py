@@ -59,7 +59,8 @@ CFG_WINDOW_NS = 24
 CFG_BLOCK_NS = 32
 CFG_BUCKET_RATE_PPS = 40
 CFG_BUCKET_BURST = 48
-CFG_SIZE = 56
+CFG_HASH_SALT = 56      # user-plane salt; BPF maps hash internally
+CFG_SIZE = 64
 
 # struct fsx_ip_state
 IPS_WIN_START_NS = 0

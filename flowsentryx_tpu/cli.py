@@ -165,6 +165,37 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             total=args.packets or None,
         )
         sink = NullSink()
+    # Boot-time hash salt (TableConfig.salt docstring): a restore must
+    # hash with the salt the checkpoint's slot layout was built under;
+    # otherwise an unspecified salt (0 = auto) draws a fresh random one
+    # so slot/owner collisions can't be precomputed by an attacker.
+    import dataclasses as _dc
+
+    if args.restore:
+        from flowsentryx_tpu.engine.checkpoint import peek_salt
+
+        ck_salt = peek_salt(args.restore)
+        if cfg.table.salt and cfg.table.salt != ck_salt:
+            print(
+                f"fsx serve: config salt {cfg.table.salt:#x} overridden "
+                f"by checkpoint salt {ck_salt:#x} (the table's slot "
+                "layout is bound to the salt it was built under)",
+                file=sys.stderr,
+            )
+        if ck_salt == 0:
+            print(
+                "fsx serve: WARNING restoring a pre-salt checkpoint - "
+                "running with the UNSALTED public hash (slot/owner "
+                "collisions are precomputable). Retire the checkpoint "
+                "to re-enable the boot-time salt defense.",
+                file=sys.stderr,
+            )
+        cfg = _dc.replace(cfg, table=_dc.replace(cfg.table, salt=ck_salt))
+    elif cfg.table.salt == 0:
+        import secrets
+
+        cfg = _dc.replace(cfg, table=_dc.replace(
+            cfg.table, salt=secrets.randbits(32) | 1))
     mesh = None
     if args.mesh and args.mesh > 1:
         from flowsentryx_tpu.parallel import make_mesh

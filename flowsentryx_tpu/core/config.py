@@ -89,12 +89,25 @@ class TableConfig:
     capacity: int = 1 << 20
     probes: int = 8
     stale_s: float = 30.0
+    #: Hash salt mixed into slot probing AND owner routing
+    #: (ops/hashtable.hash_u32).  0 = deterministic/unsalted (tests,
+    #: reproducible runs); ``fsx serve`` draws a random boot-time salt
+    #: so an attacker cannot precompute table-slot collisions or aim
+    #: every flow at one owner device (the exposure the unsalted hash
+    #: created — the reference's kernel LRU maps have no analog, their
+    #: hashing is kernel-internal and already seeded).  Carried in
+    #: checkpoints so a restored table's slot layout stays valid, and in
+    #: the packed kernel-config blob for config-file deployments that
+    #: fix the salt explicitly (see ``KERNEL_CONFIG_FIELDS``).
+    salt: int = 0
 
     def __post_init__(self) -> None:
         if self.capacity & (self.capacity - 1) or self.capacity <= 0:
             raise ValueError("capacity must be a power of two")
         if self.probes < 1:
             raise ValueError("probes must be >= 1")
+        if not 0 <= self.salt < 1 << 32:
+            raise ValueError("salt must fit in u32")
 
 
 @dataclass(frozen=True)
@@ -188,12 +201,18 @@ class FsxConfig:
         ("block_ns", "u64", "blacklist TTL"),
         ("bucket_rate_pps", "u64", "token refill rate"),
         ("bucket_burst", "u64", "token bucket depth"),
+        ("hash_salt", "u64", "salt for user-plane slot/owner hashing"
+         " (low 32 bits used).  No kernel-side consumer exists: BPF maps"
+         " hash internally with their own seed.  Carried in the blob so"
+         " a deployment that FIXES the salt in its config file presents"
+         " one value to both planes; a serve-drawn random salt is"
+         " user-plane only"),
     )
 
     KERNEL_CONFIG_FMT = "<" + "".join(
         {"u32": "I", "u64": "Q"}[t] for _, t, _ in KERNEL_CONFIG_FIELDS
     )
-    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 56
+    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 64
 
     _KIND_CODE = {
         LimiterKind.FIXED_WINDOW: 0,
@@ -218,6 +237,7 @@ class FsxConfig:
             int(lim.block_s * 1e9),
             int(lim.bucket_rate_pps),
             int(lim.bucket_burst),
+            int(self.table.salt),
         )
 
 

@@ -29,9 +29,13 @@ def save_state(
     table: schema.IpTableState,
     stats: schema.GlobalStats,
     t0_ns: int,
+    hash_salt: int = 0,
 ) -> Path:
     """Snapshot serving state.  Arrays are fetched from device (the one
-    deliberate D2H of the engine's lifetime)."""
+    deliberate D2H of the engine's lifetime).  ``hash_salt`` is the
+    salt the table's slot layout was built under — a restore into an
+    engine hashing with a different salt would mislocate every key, so
+    it travels with the state."""
     path = Path(path)
     # np.savez silently appends .npz to a suffix-less path; normalize so
     # the returned path is the file actually written (same contract as
@@ -43,15 +47,25 @@ def save_state(
         **{f"table_{k}": np.asarray(v) for k, v in table._asdict().items()},
         **{f"stats_{k}": np.asarray(v) for k, v in stats._asdict().items()},
         t0_ns=np.uint64(t0_ns),
+        hash_salt=np.uint64(hash_salt),
         schema_version=CHECKPOINT_SCHEMA_VERSION,
     )
     return path
 
 
+def peek_salt(path: str | Path) -> int:
+    """The hash salt a checkpoint's table was built under, WITHOUT
+    loading the arrays — so a server can adopt it before compiling its
+    step (pre-salt checkpoints read as 0, the unsalted hash)."""
+    with np.load(Path(path)) as z:
+        return int(z["hash_salt"]) if "hash_salt" in z else 0
+
+
 def load_state(
     path: str | Path,
-) -> tuple[schema.IpTableState, schema.GlobalStats, int]:
-    """Restore serving state to device.  Returns (table, stats, t0_ns)."""
+) -> tuple[schema.IpTableState, schema.GlobalStats, int, int]:
+    """Restore serving state to device.
+    Returns (table, stats, t0_ns, hash_salt)."""
     with np.load(Path(path)) as z:
         version = int(z["schema_version"])
         if version != CHECKPOINT_SCHEMA_VERSION:
@@ -64,4 +78,5 @@ def load_state(
         stats = schema.GlobalStats(
             **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}
         )
-        return table, stats, int(z["t0_ns"])
+        salt = int(z["hash_salt"]) if "hash_salt" in z else 0
+        return table, stats, int(z["t0_ns"]), salt

@@ -306,16 +306,29 @@ class Engine:
         every tracked flow and blacklist expiry intact."""
         from flowsentryx_tpu.engine import checkpoint as ckpt
 
-        return str(ckpt.save_state(path, self.table, self.stats, self.batcher.t0_ns))
+        return str(ckpt.save_state(path, self.table, self.stats,
+                                   self.batcher.t0_ns,
+                                   hash_salt=self.cfg.table.salt))
 
     def restore(self, path) -> None:
         from flowsentryx_tpu.engine import checkpoint as ckpt
 
-        table, stats, t0_ns = ckpt.load_state(path)
+        table, stats, t0_ns, salt = ckpt.load_state(path)
         if table.capacity != self.cfg.table.capacity:
             raise ValueError(
                 f"checkpoint capacity {table.capacity} != configured "
                 f"{self.cfg.table.capacity}"
+            )
+        if salt != self.cfg.table.salt:
+            # A different salt relocates every slot: lookups would miss
+            # all persisted flows and silently rebuild the table from
+            # scratch while the stale rows rot.  Refuse; the caller
+            # adopts the checkpoint's salt (checkpoint.peek_salt) before
+            # building the engine, as `fsx serve --restore` does.
+            raise ValueError(
+                f"checkpoint hash salt {salt} != configured "
+                f"{self.cfg.table.salt}; rebuild the engine with "
+                "TableConfig(salt=<checkpoint salt>)"
             )
         if self.mesh is not None:
             from flowsentryx_tpu import parallel as par

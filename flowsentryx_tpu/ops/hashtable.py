@@ -40,9 +40,15 @@ from flowsentryx_tpu.ops.agg import INVALID_KEY
 EMPTY_KEY = np.uint32(0)
 
 
-def hash_u32(k: jnp.ndarray) -> jnp.ndarray:
-    """Murmur3 finalizer — avalanches all 32 bits (uint32 wraparound)."""
-    k = k.astype(jnp.uint32)
+def hash_u32(k: jnp.ndarray, salt: int = 0) -> jnp.ndarray:
+    """Murmur3 finalizer — avalanches all 32 bits (uint32 wraparound).
+
+    ``salt`` (``TableConfig.salt``) is xor-mixed ahead of the finalizer
+    so its avalanche spreads the salt over every output bit: with a
+    random boot-time salt, slot/owner positions are unpredictable to an
+    attacker who knows the hash function (adversarial-collision
+    defense; parallel/step.py module docstring)."""
+    k = k.astype(jnp.uint32) ^ jnp.uint32(salt)
     k ^= k >> 16
     k *= jnp.uint32(0x85EBCA6B)
     k ^= k >> 13
@@ -84,8 +90,9 @@ def assign_slots(
     r = rep_key.shape[0]
     p = cfg.probes
 
-    h1 = hash_u32(rep_key)
-    step = (hash_u32(rep_key ^ jnp.uint32(0x9E3779B9)) | jnp.uint32(1))
+    h1 = hash_u32(rep_key, cfg.salt)
+    step = (hash_u32(rep_key ^ jnp.uint32(0x9E3779B9), cfg.salt)
+            | jnp.uint32(1))
     offs = jnp.arange(p, dtype=jnp.uint32)  # [P]
     slots = (h1[:, None] + offs[None, :] * step[:, None]) & mask  # [R, P]
     slots = slots.astype(jnp.int32)

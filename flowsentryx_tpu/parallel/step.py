@@ -22,9 +22,11 @@ This is the scale-out analog of SURVEY.md §2.3's parallelism table:
   verdicts back) + 1 ``pmax`` (batch clock) + 1 ``psum`` (stat counts).
 
 Routing capacity: each device sends at most ``C ≈ 2·(B/n)/n`` flows to
-each owner — 2× the uniform-hash expectation.  Overflow (possible only
-under adversarial hash skew: ownership is a public unsalted hash, so a
-spoofed-source flood *could* aim every flow at one owner) is handled
+each owner — 2× the uniform-hash expectation.  Ownership hashing mixes
+in the boot-time random salt (``TableConfig.salt``), so an attacker
+cannot precompute a spoofed-source flood that lands every flow on one
+owner.  Overflow remains possible in principle (natural skew at tiny
+batch/mesh ratios, or a disclosed salt) and is handled
 fail-open, the framework-wide discipline (SURVEY.md §5.3): overflowed
 flows PASS this batch, skip their limiter update, and are counted in
 ``StepOutput.route_drop`` — visible, bounded, and backstopped by the
@@ -116,7 +118,7 @@ def make_sharded_step(
         now = jax.lax.pmax(jnp.max(jnp.where(valid_l, ts_l, 0.0)), axis)
 
         # --- route local flow partials to their owner ----------------------
-        h1 = hashtable.hash_u32(fa.rep_key)
+        h1 = hashtable.hash_u32(fa.rep_key, cfg.table.salt)
         owner = ((h1 >> (32 - k_bits)).astype(jnp.int32) if k_bits
                  else jnp.zeros_like(h1, jnp.int32))
         # rank of each flow within its owner bucket: one small sort by

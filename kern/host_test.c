@@ -309,7 +309,7 @@ static void test_isqrt(void)
 static void test_struct_sizes(void)
 {
 	CHECK(sizeof(struct fsx_flow_record) == 48, "flow_record 48B");
-	CHECK(sizeof(struct fsx_config) == 56, "config 56B");
+	CHECK(sizeof(struct fsx_config) == 64, "config 64B");
 }
 
 static void test_minifloat(void)

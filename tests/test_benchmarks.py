@@ -74,6 +74,36 @@ class TestCheckpoint:
         with pytest.raises(ValueError):
             e2.restore(path)
 
+    def test_salt_mismatch_rejected_and_peekable(self, tmp_path):
+        """A checkpoint's slot layout is a function of the hash salt:
+        restoring under a different salt must refuse (it would
+        mislocate every key), and peek_salt lets a server adopt the
+        right one before compiling (the `fsx serve --restore` path)."""
+        import dataclasses
+        import pytest
+
+        from flowsentryx_tpu.engine.checkpoint import peek_salt
+
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12, salt=0x1234),
+                        batch=BatchConfig(max_batch=256))
+        e1 = Engine(cfg, TrafficSource(TrafficSpec(seed=2), total=256),
+                    CollectSink())
+        e1.run()
+        path = e1.checkpoint(tmp_path / "salted.npz")
+        assert peek_salt(path) == 0x1234
+        cfg2 = dataclasses.replace(
+            cfg, table=dataclasses.replace(cfg.table, salt=0x9999))
+        e2 = Engine(cfg2, TrafficSource(TrafficSpec(seed=2), total=256),
+                    CollectSink())
+        with pytest.raises(ValueError, match="salt"):
+            e2.restore(path)
+        # adopting the peeked salt restores cleanly
+        e3 = Engine(cfg, TrafficSource(TrafficSpec(seed=2), total=256),
+                    CollectSink())
+        e3.restore(path)
+        np.testing.assert_array_equal(np.asarray(e3.table.key),
+                                      np.asarray(e1.table.key))
+
 
 def test_meshed_engine_checkpoint_roundtrip(tmp_path):
     """A single-device checkpoint restores into an 8-device meshed

@@ -110,10 +110,10 @@ class TestConfig:
 
     def test_pack_kernel_config(self):
         blob = DEFAULT_CONFIG.pack_kernel_config()
-        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 56
-        kind, valid, pps, bps, win_ns, blk_ns, rate, burst = struct.unpack(
-            FsxConfig.KERNEL_CONFIG_FMT, blob
-        )
+        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 64
+        (kind, valid, pps, bps, win_ns, blk_ns, rate, burst,
+         salt) = struct.unpack(FsxConfig.KERNEL_CONFIG_FMT, blob)
+        assert salt == 0  # DEFAULT_CONFIG is unsalted/deterministic
         assert kind == 0 and pps == 1000 and bps == 125_000_000
         # valid=1 marks "config pushed" vs the kernel ARRAY map's zero
         # fill (which the XDP program treats as fail-open)

@@ -240,3 +240,39 @@ class TestHashTable:
         ks = jnp.arange(1, 1025, dtype=jnp.uint32)
         hs = np.asarray(hashtable.hash_u32(ks)) & 1023
         assert len(set(hs.tolist())) > 600  # good dispersion
+
+    def test_salt_relocates_and_disperses(self, rng):
+        """The boot-time salt must (a) move slot positions — so an
+        unsalted precomputation is useless — while (b) keeping
+        find-after-insert exact under the same salt, and (c) dispersing
+        keys crafted to collide under salt=0."""
+        import dataclasses
+
+        cfg0 = self.CFG4
+        cfg_s = dataclasses.replace(cfg0, salt=0xDEADBEEF)
+        keys = jnp.asarray(rng.integers(1, 2**31, 64).astype(np.uint32))
+        valid = jnp.ones((64,), bool)
+        tk, seen = self._fresh(1 << 10)
+        a0 = hashtable.assign_slots(tk, seen, keys, valid,
+                                    jnp.float32(1.0), cfg0)
+        a_s = hashtable.assign_slots(tk, seen, keys, valid,
+                                     jnp.float32(1.0), cfg_s)
+        # (a) layouts differ almost everywhere
+        same = np.asarray(a0.slot) == np.asarray(a_s.slot)
+        assert same.mean() < 0.1
+        # (b) salted insert→find round-trips (scatter winners only: an
+        # untracked row's slot is garbage and must not clobber a write)
+        slot_w = jnp.where(a_s.tracked, a_s.slot, 1 << 10)
+        tk2 = tk.at[slot_w].set(keys, mode="drop")
+        seen2 = seen.at[slot_w].set(1.0, mode="drop")
+        a2 = hashtable.assign_slots(tk2, seen2, keys, valid,
+                                    jnp.float32(2.0), cfg_s)
+        tr = np.asarray(a_s.tracked)
+        assert np.asarray(a2.found)[tr].all()
+        # (c) keys that all collide to bucket 0 under salt=0 spread out
+        # once salted (the precomputed-collision attack on table slots)
+        cand = np.arange(1, 400_000, dtype=np.uint32)
+        h0 = np.asarray(hashtable.hash_u32(jnp.asarray(cand))) & 1023
+        crafted = jnp.asarray(cand[h0 == 0][:64])
+        hs = np.asarray(hashtable.hash_u32(crafted, cfg_s.salt)) & 1023
+        assert len(set(hs.tolist())) > 48  # near-uniform again
