@@ -78,6 +78,13 @@ struct Options {
     double block_s = 10.0;
     uint64_t bucket_rate_pps = 1000;
     uint64_t bucket_burst = 2000;
+    // byte dimension of the token bucket (README.md:153-162
+    // bandwidth limit).  Defaults mirror the Python plane's
+    // LimiterConfig (125 MB/s, 250 MB burst — the window limiters'
+    // byte threshold) so both twins make the same default decisions;
+    // pass 0 0 to disable (packet-count only).
+    uint64_t bucket_rate_bps = 125000000;
+    uint64_t bucket_burst_bytes = 250000000;
     bool compact = false;              // 16 B kernel-quantized records
 };
 
@@ -100,6 +107,8 @@ struct Options {
                  "  --limiter KIND        fixed|sliding|token (default fixed)\n"
                  "  --pps-threshold N --bps-threshold N --window S --block S\n"
                  "  --bucket-rate N --bucket-burst N\n"
+                 "  --bucket-rate-bytes N --bucket-burst-bytes N\n"
+                 "                        byte dimension (default 125 MB/s, 250 MB burst; 0 0 = off)\n"
                  "  --compact             16 B kernel-quantized records (the image\n"
                  "                        must be emitted with --compact too)\n",
                  argv0);
@@ -169,6 +178,8 @@ int run_bpf(const Options &o) {
     cfg.block_ns = (uint64_t)(o.block_s * 1e9);
     cfg.bucket_rate_pps = o.bucket_rate_pps;
     cfg.bucket_burst = o.bucket_burst;
+    cfg.bucket_rate_bps = o.bucket_rate_bps;
+    cfg.bucket_burst_bytes = o.bucket_burst_bytes;
     uint32_t zero = 0;
     if (fsxbpf::map_update(lp.map_fd("config_map"), &zero, &cfg) < 0) {
         std::perror("fsxd: config_map update");
@@ -350,6 +361,10 @@ Options parse(int argc, char **argv) {
             o.bucket_rate_pps = std::stoull(next());
         else if (a == "--bucket-burst")
             o.bucket_burst = std::stoull(next());
+        else if (a == "--bucket-rate-bytes")
+            o.bucket_rate_bps = std::stoull(next());
+        else if (a == "--bucket-burst-bytes")
+            o.bucket_burst_bytes = std::stoull(next());
         else if (a == "--feature-ring")
             o.feature_ring = next();
         else if (a == "--verdict-ring")
@@ -370,6 +385,12 @@ Options parse(int argc, char **argv) {
             o.seed = std::stoull(next());
         else
             usage(argv[0]);
+    }
+    if ((o.bucket_rate_bps == 0) != (o.bucket_burst_bytes == 0)) {
+        std::fprintf(stderr, "fsxd: --bucket-rate-bytes and "
+                     "--bucket-burst-bytes must be both zero or both "
+                     "positive\n");
+        std::exit(1);
     }
     return o;
 }

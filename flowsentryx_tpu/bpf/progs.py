@@ -59,8 +59,10 @@ CFG_WINDOW_NS = 24
 CFG_BLOCK_NS = 32
 CFG_BUCKET_RATE_PPS = 40
 CFG_BUCKET_BURST = 48
-CFG_HASH_SALT = 56      # user-plane salt; BPF maps hash internally
-CFG_SIZE = 64
+CFG_BUCKET_RATE_BPS = 56
+CFG_BUCKET_BURST_BYTES = 64
+CFG_HASH_SALT = 72      # user-plane salt; BPF maps hash internally
+CFG_SIZE = 80
 
 # struct fsx_ip_state
 IPS_WIN_START_NS = 0
@@ -70,7 +72,8 @@ IPS_PREV_PPS = 24
 IPS_PREV_BPS = 32
 IPS_TOKENS_MILLI = 40
 IPS_TOK_TS_NS = 48
-IPS_SIZE = 56
+IPS_TOK_BYTES = 56
+IPS_SIZE = 64
 
 # struct fsx_flow_stats
 FS_PKT_COUNT = 0
@@ -110,21 +113,21 @@ IPPROTO_ICMP, IPPROTO_TCP, IPPROTO_UDP, IPPROTO_ICMPV6 = 1, 6, 17, 58
 S_KEY = -4          # u32: zero key, then saddr key for hash maps
 S_FKEY = -8         # u32: flow key saddr ^ (dport << 16)
 S_VAL64 = -16       # u64: blacklist-until / variance scratch
-S_IPS_ZERO = -72    # 56B: fsx_ip_state insert template    [-72, -16)
-S_FS_ZERO = -144    # 72B (>=66): fsx_flow_stats template  [-144, -72)
-S_SADDR = -152      # u64 slot: folded source address
-S_DPORT = -160      # u64 slot: dport, network byte order
-S_L4 = -168         # u64 slot: l4 protocol
-S_TCPFLAGS = -176   # u64 slot: tcp flags byte
-S_IS6 = -184        # u64 slot: ipv6 indicator (== FLAG_IPV6 when set)
-S_FEAT = -224       # 8 x u32: derived features            [-224, -192)
-S_CTX = -232        # u64 slot: ctx pointer
-S_N = -240          # u64 slot: flow pkt_count snapshot (n)
-S_CW1 = -244        # u32: compact record word1 (feat 0-3, minifloat)
-S_CW2 = -248        # u32: compact record word2 (feat 4-7, minifloat)
-S_CW3 = -252        # u32: compact record word3 (len8|flags|ts16)
-S_SADDR6 = -272     # 16B: full IPv6 source (exact-blacklist key)
-#                     [-272, -256); only initialized/read on v6 paths
+S_IPS_ZERO = -80    # 64B: fsx_ip_state insert template    [-80, -16)
+S_FS_ZERO = -152    # 72B (>=66): fsx_flow_stats template  [-152, -80)
+S_SADDR = -160      # u64 slot: folded source address
+S_DPORT = -168      # u64 slot: dport, network byte order
+S_L4 = -176         # u64 slot: l4 protocol
+S_TCPFLAGS = -184   # u64 slot: tcp flags byte
+S_IS6 = -192        # u64 slot: ipv6 indicator (== FLAG_IPV6 when set)
+S_FEAT = -232       # 8 x u32: derived features            [-232, -200)
+S_CTX = -240        # u64 slot: ctx pointer
+S_N = -248          # u64 slot: flow pkt_count snapshot (n)
+S_CW1 = -252        # u32: compact record word1 (feat 0-3, minifloat)
+S_CW2 = -256        # u32: compact record word2 (feat 4-7, minifloat)
+S_CW3 = -260        # u32: compact record word3 (len8|flags|ts16)
+S_SADDR6 = -288     # 16B: full IPv6 source (exact-blacklist key)
+#                     [-288, -272); only initialized/read on v6 paths
 
 COMPACT_REC_SIZE = 16  # struct fsx_compact_record
 
@@ -570,7 +573,11 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a.jmp_reg(BPF_JGT, R1, R3, "over")
     a.ja("features")
 
-    # -- token bucket in milli-tokens (fsx_compute.h:122-142) --
+    # -- dual-dimension token bucket (fsx_compute.h twin): packet
+    # milli-tokens AND byte tokens off one refill timestamp; a packet
+    # passes only when BOTH have credit, a refused packet spends from
+    # neither (refilled balances still stored).  burst_bytes == 0
+    # disables the byte dimension (runtime config, so a runtime jump). --
     a.label("lim_token")
     a += ldx(BPF_DW, R1, R2, IPS_TOK_TS_NS)
     a += mov64(R3, R7)
@@ -579,6 +586,7 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a.jmp_reg(BPF_JLE, R3, R4, "tb_clamped")
     a += mov64(R3, R4)
     a.label("tb_clamped")
+    a += mov64(R0, R3)  # save clamped elapsed for the byte refill
     a += ldx(BPF_DW, R4, R6, CFG_BUCKET_RATE_PPS)
     a += alu64(BPF_MUL, R3, R4)
     a += ld_imm64(R4, 1_000_000)
@@ -590,14 +598,36 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a.jmp_reg(BPF_JLE, R3, R4, "tb_capped")
     a += mov64(R3, R4)
     a.label("tb_capped")
+    # byte bucket: R0 = elapsed -> refill_bytes; R5 = byte balance;
+    # R4 = burst_bytes (kept live through the spend decision).  The
+    # refill arithmetic (MUL + two DIVs) is skipped entirely when the
+    # dimension is off — the packet-only config pays ~2 extra insns.
+    a += ldx(BPF_DW, R4, R6, CFG_BUCKET_BURST_BYTES)
+    a += ldx(BPF_DW, R5, R2, IPS_TOK_BYTES)
+    a.jmp_imm(BPF_JEQ, R4, 0, "tb_bdone")  # byte dimension off
+    a += alu64_imm(BPF_DIV, R0, 1000)  # elapsed_us (<= 1e9)
+    a += ldx(BPF_DW, R1, R6, CFG_BUCKET_RATE_BPS)
+    a += alu64(BPF_MUL, R0, R1)
+    a += ld_imm64(R1, 1_000_000)
+    a += alu64(BPF_DIV, R0, R1)  # refill_bytes
+    a += alu64(BPF_ADD, R5, R0)
+    a.jmp_reg(BPF_JLE, R5, R4, "tb_bdone")
+    a += mov64(R5, R4)
+    a.label("tb_bdone")
     a += stx(BPF_DW, R2, IPS_TOK_TS_NS, R7)
-    a.jmp_imm(BPF_JGE, R3, 1000, "tb_spend")
-    a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)  # broke: keep tokens
-    a.ja("over")
-    a.label("tb_spend")
-    a += alu64_imm(BPF_SUB, R3, 1000)
+    a.jmp_imm(BPF_JLT, R3, 1000, "tb_over")     # pkt dimension broke
+    a.jmp_imm(BPF_JEQ, R4, 0, "tb_spend_pkt")   # byte dimension off
+    a.jmp_reg(BPF_JLT, R5, R9, "tb_over")       # byte credit < pkt_len
+    a += alu64(BPF_SUB, R5, R9)                 # spend bytes
+    a.label("tb_spend_pkt")
+    a += alu64_imm(BPF_SUB, R3, 1000)           # spend a packet token
     a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)
+    a += stx(BPF_DW, R2, IPS_TOK_BYTES, R5)
     a.ja("features")
+    a.label("tb_over")  # refused: store refilled balances, spend none
+    a += stx(BPF_DW, R2, IPS_TOKENS_MILLI, R3)
+    a += stx(BPF_DW, R2, IPS_TOK_BYTES, R5)
+    a.ja("over")
 
     # ---- over threshold: blacklist + drop (fsx_kern.c:260-268).
     # v6 sources insert into the EXACT map (the full source is on the

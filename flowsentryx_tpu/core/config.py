@@ -50,8 +50,16 @@ class LimiterConfig:
     pps_threshold: float = 1000.0       # fsx_kern.c:309
     bps_threshold: float = 125_000_000.0  # fsx_kern.c:310 (125 MB/s ≈ 1 Gbit/s)
     window_s: float = 1.0               # fsx_kern.c:243 (1e9 ns window)
-    bucket_rate_pps: float = 1000.0     # token refill rate
-    bucket_burst: float = 2000.0        # token bucket depth
+    bucket_rate_pps: float = 1000.0     # token refill rate (packets/s)
+    bucket_burst: float = 2000.0        # token bucket depth (packets)
+    #: Byte dimension of the token bucket (the spec rate-limits
+    #: bandwidth as well as packets, README.md:153-162).  Both zero =
+    #: byte dimension disabled (packet-count only); defaults mirror the
+    #: window limiters' byte threshold.  One zero without the other is
+    #: rejected: burst with no refill would permanently block a source
+    #: after its first burst, refill with no depth can never admit.
+    bucket_rate_bps: float = 125_000_000.0   # byte refill rate (bytes/s)
+    bucket_burst_bytes: float = 250_000_000.0  # byte bucket depth
     block_s: float = 10.0               # fsx_kern.c:308 blacklist TTL
 
     def __post_init__(self) -> None:
@@ -60,8 +68,14 @@ class LimiterConfig:
         if self.block_s < 0:
             raise ValueError("block_s must be non-negative")
         if min(self.pps_threshold, self.bps_threshold,
-               self.bucket_rate_pps, self.bucket_burst) < 0:
+               self.bucket_rate_pps, self.bucket_burst,
+               self.bucket_rate_bps, self.bucket_burst_bytes) < 0:
             raise ValueError("thresholds must be non-negative")
+        if (self.bucket_rate_bps == 0) != (self.bucket_burst_bytes == 0):
+            raise ValueError(
+                "bucket_rate_bps and bucket_burst_bytes must be both "
+                "zero (byte dimension off) or both positive"
+            )
 
 
 @dataclass(frozen=True)
@@ -199,8 +213,11 @@ class FsxConfig:
         ("bps_threshold", "u64", "bytes per window"),
         ("window_ns", "u64", ""),
         ("block_ns", "u64", "blacklist TTL"),
-        ("bucket_rate_pps", "u64", "token refill rate"),
-        ("bucket_burst", "u64", "token bucket depth"),
+        ("bucket_rate_pps", "u64", "token refill rate (packets/s)"),
+        ("bucket_burst", "u64", "token bucket depth (packets)"),
+        ("bucket_rate_bps", "u64", "byte-bucket refill rate (bytes/s);"
+         " 0 with 0 depth = byte dimension off"),
+        ("bucket_burst_bytes", "u64", "byte bucket depth (bytes)"),
         ("hash_salt", "u64", "salt for user-plane slot/owner hashing"
          " (low 32 bits used).  No kernel-side consumer exists: BPF maps"
          " hash internally with their own seed.  Carried in the blob so"
@@ -212,7 +229,7 @@ class FsxConfig:
     KERNEL_CONFIG_FMT = "<" + "".join(
         {"u32": "I", "u64": "Q"}[t] for _, t, _ in KERNEL_CONFIG_FIELDS
     )
-    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 64
+    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 80
 
     _KIND_CODE = {
         LimiterKind.FIXED_WINDOW: 0,
@@ -237,6 +254,8 @@ class FsxConfig:
             int(lim.block_s * 1e9),
             int(lim.bucket_rate_pps),
             int(lim.bucket_burst),
+            int(lim.bucket_rate_bps),
+            int(lim.bucket_burst_bytes),
             int(self.table.salt),
         )
 

@@ -136,6 +136,7 @@ IP_STATE_FIELDS: tuple[tuple[str, str], ...] = (
     ("prev_bps", "u64"),
     ("tokens_milli", "u64"),
     ("tok_ts_ns", "u64"),
+    ("tok_bytes", "u64"),
 )
 
 #: ``struct fsx_stats`` — kernel-side global counters, kept in a
@@ -227,8 +228,10 @@ class IpTableState(NamedTuple):
     win_bps: jnp.ndarray        # f32; bytes in current window
     prev_pps: jnp.ndarray       # f32; previous window packets (sliding)
     prev_bps: jnp.ndarray       # f32; previous window bytes (sliding)
-    tokens: jnp.ndarray         # f32; token-bucket level
+    tokens: jnp.ndarray         # f32; token-bucket level (packets)
     tok_ts: jnp.ndarray         # f32 s; last token refill time
+    tok_bytes: jnp.ndarray      # f32; byte-bucket level (README.md:153-162
+                                #      bandwidth dimension; 0-depth = disabled)
     blocked_until: jnp.ndarray  # f32 s; 0 = not blacklisted (fsx_kern.c:193-204)
 
     @property
@@ -250,7 +253,7 @@ def make_table(capacity: int) -> IpTableState:
         key=jnp.zeros((capacity,), jnp.uint32),
         last_seen=z(), win_start=z(), win_pps=z(), win_bps=z(),
         prev_pps=z(), prev_bps=z(), tokens=z(), tok_ts=z(),
-        blocked_until=z(),
+        tok_bytes=z(), blocked_until=z(),
     )
 
 

@@ -72,8 +72,17 @@ def load_state(
             raise ValueError(
                 f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
             )
+        # Fields added after a checkpoint was written load as their
+        # empty-table default (e.g. tok_bytes on pre-byte-bucket
+        # snapshots: zero byte credit, refilled on first sight).  Only
+        # the missing fields materialize zeros — no throwaway table.
+        import jax.numpy as jnp
+
+        cap = int(z["table_key"].shape[0])
         table = schema.IpTableState(
-            **{k: jax.device_put(z[f"table_{k}"]) for k in schema.IpTableState._fields}
+            **{k: (jax.device_put(z[f"table_{k}"]) if f"table_{k}" in z
+                   else jnp.zeros((cap,), jnp.float32))
+               for k in schema.IpTableState._fields}
         )
         stats = schema.GlobalStats(
             **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}

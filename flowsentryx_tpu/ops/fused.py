@@ -99,7 +99,8 @@ def flow_step(
         prev_bps=gather(table.prev_bps),
     )
     bucket = limiters.BucketState(
-        tokens=gather(table.tokens), tok_ts=gather(table.tok_ts)
+        tokens=gather(table.tokens), tok_ts=gather(table.tok_ts),
+        tok_bytes=gather(table.tok_bytes),
     )
     blocked_until = gather(table.blocked_until)
 
@@ -159,6 +160,7 @@ def flow_step(
         prev_bps=scatter(table.prev_bps, dec.window.prev_bps),
         tokens=scatter(table.tokens, dec.bucket.tokens),
         tok_ts=scatter(table.tok_ts, dec.bucket.tok_ts),
+        tok_bytes=scatter(table.tok_bytes, dec.bucket.tok_bytes),
         blocked_until=scatter(table.blocked_until, new_blocked_until),
     )
 

@@ -48,8 +48,9 @@ class WindowState(NamedTuple):
 class BucketState(NamedTuple):
     """Slice of the IP table the token bucket reads/writes."""
 
-    tokens: jnp.ndarray  # [R] f32
-    tok_ts: jnp.ndarray  # [R] f32 s
+    tokens: jnp.ndarray     # [R] f32 packet tokens
+    tok_ts: jnp.ndarray     # [R] f32 s
+    tok_bytes: jnp.ndarray  # [R] f32 byte tokens (bandwidth dimension)
 
 
 class LimiterDecision(NamedTuple):
@@ -124,27 +125,44 @@ def token_bucket(
     cfg: LimiterConfig,
     st: BucketState,
     d_pkts: jnp.ndarray,
+    d_bytes: jnp.ndarray,
     now: jnp.ndarray,
     is_new: jnp.ndarray | None = None,
 ) -> tuple[BucketState, jnp.ndarray]:
-    """Token bucket: ``bucket_rate_pps`` tokens/s, depth ``bucket_burst``.
+    """Dual-dimension token bucket (the spec limits bandwidth AND packet
+    rate, ``README.md:153-162``): a packet bucket refilling at
+    ``bucket_rate_pps`` with depth ``bucket_burst``, and a byte bucket
+    refilling at ``bucket_rate_bps`` with depth ``bucket_burst_bytes``
+    (zero depth = byte dimension off, resolved at trace time).  Both
+    share one refill timestamp; a flow is over-limit when EITHER bucket
+    lacks credit for the batch's aggregate demand.
 
-    ``is_new`` marks freshly-claimed slots, which start with a FULL
-    bucket — the conventional semantics, and the kernel twin's implicit
+    ``is_new`` marks freshly-claimed slots, which start with FULL
+    buckets — the conventional semantics, and the kernel twin's implicit
     behavior (fsx_compute.h: a zeroed map entry sees a boot-relative
     ``now``, so its clamped refill fills the bucket).  The explicit flag
     matters here because the engine anchors its clock at the first
     record (now ≈ 0 at stream start), where "elapsed since tok_ts=0"
     refills almost nothing.  Over-limit flows drain to 0 and stay
-    flagged until refill catches up (packet-count based; the byte
-    dimension is governed by the window limiters)."""
-    refill = (now - st.tok_ts) * cfg.bucket_rate_pps
-    tokens = jnp.minimum(cfg.bucket_burst, st.tokens + refill)
+    flagged until refill catches up."""
+    elapsed = now - st.tok_ts
+    tokens = jnp.minimum(cfg.bucket_burst,
+                         st.tokens + elapsed * cfg.bucket_rate_pps)
     if is_new is not None:
         tokens = jnp.where(is_new, jnp.float32(cfg.bucket_burst), tokens)
     over = tokens < d_pkts
     tokens = jnp.maximum(tokens - d_pkts, 0.0)
-    return BucketState(tokens, now), over
+    if cfg.bucket_burst_bytes > 0:
+        btokens = jnp.minimum(cfg.bucket_burst_bytes,
+                              st.tok_bytes + elapsed * cfg.bucket_rate_bps)
+        if is_new is not None:
+            btokens = jnp.where(
+                is_new, jnp.float32(cfg.bucket_burst_bytes), btokens)
+        over = over | (btokens < d_bytes)
+        btokens = jnp.maximum(btokens - d_bytes, 0.0)
+    else:
+        btokens = st.tok_bytes
+    return BucketState(tokens, now, btokens), over
 
 
 def apply_limiter(
@@ -167,7 +185,7 @@ def apply_limiter(
     elif cfg.kind is LimiterKind.SLIDING_WINDOW:
         window, over = sliding_window(cfg, window, d_pkts, d_bytes, now)
     elif cfg.kind is LimiterKind.TOKEN_BUCKET:
-        bucket, over = token_bucket(cfg, bucket, d_pkts, now, is_new)
+        bucket, over = token_bucket(cfg, bucket, d_pkts, d_bytes, now, is_new)
     else:  # pragma: no cover
         raise ValueError(f"unknown limiter kind {cfg.kind}")
     return LimiterDecision(window, bucket, over)

@@ -153,33 +153,58 @@ FSX_CINLINE int fsx_limiter_sliding_window(
 	}
 }
 
-/* Token bucket in milli-tokens (no floats; README.md:153-162 spec).
- * Refill is ns-granular — (elapsed_ns * rate) / 1e6 milli-tokens — so
- * sub-millisecond inter-arrivals still accumulate credit (truncating
- * to whole ms before multiplying would starve any flow arriving faster
- * than 1 kpps).  elapsed is clamped to 1000 s before the multiply to
- * keep it overflow-free for rates up to ~1.8e7 pps; a bucket idle
- * longer than that is full anyway. */
+/* Dual-dimension token bucket (README.md:153-162: the spec limits
+ * bandwidth AND packet rate).  Packet tokens in milli-tokens; byte
+ * tokens in whole bytes (already fine-grained).  Both dimensions share
+ * one refill timestamp; a packet passes only when BOTH have credit, and
+ * a refused packet spends from neither (the refilled balances are still
+ * stored).  bucket_burst_bytes == 0 disables the byte dimension.
+ *
+ * Packet refill is ns-granular — (elapsed_ns * rate) / 1e6
+ * milli-tokens — so sub-millisecond inter-arrivals still accumulate
+ * credit (truncating to whole ms before multiplying would starve any
+ * flow arriving faster than 1 kpps).  elapsed is clamped to 1000 s
+ * before the multiply to keep it overflow-free for rates up to
+ * ~1.8e7 pps; a bucket idle longer than that is full anyway.  The byte
+ * refill multiplies by elapsed_us instead (rates up to ~1.8e10 B/s
+ * overflow-free at the same clamp; the <=1 us truncation under-refills
+ * by < rate/1e6 bytes, the documented equivalence bound the property
+ * suite adjudicates against). */
 FSX_CINLINE int fsx_limiter_token_bucket(
-	const struct fsx_config *cfg, struct fsx_ip_state *st, __u64 now)
+	const struct fsx_config *cfg, struct fsx_ip_state *st,
+	__u64 now, __u64 bytes)
 {
 	__u64 elapsed_ns = now - st->tok_ts_ns;
 	__u64 refill_milli;
+	int over = 0;
 	if (elapsed_ns > 1000000000000ULL)
 		elapsed_ns = 1000000000000ULL;
 	refill_milli = (elapsed_ns * cfg->bucket_rate_pps) / 1000000;
 	__u64 burst_milli = cfg->bucket_burst * 1000;
 	__u64 tokens = st->tokens_milli + refill_milli;
+	__u64 btokens = st->tok_bytes;
 
 	if (tokens > burst_milli)
 		tokens = burst_milli;
-	st->tok_ts_ns = now;
-	if (tokens < 1000) {
-		st->tokens_milli = tokens;
-		return 1;
+	if (cfg->bucket_burst_bytes) {
+		btokens += ((elapsed_ns / 1000) * cfg->bucket_rate_bps)
+			   / 1000000;
+		if (btokens > cfg->bucket_burst_bytes)
+			btokens = cfg->bucket_burst_bytes;
+		if (btokens < bytes)
+			over = 1;
 	}
-	st->tokens_milli = tokens - 1000;
-	return 0;
+	st->tok_ts_ns = now;
+	if (tokens < 1000)
+		over = 1;
+	if (!over) {
+		tokens -= 1000;
+		if (cfg->bucket_burst_bytes)
+			btokens -= bytes;
+	}
+	st->tokens_milli = tokens;
+	st->tok_bytes = btokens;
+	return over;
 }
 
 #endif /* FSX_COMPUTE_H */

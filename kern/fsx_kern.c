@@ -319,7 +319,7 @@ int fsx(struct xdp_md *ctx)
 			over = fsx_limiter_sliding_window(cfg, st, now, bytes);
 			break;
 		case FSX_LIMITER_TOKEN_BUCKET:
-			over = fsx_limiter_token_bucket(cfg, st, now);
+			over = fsx_limiter_token_bucket(cfg, st, now, bytes);
 			break;
 		default:
 			over = fsx_limiter_fixed_window(cfg, st, now, bytes);

@@ -254,14 +254,14 @@ static void test_token_bucket(void)
 	/* fresh flow at t=10s: full burst of 200 */
 	int dropped = 0;
 	for (int i = 0; i < 250; i++) {
-		over = fsx_limiter_token_bucket(&cfg, &st, 10000000000ULL);
+		over = fsx_limiter_token_bucket(&cfg, &st, 10000000000ULL, 0);
 		dropped += over;
 	}
 	CHECK(dropped == 50, "bucket: burst 200 then drops");
 	/* 1 s later: 100 refilled */
 	dropped = 0;
 	for (int i = 0; i < 150; i++) {
-		over = fsx_limiter_token_bucket(&cfg, &st, 11000000000ULL);
+		over = fsx_limiter_token_bucket(&cfg, &st, 11000000000ULL, 0);
 		dropped += over;
 	}
 	CHECK(dropped == 50, "bucket: refill 100/s");
@@ -280,14 +280,45 @@ static void test_token_bucket_subms_refill(void)
 	cfg.bucket_burst = 10;
 	memset(&st, 0, sizeof(st));
 	for (int i = 0; i < 4000; i++) {
-		dropped += fsx_limiter_token_bucket(&cfg, &st, t);
+		dropped += fsx_limiter_token_bucket(&cfg, &st, t, 0);
 		t += 500000;       /* +0.5 ms */
 	}
 	CHECK(dropped == 0, "bucket: sub-ms refill sustains 2kpps under 10k rate");
 	/* and a huge idle gap must not overflow the refill multiply */
-	dropped = fsx_limiter_token_bucket(&cfg, &st, t + (1ULL << 62));
+	dropped = fsx_limiter_token_bucket(&cfg, &st, t + (1ULL << 62), 0);
 	CHECK(dropped == 0 && st.tokens_milli <= 10000,
 	      "bucket: multi-year idle clamps, no overflow");
+}
+
+static void test_token_bucket_bytes(void)
+{
+	/* Byte dimension (README.md:153-162 bandwidth limit): 1500 B
+	 * packets against a 10 kB bucket refilling 1 kB/s; the packet
+	 * dimension is kept out of reach so only bytes govern. */
+	struct fsx_config cfg = mkcfg();
+	struct fsx_ip_state st;
+	int dropped = 0;
+
+	cfg.bucket_rate_pps = 1000000;
+	cfg.bucket_burst = 2000000;
+	cfg.bucket_rate_bps = 1000;
+	cfg.bucket_burst_bytes = 10000;
+	memset(&st, 0, sizeof(st));
+	/* fresh flow at t=100s: clamped refill fills the 10 kB burst ->
+	 * 6 x 1500 B pass, the rest lack byte credit */
+	for (int i = 0; i < 10; i++)
+		dropped += fsx_limiter_token_bucket(&cfg, &st,
+						    100000000000ULL, 1500);
+	CHECK(dropped == 4, "byte bucket: 6x1500B burst then drops");
+	/* 3 s later: 3000 B refilled -> exactly 2 more pass */
+	dropped = 0;
+	for (int i = 0; i < 4; i++)
+		dropped += fsx_limiter_token_bucket(&cfg, &st,
+						    103000000000ULL, 1500);
+	CHECK(dropped == 2, "byte bucket: refill 1 kB/s");
+	/* a refused packet spends from NEITHER dimension */
+	CHECK(st.tokens_milli >= 1000, "refused spends no pkt tokens");
+	CHECK(st.tok_bytes == 1000, "refused spends no byte tokens");
 }
 
 static void test_isqrt(void)
@@ -309,7 +340,7 @@ static void test_isqrt(void)
 static void test_struct_sizes(void)
 {
 	CHECK(sizeof(struct fsx_flow_record) == 48, "flow_record 48B");
-	CHECK(sizeof(struct fsx_config) == 64, "config 64B");
+	CHECK(sizeof(struct fsx_config) == 80, "config 80B");
 }
 
 static void test_minifloat(void)
@@ -349,6 +380,7 @@ int main(void)
 	test_sliding_window();
 	test_token_bucket();
 	test_token_bucket_subms_refill();
+	test_token_bucket_bytes();
 	test_isqrt();
 	test_struct_sizes();
 	test_minifloat();

@@ -4,6 +4,7 @@
  *
  * stdin (text):
  *   <kind> <pps_thr> <bps_thr> <window_ns> <rate_pps> <burst>
+ *          <rate_bps> <burst_bytes>
  *   <n_steps>
  *   <n_pkts> <n_bytes> <t_ns>        (one line per aggregated step)
  * stdout: one JSON line per step with the limiter decision for the
@@ -33,12 +34,14 @@ int main(void)
 
 	memset(&cfg, 0, sizeof(cfg));
 	memset(&st, 0, sizeof(st));
-	if (scanf("%u %llu %llu %llu %llu %llu", &kind,
+	if (scanf("%u %llu %llu %llu %llu %llu %llu %llu", &kind,
 		  (unsigned long long *)&cfg.pps_threshold,
 		  (unsigned long long *)&cfg.bps_threshold,
 		  (unsigned long long *)&cfg.window_ns,
 		  (unsigned long long *)&cfg.bucket_rate_pps,
-		  (unsigned long long *)&cfg.bucket_burst) != 6)
+		  (unsigned long long *)&cfg.bucket_burst,
+		  (unsigned long long *)&cfg.bucket_rate_bps,
+		  (unsigned long long *)&cfg.bucket_burst_bytes) != 8)
 		return 2;
 	if (scanf("%lu", &n_steps) != 1)
 		return 2;
@@ -62,7 +65,7 @@ int main(void)
 				over = fsx_limiter_sliding_window(&cfg, &st, t_ns, b);
 				break;
 			case 2:
-				over = fsx_limiter_token_bucket(&cfg, &st, t_ns);
+				over = fsx_limiter_token_bucket(&cfg, &st, t_ns, b);
 				break;
 			default:
 				return 2;
@@ -70,7 +73,8 @@ int main(void)
 		}
 		printf("{\"over\":%d,\"win_start_ns\":%llu,\"win_pps\":%llu,"
 		       "\"win_bps\":%llu,\"prev_pps\":%llu,\"prev_bps\":%llu,"
-		       "\"tokens_milli\":%llu,\"tok_ts_ns\":%llu}\n",
+		       "\"tokens_milli\":%llu,\"tok_ts_ns\":%llu,"
+		       "\"tok_bytes\":%llu}\n",
 		       over,
 		       (unsigned long long)st.win_start_ns,
 		       (unsigned long long)st.win_pps,
@@ -78,7 +82,8 @@ int main(void)
 		       (unsigned long long)st.prev_pps,
 		       (unsigned long long)st.prev_bps,
 		       (unsigned long long)st.tokens_milli,
-		       (unsigned long long)st.tok_ts_ns);
+		       (unsigned long long)st.tok_ts_ns,
+		       (unsigned long long)st.tok_bytes);
 	}
 	return 0;
 }

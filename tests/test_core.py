@@ -110,10 +110,12 @@ class TestConfig:
 
     def test_pack_kernel_config(self):
         blob = DEFAULT_CONFIG.pack_kernel_config()
-        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 64
+        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 80
         (kind, valid, pps, bps, win_ns, blk_ns, rate, burst,
-         salt) = struct.unpack(FsxConfig.KERNEL_CONFIG_FMT, blob)
+         rate_b, burst_b, salt) = struct.unpack(
+            FsxConfig.KERNEL_CONFIG_FMT, blob)
         assert salt == 0  # DEFAULT_CONFIG is unsalted/deterministic
+        assert rate_b == 125_000_000 and burst_b == 250_000_000
         assert kind == 0 and pps == 1000 and bps == 125_000_000
         # valid=1 marks "config pushed" vs the kernel ARRAY map's zero
         # fill (which the XDP program treats as fail-open)

@@ -13,9 +13,9 @@ def _win(n, start=0.0, pps=0.0, bps=0.0, prev_pps=0.0, prev_bps=0.0):
     return limiters.WindowState(f(start), f(pps), f(bps), f(prev_pps), f(prev_bps))
 
 
-def _bucket(n, tokens=0.0, ts=0.0):
+def _bucket(n, tokens=0.0, ts=0.0, tok_bytes=0.0):
     f = lambda v: jnp.full((n,), v, jnp.float32)
-    return limiters.BucketState(f(tokens), f(ts))
+    return limiters.BucketState(f(tokens), f(ts), f(tok_bytes))
 
 
 CFG = LimiterConfig(pps_threshold=100.0, bps_threshold=1e6, window_s=1.0,
@@ -88,22 +88,70 @@ class TestSlidingWindow:
 class TestTokenBucket:
     def test_fresh_flow_gets_full_burst(self):
         st = _bucket(1)
-        st, over = limiters.token_bucket(CFG, st, jnp.array([150.0]), jnp.array([10.0]))
+        st, over = limiters.token_bucket(CFG, st, jnp.array([150.0]),
+                                         jnp.array([0.0]), jnp.array([10.0]))
         assert not bool(over[0])  # burst 200 covers 150
         assert float(st.tokens[0]) == pytest.approx(50.0)
 
     def test_drain_then_refill(self):
         st = _bucket(1, tokens=10.0, ts=0.0)
-        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]), jnp.array([0.0]))
+        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]),
+                                         jnp.array([0.0]), jnp.array([0.0]))
         assert bool(over[0]) and float(st.tokens[0]) == 0.0
         # 1 s later: refilled 100 tokens
-        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]), jnp.array([1.0]))
+        st, over = limiters.token_bucket(CFG, st, jnp.array([50.0]),
+                                         jnp.array([0.0]), jnp.array([1.0]))
         assert not bool(over[0]) and float(st.tokens[0]) == pytest.approx(50.0)
 
     def test_burst_cap(self):
         st = _bucket(1, tokens=0.0, ts=0.0)
-        st, _ = limiters.token_bucket(CFG, st, jnp.array([0.0]), jnp.array([100.0]))
+        st, _ = limiters.token_bucket(CFG, st, jnp.array([0.0]),
+                                      jnp.array([0.0]), jnp.array([100.0]))
         assert float(st.tokens[0]) == 200.0  # capped at burst
+
+    def test_byte_dimension_limits_bandwidth(self):
+        """The spec's bandwidth limit (README.md:153-162): byte credit
+        governs independently of packet credit."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, bucket_rate_bps=1000.0,
+                                  bucket_burst_bytes=10_000.0)
+        # plenty of packet tokens, byte bucket drained to 1000
+        st = _bucket(1, tokens=200.0, ts=0.0, tok_bytes=1000.0)
+        st, over = limiters.token_bucket(cfg, st, jnp.array([1.0]),
+                                         jnp.array([1500.0]), jnp.array([0.0]))
+        assert bool(over[0])  # 1500 B demand vs 1000 B credit
+        # the refused batch drained the clamped balance to 0 (batch
+        # aggregate semantics; the per-packet kernel twin keeps it —
+        # the documented divergence the property suite reseeds across);
+        # 3 s later: +3000 B -> covered, 1500 left
+        st, over = limiters.token_bucket(cfg, st, jnp.array([1.0]),
+                                         jnp.array([1500.0]), jnp.array([3.0]))
+        assert not bool(over[0])
+        assert float(st.tok_bytes[0]) == pytest.approx(1500.0)
+
+    def test_byte_dimension_disabled_when_zero_depth(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, bucket_rate_bps=0.0,
+                                  bucket_burst_bytes=0.0)
+        st = _bucket(1, tokens=200.0, ts=0.0, tok_bytes=0.0)
+        st, over = limiters.token_bucket(cfg, st, jnp.array([1.0]),
+                                         jnp.array([1e9]), jnp.array([0.0]))
+        assert not bool(over[0])  # bytes ignored entirely
+        assert float(st.tok_bytes[0]) == 0.0
+
+    def test_new_flow_byte_bucket_starts_full(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, bucket_rate_bps=1000.0,
+                                  bucket_burst_bytes=10_000.0)
+        st = _bucket(1, tokens=0.0, ts=0.0, tok_bytes=0.0)
+        st, over = limiters.token_bucket(
+            cfg, st, jnp.array([1.0]), jnp.array([9000.0]),
+            jnp.array([0.0]), is_new=jnp.array([True]))
+        assert not bool(over[0])  # full 10 kB burst on first sight
+        assert float(st.tok_bytes[0]) == pytest.approx(1000.0)
 
 
 class TestApplyLimiter:
