@@ -754,9 +754,8 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += ldx(BPF_DW, R1, R6, FS_BYTE_SUM)
     a += alu64(BPF_DIV, R1, R5)  # mean
     a += mov64(R3, R1)
-    _sat_u32(a, R1, R4, "f_mean_sat")  # feat1 = feat4 = sat(mean)
+    _sat_u32(a, R1, R4, "f_mean_sat")  # feat1 = sat(mean)
     a += stx(BPF_W, R10, S_FEAT + 4, R1)
-    a += stx(BPF_W, R10, S_FEAT + 16, R1)
     a += ldx(BPF_DW, R1, R6, FS_BYTE_SQ_SUM)
     a += alu64(BPF_DIV, R1, R5)
     a += alu64(BPF_MUL, R3, R3)  # mean^2
@@ -766,11 +765,30 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64(BPF_SUB, R4, R3)  # var = byte_sq_sum/n - mean^2
     a.label("f_var_zero")
     a += mov64(R1, R4)
-    _sat_u32(a, R1, R3, "f_var_sat")  # feat3 = sat(var)
-    a += stx(BPF_W, R10, S_FEAT + 12, R1)
-    a += mov64(R1, R4)
     a.call_local("fn_isqrt")  # feat2 = isqrt(var)
     a += stx(BPF_W, R10, S_FEAT + 8, R0)
+    # flow-age features (slots 3/4, schema.FEATURE_NAMES; the C twin's
+    # dur_ms / pps_x1000 at fsx_kern.c derive block):
+    #   feat3 = sat(dur_ns / 1e6)
+    #   feat4 = dur_us ? sat(n * 1e9 / dur_us) : 0
+    a += ldx(BPF_DW, R1, R6, FS_LAST_TS_NS)
+    a += ldx(BPF_DW, R3, R6, FS_FIRST_TS_NS)
+    a += alu64(BPF_SUB, R1, R3)  # dur_ns
+    a += mov64(R4, R1)
+    a += ld_imm64(R3, 1_000_000)
+    a += alu64(BPF_DIV, R1, R3)  # dur_ms
+    _sat_u32(a, R1, R3, "f_dur_sat")
+    a += stx(BPF_W, R10, S_FEAT + 12, R1)
+    a += alu64_imm(BPF_DIV, R4, 1000)  # dur_us
+    a += mov64_imm(R1, 0)
+    a.jmp_imm(BPF_JEQ, R4, 0, "f_pps_done")  # single-stamp flow: unknown
+    a += ldx(BPF_DW, R1, R10, S_N)
+    a += ld_imm64(R3, 1_000_000_000)
+    a += alu64(BPF_MUL, R1, R3)
+    a += alu64(BPF_DIV, R1, R4)  # pps_x1000
+    _sat_u32(a, R1, R3, "f_pps_sat")
+    a.label("f_pps_done")
+    a += stx(BPF_W, R10, S_FEAT + 16, R1)
     # iat_n = max(n - 1, 1)
     a += ldx(BPF_DW, R4, R10, S_N)
     a += alu64_imm(BPF_SUB, R4, 1)

@@ -334,11 +334,20 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
     ``--data`` globs CICIDS2017/CICDDoS2019 CSVs (model.py:53-66 path);
     without it, trains on the synthetic labeled set."""
+    import numpy as np
+
     from flowsentryx_tpu.train import data, evaluate, qat
 
     _honor_jax_platform()
     if args.epochs < 1:
         raise SystemExit("--epochs must be >= 1")
+    # Recipe flags are family-specific: reject silently-ignored combos
+    # (a user reproducing the MODEL_METRICS_r05 recipes must not get a
+    # differently-trained artifact with exit code 0).
+    if getattr(args, "slow_weight", 1.0) != 1.0 and args.model != "logreg_int8":
+        raise SystemExit("--slow-weight applies to --model logreg_int8 only")
+    if getattr(args, "augment_shift", 0) and args.model != "mlp":
+        raise SystemExit("--augment-shift applies to --model mlp only")
 
     if args.model == "multiclass":
         # needs subtype labels — the calibrated fixture provides them
@@ -367,13 +376,15 @@ def _cmd_train(args: argparse.Namespace) -> int:
         print(json.dumps(out, indent=2))
         return 0
 
+    y_class = None
     if args.data == "fixture":
         # the documented CICIDS-calibrated stand-in (train/fixture.py);
         # --synthetic sets its size (default: the real cleaned-set size)
         from flowsentryx_tpu.train import fixture
 
         n = args.synthetic if args.synthetic is not None else fixture.N_CLEANED
-        X, y = fixture.cicids_fixture(n=n, seed=args.seed)
+        X, y, y_class = fixture.cicids_fixture(n=n, seed=args.seed,
+                                               return_classes=True)
     elif args.data:
         X, y = data.load_csvs(args.data)
     else:
@@ -385,7 +396,20 @@ def _cmd_train(args: argparse.Namespace) -> int:
     if args.model == "logreg_int8":
         from flowsentryx_tpu.models import logreg
 
-        res = qat.train_logreg_qat(Xtr, ytr, epochs=args.epochs)
+        sw = None
+        if getattr(args, "slow_weight", 1.0) != 1.0:
+            # slow-attack BCE upweight (train/stress.py train_binary
+            # rationale): needs the fixture's subtype labels, split with
+            # the same seed so the permutation aligns with (X, y)
+            if y_class is None:
+                raise SystemExit("--slow-weight needs --data fixture "
+                                 "(CSV datasets carry no subtype labels)")
+            from flowsentryx_tpu.train.fixture import CLASS_SLOW
+
+            ctr, _cte, _, _ = data.train_test_split(y_class, y)
+            sw = 1.0 + (ctr == CLASS_SLOW) * (args.slow_weight - 1.0)
+        res = qat.train_logreg_qat(Xtr, ytr, epochs=args.epochs,
+                                   sample_weight=sw)
         out["final_loss"] = float(res.losses[-1])
         out["test"] = evaluate.evaluate_model(
             logreg.classify_batch_int8_matmul, res.params, Xte, yte
@@ -395,6 +419,16 @@ def _cmd_train(args: argparse.Namespace) -> int:
     elif args.model == "mlp":
         from flowsentryx_tpu.models import mlp
 
+        if getattr(args, "augment_shift", 0):
+            # sweep-matched domain randomization (train/stress.py
+            # shift_augment): the robust-detector training recipe
+            from flowsentryx_tpu.train.stress import shift_augment
+
+            rng = np.random.default_rng(args.seed)
+            Xtr = np.concatenate(
+                [Xtr] + [shift_augment(Xtr, rng)
+                         for _ in range(args.augment_shift)])
+            ytr = np.concatenate([ytr] * (args.augment_shift + 1))
         params, losses = qat.train_mlp(
             Xtr, ytr, epochs=args.epochs, seed=args.seed
         )
@@ -542,6 +576,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "200000 for multiclass)")
     t.add_argument("--epochs", type=int, default=200)
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--slow-weight", type=float, default=1.0,
+                   dest="slow_weight",
+                   help="BCE upweight for slow-attack rows (fixture "
+                        "data only; x4 is the deployed default's "
+                        "training recipe — see MODEL_METRICS_r05)")
+    t.add_argument("--augment-shift", type=int, default=0,
+                   dest="augment_shift",
+                   help="add N domain-randomized training copies "
+                        "(stress.shift_augment; 2 is the robust-MLP "
+                        "recipe — see MODEL_METRICS_r05)")
     t.add_argument("--out", help="artifact output path (.npz)")
     t.set_defaults(fn=_cmd_train)
 

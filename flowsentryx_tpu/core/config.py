@@ -86,6 +86,33 @@ class ModelConfig:
     threshold: float = 0.5              # sigmoid cutoff (model.py:205-208)
     quantized: bool = True
     ml_block_s: float = 10.0            # blacklist TTL for ML-flagged sources
+    #: Young-flow vote (SERVE_r04 finding: a flow's first records carry
+    #: no variance/IAT mass and can score malicious, so without a vote
+    #: EVERY benign source eventually gets ML-blacklisted).  A flow's
+    #: malicious-scored records count as votes only once the engine has
+    #: seen ``vote_k`` records from it (the kernel emits every packet
+    #: while a flow is young, fsx_kern.c:163-165, so maturity arrives
+    #: within the first k packets); an ML block needs ``vote_m`` votes.
+    #: Flows the table cannot track (arbitration loss / full table —
+    #: an attacker must not escape detection by filling the table) use
+    #: a batch-local form: > vote_k records in the batch with >= vote_m
+    #: scored malicious (tracked flows get this burst rule too, so a
+    #: dense single-batch flood can't hide behind its youth).  Votes
+    #: decay with a ``vote_decay_s`` half-life and reset when a block
+    #: fires — an isolated borderline mis-score hours ago must not
+    #: leave a benign flow permanently one record from a block.
+    #: ``vote_k=0, vote_m=1`` restores the immediate pre-vote behavior.
+    vote_k: int = 4
+    vote_m: int = 2
+    vote_decay_s: float = 60.0  # vote half-life; 0 = no decay
+
+    def __post_init__(self) -> None:
+        if self.vote_k < 0:
+            raise ValueError("vote_k must be >= 0")
+        if self.vote_m < 1:
+            raise ValueError("vote_m must be >= 1")
+        if self.vote_decay_s < 0:
+            raise ValueError("vote_decay_s must be >= 0")
 
 
 @dataclass(frozen=True)

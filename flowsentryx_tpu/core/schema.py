@@ -40,12 +40,21 @@ import numpy as np
 #: ``feature_list`` (``model/model.py:117``): CICIDS2017 flow-level
 #: statistics.  The kernel computes streaming estimates of these (see
 #: FlowStats below); the offline trainer computes them exactly from CSVs.
+#: The 8-wide feature vector.  Slots 0-2 and 5-7 mirror the reference's
+#: CICIDS selection (``model.py:117``); slots 3 and 4 originally held
+#: packet_length_variance and average_packet_size — both redundant with
+#: their neighbours (variance = std², avg ≈ mean) — and are redefined
+#: as flow-age features the slow-attack class needs (VERDICT r4 #6;
+#: ``model.py:117``'s list is a reference limitation, not a spec):
+#: flow duration in ms and packet rate in pps×1000, both free from
+#: ``fsx_flow_stats``' first/last timestamps and count.  The wire
+#: layout (8×u32 raw, 8×minifloat compact) is unchanged.
 FEATURE_NAMES: tuple[str, ...] = (
     "destination_port",
     "packet_length_mean",
     "packet_length_std",
-    "packet_length_variance",
-    "average_packet_size",
+    "flow_duration_ms",
+    "flow_pps_x1000",
     "fwd_iat_mean",
     "fwd_iat_std",
     "fwd_iat_max",
@@ -60,8 +69,8 @@ class Feature(enum.IntEnum):
     DST_PORT = 0
     PKT_LEN_MEAN = 1
     PKT_LEN_STD = 2
-    PKT_LEN_VAR = 3
-    AVG_PKT_SIZE = 4
+    FLOW_DUR_MS = 3
+    FLOW_PPS_X1000 = 4
     FWD_IAT_MEAN = 5
     FWD_IAT_STD = 6
     FWD_IAT_MAX = 7
@@ -232,6 +241,10 @@ class IpTableState(NamedTuple):
     tok_ts: jnp.ndarray         # f32 s; last token refill time
     tok_bytes: jnp.ndarray      # f32; byte-bucket level (README.md:153-162
                                 #      bandwidth dimension; 0-depth = disabled)
+    rec_seen: jnp.ndarray       # f32; feature records seen (flow age for the
+                                #      young-flow ML vote; ModelConfig.vote_k)
+    ml_votes: jnp.ndarray       # f32; malicious-scored mature records
+                                #      (ML blocks need ModelConfig.vote_m)
     blocked_until: jnp.ndarray  # f32 s; 0 = not blacklisted (fsx_kern.c:193-204)
 
     @property
@@ -253,7 +266,7 @@ def make_table(capacity: int) -> IpTableState:
         key=jnp.zeros((capacity,), jnp.uint32),
         last_seen=z(), win_start=z(), win_pps=z(), win_bps=z(),
         prev_pps=z(), prev_bps=z(), tokens=z(), tok_ts=z(),
-        tok_bytes=z(), blocked_until=z(),
+        tok_bytes=z(), rec_seen=z(), ml_votes=z(), blocked_until=z(),
     )
 
 

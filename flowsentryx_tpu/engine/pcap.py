@@ -168,9 +168,15 @@ class FlowTracker:
         # idle gaps); mirror the wrap or long-idle flows diverge
         iat_mean_sq = (iat_mean_us * iat_mean_us) & ((1 << 64) - 1)
         iat_var = max(fs["iat_sq_sum_us2"] // iat_n - iat_mean_sq, 0)
+        # flow-age slots 3/4 (schema.FEATURE_NAMES): duration in ms and
+        # rate in pps*1000, same integer identities as the kernel
+        dur_ns = fs["last_ts_ns"] - fs["first_ts_ns"]
+        dur_us = dur_ns // 1000
+        pps_x1000 = (n * 1_000_000_000) // dur_us if dur_us else 0
         return [
-            fs["dst_port"], sat(mean), math.isqrt(var), sat(var),
-            sat(mean), sat(iat_mean_us), math.isqrt(iat_var),
+            fs["dst_port"], sat(mean), math.isqrt(var),
+            sat(dur_ns // 1_000_000), sat(pps_x1000), sat(iat_mean_us),
+            math.isqrt(iat_var),
             sat(min(fs["iat_max_ns"] // 1000, 0xFFFFFFFF)),
         ]
 

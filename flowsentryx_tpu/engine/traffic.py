@@ -106,22 +106,28 @@ class TrafficGen:
     # -- feature synthesis (kernel-estimator statistics) --------------------
 
     def _attack_feat(self, n: int) -> np.ndarray:
-        """Flood statistics: fixed small packets, machine-gun IATs."""
+        """Flood statistics: fixed small packets, machine-gun IATs,
+        short intense flows (kernel-estimator semantics: duration from
+        first/last stamps, rate = pkts/duration)."""
         f = np.zeros((n, schema.NUM_FEATURES), np.uint32)
         f[:, schema.Feature.DST_PORT] = self.rng.choice([80, 443, 53], n)
         size = self.rng.integers(60, 80, n)
         f[:, schema.Feature.PKT_LEN_MEAN] = size
         f[:, schema.Feature.PKT_LEN_STD] = self.rng.integers(0, 3, n)
-        f[:, schema.Feature.PKT_LEN_VAR] = f[:, schema.Feature.PKT_LEN_STD] ** 2
-        f[:, schema.Feature.AVG_PKT_SIZE] = size
         iat = self.rng.integers(1, 50, n)  # µs: flood-rate arrivals
+        npkts = self.rng.integers(100, 5000, n).astype(np.uint64)
+        dur_us = np.maximum(iat.astype(np.uint64) * npkts, 1)
+        f[:, schema.Feature.FLOW_DUR_MS] = dur_us // 1000
+        f[:, schema.Feature.FLOW_PPS_X1000] = np.minimum(
+            npkts * np.uint64(1_000_000_000) // dur_us, 0xFFFFFFFF)
         f[:, schema.Feature.FWD_IAT_MEAN] = iat
         f[:, schema.Feature.FWD_IAT_STD] = self.rng.integers(0, 20, n)
         f[:, schema.Feature.FWD_IAT_MAX] = iat * self.rng.integers(1, 4, n)
         return f
 
     def _benign_feat(self, n: int) -> np.ndarray:
-        """Interactive statistics: varied sizes, human-scale IATs."""
+        """Interactive statistics: varied sizes, human-scale IATs,
+        short-to-medium flows at interactive rates."""
         f = np.zeros((n, schema.NUM_FEATURES), np.uint32)
         f[:, schema.Feature.DST_PORT] = self.rng.choice(
             [443, 443, 443, 80, 22, 8443], n
@@ -130,9 +136,12 @@ class TrafficGen:
         std = self.rng.integers(100, 600, n)
         f[:, schema.Feature.PKT_LEN_MEAN] = size
         f[:, schema.Feature.PKT_LEN_STD] = std
-        f[:, schema.Feature.PKT_LEN_VAR] = std.astype(np.uint64) ** 2
-        f[:, schema.Feature.AVG_PKT_SIZE] = size
         iat = self.rng.integers(5_000, 500_000, n)  # µs: ms-scale arrivals
+        npkts = self.rng.integers(2, 200, n).astype(np.uint64)
+        dur_us = np.maximum(iat.astype(np.uint64) * npkts, 1)
+        f[:, schema.Feature.FLOW_DUR_MS] = dur_us // 1000
+        f[:, schema.Feature.FLOW_PPS_X1000] = np.minimum(
+            npkts * np.uint64(1_000_000_000) // dur_us, 0xFFFFFFFF)
         f[:, schema.Feature.FWD_IAT_MEAN] = iat
         f[:, schema.Feature.FWD_IAT_STD] = iat // self.rng.integers(1, 4, n)
         f[:, schema.Feature.FWD_IAT_MAX] = iat * self.rng.integers(2, 8, n)

@@ -56,12 +56,21 @@ def load_artifact(name: str, path: str):
     MODEL_METRICS.json analysis) for a retrained one."""
     from flowsentryx_tpu.models import logreg, mlp, multiclass
 
-    if name.startswith("logreg"):
-        return logreg.load_params(path)
-    if name == "mlp":
-        return mlp.load_params(path)
-    if name == "multiclass":
-        return multiclass.load_params(path)
+    try:
+        if name.startswith("logreg"):
+            return logreg.load_params(path)
+        if name == "mlp":
+            return mlp.load_params(path)
+        if name == "multiclass":
+            return multiclass.load_params(path)
+    except (TypeError, KeyError) as e:
+        # a structurally wrong npz (artifact from a different family)
+        # otherwise surfaces as a missing-constructor-args TypeError
+        raise ValueError(
+            f"{path!r} is not a {name!r} artifact (fields don't match "
+            f"the family's schema: {e}); set model.name in the config "
+            "to the family the artifact was trained as"
+        ) from e
     raise KeyError(f"no artifact loader for model family {name!r}")
 
 

@@ -66,7 +66,7 @@ def flow_step(
     table: IpTableState,
     fa: agg.FlowAgg,
     flow_mask: jnp.ndarray,
-    ml_flow: jnp.ndarray,
+    ml_count: jnp.ndarray,
     now: jnp.ndarray,
 ) -> tuple[IpTableState, FlowDecision]:
     """Table + limiter + blacklist core over aggregated flows.
@@ -74,10 +74,13 @@ def flow_step(
     ``flow_mask`` restricts which flows this invocation owns — all-true
     on a single device; the hash-ownership mask under ``shard_map``
     (each device updates only flows whose slots live in its table
-    shard).  ``ml_flow`` is the per-flow classifier verdict, computed by
-    the caller (score sharding differs between the local and distributed
-    paths)."""
+    shard).  ``ml_count`` is the per-flow COUNT of records the
+    classifier scored malicious this batch, computed by the caller
+    (score sharding differs between the local and distributed paths);
+    the young-flow vote (``ModelConfig.vote_k``/``vote_m``) decides
+    whether that evidence blocks."""
     lim = cfg.limiter
+    mdl = cfg.model
 
     asg = hashtable.assign_slots(
         table.key, table.last_seen, fa.rep_key, fa.rep_valid & flow_mask,
@@ -103,6 +106,9 @@ def flow_step(
         tok_bytes=gather(table.tok_bytes),
     )
     blocked_until = gather(table.blocked_until)
+    rec_seen = gather(table.rec_seen)
+    ml_votes = gather(table.ml_votes)
+    last_seen = gather(table.last_seen)
 
     eligible = fa.rep_valid & flow_mask
 
@@ -119,10 +125,37 @@ def flow_step(
     )
     over_rate = asg.tracked & dec.over_limit & ~already_blocked
 
-    # 3. ML verdict needs NO table state — it must apply even to flows
-    #    that lost slot arbitration or found a full table, otherwise an
-    #    attacker could disable detection by filling the table.
-    over_ml = eligible & ml_flow & ~already_blocked & ~over_rate
+    # 3. ML verdict with the young-flow vote (SERVE_r04: first records
+    #    carry no variance/IAT mass and mis-score, so votes only count
+    #    once the flow has shown vote_k records; blocking needs vote_m
+    #    votes AND fresh malicious evidence this batch).  The vote
+    #    state lives in the table, but the verdict must still apply to
+    #    flows that lost slot arbitration or found a full table —
+    #    otherwise an attacker could disable detection by filling the
+    #    table — so untracked flows vote batch-locally: enough records
+    #    in THIS batch to be past the young phase, vote_m of them
+    #    malicious (floods qualify; a benign trickle never does).
+    ml_hit = ml_count > 0
+    mature = rec_seen >= mdl.vote_k
+    # Vote decay (half-life vote_decay_s): an isolated borderline
+    # mis-score long ago must not leave a benign flow permanently one
+    # record from a block.  dt uses the flow's own last activity;
+    # inserted flows carry no votes, so their garbage dt is harmless.
+    if mdl.vote_decay_s > 0:
+        dt = jnp.maximum(fa.rep_ts - last_seen, 0.0)
+        ml_votes = ml_votes * jnp.exp2(-dt / mdl.vote_decay_s)
+    votes_new = jnp.minimum(
+        ml_votes + jnp.where(mature, ml_count, 0.0), jnp.float32(1e6))
+    # The batch-local burst rule applies to EVERY flow, tracked or not:
+    # a single batch carrying > vote_k records with >= vote_m scored
+    # malicious is a dense flood, not a young benign flow (interactive
+    # sources emit a handful of records per batch) — without it, a
+    # tracked source sending <= vote_k records total, or rotating IPs
+    # each batch, would never mature into blockability.
+    burst = (fa.rep_pkts > mdl.vote_k) & (ml_count >= mdl.vote_m)
+    vote_ok = jnp.where(asg.tracked, (votes_new >= mdl.vote_m) | burst,
+                        burst)
+    over_ml = eligible & ml_hit & vote_ok & ~already_blocked & ~over_rate
 
     # 4. blacklist writeback (fsx_kern.c:317-325: now + block time).
     #    The device-table scatter below only persists it for tracked
@@ -161,6 +194,11 @@ def flow_step(
         tokens=scatter(table.tokens, dec.bucket.tokens),
         tok_ts=scatter(table.tok_ts, dec.bucket.tok_ts),
         tok_bytes=scatter(table.tok_bytes, dec.bucket.tok_bytes),
+        rec_seen=scatter(table.rec_seen, rec_seen + fa.rep_pkts),
+        # a fired block consumes the votes: re-blocking after the TTL
+        # expires requires vote_m FRESH malicious records
+        ml_votes=scatter(table.ml_votes,
+                         jnp.where(over_ml, 0.0, votes_new)),
         blocked_until=scatter(table.blocked_until, new_blocked_until),
     )
 
@@ -172,16 +210,17 @@ def flow_step(
     )
 
 
-def ml_flow_verdict(
+def ml_flow_count(
     cfg: FsxConfig, score: jnp.ndarray, valid: jnp.ndarray, inv: jnp.ndarray
 ) -> jnp.ndarray:
-    """Per-flow ML verdict: a flow is malicious if ANY of its packets
-    scores over the decision threshold."""
+    """Per-flow COUNT of records scoring over the decision threshold —
+    the vote evidence :func:`flow_step` weighs against
+    ``ModelConfig.vote_m`` (a bool "any malicious" can't distinguish
+    one borderline young record from a sustained attack)."""
     mal_pkt = (score > cfg.model.threshold) & valid
     return (
-        jnp.zeros_like(inv)
-        .at[inv].max(mal_pkt.astype(jnp.int32))
-        .astype(bool)
+        jnp.zeros_like(score)
+        .at[inv].add(mal_pkt.astype(jnp.float32))
     )
 
 
@@ -250,10 +289,10 @@ def make_step(
         now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
 
         score = classify_batch(params, batch.feat)  # [B] f32, MXU path
-        ml_flow = ml_flow_verdict(cfg, score, batch.valid, fa.inv)
+        ml_count = ml_flow_count(cfg, score, batch.valid, fa.inv)
 
         all_flows = jnp.ones_like(fa.rep_valid)
-        new_table, dec = flow_step(cfg, table, fa, all_flows, ml_flow, now)
+        new_table, dec = flow_step(cfg, table, fa, all_flows, ml_count, now)
 
         verdict = jnp.where(
             batch.valid, dec.flow_verdict[fa.inv], int(Verdict.PASS)

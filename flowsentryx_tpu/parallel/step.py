@@ -113,8 +113,11 @@ def make_sharded_step(
         score_l = classify_batch(params, feat_l)                 # [local_b]
         fa = agg.aggregate(key_l, len_l, ts_l, valid_l)
         mal_l = (score_l > cfg.model.threshold) & valid_l
-        ml_l = (jnp.zeros((local_b,), jnp.int32)
-                .at[fa.inv].max(mal_l.astype(jnp.int32)))        # per local flow
+        # per-local-flow COUNT of malicious records (vote evidence;
+        # owner-side merge SUMS partials so a flow spanning slices
+        # votes with its full record count)
+        ml_l = (jnp.zeros((local_b,), jnp.float32)
+                .at[fa.inv].add(mal_l.astype(jnp.float32)))
         now = jax.lax.pmax(jnp.max(jnp.where(valid_l, ts_l, 0.0)), axis)
 
         # --- route local flow partials to their owner ----------------------
@@ -145,7 +148,7 @@ def make_sharded_step(
                 scatter_send(bits(fa.rep_pkts, jnp.uint32), jnp.uint32(0)),
                 scatter_send(bits(fa.rep_bytes, jnp.uint32), jnp.uint32(0)),
                 scatter_send(bits(fa.rep_ts, jnp.uint32), jnp.uint32(0)),
-                scatter_send(ml_l.astype(jnp.uint32), jnp.uint32(0)),
+                scatter_send(bits(ml_l, jnp.uint32), jnp.uint32(0)),
             ],
             axis=1,
         ).reshape(n_dev, C, 5)
@@ -171,7 +174,7 @@ def make_sharded_step(
         m_pkts = seg_sum(bits(r[:, 1], jnp.float32))
         m_bytes = seg_sum(bits(r[:, 2], jnp.float32))
         m_ts = seg_max(bits(r[:, 3], jnp.float32), -jnp.inf)
-        m_ml = seg_max(r[:, 4].astype(jnp.float32), 0.0) > 0
+        m_ml = seg_sum(bits(r[:, 4], jnp.float32))  # vote-count merge
         m_key = jax.ops.segment_max(sk, seg, num_segments=rn)
         m_valid = m_pkts > 0
         m_key = jnp.where(m_valid, m_key, agg.INVALID_KEY)

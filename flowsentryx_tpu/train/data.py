@@ -26,16 +26,23 @@ from flowsentryx_tpu.core import schema
 
 #: CSV column → feature index.  CICFlowMeter emits these with
 #: inconsistent leading spaces; names are matched after strip().
+#: Slots 3/4 are the flow-age features (schema.FEATURE_NAMES): CIC's
+#: "Flow Duration" is µs (→ ms via CSV_SCALE) and "Flow Packets/s" is
+#: pps (→ ×1000), matching the kernel estimator's units exactly.
 CSV_COLUMNS: tuple[str, ...] = (
     "Destination Port",
     "Packet Length Mean",
     "Packet Length Std",
-    "Packet Length Variance",
-    "Average Packet Size",
+    "Flow Duration",
+    "Flow Packets/s",
     "Fwd IAT Mean",
     "Fwd IAT Std",
     "Fwd IAT Max",
 )
+
+#: Per-column multiplier applied after load, converting CIC units to
+#: the kernel estimator's wire units.
+CSV_SCALE: tuple[float, ...] = (1.0, 1.0, 1.0, 1e-3, 1e3, 1.0, 1.0, 1.0)
 LABEL_COLUMN = "Label"
 BENIGN_LABEL = "BENIGN"
 
@@ -61,6 +68,7 @@ def load_csvs(pattern: str) -> tuple[np.ndarray, np.ndarray]:
 
     y = (df[LABEL_COLUMN].str.strip() != BENIGN_LABEL).to_numpy(np.float32)
     X = df[list(CSV_COLUMNS)].to_numpy(np.float32)
+    X *= np.asarray(CSV_SCALE, np.float32)
 
     # clean (model.py:73-106 semantics): negatives are CICFlowMeter
     # artifacts -> clip to 0; NaN/inf rows dropped; exact duplicate
@@ -115,8 +123,12 @@ def write_fixture_csv(path: str | Path, n: int = 500, seed: int = 3) -> Path:
     cols = [" " + c if i else c for i, c in enumerate(CSV_COLUMNS)]
     header = ",".join(cols) + ", Label"
     rows = [header]
+    inv_scale = 1.0 / np.asarray(CSV_SCALE, np.float64)
     for xi, yi in zip(X, y):
         label = "DDoS" if yi else BENIGN_LABEL
-        rows.append(",".join(f"{v:.1f}" for v in xi) + f", {label}")
+        # emit CIC units (Flow Duration in µs, Flow Packets/s in pps)
+        # so the loader's unit conversion is exercised for real
+        rows.append(",".join(f"{v:.3f}" for v in xi * inv_scale)
+                    + f", {label}")
     path.write_text("\n".join(rows) + "\n")
     return path

@@ -68,8 +68,6 @@ def _benign(rng: np.random.Generator, n: int) -> np.ndarray:
     std_len = mean_len * rel_std * 2.0
     X[:, Feature.PKT_LEN_MEAN] = mean_len
     X[:, Feature.PKT_LEN_STD] = std_len
-    X[:, Feature.PKT_LEN_VAR] = std_len**2
-    X[:, Feature.AVG_PKT_SIZE] = mean_len * rng.uniform(0.95, 1.3, n)
     # IATs (µs): interactive ms-scale to idle-dominated seconds-scale,
     # bounded by the real flow_duration max (1.2e8 µs)
     iat_mean = _lognormal(rng, n, 2.0e4, 2.2, 1.2e8)
@@ -79,6 +77,13 @@ def _benign(rng: np.random.Generator, n: int) -> np.ndarray:
     X[:, Feature.FWD_IAT_MAX] = np.minimum(
         iat_mean * (1.0 + 3.0 * iat_rel), 1.2e8
     )
+    # flow-age slots: duration = iat_mean x (n_pkts - 1) under the real
+    # 1.2e8 us duration cap; rate follows (kernel-estimator identity
+    # pps_x1000 = n * 1e9 / dur_us)
+    npkts = np.maximum(_lognormal(rng, n, 10.0, 1.2, 1e5), 2.0)
+    dur_us = np.clip(iat_mean * (npkts - 1.0), 1.0, 1.2e8)
+    X[:, Feature.FLOW_DUR_MS] = dur_us / 1e3
+    X[:, Feature.FLOW_PPS_X1000] = npkts * 1e9 / dur_us
     return X
 
 
@@ -120,28 +125,38 @@ def _attack(rng: np.random.Generator, n: int) -> tuple[np.ndarray, np.ndarray]:
     std_len[slow] = rng.uniform(0.0, 60.0, ns)
     X[:, Feature.PKT_LEN_MEAN] = mean_len
     X[:, Feature.PKT_LEN_STD] = std_len
-    X[:, Feature.PKT_LEN_VAR] = std_len**2
-    X[:, Feature.AVG_PKT_SIZE] = mean_len * rng.uniform(1.0, 1.1, n)
 
     iat_mean = np.empty(n)
     iat_max = np.empty(n)
+    npkts = np.empty(n)
     if nv:
         iat_mean[vol] = _lognormal(rng, nv, 50.0, 1.5, 1e6)
         iat_max[vol] = iat_mean[vol] * rng.uniform(1.0, 20.0, nv)
+        npkts[vol] = _lognormal(rng, nv, 3000.0, 1.0, 1e7)
     if ny:
-        # handshake-rate floods: slower per flow than raw volumetric
+        # handshake-rate floods: slower per flow than raw volumetric,
+        # and per-flow SHORT (a few SYNs per spoofed source)
         iat_mean[syn] = _lognormal(rng, ny, 800.0, 1.2, 1e6)
         iat_max[syn] = iat_mean[syn] * rng.uniform(1.0, 10.0, ny)
+        npkts[syn] = rng.uniform(3.0, 20.0, ny)
     if ns:
+        # Slowloris-style: long-lived by construction (holding
+        # connections open IS the attack), tens-to-hundreds of sparse
+        # keepalive frames
         iat_mean[slow] = _lognormal(rng, ns, 5.0e6, 1.0, 1.2e8)
         iat_max[slow] = np.minimum(
             iat_mean[slow] * rng.uniform(2.0, 10.0, ns), 1.2e8
         )
+        npkts[slow] = rng.uniform(10.0, 200.0, ns)
     X[:, Feature.FWD_IAT_MEAN] = iat_mean
     X[:, Feature.FWD_IAT_STD] = np.minimum(
         iat_mean * rng.lognormal(-0.5, 0.6, n), 1.2e8
     )
     X[:, Feature.FWD_IAT_MAX] = iat_max
+    dur_us = np.clip(iat_mean * (npkts - 1.0), 1.0, 1.2e8)
+    X[:, Feature.FLOW_DUR_MS] = dur_us / 1e3
+    X[:, Feature.FLOW_PPS_X1000] = np.minimum(npkts * 1e9 / dur_us,
+                                              4.0e9)
     return X, cls
 
 

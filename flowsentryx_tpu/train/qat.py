@@ -143,6 +143,7 @@ def train_logreg_qat(
     log_features: bool = True,
     optimizer: optax.GradientTransformation | None = None,
     log_every: int = 0,
+    sample_weight: np.ndarray | None = None,
 ) -> TrainResult:
     """Full-batch QAT (the reference trains full-batch 1000 epochs with
     Adagrad lr=0.05, ``model.py:169-190``; 200 epochs converges for the
@@ -154,11 +155,17 @@ def train_logreg_qat(
     small-magnitude feature — the reference artifact's exact pathology.
     The first ``warmup_fraction`` of epochs run observer-only, and the
     optimizer restarts when fake-quant engages (warmup-scale Adagrad
-    accumulators would otherwise freeze the quant-finetune phase)."""
+    accumulators would otherwise freeze the quant-finetune phase).
+
+    ``sample_weight`` scales each row's BCE term — the lever for
+    minority-mode recall (a slow-attack upweight trades a little benign
+    precision for the recall a uniform loss averages away)."""
     X = jnp.asarray(X, jnp.float32)
     if log_features:
         X = jnp.log1p(X)
     y = jnp.asarray(y, jnp.float32)
+    sw = (None if sample_weight is None
+          else jnp.asarray(sample_weight, jnp.float32))
     opt = optimizer or optax.adagrad(lr)
 
     w0 = jnp.zeros((NUM_FEATURES,), jnp.float32)
@@ -174,6 +181,8 @@ def train_logreg_qat(
         p, obs_in, obs_out = qat_forward(w, b, obs_in, obs_out, X, quantize)
         eps = 1e-7  # BCE on probabilities, summed (BCELoss(sum))
         losses = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+        if sw is not None:
+            losses = losses * sw
         return losses.sum(), (obs_in, obs_out)
 
     @partial(jax.jit, static_argnames=("quantize",))

@@ -175,6 +175,15 @@ static __always_inline void extract_features(
 		__u64 mean = fs->byte_sum / n;
 		__u64 var = fs->byte_sq_sum / n > mean * mean
 			? fs->byte_sq_sum / n - mean * mean : 0;
+		/* flow-age features (slots 3/4, schema.FEATURE_NAMES): the
+		 * slow-attack separators the original variance/avg-size
+		 * slots (redundant with std/mean) couldn't provide.
+		 * pps_x1000 = n * 1e9 / dur_us (n*1e9 is overflow-free for
+		 * any realistic count; dur_us == 0 -> 0, rate unknown). */
+		__u64 dur_ns = fs->last_ts_ns - fs->first_ts_ns;
+		__u64 dur_us = dur_ns / 1000;
+		__u64 dur_ms = dur_ns / 1000000;
+		__u64 pps_x1000 = dur_us ? (n * 1000000000ULL) / dur_us : 0;
 		__u64 iat_n = n > 1 ? n - 1 : 1;
 		__u64 iat_mean_us = (fs->iat_sum_ns / iat_n) / 1000;
 		__u64 iat_mean_sq = iat_mean_us * iat_mean_us;
@@ -206,8 +215,8 @@ static __always_inline void extract_features(
 		crec->w1_feat_lo = fsx_minifloat8(fs->dst_port)
 			| fsx_minifloat8(fsx_sat_u32(mean)) << 8
 			| fsx_minifloat8(fsx_isqrt_u64(var)) << 16
-			| fsx_minifloat8(fsx_sat_u32(var)) << 24;
-		crec->w2_feat_hi = fsx_minifloat8(fsx_sat_u32(mean))
+			| fsx_minifloat8(fsx_sat_u32(dur_ms)) << 24;
+		crec->w2_feat_hi = fsx_minifloat8(fsx_sat_u32(pps_x1000))
 			| fsx_minifloat8(fsx_sat_u32(iat_mean_us)) << 8
 			| fsx_minifloat8(fsx_isqrt_u64(iat_var)) << 16
 			| fsx_minifloat8(fsx_sat_u32(iat_max_us)) << 24;
@@ -227,8 +236,8 @@ static __always_inline void extract_features(
 		rec->feat[0] = fs->dst_port;
 		rec->feat[1] = fsx_sat_u32(mean);
 		rec->feat[2] = fsx_isqrt_u64(var);
-		rec->feat[3] = fsx_sat_u32(var);
-		rec->feat[4] = fsx_sat_u32(mean); /* avg pkt size ≈ len mean */
+		rec->feat[3] = fsx_sat_u32(dur_ms);
+		rec->feat[4] = fsx_sat_u32(pps_x1000);
 		rec->feat[5] = fsx_sat_u32(iat_mean_us);
 		rec->feat[6] = fsx_isqrt_u64(iat_var);
 		rec->feat[7] = fsx_sat_u32(iat_max_us);

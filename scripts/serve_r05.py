@@ -1,0 +1,294 @@
+"""SERVE_r05: the SERVE_r04 scenario rerun under the young-flow vote
+(VERDICT r4 next #3).
+
+Same pipeline and traffic as scripts/serve_r04.py:
+
+    BPF_PROG_TEST_RUN flood driver (the "NIC role")
+      → real in-kernel XDP program (compact 16 B emit variant)
+      → kernel BPF ringbuf → fsxd drain → shm feature ring
+      → fsx serve engine (micro-batch → fused step → verdicts)
+      → shm verdict ring → fsxd → kernel blacklist map.
+
+r04's finding: ALL 64 benign sources got ML-blacklisted, because a
+flow's first records carry no variance/IAT mass and mis-score.  r05
+serves with ModelConfig.vote_k/vote_m (malicious records only vote once
+the flow has shown vote_k records; blocking needs vote_m votes) and
+measures the two sides of that policy directly:
+
+* benign FPR — how many of the 64 benign sources (10.0.0.0/24 pool)
+  ever appear in the kernel blacklist map;
+* attack block latency — a poller snapshots the blacklist every ~2 s
+  and records each attack source's (192.168.0.0/24 pool) first-seen
+  time relative to drive start; the artifact reports count blocked and
+  the p50/max first-block latency, split by flood tier (loud tier =
+  kernel-limiter territory, quiet tier = ML-only).
+
+The engine runs on CPU (JAX_PLATFORMS=cpu) so this artifact measures
+the KERNEL-PATH plumbing independent of the axon tunnel's state.
+
+Usage: sudo python scripts/serve_r05.py [duration_s] — writes
+SERVE_r05.json at the repo root.  Maps pin under /sys/fs/bpf/fsx_serve.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from flowsentryx_tpu.bpf import loader  # noqa: E402
+
+PIN = "/sys/fs/bpf/fsx_serve"
+DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 150.0
+N_ATTACK = 64          # flood sources
+N_BENIGN = 64          # background sources
+REPEAT = 2048          # kernel runs per PROG_TEST_RUN syscall
+ATTACK_BASE = 0xC0A80000   # 192.168.0.0/24 pool
+BENIGN_BASE = 0x0A000000   # 10.0.0.0/24 pool
+
+
+def eth(proto=0x0800):
+    return b"\xff" * 6 + b"\x00" * 6 + struct.pack(">H", proto)
+
+
+def udp_pkt(saddr: int, plen: int = 120, dport: int = 443) -> bytes:
+    ihl = 5
+    hdr = bytes([0x40 | ihl, 0]) + struct.pack(">H", plen - 14)
+    hdr += b"\x00\x00\x00\x00" + bytes([64, 17]) + b"\x00\x00"
+    hdr += struct.pack("<I", saddr)
+    hdr += b"\x01\x02\x03\x04"
+    l4 = struct.pack(">HHHH", 1234, dport, plen - 14 - ihl * 4, 0)
+    pkt = eth() + hdr + l4
+    return pkt + b"X" * max(0, plen - len(pkt))
+
+
+class BlacklistPoller(threading.Thread):
+    """Snapshots the kernel blacklist map every ``period`` seconds and
+    records each key's first-seen time (drive-relative)."""
+
+    def __init__(self, t0: float, period: float = 2.0):
+        super().__init__(daemon=True)
+        self.t0 = t0
+        self.period = period
+        self.first_seen: dict[int, float] = {}
+        self.stop = threading.Event()
+
+    def _poll_once(self) -> None:
+        r = subprocess.run(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "blacklist",
+             "--pin", PIN, "--json"],
+            capture_output=True, text=True, cwd=str(REPO))
+        try:
+            bl = json.loads(r.stdout)
+        except json.JSONDecodeError:
+            return
+        t = time.perf_counter() - self.t0
+        for e in bl.get("entries", []):
+            key = e.get("key")  # "0x<hex>" (v4 fold); exact-v6 has none
+            if isinstance(key, str):
+                key = int(key, 0)
+            if key is not None and key not in self.first_seen:
+                self.first_seen[key] = round(t, 1)
+
+    def run(self) -> None:
+        while not self.stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:
+                pass
+            self.stop.wait(self.period)
+        self._poll_once()  # final snapshot
+
+
+def main() -> int:
+    t_wall0 = time.time()
+    img = tempfile.mktemp(prefix="fsx_serve_", suffix=".img")
+    r = subprocess.run(
+        [sys.executable, "-m", "flowsentryx_tpu.bpf.image", img, "--compact"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0, r.stderr
+
+    subprocess.run(["make", "-C", str(REPO / "daemon"), "-q"], check=False)
+    subprocess.run(["rm", "-rf", PIN], check=False)
+    fring = tempfile.mktemp(prefix="fsx_fring_")
+    vring = tempfile.mktemp(prefix="fsx_vring_")
+
+    # daemon: pps threshold between the two flood tiers, as in r04
+    fsxd = subprocess.Popen(
+        [str(REPO / "daemon/build/fsxd"), "--bpf", "none", "--compact",
+         "--prog-image", img, "--pin", PIN,
+         "--duration", str(DURATION + 20),
+         "--feature-ring", fring, "--verdict-ring", vring,
+         "--pps-threshold", "8000", "--window", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    serve = None
+    poller = None
+    out: dict = {
+        "round": 5,
+        "purpose": ("SERVE_r04 scenario rerun under the young-flow ML vote "
+                    "(ModelConfig.vote_k/vote_m): benign FPR and attack "
+                    "time-to-block, measured at the kernel blacklist map "
+                    "(VERDICT r4 next #3)"),
+        "duration_s": DURATION,
+        "vote_policy": {"vote_k": 4, "vote_m": 2},
+        "engine_backend": "cpu (decoupled from axon tunnel state; TPU rates "
+                          "are bench.py's artifact)",
+        "r04_baseline": ("SERVE_r04.json: blocked_sources=128 — every benign "
+                        "source ML-blacklisted; allowed 1,092 vs dropped_ml "
+                        "226,869"),
+    }
+    try:
+        deadline = time.time() + 10
+        while not os.path.exists(f"{PIN}/prog"):
+            if fsxd.poll() is not None:
+                print(fsxd.stderr.read(), file=sys.stderr)
+                raise RuntimeError("fsxd died before pinning")
+            assert time.time() < deadline, "daemon never pinned"
+            time.sleep(0.1)
+        prog_fd = loader.obj_get(f"{PIN}/prog")
+
+        cfgf = tempfile.mktemp(prefix="fsx_cfg_", suffix=".json")
+        Path(cfgf).write_text(json.dumps({
+            "table": {"capacity": 65536},
+            "batch": {"max_batch": 2048, "deadline_us": 2000},
+            "model": {"vote_k": 4, "vote_m": 2},
+        }))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        serve = subprocess.Popen(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "serve",
+             "--config", cfgf, "--feature-ring", fring,
+             "--verdict-ring", vring, "--seconds", str(DURATION + 10),
+             "--artifact", str(REPO / "artifacts/logreg_int8.npz")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=str(REPO), env=env)
+
+        t0 = time.perf_counter()
+        poller = BlacklistPoller(t0)
+        poller.start()
+        offered = 0
+        syscalls = 0
+        attack = [udp_pkt(ATTACK_BASE + i, plen=80) for i in range(N_ATTACK)]
+        benign = [[udp_pkt(BENIGN_BASE + i, plen=pl, dport=443 if i % 3
+                           else 8000 + i)
+                   for pl in (120, 600, 1400)]
+                  for i in range(N_BENIGN)]
+        k = 0
+        while time.perf_counter() - t0 < DURATION:
+            i = k % N_ATTACK
+            rep = REPEAT * 4 if i < N_ATTACK // 4 else REPEAT
+            loader.prog_test_run(prog_fd, attack[i], repeat=rep)
+            offered += rep
+            syscalls += 1
+            if k % 2 == 0:
+                b = benign[(k // 2) % N_BENIGN][(k // 2) % 3]
+                loader.prog_test_run(prog_fd, b, repeat=1)
+                offered += 1
+                syscalls += 1
+            k += 1
+        drive_wall = time.perf_counter() - t0
+        poller.stop.set()
+        poller.join(timeout=15)
+        out["offered_packets"] = offered
+        out["prog_test_run_syscalls"] = syscalls
+        out["offered_mpps"] = round(offered / drive_wall / 1e6, 3)
+        out["drive_wall_s"] = round(drive_wall, 1)
+
+        # ---- the round-5 criteria, from the poller's first-seen map --
+        fs = poller.first_seen
+        benign_blocked = sorted(
+            k - BENIGN_BASE for k in fs if BENIGN_BASE <= k < BENIGN_BASE + N_BENIGN)
+        attack_seen = {k - ATTACK_BASE: v for k, v in fs.items()
+                       if ATTACK_BASE <= k < ATTACK_BASE + N_ATTACK}
+        loud = {i: t for i, t in attack_seen.items() if i < N_ATTACK // 4}
+        quiet = {i: t for i, t in attack_seen.items() if i >= N_ATTACK // 4}
+
+        def lat(d: dict) -> dict:
+            ts = sorted(d.values())
+            return {
+                "blocked": len(d),
+                "p50_s": ts[len(ts) // 2] if ts else None,
+                "max_s": ts[-1] if ts else None,
+            }
+
+        out["benign_fpr"] = {
+            "blocked_sources": len(benign_blocked),
+            "of_total": N_BENIGN,
+            "fpr": round(len(benign_blocked) / N_BENIGN, 4),
+            "which": benign_blocked,
+        }
+        out["attack_block_latency"] = {
+            "note": ("first appearance in the kernel blacklist map, "
+                     "~2 s poll granularity, relative to drive start"),
+            "loud_tier_kernel_limiter": lat(loud),
+            "quiet_tier_ml_vote": lat(quiet),
+        }
+
+        st = subprocess.run(
+            [sys.executable, "-m", "flowsentryx_tpu.cli", "status",
+             "--pin", PIN], capture_output=True, text=True, cwd=str(REPO))
+        out["kernel"] = json.loads(st.stdout).get("kernel", {})
+    finally:
+        if poller is not None:
+            poller.stop.set()
+        try:
+            fsxd_out, fsxd_err = fsxd.communicate(timeout=40)
+        except subprocess.TimeoutExpired:
+            fsxd.kill()
+            fsxd_out, fsxd_err = fsxd.communicate()
+        if serve is not None:
+            try:
+                s_out, s_err = serve.communicate(timeout=40)
+            except subprocess.TimeoutExpired:
+                serve.kill()
+                s_out, s_err = serve.communicate()
+            try:
+                out["engine_report"] = json.loads(s_out)
+            except json.JSONDecodeError:
+                out["engine_error"] = (s_err or s_out)[-800:]
+
+        lines = [ln for ln in fsxd_err.splitlines() if "forwarded=" in ln]
+        if lines:
+            out["fsxd_first_report"] = lines[0]
+            out["fsxd_last_report"] = lines[-1]
+            m = re.search(
+                r"forwarded=(\d+) verdicts=(\d+) skipped=(\d+)", lines[-1])
+            if m:
+                fwd, ver, skip = map(int, m.groups())
+                out["forwarded_records"] = fwd
+                out["verdict_roundtrips_applied"] = ver
+                out["skipped_records"] = skip
+                if "drive_wall_s" in out:
+                    out["forwarded_mrps"] = round(
+                        fwd / out["drive_wall_s"] / 1e6, 3)
+        tail = [ln for ln in fsxd_err.splitlines()
+                if "ring_full" in ln or "final" in ln]
+        if tail:
+            out["fsxd_tail"] = tail[-3:]
+        out["wall_s"] = round(time.time() - t_wall0, 1)
+        Path(REPO / "SERVE_r05.json").write_text(
+            json.dumps(out, indent=2) + "\n")
+        print(json.dumps({k: out.get(k) for k in
+                          ("offered_mpps", "forwarded_records",
+                           "verdict_roundtrips_applied", "benign_fpr",
+                           "attack_block_latency", "wall_s")}))
+        subprocess.run(["rm", "-rf", PIN], check=False)
+        for f in (img, fring, vring):
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

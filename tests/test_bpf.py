@@ -408,12 +408,15 @@ def _derive_mirror(fs: dict) -> list[int]:
     n = fs["pkt_count"]
     mean = fs["byte_sum"] // n
     var = max(fs["byte_sq_sum"] // n - (mean * mean & M), 0)
+    dur_ns = fs["last_ts_ns"] - fs["first_ts_ns"]
+    dur_us = dur_ns // 1000
+    pps_x1000 = (n * 1_000_000_000) // dur_us if dur_us else 0
     iat_n = max(n - 1, 1)
     iat_mean_us = (fs["iat_sum_ns"] // iat_n) // 1000
     iat_var = max(fs["iat_sq_sum_us2"] // iat_n - iat_mean_us * iat_mean_us, 0)
     return [
         fs["dst_port"], sat(mean), math.isqrt(var),
-        sat(var), sat(mean), sat(iat_mean_us),
+        sat(dur_ns // 1_000_000), sat(pps_x1000), sat(iat_mean_us),
         math.isqrt(iat_var), sat(fs["iat_max_ns"] // 1000),
     ]
 

@@ -18,13 +18,15 @@ from flowsentryx_tpu.core.config import (
 
 class TestSchema:
     def test_feature_layout_matches_reference(self):
-        # model/model.py:117 feature_list, same order
+        # model/model.py:117 feature_list order, with slots 3/4
+        # redefined as the flow-age features (reference slots were
+        # std^2 / ~mean — redundant; schema.FEATURE_NAMES rationale)
         assert schema.FEATURE_NAMES == (
             "destination_port",
             "packet_length_mean",
             "packet_length_std",
-            "packet_length_variance",
-            "average_packet_size",
+            "flow_duration_ms",
+            "flow_pps_x1000",
             "fwd_iat_mean",
             "fwd_iat_std",
             "fwd_iat_max",

@@ -85,19 +85,103 @@ class TestFusedStep:
         table, stats, out3 = step(table, stats, params, later)
         assert (np.asarray(out3.verdict)[:5] == int(Verdict.PASS)).all()
 
-    def test_ml_detection_drops_and_blacklists(self):
+    def test_ml_detection_votes_then_blacklists(self):
+        """The young-flow vote (ModelConfig.vote_k/vote_m, SERVE_r04
+        fix): a new flow's first malicious-looking records do NOT block
+        it; sustained malicious evidence past maturity does."""
         step, table, stats, params = make_env()
+        # batch 1: 4 hot records from a NEW flow = exactly vote_k —
+        # all immature, zero votes, flow passes (pre-vote behavior
+        # would have ML-dropped and blacklisted every benign source
+        # whose early records mis-score)
         batch = build_batch([(3001, 4, 100, 0.1, ML_HOT), (3002, 4, 100, 0.1, ML_COLD)])
         table, stats, out = step(table, stats, params, batch)
         v = np.asarray(out.verdict)
-        assert (v[:4] == int(Verdict.DROP_ML)).all()
-        assert (v[4:8] == int(Verdict.PASS)).all()
-        assert stat_value(stats.dropped_ml) == 4
+        assert (v[:8] == int(Verdict.PASS)).all()
+        assert stat_value(stats.dropped_ml) == 0
 
-        # ML-flagged source is now blacklisted for ml_block_s
+        # batch 2: the flow is mature (rec_seen=4 >= vote_k); 2 more
+        # hot records = vote_m votes -> ML drop + blacklist writeback
+        b2 = build_batch([(3001, 2, 100, 0.3, ML_HOT)])
+        table, stats, out2 = step(table, stats, params, b2)
+        assert (np.asarray(out2.verdict)[:2] == int(Verdict.DROP_ML)).all()
+        assert stat_value(stats.dropped_ml) == 2
+        keys = np.asarray(out2.block_key)
+        assert 3001 in keys[keys != 0xFFFFFFFF]
+
+        # batch 3: blacklisted outright for ml_block_s
         again = build_batch([(3001, 2, 100, 0.5, ML_COLD)])
-        table, stats, out2 = step(table, stats, params, again)
-        assert (np.asarray(out2.verdict)[:2] == int(Verdict.DROP_BLACKLIST)).all()
+        table, stats, out3 = step(table, stats, params, again)
+        assert (np.asarray(out3.verdict)[:2] == int(Verdict.DROP_BLACKLIST)).all()
+
+    def test_ml_young_mis_scores_never_block_recovered_flow(self):
+        """A benign flow whose ONLY malicious-looking records are its
+        young ones (the exact SERVE_r04 failure) is never blocked."""
+        step, table, stats, params = make_env()
+        b1 = build_batch([(3101, 3, 100, 0.1, ML_HOT)])   # young mis-scores
+        table, stats, o1 = step(table, stats, params, b1)
+        assert (np.asarray(o1.verdict)[:3] == int(Verdict.PASS)).all()
+        # mature records score benign: no votes ever accumulate
+        for t in (0.3, 0.5, 0.7):
+            b = build_batch([(3101, 4, 100, t, ML_COLD)])
+            table, stats, o = step(table, stats, params, b)
+            assert (np.asarray(o.verdict)[:4] == int(Verdict.PASS)).all()
+        assert stat_value(stats.dropped_ml) == 0
+
+    def test_ml_dense_burst_blocks_first_batch_even_tracked(self):
+        """The batch-local burst rule applies to tracked flows too: a
+        single batch carrying > vote_k records with >= vote_m scored
+        malicious is a dense flood, not a young benign flow — youth
+        grants no immunity window to line-rate attacks."""
+        step, table, stats, params = make_env()
+        flood = build_batch([(3201, 40, 100, 0.1, ML_HOT)])
+        table, stats, out = step(table, stats, params, flood)
+        assert (np.asarray(out.verdict)[:40] == int(Verdict.DROP_ML)).all()
+
+    def test_ml_vote_decays_and_resets_on_block(self):
+        """An isolated borderline mis-score long ago must not leave a
+        flow permanently one record from a block (votes decay with
+        vote_decay_s half-life), and a fired block consumes the votes
+        (re-blocking after TTL needs vote_m fresh records)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            CFG, model=dataclasses.replace(CFG.model, vote_decay_s=1.0,
+                                           ml_block_s=0.5))
+        step, table, stats, params = make_env(cfg)
+        # mature the flow benignly
+        table, stats, _ = step(table, stats, params,
+                               build_batch([(3401, 5, 100, 0.1, ML_COLD)]))
+        # one mature mis-score: 1 vote, passes
+        table, stats, o1 = step(table, stats, params,
+                                build_batch([(3401, 1, 100, 0.2, ML_HOT)]))
+        assert (np.asarray(o1.verdict)[:1] == int(Verdict.PASS)).all()
+        # 10 half-lives later another single mis-score: the old vote
+        # decayed to ~0.001 — still only ~1 vote, must NOT block
+        table, stats, o2 = step(table, stats, params,
+                                build_batch([(3401, 1, 100, 10.2, ML_HOT)]))
+        assert (np.asarray(o2.verdict)[:1] == int(Verdict.PASS)).all()
+        # two quick mis-scores: 2 votes -> blocked; votes then reset
+        table, stats, o3 = step(table, stats, params,
+                                build_batch([(3401, 2, 100, 10.4, ML_HOT)]))
+        assert (np.asarray(o3.verdict)[:2] == int(Verdict.DROP_ML)).all()
+        # after the 0.5 s TTL, a single borderline record passes again
+        # (the block consumed the votes)
+        table, stats, o4 = step(table, stats, params,
+                                build_batch([(3401, 1, 100, 11.5, ML_HOT)]))
+        assert (np.asarray(o4.verdict)[:1] == int(Verdict.PASS)).all()
+
+    def test_ml_legacy_knob_restores_immediate_block(self):
+        """vote_k=0, vote_m=1 must reproduce the pre-vote semantics."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            CFG, model=dataclasses.replace(CFG.model, vote_k=0, vote_m=1))
+        step, table, stats, params = make_env(cfg)
+        batch = build_batch([(3301, 4, 100, 0.1, ML_HOT)])
+        table, stats, out = step(table, stats, params, batch)
+        assert (np.asarray(out.verdict)[:4] == int(Verdict.DROP_ML)).all()
+        assert stat_value(stats.dropped_ml) == 4
 
     def test_state_persists_across_batches(self):
         # 60 pkts then 60 pkts in the same window must exceed pps=100
@@ -131,18 +215,26 @@ class TestFusedStep:
     def test_ml_verdict_survives_full_table(self):
         # Attack: fill the table so new flows can't get slots, then send
         # malicious traffic.  ML detection needs no table state and must
-        # still drop (regression: over_ml was gated on asg.tracked).
+        # still drop (regression: over_ml was gated on asg.tracked) —
+        # via the batch-local vote (> vote_k records, >= vote_m of them
+        # malicious, in one batch), since an untracked flow carries no
+        # vote history.
         cfg = FsxConfig(table=TableConfig(capacity=2, probes=2, stale_s=1e9))
         step, table, stats, params = make_env(cfg)
         table = table._replace(
             key=jnp.array([111, 222], jnp.uint32),
             last_seen=jnp.full((2,), 1e9, jnp.float32),  # never stale
         )
-        batch = build_batch([(999, 4, 100, 0.1, ML_HOT)])
+        batch = build_batch([(999, 8, 100, 0.1, ML_HOT)])
         table, stats, out = step(table, stats, params, batch)
-        assert (np.asarray(out.verdict)[:4] == int(Verdict.DROP_ML)).all()
+        assert (np.asarray(out.verdict)[:8] == int(Verdict.DROP_ML)).all()
         # and the kernel writeback still carries the key
         assert 999 in np.asarray(out.block_key).tolist()
+        # a benign-volume untracked trickle (<= vote_k records) stays
+        # immune even when its young records mis-score
+        b2 = build_batch([(998, 2, 100, 0.2, ML_HOT)])
+        table, stats, out2 = step(table, stats, params, b2)
+        assert (np.asarray(out2.verdict)[:2] == int(Verdict.PASS)).all()
 
     def test_spoofed_zero_saddr_tracked(self):
         # saddr 0.0.0.0 must not collide with the empty-slot sentinel

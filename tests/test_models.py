@@ -15,8 +15,8 @@ def _feature_like_batch(rng, n):
     x[:, 0] = rng.integers(0, 65536, n)           # destination_port
     x[:, 1] = rng.uniform(0, 1500, n)             # packet_length_mean
     x[:, 2] = rng.uniform(0, 700, n)              # packet_length_std
-    x[:, 3] = rng.uniform(0, 5e5, n)              # packet_length_variance
-    x[:, 4] = rng.uniform(0, 1500, n)             # average_packet_size
+    x[:, 3] = rng.uniform(0, 1.2e5, n)            # flow_duration_ms
+    x[:, 4] = rng.uniform(0, 1e9, n)              # flow_pps_x1000
     x[:, 5] = rng.uniform(0, 1e8, n)              # fwd_iat_mean (us)
     x[:, 6] = rng.uniform(0, 1e8, n)              # fwd_iat_std
     x[:, 7] = rng.uniform(0, 2.4e8, n)            # fwd_iat_max
@@ -180,7 +180,7 @@ class TestMulticlass:
 
         X, _, y_class = fixture.cicids_fixture(n=20_000, seed=5,
                                                return_classes=True)
-        params, losses = qat.train_multiclass(X, y_class, epochs=25)
+        params, losses = qat.train_multiclass(X, y_class, epochs=40)
         assert losses[-1] < losses[0]
         rep = evaluate.multiclass_report(params, X, y_class)
         # binary detection strong; volumetric attribution works; the
@@ -260,8 +260,11 @@ class TestArtifactLoader:
         from flowsentryx_tpu.models.registry import load_artifact
 
         art = load_artifact("logreg_int8", "artifacts/logreg_int8.npz")
-        flood = np.array([[443, 80, 1, 1, 80, 50, 10, 200]], np.float32)
-        benign = np.array([[80, 900, 300, 90000, 950, 2e5, 1e5, 2e6]],
+        # new slot semantics: [.., dur_ms, pps_x1000, ..] — a flood is
+        # short-lived at machine-gun rate; benign is long-lived at
+        # interactive rate with varied frames
+        flood = np.array([[443, 80, 1, 250, 2e7, 50, 10, 200]], np.float32)
+        benign = np.array([[80, 900, 300, 40000, 5e4, 2e5, 1e5, 2e6]],
                           np.float32)
         s_f = float(logreg.classify_batch_int8_matmul(art, flood)[0])
         s_b = float(logreg.classify_batch_int8_matmul(art, benign)[0])

@@ -257,8 +257,14 @@ class TestFixture:
         assert dport.max() <= 65535.0
         # IATs bounded by the real flow_duration max (1.2e8 us)
         assert X[:, 5:8].max() <= 1.2e8
-        # variance column really is std^2
-        np.testing.assert_allclose(X[:, 3], X[:, 2] ** 2, rtol=1e-5)
+        # flow-age slots obey the kernel-estimator identity
+        # pps_x1000 = n * 1e9 / dur_us with dur capped at 1.2e8 us
+        dur_ms, pps_x1000 = X[:, 3], X[:, 4]
+        assert dur_ms.max() <= 1.2e5 + 1
+        assert (pps_x1000 > 0).all()
+        # implied packet count n = pps_x1000 * dur_us / 1e9 >= ~1
+        n_impl = pps_x1000.astype(np.float64) * dur_ms * 1e3 / 1e9
+        assert n_impl.min() > 0.9
 
     def test_learnable_and_pipeline_roundtrip(self):
         from flowsentryx_tpu.train import data, evaluate, fixture, qat
