@@ -216,8 +216,31 @@ class Verdict(enum.IntEnum):
 # ---------------------------------------------------------------------------
 
 
+class TableCol(enum.IntEnum):
+    """Column index of each per-flow f32 quantity inside
+    ``IpTableState.state`` (one ``[capacity, NUM_TABLE_COLS]`` matrix —
+    see the class docstring for why a matrix beats 12 separate arrays
+    on TPU)."""
+
+    LAST_SEEN = 0      # f32 s; drives stale-slot reclamation (LRU analog)
+    WIN_START = 1      # f32 s; current fixed/sliding window start
+    WIN_PPS = 2        # f32; packets in current window
+    WIN_BPS = 3        # f32; bytes in current window
+    PREV_PPS = 4       # f32; previous window packets (sliding)
+    PREV_BPS = 5       # f32; previous window bytes (sliding)
+    TOKENS = 6         # f32; token-bucket level (packets)
+    TOK_TS = 7         # f32 s; last token refill time
+    TOK_BYTES = 8      # f32; byte-bucket level (bandwidth dimension)
+    REC_SEEN = 9       # f32; records seen (young-flow ML vote age)
+    ML_VOTES = 10      # f32; malicious-scored mature records
+    BLOCKED_UNTIL = 11  # f32 s; 0 = not blacklisted (fsx_kern.c:193-204)
+
+
+NUM_TABLE_COLS = len(TableCol)
+
+
 class IpTableState(NamedTuple):
-    """SoA per-IP state table resident on device, ``[capacity]`` rows.
+    """Per-IP state table resident on device, ``[capacity]`` rows.
 
     Successor of the reference's three LRU hash maps (``fsx_kern.c:64-94``:
     ``ip_stats_map``, ``blacklist_v4``, ``blacklist_v6``) merged into one
@@ -225,48 +248,102 @@ class IpTableState(NamedTuple):
     the limiter update, and the verdict writeback.  Rows are sharded
     across the device mesh by slot index (= by IP hash).
 
+    The twelve per-flow f32 quantities live in ONE ``[capacity, 12]``
+    matrix (``state``, columns named by :class:`TableCol`) rather than
+    twelve separate arrays: the hot path touches a flow's row with a
+    single 48 B-contiguous gather and a single scatter — one HBM
+    transaction each way instead of twelve scattered ones, which is the
+    difference between latency-bound and bandwidth-shaped table access
+    on TPU.  Named column views are exposed as read-only properties so
+    reporting/tests keep field-style access.
+
     All times are float32 seconds on a process-relative clock; counters
     are float32 (exactly representable well past any 1-second window's
     packet count).
     """
 
-    key: jnp.ndarray            # uint32; 0 = empty slot sentinel
-    last_seen: jnp.ndarray      # f32 s; drives stale-slot reclamation (LRU analog)
-    win_start: jnp.ndarray      # f32 s; current fixed/sliding window start
-    win_pps: jnp.ndarray        # f32; packets in current window
-    win_bps: jnp.ndarray        # f32; bytes in current window
-    prev_pps: jnp.ndarray       # f32; previous window packets (sliding)
-    prev_bps: jnp.ndarray       # f32; previous window bytes (sliding)
-    tokens: jnp.ndarray         # f32; token-bucket level (packets)
-    tok_ts: jnp.ndarray         # f32 s; last token refill time
-    tok_bytes: jnp.ndarray      # f32; byte-bucket level (README.md:153-162
-                                #      bandwidth dimension; 0-depth = disabled)
-    rec_seen: jnp.ndarray       # f32; feature records seen (flow age for the
-                                #      young-flow ML vote; ModelConfig.vote_k)
-    ml_votes: jnp.ndarray       # f32; malicious-scored mature records
-                                #      (ML blocks need ModelConfig.vote_m)
-    blocked_until: jnp.ndarray  # f32 s; 0 = not blacklisted (fsx_kern.c:193-204)
+    key: jnp.ndarray    # [capacity] uint32; 0 = empty slot sentinel
+    state: jnp.ndarray  # [capacity, NUM_TABLE_COLS] f32 (TableCol columns)
 
     @property
     def capacity(self) -> int:
         return self.key.shape[-1]
+
+    # -- read-only column views (reporting/tests; the hot path slices
+    #    the matrix directly) ------------------------------------------
+    def _col(self, c: "TableCol") -> jnp.ndarray:
+        return self.state[..., int(c)]
+
+    @property
+    def last_seen(self):
+        return self._col(TableCol.LAST_SEEN)
+
+    @property
+    def win_start(self):
+        return self._col(TableCol.WIN_START)
+
+    @property
+    def win_pps(self):
+        return self._col(TableCol.WIN_PPS)
+
+    @property
+    def win_bps(self):
+        return self._col(TableCol.WIN_BPS)
+
+    @property
+    def prev_pps(self):
+        return self._col(TableCol.PREV_PPS)
+
+    @property
+    def prev_bps(self):
+        return self._col(TableCol.PREV_BPS)
+
+    @property
+    def tokens(self):
+        return self._col(TableCol.TOKENS)
+
+    @property
+    def tok_ts(self):
+        return self._col(TableCol.TOK_TS)
+
+    @property
+    def tok_bytes(self):
+        return self._col(TableCol.TOK_BYTES)
+
+    @property
+    def rec_seen(self):
+        return self._col(TableCol.REC_SEEN)
+
+    @property
+    def ml_votes(self):
+        return self._col(TableCol.ML_VOTES)
+
+    @property
+    def blocked_until(self):
+        return self._col(TableCol.BLOCKED_UNTIL)
+
+    def with_columns(self, **cols: jnp.ndarray) -> "IpTableState":
+        """New table with named columns replaced wholesale (tests /
+        state surgery; the hot path never uses this)."""
+        state = self.state
+        for name, v in cols.items():
+            state = state.at[:, int(TableCol[name.upper()])].set(v)
+        return self._replace(state=state)
+
+
+#: Legacy per-column field names, in TableCol order — the checkpoint
+#: format (one array per column) predates the matrix layout and stays
+#: column-per-key so old snapshots load unchanged.
+TABLE_COLUMN_NAMES = tuple(c.name.lower() for c in TableCol)
 
 
 def make_table(capacity: int) -> IpTableState:
     """Fresh, empty state table with ``capacity`` slots (power of two)."""
     if capacity & (capacity - 1):
         raise ValueError(f"capacity must be a power of two, got {capacity}")
-
-    # Distinct arrays per field (not one shared zeros array): donated
-    # steps reject the same buffer appearing in two donated arguments.
-    def z():
-        return jnp.zeros((capacity,), jnp.float32)
-
     return IpTableState(
         key=jnp.zeros((capacity,), jnp.uint32),
-        last_seen=z(), win_start=z(), win_pps=z(), win_bps=z(),
-        prev_pps=z(), prev_bps=z(), tokens=z(), tok_ts=z(),
-        tok_bytes=z(), rec_seen=z(), ml_votes=z(), blocked_until=z(),
+        state=jnp.zeros((capacity, NUM_TABLE_COLS), jnp.float32),
     )
 
 

@@ -42,9 +42,16 @@ def save_state(
     # models.logreg._npz_path).
     if path.suffix != ".npz":
         path = path.with_suffix(path.suffix + ".npz")
+    # One array per column (not the in-memory [N, 12] matrix): the
+    # column-per-key format predates the matrix layout, keeps old
+    # snapshots loadable, and lets future columns default cleanly.
+    state = np.asarray(table.state)
+    cols = {f"table_{name}": state[:, i]
+            for i, name in enumerate(schema.TABLE_COLUMN_NAMES)}
     np.savez_compressed(
         path,
-        **{f"table_{k}": np.asarray(v) for k, v in table._asdict().items()},
+        table_key=np.asarray(table.key),
+        **cols,
         **{f"stats_{k}": np.asarray(v) for k, v in stats._asdict().items()},
         t0_ns=np.uint64(t0_ns),
         hash_salt=np.uint64(hash_salt),
@@ -72,17 +79,17 @@ def load_state(
             raise ValueError(
                 f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
             )
-        # Fields added after a checkpoint was written load as their
+        # Columns added after a checkpoint was written load as their
         # empty-table default (e.g. tok_bytes on pre-byte-bucket
-        # snapshots: zero byte credit, refilled on first sight).  Only
-        # the missing fields materialize zeros — no throwaway table.
-        import jax.numpy as jnp
-
+        # snapshots: zero byte credit, refilled on first sight).
         cap = int(z["table_key"].shape[0])
+        state = np.zeros((cap, schema.NUM_TABLE_COLS), np.float32)
+        for i, name in enumerate(schema.TABLE_COLUMN_NAMES):
+            if f"table_{name}" in z:
+                state[:, i] = z[f"table_{name}"]
         table = schema.IpTableState(
-            **{k: (jax.device_put(z[f"table_{k}"]) if f"table_{k}" in z
-                   else jnp.zeros((cap,), jnp.float32))
-               for k in schema.IpTableState._fields}
+            key=jax.device_put(z["table_key"]),
+            state=jax.device_put(state),
         )
         stats = schema.GlobalStats(
             **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}

@@ -125,12 +125,19 @@ def assign_slots(
     inserted = usable & ~found
 
     # --- batch-internal arbitration: one winner per claimed slot -----------
-    # Distinct keys may claim the same empty/stale slot.  Sort by
-    # (slot, found-first); the head of each slot group wins.  A flow that
-    # FOUND its key always beats one reclaiming that slot as stale
-    # (same-key collisions are impossible: agg yields distinct reps).
+    # Distinct keys may claim the same empty/stale slot.  One sort over
+    # a PACKED key — slot*2 + (0 if found else 1) — orders by slot with
+    # found-first inside each slot group (a flow that FOUND its key
+    # always beats one reclaiming that slot as stale; same-key
+    # collisions are impossible: agg yields distinct reps).  Packing
+    # replaces the previous two-pass lexsort with a single sort pass —
+    # the sort is the arbitration's whole cost on TPU.  Ties among
+    # same-priority claimants break arbitrarily (exactly one wins,
+    # which is all correctness needs).  slot < capacity <= 2^30 keeps
+    # the packed key inside int32.
     slot_for_sort = jnp.where(usable, slot, jnp.int32(n))  # park unusable at n
-    order = jnp.lexsort((~found, slot_for_sort))  # primary: slot, secondary: found
+    packed = slot_for_sort * 2 + (~found).astype(jnp.int32)
+    order = jnp.argsort(packed)
     sorted_slot = slot_for_sort[order]
     head = jnp.concatenate(
         [jnp.ones((1,), bool), sorted_slot[1:] != sorted_slot[:-1]]

@@ -83,21 +83,20 @@ class BlacklistPoller(threading.Thread):
         self.stop = threading.Event()
 
     def _poll_once(self) -> None:
-        r = subprocess.run(
-            [sys.executable, "-m", "flowsentryx_tpu.cli", "blacklist",
-             "--pin", PIN, "--json"],
-            capture_output=True, text=True, cwd=str(REPO))
+        # direct in-process map walk (a CLI subprocess per poll adds
+        # 1-3 s of interpreter startup to every sample, inflating the
+        # reported first-block latencies past the stated granularity)
+        from flowsentryx_tpu.bpf import blacklist as bl
+
+        m = bl.open_map(PIN)
         try:
-            bl = json.loads(r.stdout)
-        except json.JSONDecodeError:
-            return
+            entries = bl.entries(m)
+        finally:
+            m.close()
         t = time.perf_counter() - self.t0
-        for e in bl.get("entries", []):
-            key = e.get("key")  # "0x<hex>" (v4 fold); exact-v6 has none
-            if isinstance(key, str):
-                key = int(key, 0)
-            if key is not None and key not in self.first_seen:
-                self.first_seen[key] = round(t, 1)
+        for e in entries:
+            if e.key is not None and e.key not in self.first_seen:
+                self.first_seen[e.key] = round(t, 1)
 
     def run(self) -> None:
         while not self.stop.is_set():

@@ -1,0 +1,200 @@
+"""Device-resident component profile of the fused step (VERDICT r4 #4).
+
+Times the full compact-wire step and ablated variants on the live
+backend, all device-resident (no link traffic inside the timed loop, so
+the numbers are valid even on a degraded tunnel window):
+
+* ``full``        — the production compact step.
+* ``no_arb``      — assign_slots' lexsort arbitration stubbed (every
+                    usable flow wins): isolates sort #2.
+* ``no_agg_sort`` — aggregation's argsort replaced by identity segs
+                    (every packet its own flow): isolates sort #1
+                    (changes semantics, keeps shapes/ops comparable).
+* ``classify``    — decode + classifier matmul only.
+
+Prints ONE JSON line with per-variant ms at B=1024 and 2048.
+
+Usage: python scripts/step_profile.py [table_capacity_log2]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+CAP = 1 << (int(sys.argv[1]) if len(sys.argv) > 1 else 20)
+
+out = {"ts": time.time(), "table_capacity": CAP}
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("FSX_FORCE_CPU"):
+        # sitecustomize force-registers axon and overrides JAX_PLATFORMS
+        # from the environment; the config API wins
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    dev = jax.devices()[0]
+    out["backend"] = dev.platform
+    out["device_kind"] = dev.device_kind
+
+    spec = get_model("logreg_int8")
+    params = jax.device_put(spec.init())
+    quant = schema.wire_quant_for(params)
+
+    def time_step(step, table, stats, raws, iters=30):
+        # warmup + compile
+        t, s, o = step(table, stats, params, raws[0])
+        jax.block_until_ready(o.verdict)
+        # adapt: on a wedged window one step can cost seconds — sample
+        # once and shrink the loop so the profile still completes
+        t0 = time.perf_counter()
+        t, s, o = step(t, s, params, raws[0])
+        jax.block_until_ready(o.verdict)
+        once = time.perf_counter() - t0
+        iters = max(3, min(iters, int(3.0 / max(once, 1e-4))))
+        t0 = time.perf_counter()
+        for i in range(iters):
+            t, s, o = step(t, s, params, raws[i % len(raws)])
+        jax.block_until_ready(o.verdict)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    rng = np.random.default_rng(0)
+    for b in (1024, 2048):
+        cfg = FsxConfig(table=TableConfig(capacity=CAP),
+                        batch=BatchConfig(max_batch=b))
+        raws = []
+        for i in range(8):
+            buf = np.zeros(b, dtype=schema.FLOW_RECORD_DTYPE)
+            buf["saddr"] = rng.integers(1, 1 << 20, b).astype(np.uint32)
+            buf["pkt_len"] = rng.integers(64, 1500, b)
+            buf["ts_ns"] = (i * b + np.arange(b)) * 100
+            buf["feat"] = rng.integers(0, 1 << 20, (b, schema.NUM_FEATURES))
+            raws.append(jax.device_put(
+                schema.encode_compact(buf, b, t0_ns=0, **quant)))
+
+        variants = {}
+
+        # full production step (donated, as the engine runs it: the
+        # table updates in place — no per-step copy of the state)
+        step_don = fused.make_jitted_compact_step(
+            cfg, spec.classify_batch, donate=True, **quant)
+        variants["full_donated"] = time_step(
+            step_don, jax.device_put(schema.make_table(CAP)),
+            jax.device_put(schema.make_stats()), raws)
+
+        # undonated twin (isolates the copy cost)
+        step_full = fused.make_jitted_compact_step(
+            cfg, spec.classify_batch, donate=False, **quant)
+        table = jax.device_put(schema.make_table(CAP))
+        stats = jax.device_put(schema.make_stats())
+        variants["full"] = time_step(step_full, table, stats, raws)
+
+        # ablations via monkeypatching (separate jit builds)
+        import flowsentryx_tpu.ops.hashtable as ht
+        import flowsentryx_tpu.ops.agg as agg
+
+        orig_assign = ht.assign_slots
+        orig_seg = agg.segment_by_key
+
+        def assign_no_arb(table_key, table_last_seen, rep_key, rep_valid,
+                          now, tcfg):
+            n = table_key.shape[0]
+            mask = jnp.uint32(n - 1)
+            r = rep_key.shape[0]
+            p = tcfg.probes
+            h1 = ht.hash_u32(rep_key, tcfg.salt)
+            stp = (ht.hash_u32(rep_key ^ jnp.uint32(0x9E3779B9), tcfg.salt)
+                   | jnp.uint32(1))
+            offs = jnp.arange(p, dtype=jnp.uint32)
+            slots = ((h1[:, None] + offs[None, :] * stp[:, None]) & mask
+                     ).astype(jnp.int32)
+            cand_key = table_key[slots]
+            cand_seen = table_last_seen[slots]
+            match = cand_key == rep_key[:, None]
+            empty = cand_key == ht.EMPTY_KEY
+            stale = (~match) & (~empty) & (now - cand_seen > tcfg.stale_s)
+            probe_idx = jnp.arange(p, dtype=jnp.int32)[None, :]
+            score = jnp.where(
+                match, probe_idx,
+                jnp.where(empty, p + probe_idx,
+                          jnp.where(stale, 2 * p + probe_idx, 4 * p)))
+            best = jnp.argmin(score, axis=1)
+            best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+            slot = jnp.take_along_axis(slots, best[:, None], axis=1)[:, 0]
+            found = rep_valid & (best_score < p)
+            usable = rep_valid & (best_score < 4 * p)
+            inserted = usable & ~found
+            return ht.SlotAssignment(slot=slot, found=found,
+                                     inserted=inserted, tracked=usable)
+
+        try:
+            ht.assign_slots = assign_no_arb
+            step_na = fused.make_jitted_compact_step(
+                cfg, spec.classify_batch, donate=False, **quant)
+            variants["no_arb"] = time_step(
+                step_na, jax.device_put(schema.make_table(CAP)),
+                jax.device_put(schema.make_stats()), raws)
+        finally:
+            ht.assign_slots = orig_assign
+
+        def seg_identity(k):
+            bsz = k.shape[0]
+            idx = jnp.arange(bsz, dtype=jnp.int32)
+            return agg.KeySegments(
+                order=idx, sorted_key=k, heads=jnp.ones((bsz,), bool),
+                seg=idx, inv=idx)
+
+        try:
+            agg.segment_by_key = seg_identity
+            step_ns = fused.make_jitted_compact_step(
+                cfg, spec.classify_batch, donate=False, **quant)
+            variants["no_agg_sort"] = time_step(
+                step_ns, jax.device_put(schema.make_table(CAP)),
+                jax.device_put(schema.make_stats()), raws)
+        finally:
+            agg.segment_by_key = orig_seg
+
+        # decode + classify only
+        def classify_only(table, stats, p_, raw):
+            batch = schema.decode_compact(raw, **quant)
+            score = spec.classify_batch(p_, batch.feat)
+            out_ = fused.StepOutput(
+                verdict=jnp.zeros_like(score, jnp.int32), score=score,
+                block_key=batch.key, block_until=score, now=jnp.max(batch.ts))
+            return table, stats, out_
+
+        step_cl = jax.jit(classify_only)
+        variants["classify"] = time_step(
+            step_cl, jax.device_put(schema.make_table(CAP)),
+            jax.device_put(schema.make_stats()), raws)
+
+        out[f"ms_{b}"] = {k: round(v, 4) for k, v in variants.items()}
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except Exception as e:  # one JSON line even on failure
+        out["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(out))
+        raise SystemExit(1)

@@ -60,8 +60,7 @@ class TestTableSummary:
         seen[slots] = rng.uniform(0, 100, n_fill)
         blocked = np.zeros(cap, np.float32)
         blocked[slots[:200]] = rng.uniform(100, 200, 200)  # future expiry
-        table = table._replace(
-            key=jnp.asarray(key),
+        table = table._replace(key=jnp.asarray(key)).with_columns(
             last_seen=jnp.asarray(seen),
             blocked_until=jnp.asarray(blocked),
         )
