@@ -592,17 +592,12 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
             # summed drop-attribution block reconciles exactly against
             # the paced runs' record counts.
             eng.stats = jax.device_put(schema.make_stats())
-        eng.reset_stream(src, readback_depth=depth)
-        lats: list = []
-        eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
-            t - s.pop_scheduled(n)
-        )
-        t0 = time.perf_counter()
-        eng.run(max_seconds=6.0)
-        wall = time.perf_counter() - t0
-        if not lats:
+        from flowsentryx_tpu.benchmarks import paced_latency_run
+
+        lats, wall = paced_latency_run(eng, src, readback_depth=depth)
+        if not len(lats):
             return None
-        a = np.asarray(lats) * 1e3
+        a = lats * 1e3
         rec = {
             "batch": bsz, "depth": depth, "load_mpps": load,
             "n": len(lats),

@@ -192,6 +192,26 @@ def run_suite(scale: float = 1.0, names: list[str] | None = None) -> list[dict]:
     return results
 
 
+def paced_latency_run(eng, src, readback_depth=None, max_seconds=6.0):
+    """Open-loop paced run through a PRE-COMPILED engine.
+
+    The one copy of the per-record latency measurement methodology
+    (``bench.py`` phase_latency and ``scripts/paced_profile.py`` both
+    call it): rebind the stream, attach the reap hook that pairs each
+    sunk record with its scheduled arrival, run, return
+    ``(lats_s ndarray, wall_s)``.  The caller compiles the engine
+    outside the paced clock (the open-loop clock starts at the first
+    poll, so XLA compile inside the run would read as queueing)."""
+    eng.reset_stream(src, readback_depth=readback_depth)
+    lats: list = []
+    eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
+        t - s.pop_scheduled(n))
+    t0 = time.perf_counter()
+    eng.run(max_seconds=max_seconds)
+    wall = time.perf_counter() - t0
+    return np.asarray(lats), wall
+
+
 def run_scaling(
     device_counts: tuple[int, ...] = (1, 2, 4, 8),
     capacity: int = 1 << 20,

@@ -3,11 +3,15 @@
 The online loop of BASELINE configs 4/5.  Single process, no threads:
 JAX dispatch is already asynchronous, so the natural double-buffering is
 "dispatch batch N, then fill batch N+1 while the device runs N" — the
-host's fill work and the device's step overlap without locks.  Verdict
-readback is *deferred* by ``readback_depth`` batches: outputs queue as
-device futures and are fetched in arrears, keeping the dispatch pipe
-full (and, on the axon tunnel, amortizing its fixed per-readback RPC
-cost).  The blacklist tolerates that small delay by design — the kernel
+host's fill work and the device's step overlap without locks.  Verdicts
+sink on READINESS: every loop iteration harvests whatever batches the
+device has finished (coalesced; deep drain groups fetch as one
+device-side concat so tunneled runtimes pay their per-readback RPC
+floor per group).  ``readback_depth`` only caps how many batches may
+queue before the engine blocks — it is a pipe bound, not a readback
+schedule (scheduling readback BY depth deferred every verdict by
+depth × batch-fill time, the r4 open-loop latency collapse).  The
+blacklist tolerates the remaining small delay by design — the kernel
 limiter stands alone during the gap (fail-open, SURVEY.md §5.3).
 """
 
@@ -193,6 +197,13 @@ class Engine:
         self._blocked: set[int] = set()
         self._device_now = 0.0  # newest stream time seen in reaped outputs
         self._route_drop = 0    # routing-overflow fail-opens (sharded step)
+        # ready-reap coalescing (see _reap_ready): each sink has a fixed
+        # host cost, so cap the sink rate when the pipe is shallow —
+        # but never above half the flush deadline, which is the
+        # configured latency budget (a fixed floor would silently
+        # override small deadline_us values)
+        self._last_sink_t = 0.0
+        self._min_sink_gap_s = min(0.3e-3, cfg.batch.deadline_us * 1e-6 / 2)
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -206,36 +217,79 @@ class Engine:
 
     def _reap(self, down_to: int) -> None:
         """Fetch + sink verdicts until only ``down_to`` batches remain
-        queued.  The whole group is reaped as ONE device concatenation +
-        one host fetch: a D2H round trip has a fixed cost (RPC floor on
-        tunneled runtimes, sync overhead everywhere), so it is paid per
-        reap group, not per batch."""
+        queued — BLOCKING on device completion if needed.  This is the
+        pipeline-depth cap; the latency path is :meth:`_reap_ready`."""
         n = len(self._inflight) - down_to
         if n <= 0:
             return
-        group = [self._inflight.pop(0) for _ in range(n)]
-        import jax.numpy as jnp
+        self._sink_group([self._inflight.pop(0) for _ in range(n)])
 
+    def _reap_ready(self) -> None:
+        """Sink every batch the device has ALREADY finished, oldest
+        first, without blocking on anything unfinished.
+
+        Called every loop iteration: without it, a batch's verdicts
+        waited until ``readback_depth`` MORE batches had been
+        dispatched — at an offered load L and batch size B that is
+        ``depth × B/L`` of pure queueing added to every record (the r4
+        open-loop collapse: p99 20×+ the step time at trivial loads).
+        Readiness is a local future check, not a device round trip; the
+        sink itself has a fixed host cost, so reaps COALESCE — a sink
+        happens only when one is due (minimum gap) or the pipe is
+        stacking up, and consecutive ready batches go as one group."""
+        if not self._inflight or not self._inflight[0].out.block_key.is_ready():
+            return
+        t = time.perf_counter()
+        if (len(self._inflight) < 2
+                and t - self._last_sink_t < self._min_sink_gap_s):
+            return
+        group = [self._inflight.pop(0)]
+        while self._inflight and self._inflight[0].out.block_key.is_ready():
+            group.append(self._inflight.pop(0))
+        self._sink_group(group)
+
+    def _sink_group(self, group: list[_InFlight]) -> None:
+        """Fetch + sink a reap group.
+
+        Small groups (the steady state under ready-based reaping) fetch
+        with plain ``np.asarray`` and concatenate on HOST: composing a
+        device-side concat + stack/sum cost three extra jit dispatches
+        per sink (~1.5 ms of host time each — measured dominating the
+        paced loop), starving the pipeline far below the step's own
+        throughput.  LARGE groups (deep drains, post-stall bursts)
+        switch back to one device-side concat so the per-readback fixed
+        cost — the RPC floor on tunneled runtimes — is paid per group,
+        not per batch."""
         with self.metrics.readback.time():
-            keys = np.asarray(
-                jnp.concatenate([g.out.block_key for g in group])
-            )
-            untils = np.asarray(
-                jnp.concatenate([g.out.block_until for g in group])
-            )
+            if len(group) <= 2:
+                keys = np.concatenate(
+                    [np.asarray(g.out.block_key) for g in group]) \
+                    if len(group) > 1 else np.asarray(group[0].out.block_key)
+                untils = np.concatenate(
+                    [np.asarray(g.out.block_until) for g in group]) \
+                    if len(group) > 1 else np.asarray(group[0].out.block_until)
+            else:
+                import jax.numpy as jnp
+
+                keys = np.asarray(
+                    jnp.concatenate([g.out.block_key for g in group]))
+                untils = np.asarray(
+                    jnp.concatenate([g.out.block_until for g in group]))
             now = float(np.asarray(group[-1].out.now))
-            # routing-overflow fail-opens (sharded step): one extra
-            # scalar fetch per reap GROUP keeps the counter visible to
-            # operators without a per-batch readback
-            self._route_drop += int(np.asarray(
-                jnp.sum(jnp.stack([jnp.asarray(g.out.route_drop)
-                                   for g in group]))
-            ))
+            # routing-overflow fail-opens (sharded step): single-device
+            # steps carry a module-level numpy zero here — free, no
+            # device fetch; the sharded step's jax scalar costs one
+            # small fetch per batch.
+            self._route_drop += sum(
+                int(rd) if isinstance(rd, (int, np.integer, np.generic))
+                else int(np.asarray(rd))
+                for rd in (g.out.route_drop for g in group))
         upd = extract_updates(keys, untils)
         self.sink.apply(upd)
         self._blocked.update(upd.key.tolist())
         self._device_now = max(self._device_now, now)
         t_done = time.perf_counter()
+        self._last_sink_t = t_done
         for g in group:
             self.metrics.e2e.add(t_done - g.t_enqueue)
             if self.on_reap is not None:
@@ -384,12 +438,25 @@ class Engine:
                     sealed = self.batcher.add_precompact(records)
                 else:
                     sealed = self.batcher.add(records)
-                if not sealed and self.batcher.flush_due():
+                # Deadline flush ONLY into an idle pipe: while batches
+                # are in flight, an early flush cannot reduce latency
+                # (the new batch queues behind them anyway) but it does
+                # burn a full padded step per near-empty buffer — the r4
+                # open-loop collapse at tiny loads was exactly this
+                # flush-faster-than-the-step-drains spiral.  When the
+                # pipe drains (<= one step time) the deadline fires.
+                if (not sealed and not self._inflight
+                        and self.batcher.flush_due()):
                     took = self.batcher.take()
                     sealed = [took] if took is not None else []
             for raw in sealed:
                 self._dispatch(raw, self.batcher.pop_seal_time())
                 self._reap(self.readback_depth)
+            # Latency path: sink whatever the device has finished, every
+            # iteration — including iterations that sealed nothing (the
+            # depth cap above only bounds the pipe; waiting for it to
+            # fill would defer verdicts by depth × batch-fill time).
+            self._reap_ready()
             if not sealed and self.source.exhausted():
                 if self.batcher.fill:
                     self._dispatch(self.batcher.take(), self.batcher.pop_seal_time())

@@ -1,0 +1,102 @@
+"""Open-loop paced latency/throughput curve (VERDICT r4 #2 evidence).
+
+Drives the real Engine with PacedSource at a grid of offered loads and
+prints ONE JSON line per config with achieved rate and per-record
+arrival→verdict-sunk latency percentiles, plus a final summary line.
+
+The engine compiles OUTSIDE the paced clock (reset_stream reuse).
+Run on CPU (FSX_FORCE_CPU=1) or the live backend.
+
+Usage: [FSX_FORCE_CPU=1] python scripts/paced_profile.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+GRID = (
+    # (batch, depth, load_mpps, deadline_us)
+    (256, 2, 0.01, 200),
+    (1024, 2, 0.2, 1000),
+    (1024, 4, 0.5, 1000),
+    (2048, 4, 0.8, 2000),
+    (2048, 4, 1.0, 2000),
+)
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("FSX_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.engine import Engine, NullSink, PacedSource
+
+    dev = jax.devices()[0]
+    out = {"ts": time.time(), "backend": dev.platform,
+           "device_kind": dev.device_kind, "rows": []}
+
+    rng = np.random.default_rng(0)
+    pool = np.zeros(1 << 14, dtype=schema.FLOW_RECORD_DTYPE)
+    pool["saddr"] = rng.integers(1, 1 << 13, len(pool)).astype(np.uint32)
+    pool["pkt_len"] = rng.integers(64, 1500, len(pool))
+    pool["feat"] = rng.integers(0, 1 << 20, (len(pool), 8))
+
+    engines: dict = {}
+    for bsz, depth, load, dl in GRID:
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 16),
+                        batch=BatchConfig(max_batch=bsz, deadline_us=dl))
+        rate = load * 1e6
+        total = int(max(rate * 3, 1))
+        src = PacedSource(pool, rate_pps=rate, total=total)
+        key = (bsz, dl)
+        eng = engines.get(key)
+        if eng is None:
+            eng = Engine(cfg, src, NullSink(), donate=False,
+                         readback_depth=depth, wire=schema.WIRE_COMPACT16)
+            quant = schema.wire_quant_for(eng.params)
+            warm = schema.encode_compact(pool[:bsz], bsz, t0_ns=0, **quant)
+            eng.table, eng.stats, o = eng.step(
+                eng.table, eng.stats, eng.params, warm)
+            jax.block_until_ready(o.verdict)
+            engines[key] = eng
+        from flowsentryx_tpu.benchmarks import paced_latency_run
+
+        lats, wall = paced_latency_run(eng, src, readback_depth=depth)
+        a = lats * 1e3
+        row = {
+            "batch": bsz, "depth": depth, "load_mpps": load,
+            "deadline_us": dl, "n": len(lats),
+            "achieved_mpps": round(len(lats) / wall / 1e6, 4),
+            "p50_ms": round(float(np.percentile(a, 50)), 2),
+            "p90_ms": round(float(np.percentile(a, 90)), 2),
+            "p99_ms": round(float(np.percentile(a, 99)), 2),
+            "offered_all_consumed": bool(len(lats) >= total),
+        }
+        out["rows"].append(row)
+        print(json.dumps(row), flush=True)
+
+    print(json.dumps({"summary": True, **{k: out[k] for k in
+                                          ("backend", "device_kind")},
+                      "n_rows": len(out["rows"])}))
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
