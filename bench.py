@@ -383,6 +383,51 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
         side.emit("chunk", mpps=round(mpps, 2), iters=chunk_iters)
         log(f"chunk: {mpps:.2f} Mpps ({chunk_iters} iters)")
 
+    # -- mega-dispatch chunks: N batches per jit call (lax.scan over a
+    # stacked wire group) — one dispatch round trip per N batches, so
+    # per-dispatch overhead (the tunnel's RPC floor above all) is paid
+    # once per group.  Same records, same state chain; whichever mode
+    # sustains more is the honest headline (mode recorded).
+    MEGA_N = 8
+    if time.perf_counter() + 30 < deadline:
+        from flowsentryx_tpu.models import get_model
+        from flowsentryx_tpu.ops import fused as _fused
+
+        spec = get_model(cfg.model.name)
+        quant_m = schema.model_quant_args(params)
+        mega = _fused.make_jitted_compact_megastep(
+            cfg, spec.classify_batch, n_chunks=MEGA_N, donate=True,
+            **quant_m)
+        stacked = [np.stack([raws[(g * MEGA_N + i) % len(raws)]
+                             for i in range(MEGA_N)])
+                   for g in range(4)]
+        t0 = time.perf_counter()
+        table, stats, outs = mega(table, stats, params,
+                                  jax.device_put(stacked[0]))
+        jax.block_until_ready(outs.verdict)
+        side.emit("mega_compile", s=round(time.perf_counter() - t0, 1))
+        result["mega_chunk_mpps"] = []
+        gk = 0
+        mpre = [jax.device_put(stacked[i % len(stacked)]) for i in range(2)]
+        jax.block_until_ready(mpre)
+        # ~5 s chunks like the single-dispatch loop
+        giters = max(2, min(25, int(5.0 / max(per_iter * MEGA_N, 1e-6))))
+        while len(result["mega_chunk_mpps"]) < 6:
+            if time.perf_counter() + giters * per_iter * MEGA_N * 2 \
+                    + reserve > deadline:
+                break
+            t0 = time.perf_counter()
+            for _ in range(giters):
+                mpre.append(jax.device_put(stacked[(gk + 2) % len(stacked)]))
+                table, stats, outs = mega(table, stats, params, mpre.pop(0))
+                gk += 1
+            jax.block_until_ready(outs.verdict)
+            dt = time.perf_counter() - t0
+            mpps = giters * MEGA_N * B / dt / 1e6
+            result["mega_chunk_mpps"].append(round(mpps, 2))
+            side.emit("mega_chunk", mpps=round(mpps, 2), iters=giters)
+            log(f"mega chunk (N={MEGA_N}): {mpps:.2f} Mpps")
+
     # Median over steady-state chunks (exclude the probe when real
     # chunks exist: the probe is tiny and noisy).  The max chunk is
     # reported separately as burst_mpps: under the tunnel's tiered
@@ -391,8 +436,24 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
     # honest sustained number, the max shows the burst regime a
     # local-PCIe deployment would sustain continuously.
     steady = result["chunk_mpps"][1:] or result["chunk_mpps"]
-    result["mpps"] = float(np.median(steady))
+    # single_mpps stays the cross-round comparable series: the link
+    # baseline and the transport_limited judgment key on it (folding
+    # mega numbers into those would let an amortized-dispatch win mask
+    # a genuinely collapsed transport).  The HEADLINE may be the mega
+    # median — it is a real serving mode — labeled by dispatch_mode.
+    result["single_mpps"] = float(np.median(steady))
+    result["mpps"] = result["single_mpps"]
     result["burst_mpps"] = float(np.max(steady))
+    mega_chunks = result.get("mega_chunk_mpps") or []
+    if mega_chunks:
+        mega_med = float(np.median(mega_chunks))
+        result["mega_mpps"] = mega_med
+        result["dispatch_mode"] = (
+            f"mega{MEGA_N}" if mega_med > result["mpps"] else "single")
+        if mega_med > result["mpps"]:
+            result["mpps"] = mega_med
+        result["burst_mpps"] = max(result["burst_mpps"],
+                                   float(np.max(mega_chunks)))
     # transport_limited is judged by the PARENT against the persisted
     # healthy baseline — a same-run flag here would re-introduce the r3
     # defect (a uniformly degraded tunnel reading as "not limited").
@@ -1010,7 +1071,9 @@ def main() -> int:
                 device_kind=tput.get("device_kind"),
                 throughput_partial=tput.get("partial", False),
             )
-            for k in ("h2d_mbps", "device_mpps", "burst_mpps"):
+            for k in ("h2d_mbps", "device_mpps", "burst_mpps",
+                      "single_mpps", "mega_mpps", "mega_chunk_mpps",
+                      "dispatch_mode"):
                 if k in tput:
                     detail[k] = tput[k]
             # transport_limited vs the PERSISTED healthy baseline (r3
@@ -1018,15 +1081,20 @@ def main() -> int:
             # "not transport limited" just because its same-run
             # device-resident number degraded too).
             if tput.get("backend") != "cpu":
+                # baseline + transport judgment use the SINGLE-dispatch
+                # number: mega amortizes the per-dispatch RPC floor, so
+                # a mega value can look healthy on a collapsed link and
+                # would poison the cross-round comparable series.
+                single_mpps = tput.get("single_mpps", mpps)
                 bl = _update_link_baseline(
                     h2d_mbps_best=tput.get("h2d_mbps"),
                     device_mpps_best=tput.get("device_mpps"),
-                    e2e_mpps_best=mpps,
+                    e2e_mpps_best=single_mpps,
                 )
                 best_dev = bl.get("device_mpps_best")
                 if best_dev:
                     detail["transport_limited"] = bool(
-                        mpps < TARGET_MPPS and best_dev > 2 * mpps
+                        mpps < TARGET_MPPS and best_dev > 2 * single_mpps
                     )
                     detail["device_mpps_healthy_baseline"] = best_dev
             log(f"throughput: {mpps:.2f} Mpps median over {tput.get('chunk_mpps')}")

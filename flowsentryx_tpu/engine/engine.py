@@ -278,12 +278,21 @@ class Engine:
             now = float(np.asarray(group[-1].out.now))
             # routing-overflow fail-opens (sharded step): single-device
             # steps carry a module-level numpy zero here — free, no
-            # device fetch; the sharded step's jax scalar costs one
-            # small fetch per batch.
-            self._route_drop += sum(
-                int(rd) if isinstance(rd, (int, np.integer, np.generic))
-                else int(np.asarray(rd))
-                for rd in (g.out.route_drop for g in group))
+            # device fetch.  Sharded jax scalars: per-batch fetch on the
+            # small-group fast path; ONE device-side sum for deep
+            # groups (the whole point of that branch is one RPC round
+            # trip per group).
+            rds = [g.out.route_drop for g in group]
+            if all(isinstance(rd, (int, np.integer, np.generic))
+                   for rd in rds):
+                self._route_drop += sum(int(rd) for rd in rds)
+            elif len(group) <= 2:
+                self._route_drop += sum(int(np.asarray(rd)) for rd in rds)
+            else:
+                import jax.numpy as jnp
+
+                self._route_drop += int(np.asarray(
+                    jnp.sum(jnp.stack([jnp.asarray(rd) for rd in rds]))))
         upd = extract_updates(keys, untils)
         self.sink.apply(upd)
         self._blocked.update(upd.key.tolist())

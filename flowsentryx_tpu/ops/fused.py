@@ -509,6 +509,57 @@ def make_jitted_compact_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def make_jitted_compact_megastep(
+    cfg: FsxConfig,
+    classify_batch,
+    n_chunks: int,
+    donate: bool | None = None,
+    **quant,
+):
+    """N micro-batches in ONE dispatch: a ``lax.scan`` over the leading
+    axis of a ``[N, B+1, 4]`` stacked compact wire buffer, carrying
+    (table, stats) through the chain — the "persistent on-device loop"
+    prototype (SURVEY.md §7.4.1).
+
+    One jit call amortizes the fixed dispatch cost over ``n_chunks``
+    batches, which is the difference between dispatch-bound and
+    compute-bound throughput wherever per-dispatch overhead rivals the
+    step time (the tunneled runtime's RPC floor most of all; real-chip
+    dispatch at high rates too).  Latency trade: records wait for the
+    whole group to fill before the dispatch, so the engine reserves
+    mega-dispatch for load regimes where the group fills faster than
+    one dispatch turnaround.
+
+    Returns ``mega(table, stats, params, raws) -> (table, stats, outs)``
+    where outs fields are stacked ``[N, B]`` (``now``: ``[N]``).
+    """
+    if donate is None:
+        donate = donation_supported()
+    import functools
+
+    from flowsentryx_tpu.core import schema
+
+    base = make_step(cfg, classify_batch)
+    decode = functools.partial(schema.decode_compact, **quant)
+
+    def mega(table, stats, params, raws):
+        if raws.shape[0] != n_chunks:
+            raise ValueError(
+                f"mega-step compiled for {n_chunks} chunks, got a "
+                f"[{raws.shape[0]}, ...] group (any other leading dim "
+                "would silently recompile)")
+
+        def body(carry, raw):
+            tbl, st = carry
+            tbl, st, out = base(tbl, st, params, decode(raw))
+            return (tbl, st), out
+
+        (table, stats), outs = jax.lax.scan(body, (table, stats), raws)
+        return table, stats, outs
+
+    return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
+
+
 def donation_supported() -> bool:
     """Whether table/stats donation is safe on the active backend.
 

@@ -1,0 +1,156 @@
+"""Mega-step dispatch-amortization profile (VERDICT r4 #5 evidence).
+
+Measures, device-resident and donated (the production regime):
+
+* ``single``  — K iterations of the one-batch compact step;
+* ``mega_N``  — K/N iterations of the N-in-one-dispatch lax.scan
+  mega-step over the SAME records (N in 4/8/16);
+* ``h2d_group_ms`` — host→device transfer of one stacked [N, B+1, 4]
+  wire group (the per-group transport the engine's mega mode pays).
+
+From these it derives per-batch dispatch overhead (single minus
+amortized mega cost) and a latency budget through the mega loop at
+1/5/10 Mpps offered: group-fill residency + H2D + scan — the
+"e2e p99 net of transport" the persistent-loop story is judged on.
+
+Usage: [FSX_FORCE_CPU=1] python scripts/megastep_profile.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+B = 1024
+CAP = 1 << 20
+K = 64  # total micro-batches timed per variant
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("FSX_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+    from flowsentryx_tpu.models import get_model
+    from flowsentryx_tpu.ops import fused
+
+    dev = jax.devices()[0]
+    out = {"ts": time.time(), "backend": dev.platform,
+           "device_kind": dev.device_kind, "batch": B, "table_capacity": CAP}
+
+    spec = get_model("logreg_int8")
+    params = jax.device_put(spec.init())
+    quant = schema.wire_quant_for(params)
+    cfg = FsxConfig(table=TableConfig(capacity=CAP),
+                    batch=BatchConfig(max_batch=B))
+
+    rng = np.random.default_rng(0)
+    raws_np = []
+    for i in range(K):
+        buf = np.zeros(B, dtype=schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = rng.integers(1, 1 << 20, B).astype(np.uint32)
+        buf["pkt_len"] = rng.integers(64, 1500, B)
+        buf["ts_ns"] = (i * B + np.arange(B)) * 100
+        buf["feat"] = rng.integers(0, 1 << 20, (B, 8))
+        raws_np.append(schema.encode_compact(buf, B, t0_ns=0, **quant))
+
+    donate = fused.donation_supported()
+    out["donated"] = donate
+
+    # -- single-step loop ---------------------------------------------------
+    step = fused.make_jitted_compact_step(
+        cfg, spec.classify_batch, donate=donate, **quant)
+    raws_dev = [jax.device_put(r) for r in raws_np]
+    table = jax.device_put(schema.make_table(CAP))
+    stats = jax.device_put(schema.make_stats())
+    table, stats, o = step(table, stats, params, raws_dev[0])
+    jax.block_until_ready(o.verdict)
+    t0 = time.perf_counter()
+    for r in raws_dev:
+        table, stats, o = step(table, stats, params, r)
+    jax.block_until_ready(o.verdict)
+    single_ms = (time.perf_counter() - t0) / K * 1e3
+    out["single_ms_per_batch"] = round(single_ms, 4)
+
+    # -- mega loops ---------------------------------------------------------
+    out["mega"] = {}
+    for n in (4, 8, 16):
+        mega = fused.make_jitted_compact_megastep(
+            cfg, spec.classify_batch, n_chunks=n, donate=donate, **quant)
+        groups = [jax.device_put(np.stack(raws_np[i:i + n]))
+                  for i in range(0, K, n)]
+        table = jax.device_put(schema.make_table(CAP))
+        stats = jax.device_put(schema.make_stats())
+        table, stats, outs = mega(table, stats, params, groups[0])
+        jax.block_until_ready(outs.verdict)
+        t0 = time.perf_counter()
+        for g in groups:
+            table, stats, outs = mega(table, stats, params, g)
+        jax.block_until_ready(outs.verdict)
+        per_batch = (time.perf_counter() - t0) / K * 1e3
+        # one stacked-group H2D (the engine's per-group transport)
+        gnp = np.stack(raws_np[:n])
+        t0 = time.perf_counter()
+        for _ in range(8):
+            jax.block_until_ready(jax.device_put(gnp))
+        h2d = (time.perf_counter() - t0) / 8 * 1e3
+        out["mega"][str(n)] = {
+            "ms_per_batch": round(per_batch, 4),
+            "mpps": round(B / per_batch / 1e3, 3),
+            "h2d_group_ms": round(h2d, 4),
+            "dispatch_overhead_recovered_ms": round(single_ms - per_batch, 4),
+        }
+
+    # -- latency budget through the mega loop -------------------------------
+    # per-record e2e net of transport = group-fill residency (oldest
+    # record waits N*B/L) + H2D + scan(N batches)
+    out["latency_budget_net_of_transport"] = {}
+    for load_mpps in (1.0, 5.0, 10.0):
+        budgets = {}
+        for n in (4, 8, 16):
+            m = out["mega"][str(n)]
+            fill_ms = n * B / (load_mpps * 1e3)
+            scan_ms = m["ms_per_batch"] * n
+            budgets[str(n)] = {
+                "group_fill_ms": round(fill_ms, 3),
+                "h2d_ms": m["h2d_group_ms"],
+                "scan_ms": round(scan_ms, 3),
+                "e2e_oldest_record_ms": round(
+                    fill_ms + m["h2d_group_ms"] + scan_ms, 3),
+            }
+        # single-batch dispatch comparison at the same load
+        budgets["single_dispatch"] = {
+            "fill_ms": round(B / (load_mpps * 1e3), 3),
+            "step_ms": out["single_ms_per_batch"],
+            "e2e_oldest_record_ms": round(
+                B / (load_mpps * 1e3) + out["single_ms_per_batch"], 3),
+        }
+        out["latency_budget_net_of_transport"][f"{load_mpps}Mpps"] = budgets
+
+    print(json.dumps(out))
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(out))
+        raise SystemExit(1)
