@@ -535,12 +535,7 @@ def make_jitted_compact_megastep(
     """
     if donate is None:
         donate = donation_supported()
-    import functools
-
-    from flowsentryx_tpu.core import schema
-
-    base = make_step(cfg, classify_batch)
-    decode = functools.partial(schema.decode_compact, **quant)
+    base = make_compact_step(cfg, classify_batch, **quant)
 
     def mega(table, stats, params, raws):
         if raws.shape[0] != n_chunks:
@@ -551,7 +546,7 @@ def make_jitted_compact_megastep(
 
         def body(carry, raw):
             tbl, st = carry
-            tbl, st, out = base(tbl, st, params, decode(raw))
+            tbl, st, out = base(tbl, st, params, raw)
             return (tbl, st), out
 
         (table, stats), outs = jax.lax.scan(body, (table, stats), raws)
