@@ -387,33 +387,37 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
     # stacked wire group) — one dispatch round trip per N batches, so
     # per-dispatch overhead (the tunnel's RPC floor above all) is paid
     # once per group.  Same records, same state chain; whichever mode
-    # sustains more is the honest headline (mode recorded).
+    # sustains more is the honest headline (mode recorded).  A deeper
+    # N=32 tier runs after N=8 when time and its win justify it: on a
+    # dispatch-floor-bound transport each 4x depth amortizes 4x more.
     MEGA_N = 8
-    if time.perf_counter() + 30 < deadline:
+
+    def run_mega_tier(n_mega: int, max_groups: int) -> list:
         from flowsentryx_tpu.models import get_model
         from flowsentryx_tpu.ops import fused as _fused
 
+        nonlocal table, stats
         spec = get_model(cfg.model.name)
         quant_m = schema.model_quant_args(params)
         mega = _fused.make_jitted_compact_megastep(
-            cfg, spec.classify_batch, n_chunks=MEGA_N, donate=True,
+            cfg, spec.classify_batch, n_chunks=n_mega, donate=True,
             **quant_m)
-        stacked = [np.stack([raws[(g * MEGA_N + i) % len(raws)]
-                             for i in range(MEGA_N)])
+        stacked = [np.stack([raws[(g * n_mega + i) % len(raws)]
+                             for i in range(n_mega)])
                    for g in range(4)]
         t0 = time.perf_counter()
         table, stats, outs = mega(table, stats, params,
                                   jax.device_put(stacked[0]))
         jax.block_until_ready(outs.verdict)
-        side.emit("mega_compile", s=round(time.perf_counter() - t0, 1))
-        result["mega_chunk_mpps"] = []
+        side.emit("mega_compile", n=n_mega,
+                  s=round(time.perf_counter() - t0, 1))
+        chunks: list = []
         gk = 0
         mpre = [jax.device_put(stacked[i % len(stacked)]) for i in range(2)]
         jax.block_until_ready(mpre)
-        # ~5 s chunks like the single-dispatch loop
-        giters = max(2, min(25, int(5.0 / max(per_iter * MEGA_N, 1e-6))))
-        while len(result["mega_chunk_mpps"]) < 6:
-            if time.perf_counter() + giters * per_iter * MEGA_N * 2 \
+        giters = max(2, min(25, int(5.0 / max(per_iter * n_mega, 1e-6))))
+        while len(chunks) < max_groups:
+            if time.perf_counter() + giters * per_iter * n_mega * 2 \
                     + reserve > deadline:
                 break
             t0 = time.perf_counter()
@@ -423,10 +427,54 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
                 gk += 1
             jax.block_until_ready(outs.verdict)
             dt = time.perf_counter() - t0
-            mpps = giters * MEGA_N * B / dt / 1e6
-            result["mega_chunk_mpps"].append(round(mpps, 2))
-            side.emit("mega_chunk", mpps=round(mpps, 2), iters=giters)
-            log(f"mega chunk (N={MEGA_N}): {mpps:.2f} Mpps")
+            mpps = giters * n_mega * B / dt / 1e6
+            chunks.append(round(mpps, 2))
+            side.emit("mega_chunk", n=n_mega, mpps=round(mpps, 2),
+                      iters=giters)
+            log(f"mega chunk (N={n_mega}): {mpps:.2f} Mpps")
+        return chunks
+
+    def _finalize(res: dict) -> None:
+        """Fold chunk series into the headline fields.  mega_chunk_mpps
+        is ALWAYS the N=8 series and mega32_chunk_mpps always N=32 —
+        keys never change meaning across rounds; dispatch_mode records
+        which mode won the headline."""
+        steady_ = res["chunk_mpps"][1:] or res["chunk_mpps"]
+        res["single_mpps"] = float(np.median(steady_))
+        res["mpps"] = res["single_mpps"]
+        res["burst_mpps"] = float(np.max(steady_))
+        res.pop("dispatch_mode", None)
+        res.pop("mega_mpps", None)
+        for key, label in (("mega_chunk_mpps", "mega8"),
+                           ("mega32_chunk_mpps", "mega32")):
+            chunks_ = res.get(key) or []
+            if not chunks_:
+                continue
+            med = float(np.median(chunks_))
+            if med > res["mpps"]:
+                res["mpps"] = med
+                res["mega_mpps"] = med
+                res["dispatch_mode"] = label
+            res["burst_mpps"] = max(res["burst_mpps"],
+                                    float(np.max(chunks_)))
+        res.setdefault("dispatch_mode", "single")
+
+    if time.perf_counter() + 30 < deadline:
+        result["mega_chunk_mpps"] = run_mega_tier(MEGA_N, 6)
+        m8 = result["mega_chunk_mpps"]
+        if (m8 and float(np.median(m8)) > 1.2 * float(np.median(
+                result["chunk_mpps"][1:] or result["chunk_mpps"]))
+                and time.perf_counter() + 40 < deadline):
+            # Dispatch overhead is a real binder here — try 4x deeper.
+            # The 32-deep scan's COMPILE is unbounded on a cache miss,
+            # so snapshot a complete result first: if the child dies
+            # inside the tier, sidecar recovery returns this snapshot
+            # instead of downgrading the whole phase to partial.
+            _finalize(result)
+            side.emit("result", **result)
+            m32 = run_mega_tier(32, 4)
+            if m32:
+                result["mega32_chunk_mpps"] = m32
 
     # Median over steady-state chunks (exclude the probe when real
     # chunks exist: the probe is tiny and noisy).  The max chunk is
@@ -435,25 +483,12 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
     # later ones at the metered sustained rate — the median is the
     # honest sustained number, the max shows the burst regime a
     # local-PCIe deployment would sustain continuously.
-    steady = result["chunk_mpps"][1:] or result["chunk_mpps"]
     # single_mpps stays the cross-round comparable series: the link
     # baseline and the transport_limited judgment key on it (folding
     # mega numbers into those would let an amortized-dispatch win mask
-    # a genuinely collapsed transport).  The HEADLINE may be the mega
+    # a genuinely collapsed transport).  The HEADLINE may be a mega
     # median — it is a real serving mode — labeled by dispatch_mode.
-    result["single_mpps"] = float(np.median(steady))
-    result["mpps"] = result["single_mpps"]
-    result["burst_mpps"] = float(np.max(steady))
-    mega_chunks = result.get("mega_chunk_mpps") or []
-    if mega_chunks:
-        mega_med = float(np.median(mega_chunks))
-        result["mega_mpps"] = mega_med
-        result["dispatch_mode"] = (
-            f"mega{MEGA_N}" if mega_med > result["mpps"] else "single")
-        if mega_med > result["mpps"]:
-            result["mpps"] = mega_med
-        result["burst_mpps"] = max(result["burst_mpps"],
-                                   float(np.max(mega_chunks)))
+    _finalize(result)
     # transport_limited is judged by the PARENT against the persisted
     # healthy baseline — a same-run flag here would re-introduce the r3
     # defect (a uniformly degraded tunnel reading as "not limited").
@@ -744,16 +779,20 @@ def _recover_sidecar(path: str) -> dict | None:
         return None
     out: dict = {"partial": True}
     chunks = []
-    mega_chunks = []
+    mega_tiers: dict[int, list] = {}
+    last_result = None
     for rec in lines:
         kind = rec.pop("kind")
         if kind == "result":
+            # keep scanning: a phase may snapshot a complete result
+            # before an optional extra tier — the LAST one wins
             rec.pop("partial", None)
-            return {**rec, "partial": False}
-        if kind == "chunk":
+            last_result = rec
+        elif kind == "chunk":
             chunks.append(rec["mpps"])
         elif kind == "mega_chunk":
-            mega_chunks.append(rec["mpps"])
+            mega_tiers.setdefault(int(rec.get("n", 8)), []).append(
+                rec["mpps"])
         elif kind == "init":
             # Post-mortem trail: which init stage the child reached
             # (import_jax vs devices_call) and when.
@@ -766,17 +805,21 @@ def _recover_sidecar(path: str) -> dict | None:
             out.setdefault("paced", []).append(rec)
         elif kind in ("device", "compile", "sync_floor", "lat_partial"):
             out.update(rec)
+    if last_result is not None:
+        return {**last_result, "partial": False}
     if chunks:
         steady = chunks[1:] or chunks
         out["chunk_mpps"] = chunks
         out["single_mpps"] = float(np.median(steady))
         out["mpps"] = out["single_mpps"]
-    if mega_chunks:
-        out["mega_chunk_mpps"] = mega_chunks
-        out["mega_mpps"] = float(np.median(mega_chunks))
-        if out["mega_mpps"] > out.get("mpps", 0.0):
-            out["mpps"] = out["mega_mpps"]
-            out["dispatch_mode"] = "mega8"
+    for n, series in sorted(mega_tiers.items()):
+        key = "mega_chunk_mpps" if n == 8 else f"mega{n}_chunk_mpps"
+        out[key] = series
+        med = float(np.median(series))
+        if med > out.get("mpps", 0.0):
+            out["mpps"] = med
+            out["mega_mpps"] = med
+            out["dispatch_mode"] = f"mega{n}"
     return out
 
 
@@ -1088,7 +1131,7 @@ def main() -> int:
             )
             for k in ("h2d_mbps", "device_mpps", "burst_mpps",
                       "single_mpps", "mega_mpps", "mega_chunk_mpps",
-                      "dispatch_mode"):
+                      "mega32_chunk_mpps", "dispatch_mode"):
                 if k in tput:
                     detail[k] = tput[k]
             # transport_limited vs the PERSISTED healthy baseline (r3
@@ -1225,7 +1268,8 @@ def main() -> int:
 #: backend that measured them via latency_backend).
 _ATTEMPT_KEYS = (
     "value", "vs_baseline", "backend", "device_kind", "chunk_mpps",
-    "single_mpps", "mega_mpps", "mega_chunk_mpps", "dispatch_mode",
+    "single_mpps", "mega_mpps", "mega_chunk_mpps", "mega32_chunk_mpps",
+    "dispatch_mode",
     "h2d_mbps", "device_mpps", "burst_mpps", "transport_limited",
     "device_mpps_healthy_baseline", "compile_s", "throughput_partial",
 )
