@@ -145,6 +145,10 @@ class TableConfig:
     def __post_init__(self) -> None:
         if self.capacity & (self.capacity - 1) or self.capacity <= 0:
             raise ValueError("capacity must be a power of two")
+        if self.capacity > 1 << 29:
+            # the packed arbitration sort key (slot*2 + priority bit,
+            # parked at 2*capacity) must fit int32
+            raise ValueError("capacity must be <= 2^29")
         if self.probes < 1:
             raise ValueError("probes must be >= 1")
         if not 0 <= self.salt < 1 << 32:

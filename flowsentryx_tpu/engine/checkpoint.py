@@ -70,9 +70,12 @@ def peek_salt(path: str | Path) -> int:
 
 def load_state(
     path: str | Path,
-) -> tuple[schema.IpTableState, schema.GlobalStats, int, int]:
+) -> tuple[schema.IpTableState, schema.GlobalStats, int, int, tuple]:
     """Restore serving state to device.
-    Returns (table, stats, t0_ns, hash_salt)."""
+    Returns (table, stats, t0_ns, hash_salt, missing_columns) —
+    ``missing_columns`` names table columns the snapshot predates (they
+    load zero-filled; the caller decides whether zero is the right
+    default, e.g. Engine.restore refills byte-bucket credit)."""
     with np.load(Path(path)) as z:
         version = int(z["schema_version"])
         if version != CHECKPOINT_SCHEMA_VERSION:
@@ -84,9 +87,12 @@ def load_state(
         # snapshots: zero byte credit, refilled on first sight).
         cap = int(z["table_key"].shape[0])
         state = np.zeros((cap, schema.NUM_TABLE_COLS), np.float32)
+        missing = []
         for i, name in enumerate(schema.TABLE_COLUMN_NAMES):
             if f"table_{name}" in z:
                 state[:, i] = z[f"table_{name}"]
+            else:
+                missing.append(name)
         table = schema.IpTableState(
             key=jax.device_put(z["table_key"]),
             state=jax.device_put(state),
@@ -95,4 +101,4 @@ def load_state(
             **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}
         )
         salt = int(z["hash_salt"]) if "hash_salt" in z else 0
-        return table, stats, int(z["t0_ns"]), salt
+        return table, stats, int(z["t0_ns"]), salt, tuple(missing)

@@ -376,7 +376,18 @@ class Engine:
     def restore(self, path) -> None:
         from flowsentryx_tpu.engine import checkpoint as ckpt
 
-        table, stats, t0_ns, salt = ckpt.load_state(path)
+        table, stats, t0_ns, salt, missing = ckpt.load_state(path)
+        if "tok_bytes" in missing and self.cfg.limiter.bucket_burst_bytes > 0:
+            # Pre-byte-bucket snapshot under a byte-limited config:
+            # zero credit would spuriously rate-block every restored
+            # flow's first batch (refill is elapsed-based, not full).
+            # Occupied slots start with the full burst, matching the
+            # is_new semantics their flows got on first sight.
+            import jax.numpy as jnp
+
+            table = table.with_columns(tok_bytes=jnp.where(
+                table.key != 0,
+                jnp.float32(self.cfg.limiter.bucket_burst_bytes), 0.0))
         if table.capacity != self.cfg.table.capacity:
             raise ValueError(
                 f"checkpoint capacity {table.capacity} != configured "

@@ -340,32 +340,13 @@ def make_step(
         key = jnp.where(batch.key == 0, jnp.uint32(0xFFFFFFFE), batch.key)
         key = jnp.where(batch.valid, key, agg.INVALID_KEY)
 
-        # --- per-packet probe + slot selection (assign_slots' math) ---
-        tcfg = cfg.table
+        # --- per-packet probe + slot selection (the ONE probe-math
+        # copy, shared with assign_slots — cross-path slot decisions
+        # must stay bit-identical) ---
         n = table.key.shape[0]
-        mask = jnp.uint32(n - 1)
-        p = tcfg.probes
-        h1 = hashtable.hash_u32(key, tcfg.salt)
-        stp = (hashtable.hash_u32(key ^ jnp.uint32(0x9E3779B9), tcfg.salt)
-               | jnp.uint32(1))
-        offs = jnp.arange(p, dtype=jnp.uint32)
-        slots = ((h1[:, None] + offs[None, :] * stp[:, None]) & mask
-                 ).astype(jnp.int32)
-        cand_key = table.key[slots]
-        cand_seen = table.last_seen[slots]
-        match = cand_key == key[:, None]
-        empty = cand_key == hashtable.EMPTY_KEY
-        stale = (~match) & (~empty) & (now - cand_seen > tcfg.stale_s)
-        probe_idx = jnp.arange(p, dtype=jnp.int32)[None, :]
-        pscore = jnp.where(
-            match, probe_idx,
-            jnp.where(empty, p + probe_idx,
-                      jnp.where(stale, 2 * p + probe_idx, 4 * p)))
-        best = jnp.argmin(pscore, axis=1)
-        best_score = jnp.take_along_axis(pscore, best[:, None], axis=1)[:, 0]
-        slot = jnp.take_along_axis(slots, best[:, None], axis=1)[:, 0]
-        found = batch.valid & (best_score < p)
-        usable = batch.valid & (best_score < 4 * p)
+        pr = hashtable.probe_slots(table.key, table.last_seen, key,
+                                   batch.valid, now, cfg.table)
+        slot, found, usable = pr.slot, pr.found, pr.usable
 
         # --- the one sort: (slot-priority, key), carrying iota --------
         slot_pri = jnp.where(

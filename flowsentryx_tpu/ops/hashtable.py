@@ -66,32 +66,41 @@ class SlotAssignment(NamedTuple):
     tracked: jnp.ndarray   # [R] bool: found | inserted (and won arbitration)
 
 
-def assign_slots(
+class ProbeResult(NamedTuple):
+    """Per-key slot selection, BEFORE any batch-internal arbitration."""
+
+    slot: jnp.ndarray    # [R] int32 selected table row
+    found: jnp.ndarray   # [R] bool: exact key match at slot
+    usable: jnp.ndarray  # [R] bool: match, empty, or stale-reclaimable
+
+
+def probe_slots(
     table_key: jnp.ndarray,
     table_last_seen: jnp.ndarray,
-    rep_key: jnp.ndarray,
-    rep_valid: jnp.ndarray,
+    key: jnp.ndarray,
+    valid: jnp.ndarray,
     now: jnp.ndarray,
     cfg: TableConfig,
-) -> SlotAssignment:
-    """Find-or-claim a table slot for each representative key.
+) -> ProbeResult:
+    """Double-hashed probe + claim-priority selection for each key.
 
-    Probe sequence: double hashing ``(h1 + p·step) mod N`` with an odd
-    ``step`` derived from a second hash — odd step sizes generate the
-    full ring for power-of-two ``N``, so probes don't clump the way
-    linear probing does under adversarial many-IP floods.
+    THE one copy of the probe math: :func:`assign_slots` (per-flow,
+    sharded path) and the single-sort fused step (per-packet) both call
+    it, so their slot decisions cannot drift — the cross-path parity
+    test relies on bit-identical selection.
 
-    Claim priority per flow: exact match > first empty > stalest
-    reclaimable slot.  All candidates are examined in one ``[R, P]``
-    gather; selection is ``argmin`` over a priority score — branch-free.
-    """
+    Probe sequence: ``(h1 + p·step) mod N`` with an odd ``step`` from a
+    second salted hash — odd steps generate the full ring for
+    power-of-two ``N``, so probes don't clump under adversarial floods.
+    Claim priority per key: exact match > first empty > earliest stale
+    reclaimable.  All candidates are examined in one ``[R, P]`` gather;
+    selection is ``argmin`` over a priority score — branch-free."""
     n = table_key.shape[0]
     mask = jnp.uint32(n - 1)
-    r = rep_key.shape[0]
     p = cfg.probes
 
-    h1 = hash_u32(rep_key, cfg.salt)
-    step = (hash_u32(rep_key ^ jnp.uint32(0x9E3779B9), cfg.salt)
+    h1 = hash_u32(key, cfg.salt)
+    step = (hash_u32(key ^ jnp.uint32(0x9E3779B9), cfg.salt)
             | jnp.uint32(1))
     offs = jnp.arange(p, dtype=jnp.uint32)  # [P]
     slots = (h1[:, None] + offs[None, :] * step[:, None]) & mask  # [R, P]
@@ -100,7 +109,7 @@ def assign_slots(
     cand_key = table_key[slots]            # [R, P] gather
     cand_seen = table_last_seen[slots]     # [R, P]
 
-    match = cand_key == rep_key[:, None]
+    match = cand_key == key[:, None]
     empty = cand_key == EMPTY_KEY
     stale = (~match) & (~empty) & (now - cand_seen > cfg.stale_s)
 
@@ -120,8 +129,27 @@ def assign_slots(
     best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
     slot = jnp.take_along_axis(slots, best[:, None], axis=1)[:, 0]
 
-    found = rep_valid & (best_score < p)
-    usable = rep_valid & (best_score < 4 * p)
+    found = valid & (best_score < p)
+    usable = valid & (best_score < 4 * p)
+    return ProbeResult(slot=slot, found=found, usable=usable)
+
+
+def assign_slots(
+    table_key: jnp.ndarray,
+    table_last_seen: jnp.ndarray,
+    rep_key: jnp.ndarray,
+    rep_valid: jnp.ndarray,
+    now: jnp.ndarray,
+    cfg: TableConfig,
+) -> SlotAssignment:
+    """Find-or-claim a table slot for each representative key (probe
+    math shared with the fused step via :func:`probe_slots`)."""
+    n = table_key.shape[0]
+    r = rep_key.shape[0]
+
+    pr = probe_slots(table_key, table_last_seen, rep_key, rep_valid,
+                     now, cfg)
+    slot, found, usable = pr.slot, pr.found, pr.usable
     inserted = usable & ~found
 
     # --- batch-internal arbitration: one winner per claimed slot -----------
@@ -133,8 +161,9 @@ def assign_slots(
     # replaces the previous two-pass lexsort with a single sort pass —
     # the sort is the arbitration's whole cost on TPU.  Ties among
     # same-priority claimants break arbitrarily (exactly one wins,
-    # which is all correctness needs).  slot < capacity <= 2^30 keeps
-    # the packed key inside int32.
+    # which is all correctness needs).  The parked sentinel 2n must
+    # also fit int32, so capacity <= 2^29 (enforced by TableConfig; a
+    # 2^29-row table is already ~26 GB of state).
     slot_for_sort = jnp.where(usable, slot, jnp.int32(n))  # park unusable at n
     packed = slot_for_sort * 2 + (~found).astype(jnp.int32)
     order = jnp.argsort(packed)

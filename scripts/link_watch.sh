@@ -27,6 +27,16 @@ for _ in $(seq 1 60); do
     FSX_BENCH_NO_MERGE=1 timeout 760 python bench.py --budget-s 700 \
       2>"/tmp/bench_attempt_r05_$ts.log" | tail -1 \
       > "artifacts/bench_attempt_r05_$ts.json"
+    # a timed-out/empty attempt must not consume one of the three
+    # attempt slots: demote files without a usable TPU value
+    if ! python -c "
+import json,sys
+d = json.load(open('artifacts/bench_attempt_r05_$ts.json'))
+sys.exit(0 if d.get('value') and d.get('backend') not in (None,'cpu') else 1)
+" 2>/dev/null; then
+      mv "artifacts/bench_attempt_r05_$ts.json" \
+         "artifacts/bench_attempt_r05_$ts.failed" 2>/dev/null
+    fi
     echo "{\"ts\": $(date +%s), \"event\": \"bench_attempt_done\", \"file\": \"bench_attempt_r05_$ts.json\"}" >> "$MON"
   fi
   sleep 400

@@ -99,79 +99,53 @@ def main() -> int:
             step_don, jax.device_put(schema.make_table(CAP)),
             jax.device_put(schema.make_stats()), raws)
 
-        # undonated twin (isolates the copy cost)
-        step_full = fused.make_jitted_compact_step(
-            cfg, spec.classify_batch, donate=False, **quant)
-        table = jax.device_put(schema.make_table(CAP))
-        stats = jax.device_put(schema.make_stats())
-        variants["full"] = time_step(step_full, table, stats, raws)
-
-        # ablations via monkeypatching (separate jit builds)
+        # Ablations of the SINGLE-SORT step (fused.make_step): all
+        # donated so the deltas isolate the targeted component, not
+        # state-copy overhead.  Semantics of ablated variants are
+        # deliberately wrong — only the timing is meaningful.
         import flowsentryx_tpu.ops.hashtable as ht
-        import flowsentryx_tpu.ops.agg as agg
 
-        orig_assign = ht.assign_slots
-        orig_seg = agg.segment_by_key
+        # (a) no_sort: lax.sort passthrough — isolates the one sort
+        # pass (the step's only data-dependent reordering).
+        orig_sort = jax.lax.sort
 
-        def assign_no_arb(table_key, table_last_seen, rep_key, rep_valid,
-                          now, tcfg):
-            n = table_key.shape[0]
-            mask = jnp.uint32(n - 1)
-            r = rep_key.shape[0]
-            p = tcfg.probes
-            h1 = ht.hash_u32(rep_key, tcfg.salt)
-            stp = (ht.hash_u32(rep_key ^ jnp.uint32(0x9E3779B9), tcfg.salt)
-                   | jnp.uint32(1))
-            offs = jnp.arange(p, dtype=jnp.uint32)
-            slots = ((h1[:, None] + offs[None, :] * stp[:, None]) & mask
-                     ).astype(jnp.int32)
-            cand_key = table_key[slots]
-            cand_seen = table_last_seen[slots]
-            match = cand_key == rep_key[:, None]
-            empty = cand_key == ht.EMPTY_KEY
-            stale = (~match) & (~empty) & (now - cand_seen > tcfg.stale_s)
-            probe_idx = jnp.arange(p, dtype=jnp.int32)[None, :]
-            score = jnp.where(
-                match, probe_idx,
-                jnp.where(empty, p + probe_idx,
-                          jnp.where(stale, 2 * p + probe_idx, 4 * p)))
-            best = jnp.argmin(score, axis=1)
-            best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
-            slot = jnp.take_along_axis(slots, best[:, None], axis=1)[:, 0]
-            found = rep_valid & (best_score < p)
-            usable = rep_valid & (best_score < 4 * p)
-            inserted = usable & ~found
-            return ht.SlotAssignment(slot=slot, found=found,
-                                     inserted=inserted, tracked=usable)
+        def sort_passthrough(operands, dimension=-1, is_stable=True,
+                             num_keys=1):
+            return operands
 
         try:
-            ht.assign_slots = assign_no_arb
-            step_na = fused.make_jitted_compact_step(
-                cfg, spec.classify_batch, donate=False, **quant)
-            variants["no_arb"] = time_step(
-                step_na, jax.device_put(schema.make_table(CAP)),
-                jax.device_put(schema.make_stats()), raws)
-        finally:
-            ht.assign_slots = orig_assign
-
-        def seg_identity(k):
-            bsz = k.shape[0]
-            idx = jnp.arange(bsz, dtype=jnp.int32)
-            return agg.KeySegments(
-                order=idx, sorted_key=k, heads=jnp.ones((bsz,), bool),
-                seg=idx, inv=idx)
-
-        try:
-            agg.segment_by_key = seg_identity
+            jax.lax.sort = sort_passthrough
             step_ns = fused.make_jitted_compact_step(
-                cfg, spec.classify_batch, donate=False, **quant)
-            variants["no_agg_sort"] = time_step(
+                cfg, spec.classify_batch, donate=True, **quant)
+            variants["no_sort"] = time_step(
                 step_ns, jax.device_put(schema.make_table(CAP)),
                 jax.device_put(schema.make_stats()), raws)
         finally:
-            agg.segment_by_key = orig_seg
+            jax.lax.sort = orig_sort
 
-        # decode + classify only
+        # (b) no_probe: identity slot selection — isolates the [B, P]
+        # table-candidate gather + claim scoring.
+        orig_probe = ht.probe_slots
+
+        def probe_identity(table_key, table_last_seen, key, valid, now,
+                           tcfg):
+            n = table_key.shape[0]
+            idx = jnp.arange(key.shape[0], dtype=jnp.int32) % n
+            return ht.ProbeResult(slot=idx, found=jnp.zeros_like(valid),
+                                  usable=valid)
+
+        try:
+            ht.probe_slots = probe_identity
+            step_np = fused.make_jitted_compact_step(
+                cfg, spec.classify_batch, donate=True, **quant)
+            variants["no_probe"] = time_step(
+                step_np, jax.device_put(schema.make_table(CAP)),
+                jax.device_put(schema.make_stats()), raws)
+        finally:
+            ht.probe_slots = orig_probe
+
+        # (c) decode + classify only (donated, table returned as the
+        # same aliased buffer — no copy inflating the baseline)
         def classify_only(table, stats, p_, raw):
             batch = schema.decode_compact(raw, **quant)
             score = spec.classify_batch(p_, batch.feat)
@@ -180,7 +154,7 @@ def main() -> int:
                 block_key=batch.key, block_until=score, now=jnp.max(batch.ts))
             return table, stats, out_
 
-        step_cl = jax.jit(classify_only)
+        step_cl = jax.jit(classify_only, donate_argnums=(0, 1))
         variants["classify"] = time_step(
             step_cl, jax.device_put(schema.make_table(CAP)),
             jax.device_put(schema.make_stats()), raws)

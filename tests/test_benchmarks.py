@@ -74,7 +74,39 @@ class TestCheckpoint:
         with pytest.raises(ValueError):
             e2.restore(path)
 
-    def test_salt_mismatch_rejected_and_peekable(self, tmp_path):
+    def test_pre_byte_bucket_checkpoint_refills_credit(self, tmp_path):
+        """A snapshot that predates the byte bucket (no tok_bytes
+        column) must restore occupied slots with FULL byte credit under
+        a byte-limited config — zero credit would spuriously rate-block
+        every restored flow's first batch."""
+        import numpy as np
+
+        from flowsentryx_tpu.core.config import LimiterKind
+
+        cfg = FsxConfig(
+            table=TableConfig(capacity=1 << 12),
+            batch=BatchConfig(max_batch=256),
+            limiter=LimiterConfig(kind=LimiterKind.TOKEN_BUCKET,
+                                  bucket_rate_bps=1e4,
+                                  bucket_burst_bytes=5e4),
+        )
+        e1 = Engine(cfg, TrafficSource(TrafficSpec(seed=4), total=512),
+                    CollectSink())
+        e1.run()
+        path = e1.checkpoint(tmp_path / "old.npz")
+        # strip the tok_bytes column, emulating an r4-era snapshot
+        with np.load(path) as z:
+            d = {k: z[k] for k in z.files if k != "table_tok_bytes"}
+        np.savez_compressed(path, **d)
+
+        e2 = Engine(cfg, TrafficSource(TrafficSpec(seed=4), total=256),
+                    CollectSink())
+        e2.restore(path)
+        occ = np.asarray(e2.table.key) != 0
+        assert occ.any()
+        tb = np.asarray(e2.table.tok_bytes)
+        assert (tb[occ] == 5e4).all()   # full burst, not zero
+        assert (tb[~occ] == 0).all()
         """A checkpoint's slot layout is a function of the hash salt:
         restoring under a different salt must refuse (it would
         mislocate every key), and peek_salt lets a server adopt the
