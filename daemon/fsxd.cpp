@@ -85,6 +85,9 @@ struct Options {
     // pass 0 0 to disable (packet-count only).
     uint64_t bucket_rate_bps = 125000000;
     uint64_t bucket_burst_bytes = 250000000;
+    // stateless firewall rules: (packed key, action) pairs from
+    // --rule proto:dport (key = (proto << 16) | dport, 0 = wildcard)
+    std::vector<std::pair<uint32_t, uint64_t>> rules;
     bool compact = false;              // 16 B kernel-quantized records
 };
 
@@ -108,6 +111,9 @@ struct Options {
                  "  --pps-threshold N --bps-threshold N --window S --block S\n"
                  "  --bucket-rate N --bucket-burst N\n"
                  "  --bucket-rate-bytes N --bucket-burst-bytes N\n"
+                 "  --rule PROTO:DPORT    stateless drop rule (repeatable;\n"
+                 "                        proto any/tcp/udp/icmp[v6]/number,\n"
+                 "                        dport 0 = any)\n"
                  "                        byte dimension (default 125 MB/s, 250 MB burst; 0 0 = off)\n"
                  "  --compact             16 B kernel-quantized records (the image\n"
                  "                        must be emitted with --compact too)\n",
@@ -152,6 +158,7 @@ fsx_stats read_stats(int stats_fd) {
             total.dropped_blacklist += s.dropped_blacklist;
             total.dropped_rate += s.dropped_rate;
             total.dropped_ml += s.dropped_ml;
+            total.dropped_rule += s.dropped_rule;
         }
     }
     return total;
@@ -180,11 +187,23 @@ int run_bpf(const Options &o) {
     cfg.bucket_burst = o.bucket_burst;
     cfg.bucket_rate_bps = o.bucket_rate_bps;
     cfg.bucket_burst_bytes = o.bucket_burst_bytes;
+    cfg.rule_count = o.rules.size();
     uint32_t zero = 0;
     if (fsxbpf::map_update(lp.map_fd("config_map"), &zero, &cfg) < 0) {
         std::perror("fsxd: config_map update");
         return 1;
     }
+    for (const auto &r : o.rules) {
+        uint32_t key = r.first;
+        uint64_t act = r.second;
+        if (fsxbpf::map_update(lp.map_fd("rule_map"), &key, &act) < 0) {
+            std::perror("fsxd: rule_map update");
+            return 1;
+        }
+    }
+    if (!o.rules.empty())
+        std::fprintf(stderr, "fsxd: %zu firewall rule(s) pushed\n",
+                     o.rules.size());
 
     int link_fd = -1;
     if (o.iface != "none") {
@@ -311,11 +330,12 @@ int run_bpf(const Options &o) {
                 ", \"skipped\": %" PRIu64
                 ", \"allowed\": %" PRIu64 ", \"dropped_blacklist\": %" PRIu64
                 ", \"dropped_rate\": %" PRIu64 ", \"dropped_ml\": %" PRIu64
+                ", \"dropped_rule\": %" PRIu64
                 "}\n",
                 forwarded, verdicts, dropped_ring_full, rb.skipped,
                 (uint64_t)s.allowed,
                 (uint64_t)s.dropped_blacklist, (uint64_t)s.dropped_rate,
-                (uint64_t)s.dropped_ml);
+                (uint64_t)s.dropped_ml, (uint64_t)s.dropped_rule);
     if (link_fd >= 0)
         ::close(link_fd);
     return 0;
@@ -365,6 +385,35 @@ Options parse(int argc, char **argv) {
             o.bucket_rate_bps = std::stoull(next());
         else if (a == "--bucket-burst-bytes")
             o.bucket_burst_bytes = std::stoull(next());
+        else if (a == "--rule") {
+            std::string spec = next();
+            auto colon = spec.find(':');
+            if (colon == std::string::npos)
+                usage(argv[0]);
+            std::string p = spec.substr(0, colon);
+            uint32_t proto;
+            if (p == "any") proto = 0;
+            else if (p == "icmp") proto = 1;
+            else if (p == "tcp") proto = 6;
+            else if (p == "udp") proto = 17;
+            else if (p == "icmpv6") proto = 58;
+            else {
+                try {
+                    proto = (uint32_t)std::stoul(p);
+                } catch (const std::exception &) {
+                    usage(argv[0]);
+                }
+            }
+            uint32_t dport;
+            try {
+                dport = (uint32_t)std::stoul(spec.substr(colon + 1));
+            } catch (const std::exception &) {
+                usage(argv[0]);
+            }
+            if (proto > 255 || dport > 65535 || (proto == 0 && dport == 0))
+                usage(argv[0]);
+            o.rules.emplace_back((proto << 16) | dport, 1 /*FSX_RULE_DROP*/);
+        }
         else if (a == "--feature-ring")
             o.feature_ring = next();
         else if (a == "--verdict-ring")

@@ -263,15 +263,24 @@ def run_scaling(
         jax.block_until_ready(out.verdict)
         compile_s = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        for i in range(iters):
+        # Per-step timing with the warmup discarded by MEDIAN, not by a
+        # fixed count: the first donated steps pay allocator churn that
+        # has measured as high as ~100x a steady step on the CPU
+        # backend — an average over a short loop reports the allocator,
+        # not the step.
+        times = []
+        for i in range(max(iters, 25)):
+            t0 = time.perf_counter()
             table, stats, out = step(table, stats, params, raws[i % len(raws)])
-        jax.block_until_ready(out.verdict)
-        dt = (time.perf_counter() - t0) / iters
+            jax.block_until_ready(out.verdict)
+            times.append(time.perf_counter() - t0)
+        steady = times[len(times) // 3:]
+        dt = float(np.median(steady))
         results.append({
             "devices": n,
             "compile_s": round(compile_s, 2),
             "step_ms": round(dt * 1e3, 2),
+            "warmup_max_ms": round(max(times[:len(times) // 3]) * 1e3, 1),
             "records_per_s": round(batch / dt, 0),
             "mpps": round(batch / dt / 1e6, 3),
         })

@@ -24,6 +24,7 @@ import sys
 from dataclasses import dataclass
 
 from flowsentryx_tpu.bpf import progs
+from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.bpf.asm import Program
 
 MAGIC = int.from_bytes(b"FSXPROG1", "little")
@@ -55,7 +56,8 @@ def emit(prog: Program | None = None,
     for name in names:
         mtype, ks, vs, ent = progs.MAP_SPECS[name]
         n = {"one": 1, "ips": sizes.max_track_ips,
-             "ring": sizes.ring_bytes}[ent]
+             "ring": sizes.ring_bytes,
+             "rules": schema.MAX_RULES}[ent]
         specs.append(ImageMap(name, mtype, ks, vs, n))
     out = [_HDR.pack(MAGIC, VERSION, len(specs), len(prog.relocs),
                      len(prog.insns))]

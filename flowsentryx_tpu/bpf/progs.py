@@ -34,6 +34,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from flowsentryx_tpu.bpf import loader
+from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.bpf.asm import Asm, Program
 from flowsentryx_tpu.bpf.isa import (
     BPF_ADD, BPF_AND, BPF_B, BPF_DIV, BPF_DW, BPF_H, BPF_JEQ, BPF_JGE,
@@ -61,8 +62,9 @@ CFG_BUCKET_RATE_PPS = 40
 CFG_BUCKET_BURST = 48
 CFG_BUCKET_RATE_BPS = 56
 CFG_BUCKET_BURST_BYTES = 64
-CFG_HASH_SALT = 72      # user-plane salt; BPF maps hash internally
-CFG_SIZE = 80
+CFG_RULE_COUNT = 72     # 0 = skip the firewall-rule lookups
+CFG_HASH_SALT = 80      # user-plane salt; BPF maps hash internally
+CFG_SIZE = 88
 
 # struct fsx_ip_state
 IPS_WIN_START_NS = 0
@@ -101,7 +103,8 @@ ST_ALLOWED = 0
 ST_DROPPED_BLACKLIST = 8
 ST_DROPPED_RATE = 16
 ST_DROPPED_ML = 24
-ST_SIZE = 32
+ST_DROPPED_RULE = 32
+ST_SIZE = 40
 
 # flags (core.schema.FLAG_*)
 FLAG_IPV6, FLAG_TCP_SYN, FLAG_TCP, FLAG_UDP, FLAG_ICMP = 1, 2, 4, 8, 16
@@ -152,6 +155,9 @@ MAP_SPECS = {
     "flow_stats_map": (loader.MAP_TYPE_LRU_HASH, 4, FS_SIZE, "ips"),
     "stats_map": (loader.MAP_TYPE_PERCPU_ARRAY, 4, ST_SIZE, "one"),
     "feature_ring": (loader.MAP_TYPE_RINGBUF, 0, 0, "ring"),
+    # stateless firewall rules (kern/fsx_kern.c rule_map): key packs
+    # (proto << 16) | dport host-order, 0 = wildcard; value = action
+    "rule_map": (loader.MAP_TYPE_HASH, 4, 8, "rules"),
 }
 
 
@@ -160,7 +166,8 @@ def create_maps(sizes: MapSizes = MapSizes()) -> dict[str, loader.Map]:
     out = {}
     for name, (mtype, ks, vs, ent) in MAP_SPECS.items():
         n = {"one": 1, "ips": sizes.max_track_ips,
-             "ring": sizes.ring_bytes}[ent]
+             "ring": sizes.ring_bytes,
+             "rules": schema.MAX_RULES}[ent]
         out[name] = loader.map_create(mtype, ks, vs, n, name)
     return out
 
@@ -402,11 +409,59 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64_imm(BPF_ADD, R4, 8)  # sizeof(icmphdr) == sizeof(icmp6hdr)
     a.jmp_reg(BPF_JGT, R4, R3, "drop")
 
+    # ---- stateless firewall rules (kern/fsx_kern.c rule gate; the
+    # reference's planned "basic firewall", README.md:70-74): exact
+    # (proto, dport), then (proto, *), then (*, dport) — before any
+    # per-IP state is touched.  Gated on cfg->rule_count, so rule-less
+    # deployments pay one load + one jump.  Each lookup clobbers
+    # r1-r5, so every key recomputes from the S_L4/S_DPORT slots. ------
+    a.label("parsed")
+    a += ldx(BPF_DW, R1, R6, CFG_RULE_COUNT)
+    a.jmp_imm(BPF_JEQ, R1, 0, "bl_gate")
+
+    def _rule_key(with_proto: bool, with_port: bool) -> None:
+        # build the u32 key in the low half of S_VAL64
+        nonlocal a
+        if with_port:
+            # host-order dport from the BE u16 on the stack
+            a += ldx(BPF_DW, R1, R10, S_DPORT)
+            a += mov64(R2, R1)
+            a += alu64_imm(BPF_AND, R1, 0xFF)
+            a += alu64_imm(BPF_LSH, R1, 8)
+            a += alu64_imm(BPF_RSH, R2, 8)
+            a += alu64_imm(BPF_AND, R2, 0xFF)
+            a += alu64(BPF_OR, R1, R2)
+        else:
+            a += mov64_imm(R1, 0)
+        if with_proto:
+            a += ldx(BPF_DW, R2, R10, S_L4)
+            a += alu64_imm(BPF_LSH, R2, 16)
+            a += alu64(BPF_OR, R1, R2)
+        a += stx(BPF_W, R10, S_VAL64, R1)
+        a.ld_map(R1, "rule_map")
+        a += mov64(R2, R10)
+        a += alu64_imm(BPF_ADD, R2, S_VAL64)
+        a += call(FN_map_lookup_elem)
+
+    _rule_key(True, True)
+    a.jmp_imm(BPF_JNE, R0, 0, "rule_hit")
+    _rule_key(True, False)
+    a.jmp_imm(BPF_JNE, R0, 0, "rule_hit")
+    _rule_key(False, True)
+    a.jmp_imm(BPF_JEQ, R0, 0, "bl_gate")
+    a.label("rule_hit")
+    a += ldx(BPF_DW, R1, R0, 0)
+    a.jmp_imm(BPF_JNE, R1, 1, "bl_gate")  # FSX_RULE_DROP
+    a += ldx(BPF_DW, R1, R8, ST_DROPPED_RULE)
+    a += alu64_imm(BPF_ADD, R1, 1)
+    a += stx(BPF_DW, R8, ST_DROPPED_RULE, R1)
+    a.ja("drop_counted")
+
     # ---- blacklist gate with TTL expiry (fsx_kern.c:222-233).
     # v6 checks the EXACT 128-bit map first (reference blacklist_v6
     # parity, src/fsx_kern.c:159-166); both then fall through to the
     # folded map, which carries the TPU plane's ML verdicts. ----------
-    a.label("parsed")
+    a.label("bl_gate")
     a += ldx(BPF_DW, R1, R10, S_IS6)
     a.jmp_imm(BPF_JEQ, R1, 0, "bl_fold")  # v4: no exact-v6 gate
     a.ld_map(R1, "blacklist_v6")

@@ -1,0 +1,99 @@
+"""Live firewall-rule management over the pinned ``rule_map``.
+
+The reference planned "Dynamic Rule Management ... adding or removing
+IP addresses from the blocklist" and "config files ... rules to drop
+certain packets" (``README.md:70-74,142-147``); blacklist.py covers the
+per-IP half, this module the (proto, dport) stateless-rule half.  Keys
+pack ``(l4_proto << 16) | dport`` host-order with 0 as wildcard
+(:func:`flowsentryx_tpu.core.schema.pack_rule_key`), values are
+``schema.RULE_*`` action codes — the exact layout both kernel twins
+probe per packet.
+
+NOTE: adding a rule at runtime also requires the config map's
+``rule_count`` to be nonzero (the kernel gates the lookups on it);
+``fsxd --rule`` sets it at load time, and :func:`set_enabled` flips it
+live for rules added post-start.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from flowsentryx_tpu.bpf import loader
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import FsxConfig, RuleConfig
+
+DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
+
+_PROTO_NAMES = {0: "any", 1: "icmp", 6: "tcp", 17: "udp", 58: "icmpv6"}
+
+
+@dataclass
+class Rule:
+    proto: int
+    dport: int
+    action: int
+
+    def to_json(self) -> dict:
+        return {
+            "proto": _PROTO_NAMES.get(self.proto, self.proto),
+            "dport": self.dport or "any",
+            "action": "drop" if self.action == schema.RULE_DROP
+            else self.action,
+        }
+
+
+def open_map(pin_dir: str = DEFAULT_PIN_DIR) -> loader.Map:
+    fd = loader.obj_get(f"{pin_dir}/rule_map")
+    return loader.Map(fd, loader.MAP_TYPE_HASH, 4, 8, 0, "rule_map")
+
+
+def entries(m: loader.Map) -> list[Rule]:
+    out = []
+    for kb in m.keys():
+        vb = m.lookup(kb)
+        if vb is None:
+            continue
+        key = struct.unpack("<I", kb)[0]
+        out.append(Rule(proto=(key >> 16) & 0xFF, dport=key & 0xFFFF,
+                        action=struct.unpack("<Q", vb)[0]))
+    return sorted(out, key=lambda r: (r.proto, r.dport))
+
+
+def add(m: loader.Map, spec: str) -> Rule:
+    """Insert a ``proto:dport`` drop rule (proto name/number/'any',
+    dport 0 = any) — RuleConfig does the validation."""
+    proto_s, _, dport_s = spec.partition(":")
+    rule = RuleConfig(proto=proto_s if not proto_s.isdigit() else int(proto_s),
+                      dport=int(dport_s or 0))
+    m.update(struct.pack("<I", rule.key()),
+             struct.pack("<Q", schema.RULE_DROP))
+    return Rule(proto=rule.proto_code(), dport=rule.dport,
+                action=schema.RULE_DROP)
+
+
+def remove(m: loader.Map, spec: str) -> bool:
+    proto_s, _, dport_s = spec.partition(":")
+    rule = RuleConfig(proto=proto_s if not proto_s.isdigit() else int(proto_s),
+                      dport=int(dport_s or 0))
+    return bool(m.delete(struct.pack("<I", rule.key())))
+
+
+def set_enabled(pin_dir: str, count: int) -> None:
+    """Update ``rule_count`` in the pinned config map so runtime-added
+    rules take effect (the kernel gate; module docstring)."""
+    fd = loader.obj_get(f"{pin_dir}/config_map")
+    m = loader.Map(fd, loader.MAP_TYPE_ARRAY, 4,
+                   FsxConfig.KERNEL_CONFIG_SIZE, 0, "config_map")
+    try:
+        blob = m.lookup(struct.pack("<I", 0))
+        if blob is None:
+            raise RuntimeError("no config pushed yet (daemon not started?)")
+        vals = list(struct.unpack(FsxConfig.KERNEL_CONFIG_FMT, blob))
+        # rule_count is the second-to-last field (KERNEL_CONFIG_FIELDS)
+        vals[-2] = count
+        m.update(struct.pack("<I", 0),
+                 struct.pack(FsxConfig.KERNEL_CONFIG_FMT, *vals))
+    finally:
+        m.close()

@@ -108,6 +108,37 @@ def _cmd_blacklist(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_rules(args: argparse.Namespace) -> int:
+    """List / add / remove live stateless-firewall rules (the
+    reference's planned dynamic rule management, README.md:70-74,
+    142-147; per-IP rules live under ``fsx block``)."""
+    from flowsentryx_tpu.bpf import rules
+
+    m = rules.open_map(args.pin)
+    try:
+        if args.add:
+            r = rules.add(m, args.add)
+            rules.set_enabled(args.pin, len(rules.entries(m)))
+            print(json.dumps({"added": r.to_json()}))
+            return 0
+        if args.remove:
+            ok = rules.remove(m, args.remove)
+            rules.set_enabled(args.pin, len(rules.entries(m)))
+            print(json.dumps({"removed": bool(ok)}))
+            return 0
+        ents = [r.to_json() for r in rules.entries(m)]
+        if args.json:
+            print(json.dumps({"entries": ents}))
+        else:
+            print(f"{'proto':>8}  {'dport':>6}  action")
+            for e in ents:
+                print(f"{e['proto']:>8}  {e['dport']:>6}  {e['action']}")
+            print(f"{len(ents)} rule{'' if len(ents) == 1 else 's'}")
+    finally:
+        m.close()
+    return 0
+
+
 def _honor_jax_platform() -> None:
     """Some TPU plugins force-register themselves regardless of
     JAX_PLATFORMS; honor an explicit env request through the config API
@@ -519,6 +550,16 @@ def build_parser() -> argparse.ArgumentParser:
     bl.add_argument("--clear", action="store_true",
                     help="delete every entry")
     bl.set_defaults(fn=_cmd_blacklist)
+
+    ru = sub.add_parser("rules",
+                        help="list/add/remove stateless firewall rules")
+    ru.add_argument("--pin", default=DEFAULT_PIN_DIR)
+    ru.add_argument("--json", action="store_true")
+    ru.add_argument("--add", metavar="PROTO:DPORT",
+                    help="insert a drop rule (proto any/tcp/udp/icmp[v6]"
+                         "/number; dport 0 = any)")
+    ru.add_argument("--remove", metavar="PROTO:DPORT")
+    ru.set_defaults(fn=_cmd_rules)
 
     s = sub.add_parser("serve", help="run the serving engine")
     s.add_argument("--config", help="JSON config file")

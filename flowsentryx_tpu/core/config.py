@@ -78,6 +78,50 @@ class LimiterConfig:
             )
 
 
+#: L4 protocol names accepted in rules (number literals also work).
+_PROTO_CODES = {"any": 0, "icmp": 1, "tcp": 6, "udp": 17, "icmpv6": 58}
+
+
+@dataclass(frozen=True)
+class RuleConfig:
+    """One stateless-firewall drop rule — the reference's planned
+    "basic firewall ... config files ... rules to drop certain packets"
+    (``README.md:70-74``), enforced in the kernel data plane before any
+    per-IP state is touched.
+
+    ``proto``/``dport`` of 0 (or ``"any"``) are wildcards; at least one
+    must be concrete.  Matching precedence per packet: exact
+    (proto, dport), then (proto, any-port), then (any-proto, dport).
+    """
+
+    proto: str | int = "any"   # "tcp"/"udp"/"icmp"/"icmpv6"/number/"any"
+    dport: int = 0             # 0 = any
+    action: str = "drop"
+
+    def __post_init__(self) -> None:
+        if self.action != "drop":
+            raise ValueError(f"unknown rule action {self.action!r}")
+        if not 0 <= self.dport <= 65535:
+            raise ValueError("dport must be 0..65535")
+        if self.proto_code() == 0 and self.dport == 0:
+            raise ValueError("a rule needs a concrete proto or dport")
+
+    def proto_code(self) -> int:
+        if isinstance(self.proto, int):
+            if not 0 <= self.proto <= 255:
+                raise ValueError("proto number must be 0..255")
+            return self.proto
+        try:
+            return _PROTO_CODES[self.proto.lower()]
+        except KeyError:
+            raise ValueError(f"unknown protocol {self.proto!r}") from None
+
+    def key(self) -> int:
+        from flowsentryx_tpu.core import schema
+
+        return schema.pack_rule_key(self.proto_code(), self.dport)
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """Classifier selection + decision policy."""
@@ -188,7 +232,18 @@ class FsxConfig:
     table: TableConfig = field(default_factory=TableConfig)
     batch: BatchConfig = field(default_factory=BatchConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    #: Stateless firewall rules (kernel plane; RuleConfig docstring)
+    rules: tuple[RuleConfig, ...] = ()
     interface: str = "eth0"             # XDP attach point
+
+    def __post_init__(self) -> None:
+        from flowsentryx_tpu.core import schema
+
+        if len(self.rules) > schema.MAX_RULES:
+            raise ValueError(f"at most {schema.MAX_RULES} rules")
+        keys = [r.key() for r in self.rules]
+        if len(set(keys)) != len(keys):
+            raise ValueError("duplicate (proto, dport) rule")
 
     # -- JSON round-trip ----------------------------------------------------
 
@@ -199,6 +254,8 @@ class FsxConfig:
                         for f in dataclasses.fields(obj)}
             if isinstance(obj, enum.Enum):
                 return obj.value
+            if isinstance(obj, (list, tuple)):
+                return [enc(x) for x in obj]
             return obj
 
         return enc(self)
@@ -211,6 +268,10 @@ class FsxConfig:
         import typing
 
         def dec(tp: type, v: Any) -> Any:
+            origin = typing.get_origin(tp)
+            if origin in (tuple, list):
+                elem = typing.get_args(tp)[0]
+                return tuple(dec(elem, x) for x in v)
             if dataclasses.is_dataclass(tp):
                 hints = typing.get_type_hints(tp)
                 names = {f.name for f in dataclasses.fields(tp)}
@@ -249,6 +310,8 @@ class FsxConfig:
         ("bucket_rate_bps", "u64", "byte-bucket refill rate (bytes/s);"
          " 0 with 0 depth = byte dimension off"),
         ("bucket_burst_bytes", "u64", "byte bucket depth (bytes)"),
+        ("rule_count", "u64", "number of stateless firewall rules pushed"
+         " into rule_map; 0 skips the rule lookups entirely"),
         ("hash_salt", "u64", "salt for user-plane slot/owner hashing"
          " (low 32 bits used).  No kernel-side consumer exists: BPF maps"
          " hash internally with their own seed.  Carried in the blob so"
@@ -260,7 +323,7 @@ class FsxConfig:
     KERNEL_CONFIG_FMT = "<" + "".join(
         {"u32": "I", "u64": "Q"}[t] for _, t, _ in KERNEL_CONFIG_FIELDS
     )
-    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 80
+    KERNEL_CONFIG_SIZE = struct.calcsize(KERNEL_CONFIG_FMT)  # 88
 
     _KIND_CODE = {
         LimiterKind.FIXED_WINDOW: 0,
@@ -287,8 +350,16 @@ class FsxConfig:
             int(lim.bucket_burst),
             int(lim.bucket_rate_bps),
             int(lim.bucket_burst_bytes),
+            len(self.rules),
             int(self.table.salt),
         )
+
+    def rule_entries(self) -> list[tuple[int, int]]:
+        """``(key, action)`` pairs for the kernel rule map (key packing
+        in :func:`flowsentryx_tpu.core.schema.pack_rule_key`)."""
+        from flowsentryx_tpu.core import schema
+
+        return [(r.key(), schema.RULE_DROP) for r in self.rules]
 
 
 DEFAULT_CONFIG = FsxConfig()

@@ -158,7 +158,25 @@ KERNEL_STATS_FIELDS: tuple[tuple[str, str], ...] = (
     ("dropped_blacklist", "u64"),
     ("dropped_rate", "u64"),
     ("dropped_ml", "u64"),
+    ("dropped_rule", "u64"),
 )
+
+# ---------------------------------------------------------------------------
+# Stateless firewall rules (the reference's planned "basic firewall",
+# README.md:70-74: config-file rules to drop certain packets)
+# ---------------------------------------------------------------------------
+
+#: Kernel rule map capacity (exact + wildcard (proto,dport) entries).
+MAX_RULES = 1024
+#: Rule action codes (map value).
+RULE_DROP = 1
+
+
+def pack_rule_key(proto: int, dport: int) -> int:
+    """Rule-map key: ``(l4_proto << 16) | dport`` in HOST order, with 0
+    as the wildcard in either position — the exact packing the kernel
+    twins compute per packet."""
+    return ((proto & 0xFF) << 16) | (dport & 0xFFFF)
 
 
 # ---------------------------------------------------------------------------

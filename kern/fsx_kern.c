@@ -45,6 +45,19 @@ struct {
 	__type(value, struct fsx_config);
 } config_map SEC(".maps");
 
+/* Stateless firewall rules (the reference's planned "basic firewall"
+ * with config-file drop rules, README.md:70-74): key packs
+ * (l4_proto << 16) | dport in host order (0 = wildcard in either
+ * position), value = FSX_RULE_* action.  Pushed by user space
+ * (fsxd --rule / FsxConfig.rules); the per-packet lookups are gated on
+ * cfg->rule_count so rule-less deployments pay nothing. */
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, FSX_MAX_RULES);
+	__type(key, __u32);
+	__type(value, __u64);
+} rule_map SEC(".maps");
+
 /* Blacklist: key = folded source addr, value = blocked-until (ktime ns).
  * Serves v4 exactly and v6 approximately via the 32-bit fold; written by
  * this program (v4 rate limit) AND by the daemon (TPU verdict ingress,
@@ -280,6 +293,30 @@ int fsx(struct xdp_md *ctx)
 		return XDP_DROP;    /* malformed (fsx_kern.c:126) */
 	if (rc > 0)
 		return XDP_PASS;    /* non-IP (fsx_kern.c:130) */
+
+	/* 0. stateless firewall rules (planned "basic firewall",
+	 * reference README.md:70-74): exact (proto, dport), then
+	 * (proto, any-port), then (any-proto, dport).  Before any per-IP
+	 * state is touched — a dropped-by-rule packet must not feed the
+	 * limiter windows or the feature stream. */
+	if (cfg->rule_count) {
+		__u16 dport_h = fsx_htons(pkt.dport);
+		__u32 rk = ((__u32)pkt.l4_proto << 16) | dport_h;
+		__u64 *act = bpf_map_lookup_elem(&rule_map, &rk);
+
+		if (!act) {
+			rk = (__u32)pkt.l4_proto << 16;
+			act = bpf_map_lookup_elem(&rule_map, &rk);
+		}
+		if (!act) {
+			rk = dport_h;
+			act = bpf_map_lookup_elem(&rule_map, &rk);
+		}
+		if (act && *act == FSX_RULE_DROP) {
+			stats->dropped_rule++;
+			return XDP_DROP;
+		}
+	}
 
 	/* 1. blacklist gate with TTL expiry (fsx_kern.c:189-216).
 	 * v6 checks the EXACT 128-bit map first (fsx_kern.c:159-166

@@ -340,7 +340,7 @@ static void test_isqrt(void)
 static void test_struct_sizes(void)
 {
 	CHECK(sizeof(struct fsx_flow_record) == 48, "flow_record 48B");
-	CHECK(sizeof(struct fsx_config) == 80, "config 80B");
+	CHECK(sizeof(struct fsx_config) == 88, "config 88B");
 }
 
 static void test_minifloat(void)

@@ -21,7 +21,8 @@ for _ in $(seq 1 60); do
     "import json,sys; print(json.load(sys.stdin).get('state','wedged'))" \
     2>/dev/null)
   n=$(ls artifacts/bench_attempt_r05_*.json 2>/dev/null | wc -l)
-  if [ "$state" != "wedged" ] && [ "$n" -lt 3 ]; then
+  nfail=$(ls artifacts/bench_attempt_r05_*.failed 2>/dev/null | wc -l)
+  if [ "$state" != "wedged" ] && [ "$n" -lt 3 ] && [ "$nfail" -lt 10 ]; then
     ts=$(date +%s)
     echo "{\"ts\": $ts, \"event\": \"bench_attempt_start\", \"probe_state\": \"$state\"}" >> "$MON"
     FSX_BENCH_NO_MERGE=1 timeout 760 python bench.py --budget-s 700 \
@@ -37,7 +38,9 @@ sys.exit(0 if d.get('value') and d.get('backend') not in (None,'cpu') else 1)
       mv "artifacts/bench_attempt_r05_$ts.json" \
          "artifacts/bench_attempt_r05_$ts.failed" 2>/dev/null
     fi
-    echo "{\"ts\": $(date +%s), \"event\": \"bench_attempt_done\", \"file\": \"bench_attempt_r05_$ts.json\"}" >> "$MON"
+    res="bench_attempt_r05_$ts.json"
+    [ -f "artifacts/$res" ] || res="bench_attempt_r05_$ts.failed"
+    echo "{\"ts\": $(date +%s), \"event\": \"bench_attempt_done\", \"file\": \"$res\"}" >> "$MON"
   fi
   sleep 400
 done

@@ -107,6 +107,8 @@ class TestCheckpoint:
         tb = np.asarray(e2.table.tok_bytes)
         assert (tb[occ] == 5e4).all()   # full burst, not zero
         assert (tb[~occ] == 0).all()
+
+    def test_salt_mismatch_rejected_and_peekable(self, tmp_path):
         """A checkpoint's slot layout is a function of the hash salt:
         restoring under a different salt must refuse (it would
         mislocate every key), and peek_salt lets a server adopt the

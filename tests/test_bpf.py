@@ -96,19 +96,22 @@ class Fsx:
         self.fd, self.maps = progs.load(sizes, compact=compact)
         self.ring = loader.RingbufReader(self.maps["feature_ring"])
 
-    def push_config(self, **limiter_kw) -> None:
-        cfg = FsxConfig(limiter=LimiterConfig(**limiter_kw))
+    def push_config(self, rules=(), **limiter_kw) -> None:
+        cfg = FsxConfig(limiter=LimiterConfig(**limiter_kw), rules=rules)
         self.maps["config_map"].update(ZERO_KEY, cfg.pack_kernel_config())
+        for key, action in cfg.rule_entries():
+            self.maps["rule_map"].update(
+                struct.pack("<I", key), struct.pack("<Q", action))
 
     def run(self, pkt: bytes, repeat: int = 1) -> int:
         rv, _, _ = loader.prog_test_run(self.fd, pkt, repeat=repeat)
         return rv
 
     def stats(self) -> dict[str, int]:
-        names = ("allowed", "dropped_blacklist", "dropped_rate", "dropped_ml")
-        tot = [0, 0, 0, 0]
+        names = tuple(n for n, _ in schema.KERNEL_STATS_FIELDS)
+        tot = [0] * len(names)
         for v in self.maps["stats_map"].lookup_percpu(ZERO_KEY):
-            for i, x in enumerate(struct.unpack("<4Q", v)):
+            for i, x in enumerate(struct.unpack(f"<{len(names)}Q", v)):
                 tot[i] += x
         return dict(zip(names, tot))
 
@@ -244,6 +247,38 @@ def test_icmp6_truncated_drops(fsx):
 # ---- blacklist gate (verdict ingress seam) ---------------------------
 
 
+def test_firewall_rules_drop_and_wildcards():
+    """The stateless firewall (reference README.md:70-74 planned
+    'config files ... rules to drop certain packets'): exact
+    (proto, dport) rules, port and proto wildcards, counted in
+    dropped_rule — before any per-IP state is touched."""
+    from flowsentryx_tpu.core.config import RuleConfig
+
+    f = Fsx()
+    f.push_config(rules=(
+        RuleConfig(proto="udp", dport=9999),     # exact
+        RuleConfig(proto="icmp"),                # proto wildcard-port
+        RuleConfig(proto="any", dport=4444),     # port wildcard-proto
+    ))
+    # exact (udp, 9999) drops; (udp, 9998) passes
+    assert f.run(ip4_pkt(0x0A00000A, proto=17, dport=9999)) == XDP_DROP
+    assert f.run(ip4_pkt(0x0A00000A, proto=17, dport=9998)) == XDP_PASS
+    # all icmp drops (wildcard port)
+    assert f.run(ip4_pkt(0x0A00000B, proto=1, dport=0)) == XDP_DROP
+    # port 4444 drops on BOTH tcp and udp (wildcard proto)
+    assert f.run(ip4_pkt(0x0A00000C, proto=6, dport=4444)) == XDP_DROP
+    assert f.run(ip4_pkt(0x0A00000C, proto=17, dport=4444)) == XDP_DROP
+    st = f.stats()
+    assert st["dropped_rule"] == 4
+    assert st["allowed"] == 1
+    # rule drops feed no per-IP state and emit no feature records:
+    # only the allowed packet's flow exists
+    recs = f.records()
+    assert len(recs) == 1
+    # the rule gate works on v6 too (same proto/port seam)
+    assert f.run(ip6_pkt((1, 2, 3, 4), nexthdr=17, dport=9999)) == XDP_DROP
+
+
 def test_blacklist_drop_and_ttl_expiry(fsx):
     saddr = 0x0A000001
     until = ktime_ns() + 300_000_000  # 300 ms
@@ -274,7 +309,7 @@ def test_fixed_window_limiter_blocks_flood():
     assert results[6:] == [XDP_DROP] * 4  # now blacklisted
     st = f.stats()
     assert st == {"allowed": 5, "dropped_blacklist": 4, "dropped_rate": 1,
-                  "dropped_ml": 0}
+                  "dropped_ml": 0, "dropped_rule": 0}
     # rate-limit verdict landed in the blacklist with a TTL
     raw = f.maps["blacklist_map"].lookup(saddr_key(saddr))
     until = struct.unpack("<Q", raw)[0]

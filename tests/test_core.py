@@ -112,18 +112,52 @@ class TestConfig:
 
     def test_pack_kernel_config(self):
         blob = DEFAULT_CONFIG.pack_kernel_config()
-        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 80
+        assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 88
         (kind, valid, pps, bps, win_ns, blk_ns, rate, burst,
-         rate_b, burst_b, salt) = struct.unpack(
+         rate_b, burst_b, rule_count, salt) = struct.unpack(
             FsxConfig.KERNEL_CONFIG_FMT, blob)
         assert salt == 0  # DEFAULT_CONFIG is unsalted/deterministic
         assert rate_b == 125_000_000 and burst_b == 250_000_000
+        assert rule_count == 0
         assert kind == 0 and pps == 1000 and bps == 125_000_000
         # valid=1 marks "config pushed" vs the kernel ARRAY map's zero
         # fill (which the XDP program treats as fail-open)
         assert valid == 1
         assert win_ns == 1_000_000_000 and blk_ns == 10_000_000_000
         assert rate == 1000 and burst == 2000
+
+    def test_firewall_rules_config(self):
+        """RuleConfig packing, validation, and JSON round-trip (the
+        reference's planned config-file firewall, README.md:70-74)."""
+        import pytest
+
+        from flowsentryx_tpu.core import schema
+        from flowsentryx_tpu.core.config import RuleConfig
+
+        cfg = FsxConfig(rules=(
+            RuleConfig(proto="udp", dport=53),
+            RuleConfig(proto="tcp"),
+            RuleConfig(proto="any", dport=8080),
+        ))
+        ents = cfg.rule_entries()
+        assert ents[0] == (schema.pack_rule_key(17, 53), schema.RULE_DROP)
+        assert ents[1] == ((6 << 16), schema.RULE_DROP)
+        assert ents[2] == (8080, schema.RULE_DROP)
+        # rule_count lands in the packed kernel blob
+        vals = struct.unpack(FsxConfig.KERNEL_CONFIG_FMT,
+                             cfg.pack_kernel_config())
+        assert vals[-2] == 3
+        # JSON round-trip preserves rules
+        cfg2 = FsxConfig.from_json(cfg.to_json())
+        assert cfg2 == cfg
+        # validation: wholly-wildcard and duplicate rules rejected
+        with pytest.raises(ValueError):
+            RuleConfig(proto="any", dport=0)
+        with pytest.raises(ValueError):
+            RuleConfig(proto="udp", dport=53, action="allow")
+        with pytest.raises(ValueError):
+            FsxConfig(rules=(RuleConfig(proto="udp", dport=53),
+                             RuleConfig(proto=17, dport=53)))
 
     def test_configs_hashable_for_jit_static(self):
         assert hash(DEFAULT_CONFIG) == hash(FsxConfig())
