@@ -111,10 +111,10 @@ struct Options {
                  "  --pps-threshold N --bps-threshold N --window S --block S\n"
                  "  --bucket-rate N --bucket-burst N\n"
                  "  --bucket-rate-bytes N --bucket-burst-bytes N\n"
+                 "                        byte dimension (default 125 MB/s, 250 MB burst; 0 0 = off)\n"
                  "  --rule PROTO:DPORT    stateless drop rule (repeatable;\n"
                  "                        proto any/tcp/udp/icmp[v6]/number,\n"
                  "                        dport 0 = any)\n"
-                 "                        byte dimension (default 125 MB/s, 250 MB burst; 0 0 = off)\n"
                  "  --compact             16 B kernel-quantized records (the image\n"
                  "                        must be emitted with --compact too)\n",
                  argv0);

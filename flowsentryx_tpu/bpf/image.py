@@ -55,9 +55,7 @@ def emit(prog: Program | None = None,
     specs = []
     for name in names:
         mtype, ks, vs, ent = progs.MAP_SPECS[name]
-        n = {"one": 1, "ips": sizes.max_track_ips,
-             "ring": sizes.ring_bytes,
-             "rules": schema.MAX_RULES}[ent]
+        n = progs.max_entries_for(ent, sizes)
         specs.append(ImageMap(name, mtype, ks, vs, n))
     out = [_HDR.pack(MAGIC, VERSION, len(specs), len(prog.relocs),
                      len(prog.insns))]

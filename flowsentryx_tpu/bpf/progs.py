@@ -161,14 +161,21 @@ MAP_SPECS = {
 }
 
 
+def max_entries_for(selector: str, sizes: MapSizes) -> int:
+    """Resolve a MAP_SPECS size selector — the ONE copy image emission
+    and live map creation both use (a literal dict in each would have
+    to be extended in lockstep for every new map kind)."""
+    return {"one": 1, "ips": sizes.max_track_ips,
+            "ring": sizes.ring_bytes,
+            "rules": schema.MAX_RULES}[selector]
+
+
 def create_maps(sizes: MapSizes = MapSizes()) -> dict[str, loader.Map]:
-    """Create the six-map kernel/user seam (kern/fsx_kern.c:39-87)."""
+    """Create the eight-map kernel/user seam (kern/fsx_kern.c maps)."""
     out = {}
     for name, (mtype, ks, vs, ent) in MAP_SPECS.items():
-        n = {"one": 1, "ips": sizes.max_track_ips,
-             "ring": sizes.ring_bytes,
-             "rules": schema.MAX_RULES}[ent]
-        out[name] = loader.map_create(mtype, ks, vs, n, name)
+        out[name] = loader.map_create(mtype, ks, vs,
+                                      max_entries_for(ent, sizes), name)
     return out
 
 

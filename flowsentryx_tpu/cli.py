@@ -117,12 +117,22 @@ def _cmd_rules(args: argparse.Namespace) -> int:
     m = rules.open_map(args.pin)
     try:
         if args.add:
-            r = rules.add(m, args.add)
+            # enable the kernel gate FIRST: if no config was pushed yet
+            # (daemon not started) this fails before any partial state
+            # lands in the map
+            try:
+                rules.set_enabled(args.pin, len(rules.entries(m)) + 1)
+                r = rules.add(m, args.add)
+            except (ValueError, RuntimeError, OSError) as e:
+                raise SystemExit(f"fsx rules: {e}") from None
             rules.set_enabled(args.pin, len(rules.entries(m)))
             print(json.dumps({"added": r.to_json()}))
             return 0
         if args.remove:
-            ok = rules.remove(m, args.remove)
+            try:
+                ok = rules.remove(m, args.remove)
+            except ValueError as e:
+                raise SystemExit(f"fsx rules: {e}") from None
             rules.set_enabled(args.pin, len(rules.entries(m)))
             print(json.dumps({"removed": bool(ok)}))
             return 0

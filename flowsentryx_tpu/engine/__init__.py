@@ -12,7 +12,7 @@ daemon's replay mode, or an in-process traffic generator.
 Pipeline stages (SURVEY.md §7.2 "daemon"):
 
     source.poll() → MicroBatcher (size/deadline) → raw [B+1,12] u32
-    → fused step on device → deferred verdict readback → VerdictSink
+    → fused step on device → readiness-based verdict sink → VerdictSink
 
 Stage latencies are tracked per batch (:mod:`.metrics`) — the reference
 has no profiling at all (SURVEY.md §5.1).

@@ -54,6 +54,29 @@ class StepOutput(NamedTuple):
     #                        parallel/step.py module docstring)
 
 
+#: Internal flow-verdict sentinel (never leaves a step): the flow
+#: failed the ML vote but had malicious-scoring records — the
+#: per-packet assembly translates it record-by-record (malicious
+#: records DROP_ML, the flow's other records PASS).
+ML_RECORD_GATE = 100
+
+
+def resolve_record_verdicts(
+    flow_verdict: jnp.ndarray,   # [R] int32 (may carry ML_RECORD_GATE)
+    inv: jnp.ndarray,            # [B] packet -> flow segment
+    mal: jnp.ndarray,            # [B] bool: record scored malicious
+    valid: jnp.ndarray,          # [B] bool
+) -> jnp.ndarray:
+    """Broadcast flow verdicts to packets, translating the
+    :data:`ML_RECORD_GATE` sentinel per record."""
+    per_pkt = flow_verdict[inv]
+    gated = per_pkt == ML_RECORD_GATE
+    per_pkt = jnp.where(
+        gated, jnp.where(mal, int(Verdict.DROP_ML), int(Verdict.PASS)),
+        per_pkt)
+    return jnp.where(valid, per_pkt, int(Verdict.PASS))
+
+
 class FlowDecision(NamedTuple):
     """Per-flow outcome of the table+limiter core."""
 
@@ -175,15 +198,18 @@ def _flow_core(
     vote_ok = jnp.where(asg.tracked, (votes_new >= mdl.vote_m) | burst,
                         burst)
     over_ml = eligible & ml_hit & vote_ok & ~already_blocked & ~over_rate
-    # Untracked flows that score malicious but fail the burst vote:
-    # DROP their records this batch (fail-closed per record — a full
-    # table must not shield a slow attack from the ML plane) but do
-    # NOT blacklist (blacklisting on unvoted evidence is the exact
-    # SERVE_r04 failure; the collateral here is a few dropped records
-    # from a young benign flow in the rare untracked window, never a
-    # block).  Tracked flows are not affected — their young records
-    # pass while votes accumulate.
-    ml_drop_only = (eligible & ml_hit & ~asg.tracked & ~vote_ok
+    # Flows that score malicious but fail the vote: drop the RECORDS
+    # that scored malicious (fail-closed per record — the ML verdict
+    # applies to the packet regardless of flow age or table state, or
+    # a rotating spoofed-source flood whose every source sends
+    # <= vote_k records would sail through untouched) but do NOT
+    # blacklist.  The vote gates the heavy hammer only: SERVE_r04's
+    # failure was benign SOURCES being condemned for ml_block_s on
+    # their first records' mis-scores.  The flow-level verdict here is
+    # the ML_RECORD_GATE sentinel; the per-packet assembly translates
+    # it record-by-record (a flow's benign-scoring records PASS — one
+    # borderline record must not drop its whole batch).
+    ml_drop_only = (eligible & ml_hit & ~vote_ok
                     & ~already_blocked & ~over_rate)
 
     # 4. blacklist writeback (fsx_kern.c:317-325: now + block time).
@@ -198,8 +224,9 @@ def _flow_core(
     flow_verdict = jnp.where(
         already_blocked, int(Verdict.DROP_BLACKLIST),
         jnp.where(over_rate, int(Verdict.DROP_RATE),
-                  jnp.where(over_ml | ml_drop_only, int(Verdict.DROP_ML),
-                            int(Verdict.PASS))),
+                  jnp.where(over_ml, int(Verdict.DROP_ML),
+                            jnp.where(ml_drop_only, ML_RECORD_GATE,
+                                      int(Verdict.PASS)))),
     ).astype(jnp.int32)
 
     # 5. scatter state back (tracked flows only).  Untracked reps are
@@ -400,9 +427,8 @@ def make_step(
         new_table, dec = _flow_core(cfg, table, fa, asg, all_flows,
                                     ml_count, now)
 
-        verdict = jnp.where(
-            batch.valid, dec.flow_verdict[fa.inv], int(Verdict.PASS)
-        )
+        verdict = resolve_record_verdicts(dec.flow_verdict, fa.inv, mal,
+                                          batch.valid)
         new_stats = update_stats(stats, verdict, batch.valid)
 
         out = StepOutput(

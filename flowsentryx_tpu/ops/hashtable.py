@@ -1,20 +1,21 @@
 """Device-resident open-addressing IP state table.
 
 Successor of the reference's three ``BPF_MAP_TYPE_LRU_HASH`` maps
-(``fsx_kern.c:64-94``) as one SoA table of JAX arrays
-(:class:`~flowsentryx_tpu.core.schema.IpTableState`) that lives in HBM
-and is updated in place via donated buffers.  Design constraints that
-shaped it (SURVEY.md §7.4.2):
+(``fsx_kern.c:64-94``) as a key vector + one ``[capacity, 12]`` state
+matrix (:class:`~flowsentryx_tpu.core.schema.IpTableState`) that lives
+in HBM and is updated in place via donated buffers.  Design constraints
+that shaped it (SURVEY.md §7.4.2):
 
 * **Static shapes, bounded probes.**  Open addressing with a
   compile-time probe count ``P``: lookup is one ``[R, P]`` gather + a
   reduction — no data-dependent loops, so XLA vectorizes it flat.
 * **Batch-internal collision resolution.**  Two distinct keys in one
   micro-batch can select the same slot (hash collision on insert); a
-  sort-based arbitration keeps the lowest-indexed flow and marks the
-  rest untracked for this batch (they still get classified — losing a
-  limiter update for one batch is the bounded-error analog of the
-  reference's LRU silently evicting attackers, SURVEY.md §5.3).
+  sort-based arbitration picks exactly one winner per slot
+  (found-key beats stale-reclaimer) and marks the rest untracked for
+  this batch (they still get classified — losing a limiter update for
+  one batch is the bounded-error analog of the reference's LRU
+  silently evicting attackers, SURVEY.md §5.3).
 * **Stale reclamation ≈ LRU.**  Slots idle longer than
   ``TableConfig.stale_s`` are reclaimed by inserts, approximating the
   kernel map's LRU eviction without global bookkeeping.

@@ -197,7 +197,10 @@ def make_sharded_step(
             back[jnp.clip(owner, 0, n_dev - 1), jnp.clip(rank, 0, C - 1)],
             int(Verdict.PASS),  # overflow: fail-open this batch (counted)
         )
-        verdict_l = jnp.where(valid_l, rep_verdict[fa.inv], int(Verdict.PASS))
+        # the ML_RECORD_GATE sentinel rides the verdict all_to_all and
+        # resolves per record HERE, where the local slice's scores live
+        verdict_l = fused.resolve_record_verdicts(rep_verdict, fa.inv,
+                                                  mal_l, valid_l)
 
         # --- stats: local counts, one psum ---------------------------------
         route_drop_l = jnp.sum(

@@ -87,25 +87,27 @@ class TestFusedStep:
 
     def test_ml_detection_votes_then_blacklists(self):
         """The young-flow vote (ModelConfig.vote_k/vote_m, SERVE_r04
-        fix): a new flow's first malicious-looking records do NOT block
-        it; sustained malicious evidence past maturity does."""
+        fix): a new flow's malicious-scoring records DROP per record
+        (fail-closed — a rotating spoofed flood must not sail through)
+        but the flow is NOT blacklisted until the vote carries;
+        sustained malicious evidence past maturity blacklists."""
         step, table, stats, params = make_env()
         # batch 1: 4 hot records from a NEW flow = exactly vote_k —
-        # all immature, zero votes, flow passes (pre-vote behavior
-        # would have ML-dropped and blacklisted every benign source
-        # whose early records mis-score)
+        # the records drop, but NO blacklist entry lands (pre-vote
+        # behavior condemned the source for ml_block_s on the spot)
         batch = build_batch([(3001, 4, 100, 0.1, ML_HOT), (3002, 4, 100, 0.1, ML_COLD)])
         table, stats, out = step(table, stats, params, batch)
         v = np.asarray(out.verdict)
-        assert (v[:8] == int(Verdict.PASS)).all()
-        assert stat_value(stats.dropped_ml) == 0
+        assert (v[:4] == int(Verdict.DROP_ML)).all()
+        assert (v[4:8] == int(Verdict.PASS)).all()
+        keys = np.asarray(out.block_key)
+        assert 3001 not in keys[keys != 0xFFFFFFFF]  # dropped, not blocked
 
         # batch 2: the flow is mature (rec_seen=4 >= vote_k); 2 more
         # hot records = vote_m votes -> ML drop + blacklist writeback
         b2 = build_batch([(3001, 2, 100, 0.3, ML_HOT)])
         table, stats, out2 = step(table, stats, params, b2)
         assert (np.asarray(out2.verdict)[:2] == int(Verdict.DROP_ML)).all()
-        assert stat_value(stats.dropped_ml) == 2
         keys = np.asarray(out2.block_key)
         assert 3001 in keys[keys != 0xFFFFFFFF]
 
@@ -116,17 +118,21 @@ class TestFusedStep:
 
     def test_ml_young_mis_scores_never_block_recovered_flow(self):
         """A benign flow whose ONLY malicious-looking records are its
-        young ones (the exact SERVE_r04 failure) is never blocked."""
+        young ones (the exact SERVE_r04 failure) loses those records —
+        per-record fail-closed — but is NEVER blacklisted, and its
+        mature traffic flows untouched."""
         step, table, stats, params = make_env()
         b1 = build_batch([(3101, 3, 100, 0.1, ML_HOT)])   # young mis-scores
         table, stats, o1 = step(table, stats, params, b1)
-        assert (np.asarray(o1.verdict)[:3] == int(Verdict.PASS)).all()
-        # mature records score benign: no votes ever accumulate
+        assert (np.asarray(o1.verdict)[:3] == int(Verdict.DROP_ML)).all()
+        keys = np.asarray(o1.block_key)
+        assert 3101 not in keys[keys != 0xFFFFFFFF]  # no condemnation
+        # mature records score benign: they pass, and no blacklist entry
+        # ever lands (the r4 failure was DROP_BLACKLIST from here on)
         for t in (0.3, 0.5, 0.7):
             b = build_batch([(3101, 4, 100, t, ML_COLD)])
             table, stats, o = step(table, stats, params, b)
             assert (np.asarray(o.verdict)[:4] == int(Verdict.PASS)).all()
-        assert stat_value(stats.dropped_ml) == 0
 
     def test_ml_dense_burst_blocks_first_batch_even_tracked(self):
         """The batch-local burst rule applies to tracked flows too: a
@@ -149,27 +155,35 @@ class TestFusedStep:
             CFG, model=dataclasses.replace(CFG.model, vote_decay_s=1.0,
                                            ml_block_s=0.5))
         step, table, stats, params = make_env(cfg)
+
+        def blocked_keys(out):
+            keys = np.asarray(out.block_key)
+            return set(keys[keys != 0xFFFFFFFF].tolist())
+
         # mature the flow benignly
         table, stats, _ = step(table, stats, params,
                                build_batch([(3401, 5, 100, 0.1, ML_COLD)]))
-        # one mature mis-score: 1 vote, passes
+        # one mature mis-score: the record drops (fail-closed) but
+        # 1 vote < vote_m -> NO blacklist entry
         table, stats, o1 = step(table, stats, params,
                                 build_batch([(3401, 1, 100, 0.2, ML_HOT)]))
-        assert (np.asarray(o1.verdict)[:1] == int(Verdict.PASS)).all()
+        assert 3401 not in blocked_keys(o1)
         # 10 half-lives later another single mis-score: the old vote
-        # decayed to ~0.001 — still only ~1 vote, must NOT block
+        # decayed to ~0.001 — still ~1 vote, must NOT blacklist (an
+        # undecayed vote would have carried it over vote_m)
         table, stats, o2 = step(table, stats, params,
                                 build_batch([(3401, 1, 100, 10.2, ML_HOT)]))
-        assert (np.asarray(o2.verdict)[:1] == int(Verdict.PASS)).all()
-        # two quick mis-scores: 2 votes -> blocked; votes then reset
+        assert 3401 not in blocked_keys(o2)
+        # two quick mis-scores: 2 votes -> blacklisted; votes then reset
         table, stats, o3 = step(table, stats, params,
                                 build_batch([(3401, 2, 100, 10.4, ML_HOT)]))
         assert (np.asarray(o3.verdict)[:2] == int(Verdict.DROP_ML)).all()
-        # after the 0.5 s TTL, a single borderline record passes again
-        # (the block consumed the votes)
+        assert 3401 in blocked_keys(o3)
+        # after the 0.5 s TTL, a single borderline record drops but does
+        # NOT re-blacklist (the block consumed the votes)
         table, stats, o4 = step(table, stats, params,
                                 build_batch([(3401, 1, 100, 11.5, ML_HOT)]))
-        assert (np.asarray(o4.verdict)[:1] == int(Verdict.PASS)).all()
+        assert 3401 not in blocked_keys(o4)
 
     def test_single_sort_step_matches_two_stage_composition(self):
         """The production single-sort pipeline (make_step) must be
@@ -198,13 +212,14 @@ class TestFusedStep:
                                    batch.valid)
             now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
             score = spec.classify_batch(params, batch.feat)
+            mal = (score > cfg.model.threshold) & batch.valid
             ml_count = fused_mod.ml_flow_count(cfg, score, batch.valid,
                                                fa.inv)
             all_flows = jnp.ones_like(fa.rep_valid)
             table, dec = fused_mod.flow_step(cfg, table, fa, all_flows,
                                              ml_count, now)
-            verdict = jnp.where(batch.valid, dec.flow_verdict[fa.inv],
-                                int(Verdict.PASS))
+            verdict = fused_mod.resolve_record_verdicts(
+                dec.flow_verdict, fa.inv, mal, batch.valid)
             return table, fused_mod.update_stats(stats, verdict,
                                                  batch.valid), verdict
 
@@ -290,6 +305,23 @@ class TestFusedStep:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         np.testing.assert_array_equal(
             np.asarray(outs.verdict), np.stack(verdicts))
+
+    def test_ml_record_gate_drops_only_malicious_records(self):
+        """One borderline record must not drop its flow's whole batch:
+        the ML_RECORD_GATE resolves per record, so a mature flow with
+        1 hot + 5 cold records in a batch loses exactly the hot one
+        (and is not blacklisted — 1 vote < vote_m)."""
+        step, table, stats, params = make_env()
+        # mature the flow benignly first
+        table, stats, _ = step(table, stats, params,
+                               build_batch([(3501, 5, 100, 0.1, ML_COLD)]))
+        mixed = build_batch([(3501, 1, 100, 0.3, ML_HOT),
+                             (3501, 5, 100, 0.3001, ML_COLD)])
+        table, stats, out = step(table, stats, params, mixed)
+        v = np.asarray(out.verdict)[:6]
+        assert (v == [int(Verdict.DROP_ML)] + [int(Verdict.PASS)] * 5).all()
+        keys = np.asarray(out.block_key)
+        assert 3501 not in keys[keys != 0xFFFFFFFF]
 
     def test_ml_legacy_knob_restores_immediate_block(self):
         """vote_k=0, vote_m=1 must reproduce the pre-vote semantics."""
