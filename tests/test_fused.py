@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from flowsentryx_tpu.core.config import (
     BatchConfig, FsxConfig, LimiterConfig, LimiterKind, ModelConfig, TableConfig,
@@ -185,12 +186,18 @@ class TestFusedStep:
                                 build_batch([(3401, 1, 100, 11.5, ML_HOT)]))
         assert 3401 not in blocked_keys(o4)
 
-    def test_single_sort_step_matches_two_stage_composition(self):
+    @pytest.mark.parametrize("cap,probes,salt", [
+        (64, 4, 0),            # tiny table: heavy collisions/fail-opens
+        (16, 2, 0xBEEF),       # tinier still, salted, short probes
+        (1 << 12, 8, 0xA5A5),  # roomy: mostly inserts/finds
+    ])
+    def test_single_sort_step_matches_two_stage_composition(
+            self, cap, probes, salt):
         """The production single-sort pipeline (make_step) must be
         decision-identical to the legacy aggregate→assign_slots→core
         composition the sharded path still uses — across random
-        traffic, slot collisions, zero/invalid keys, and repeat batches
-        against evolving table state."""
+        traffic, slot collisions, zero/invalid keys, salts, probe
+        counts, and repeat batches against evolving table state."""
         import dataclasses
 
         from flowsentryx_tpu.core.schema import FeatureBatch, make_stats, make_table
@@ -199,7 +206,8 @@ class TestFusedStep:
         from flowsentryx_tpu.ops import fused as fused_mod
 
         cfg = dataclasses.replace(
-            CFG, table=TableConfig(capacity=64, probes=4, stale_s=1e6))
+            CFG, table=TableConfig(capacity=cap, probes=probes,
+                                   stale_s=1e6, salt=salt))
         spec = get_model(cfg.model.name)
         params = spec.init()
         step = fused_mod.make_jitted_step(cfg, spec.classify_batch,
@@ -224,14 +232,15 @@ class TestFusedStep:
                                                  batch.valid), verdict
 
         rng = np.random.default_rng(3)
-        t1, s1 = make_table(64), make_stats()
-        t2, s2 = make_table(64), make_stats()
+        t1, s1 = make_table(cap), make_stats()
+        t2, s2 = make_table(cap), make_stats()
         b = 256
         for i in range(6):
             batch = FeatureBatch(
-                # tiny 64-row table + keys from a pool of 200 forces
-                # probe collisions, stale reclaims, and full-table
-                # fail-opens; some zero keys and invalid rows
+                # keys from a pool of 200 vs a cap-row table: tiny
+                # caps force collisions, stale reclaims, and full-table
+                # fail-opens; the roomy cap is mostly inserts/finds;
+                # some zero keys and invalid rows either way
                 key=jnp.asarray(np.where(rng.random(b) < 0.05, 0,
                                          rng.integers(1, 200, b))
                                 .astype(np.uint32)),
