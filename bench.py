@@ -559,8 +559,11 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
         cfg = FsxConfig(table=TableConfig(capacity=TABLE_CAP),
                         batch=BatchConfig(max_batch=size))
         step = fused.make_jitted_compact_step(
-            cfg, spec.classify_batch, donate=False, **quant
-        )
+            cfg, spec.classify_batch, donate=None, **quant
+        )  # donate=None: auto — off only on axon, where a donated
+        # step's first readback wedges the client; everywhere else an
+        # undonated 1M-row table pays a ~50 MB copy per step, which
+        # would be the latency phase measuring its own harness
         table = jax.device_put(schema.make_table(TABLE_CAP))
         stats = jax.device_put(schema.make_stats())
         feeds = [
@@ -632,7 +635,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     cfg = FsxConfig(table=TableConfig(capacity=TABLE_CAP),
                     batch=BatchConfig(max_batch=decomp_b))
     step = fused.make_jitted_compact_step(
-        cfg, spec.classify_batch, donate=False, **quant
+        cfg, spec.classify_batch, donate=None, **quant
     )
     table = jax.device_put(schema.make_table(TABLE_CAP))
     stats = jax.device_put(schema.make_stats())
@@ -674,7 +677,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
                 batch=BatchConfig(max_batch=bsz, deadline_us=200),
             )
             eng = Engine(cfg, src, NullSink(), params=params,
-                         donate=False, readback_depth=depth,
+                         donate=None, readback_depth=depth,
                          wire=schema.WIRE_COMPACT16)
             engines[bsz] = eng
             # Compile OUTSIDE the paced run: the open-loop clock

@@ -61,22 +61,24 @@ def entries(m: loader.Map) -> list[Rule]:
     return sorted(out, key=lambda r: (r.proto, r.dport))
 
 
-def add(m: loader.Map, spec: str) -> Rule:
-    """Insert a ``proto:dport`` drop rule (proto name/number/'any',
-    dport 0 = any) — RuleConfig does the validation."""
+def parse_spec(spec: str) -> RuleConfig:
+    """Validate a ``proto:dport`` spec (proto name/number/'any',
+    dport 0 = any) — raises ValueError on malformed input BEFORE any
+    map state is touched."""
     proto_s, _, dport_s = spec.partition(":")
-    rule = RuleConfig(proto=proto_s if not proto_s.isdigit() else int(proto_s),
-                      dport=int(dport_s or 0))
+    return RuleConfig(
+        proto=proto_s if not proto_s.isdigit() else int(proto_s),
+        dport=int(dport_s or 0))
+
+
+def add(m: loader.Map, rule: RuleConfig) -> Rule:
     m.update(struct.pack("<I", rule.key()),
              struct.pack("<Q", schema.RULE_DROP))
     return Rule(proto=rule.proto_code(), dport=rule.dport,
                 action=schema.RULE_DROP)
 
 
-def remove(m: loader.Map, spec: str) -> bool:
-    proto_s, _, dport_s = spec.partition(":")
-    rule = RuleConfig(proto=proto_s if not proto_s.isdigit() else int(proto_s),
-                      dport=int(dport_s or 0))
+def remove(m: loader.Map, rule: RuleConfig) -> bool:
     return bool(m.delete(struct.pack("<I", rule.key())))
 
 

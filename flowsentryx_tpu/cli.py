@@ -117,23 +117,28 @@ def _cmd_rules(args: argparse.Namespace) -> int:
     m = rules.open_map(args.pin)
     try:
         if args.add:
-            # enable the kernel gate FIRST: if no config was pushed yet
-            # (daemon not started) this fails before any partial state
-            # lands in the map
+            # order: validate the spec first (nothing touched on
+            # malformed input), probe the kernel gate (fails cleanly if
+            # no config was pushed yet - daemon not started), insert,
+            # then reconcile the gate to the map's actual count - ALSO
+            # on a failed insert, so the count can never stay inflated
             try:
+                rule = rules.parse_spec(args.add)
                 rules.set_enabled(args.pin, len(rules.entries(m)) + 1)
-                r = rules.add(m, args.add)
+                try:
+                    r = rules.add(m, rule)
+                finally:
+                    rules.set_enabled(args.pin, len(rules.entries(m)))
             except (ValueError, RuntimeError, OSError) as e:
                 raise SystemExit(f"fsx rules: {e}") from None
-            rules.set_enabled(args.pin, len(rules.entries(m)))
             print(json.dumps({"added": r.to_json()}))
             return 0
         if args.remove:
             try:
-                ok = rules.remove(m, args.remove)
-            except ValueError as e:
+                ok = rules.remove(m, rules.parse_spec(args.remove))
+                rules.set_enabled(args.pin, len(rules.entries(m)))
+            except (ValueError, RuntimeError, OSError) as e:
                 raise SystemExit(f"fsx rules: {e}") from None
-            rules.set_enabled(args.pin, len(rules.entries(m)))
             print(json.dumps({"removed": bool(ok)}))
             return 0
         ents = [r.to_json() for r in rules.entries(m)]
