@@ -64,7 +64,7 @@ def main() -> int:
         key = (bsz, dl)
         eng = engines.get(key)
         if eng is None:
-            eng = Engine(cfg, src, NullSink(), donate=False,
+            eng = Engine(cfg, src, NullSink(), donate=None,
                          readback_depth=depth, wire=schema.WIRE_COMPACT16)
             quant = schema.wire_quant_for(eng.params)
             warm = schema.encode_compact(pool[:bsz], bsz, t0_ns=0, **quant)
