@@ -49,6 +49,11 @@ from flowsentryx_tpu.bpf import loader  # noqa: E402
 
 PIN = "/sys/fs/bpf/fsx_serve"
 DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 150.0
+#: Model family + artifact to serve (env-overridable so the same
+#: harness evidences both deployables; defaults = the logreg artifact)
+MODEL_NAME = os.environ.get("FSX_SERVE_MODEL", "logreg_int8")
+ARTIFACT = os.environ.get("FSX_SERVE_ARTIFACT", "artifacts/logreg_int8.npz")
+OUT_NAME = os.environ.get("FSX_SERVE_OUT", "SERVE_r05.json")
 N_ATTACK = 64          # flood sources
 N_BENIGN = 64          # background sources
 REPEAT = 2048          # kernel runs per PROG_TEST_RUN syscall
@@ -160,14 +165,14 @@ def main() -> int:
         Path(cfgf).write_text(json.dumps({
             "table": {"capacity": 65536},
             "batch": {"max_batch": 2048, "deadline_us": 2000},
-            "model": {"vote_k": 4, "vote_m": 2},
+            "model": {"name": MODEL_NAME, "vote_k": 4, "vote_m": 2},
         }))
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         serve = subprocess.Popen(
             [sys.executable, "-m", "flowsentryx_tpu.cli", "serve",
              "--config", cfgf, "--feature-ring", fring,
              "--verdict-ring", vring, "--seconds", str(DURATION + 10),
-             "--artifact", str(REPO / "artifacts/logreg_int8.npz")],
+             "--artifact", str(REPO / ARTIFACT)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             cwd=str(REPO), env=env)
 
@@ -274,7 +279,8 @@ def main() -> int:
         if tail:
             out["fsxd_tail"] = tail[-3:]
         out["wall_s"] = round(time.time() - t_wall0, 1)
-        Path(REPO / "SERVE_r05.json").write_text(
+        out["model"] = {"name": MODEL_NAME, "artifact": ARTIFACT}
+        Path(REPO / OUT_NAME).write_text(
             json.dumps(out, indent=2) + "\n")
         print(json.dumps({k: out.get(k) for k in
                           ("offered_mpps", "forwarded_records",
