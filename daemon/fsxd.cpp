@@ -89,6 +89,12 @@ struct Options {
     // --rule proto:dport (key = (proto << 16) | dport, 0 = wildcard)
     std::vector<std::pair<uint32_t, uint64_t>> rules;
     bool compact = false;              // 16 B kernel-quantized records
+    // --pace: sim produces at --rate in REAL time (sleeps when ahead)
+    // instead of free-running against ring backpressure.  A real data
+    // plane delivers records at line rate, not at memcpy speed; paced
+    // mode models that — essential on small hosts where a free-running
+    // generator would starve the engine it is feeding.
+    bool pace = false;
 };
 
 [[noreturn]] void usage(const char *argv0) {
@@ -98,10 +104,13 @@ struct Options {
                  "  --verdict-ring PATH   shm verdict ring (default /tmp/fsx_verdict_ring)\n"
                  "  --ring-capacity N     feature ring slots, power of 2 (default 65536)\n"
                  "  --rate PPS            sim packet rate (default 1e6)\n"
+                 "  --pace                sim produces at --rate in REAL time\n"
+                 "                        (default: free-run vs ring backpressure)\n"
                  "  --packets N           stop after N packets\n"
                  "  --duration S          stop after S seconds\n"
                  "  --attack-fraction F   sim attack share (default 0.8)\n"
-                 "  --attack-ips N        sim attack pool (default 64)\n"
+                 "  --attack-ips N        sim attack pool (default 64, min 1)\n"
+                 "  --benign-ips N        sim benign pool (default 1024, min 1)\n"
                  "  --seed N              sim rng seed\n"
                  "bpf mode (--bpf IFACE, or --bpf none to load without attach):\n"
                  "  --prog-image PATH     FSXPROG image (default kern/build/fsx_prog.img;\n"
@@ -422,6 +431,8 @@ Options parse(int argc, char **argv) {
             o.ring_capacity = std::stoull(next());
         else if (a == "--rate")
             o.rate_pps = std::stod(next());
+        else if (a == "--pace")
+            o.pace = true;
         else if (a == "--packets")
             o.total_packets = std::stoull(next());
         else if (a == "--duration")
@@ -430,6 +441,8 @@ Options parse(int argc, char **argv) {
             o.attack_fraction = std::stod(next());
         else if (a == "--attack-ips")
             o.n_attack_ips = (uint32_t)std::stoul(next());
+        else if (a == "--benign-ips")
+            o.n_benign_ips = (uint32_t)std::stoul(next());
         else if (a == "--seed")
             o.seed = std::stoull(next());
         else
@@ -439,6 +452,13 @@ Options parse(int argc, char **argv) {
         std::fprintf(stderr, "fsxd: --bucket-rate-bytes and "
                      "--bucket-burst-bytes must be both zero or both "
                      "positive\n");
+        std::exit(1);
+    }
+    if (o.n_attack_ips == 0 || o.n_benign_ips == 0) {
+        // SimSource indexes each pool with rng() % size: an empty pool
+        // is a modulo-by-zero SIGFPE on the first record of that class.
+        std::fprintf(stderr,
+                     "fsxd: --attack-ips and --benign-ips must be >= 1\n");
         std::exit(1);
     }
     return o;
@@ -472,6 +492,9 @@ public:
             bool attack = u01(rng_) < o_.attack_fraction;
             r.ts_ns = clock_ns_;
             clock_ns_ += dt_ns_;
+            // Feature slots follow core/schema.py FEATURE_NAMES: 3/4
+            // are flow_duration_ms / flow_pps_x1000 (the r5 flow-age
+            // slots), NOT the pre-r5 variance/avg-size pair.
             if (attack) {
                 r.saddr = attack_ips_[rng_() % attack_ips_.size()];
                 r.pkt_len = 60 + rng_() % 20;
@@ -480,12 +503,15 @@ public:
                 uint32_t size = r.pkt_len;
                 r.feat[1] = size;
                 r.feat[2] = rng_() % 3;
-                r.feat[3] = r.feat[2] * r.feat[2];
-                r.feat[4] = size;
-                uint32_t iat = 1 + rng_() % 50;
-                r.feat[5] = iat;
+                uint64_t iat = 1 + rng_() % 50;  // µs: flood arrivals
+                uint64_t npkts = 100 + rng_() % 4900;
+                uint64_t dur_us = std::max<uint64_t>(iat * npkts, 1);
+                r.feat[3] = (uint32_t)(dur_us / 1000);
+                r.feat[4] = (uint32_t)std::min<uint64_t>(
+                    npkts * 1'000'000'000ULL / dur_us, 0xFFFFFFFFULL);
+                r.feat[5] = (uint32_t)iat;
                 r.feat[6] = rng_() % 20;
-                r.feat[7] = iat * (1 + rng_() % 3);
+                r.feat[7] = (uint32_t)(iat * (1 + rng_() % 3));
             } else {
                 r.saddr = benign_ips_[rng_() % benign_ips_.size()];
                 r.pkt_len = 100 + rng_() % 1400;
@@ -496,12 +522,15 @@ public:
                 uint32_t std_ = 100 + rng_() % 500;
                 r.feat[1] = size;
                 r.feat[2] = std_;
-                r.feat[3] = std_ * std_;
-                r.feat[4] = size;
-                uint32_t iat = 5'000 + rng_() % 495'000;
-                r.feat[5] = iat;
-                r.feat[6] = iat / (1 + rng_() % 3);
-                r.feat[7] = iat * (2 + rng_() % 6);
+                uint64_t iat = 5'000 + rng_() % 495'000;  // µs: human-scale
+                uint64_t npkts = 2 + rng_() % 198;
+                uint64_t dur_us = std::max<uint64_t>(iat * npkts, 1);
+                r.feat[3] = (uint32_t)(dur_us / 1000);
+                r.feat[4] = (uint32_t)std::min<uint64_t>(
+                    npkts * 1'000'000'000ULL / dur_us, 0xFFFFFFFFULL);
+                r.feat[5] = (uint32_t)iat;
+                r.feat[6] = (uint32_t)(iat / (1 + rng_() % 3));
+                r.feat[7] = (uint32_t)(iat * (2 + rng_() % 6));
             }
         }
     }
@@ -559,6 +588,20 @@ int main(int argc, char **argv) {
     while (!g_stop) {
         // ---- produce features -------------------------------------------
         size_t want = CHUNK;
+        if (o.pace) {
+            // Real-time pacing: never run ahead of rate_pps × elapsed.
+            // Sleep in small slices so verdict ingress stays responsive.
+            uint64_t target =
+                (uint64_t)((double)(now_ns() - t_start) * o.rate_pps / 1e9);
+            if (produced >= target) {
+                std::this_thread::sleep_for(std::chrono::microseconds(100));
+                target = (uint64_t)((double)(now_ns() - t_start) *
+                                    o.rate_pps / 1e9);
+            }
+            want = produced < target
+                       ? std::min<uint64_t>(CHUNK, target - produced)
+                       : 0;
+        }
         if (o.total_packets && produced + want > o.total_packets)
             want = o.total_packets - produced;
         if (want > 0) {

@@ -304,6 +304,23 @@ class Engine:
             if self.on_reap is not None:
                 self.on_reap(g.n_records, t_done)
 
+    def warm(self) -> None:
+        """Trigger the step's XLA compile with a zero-fill batch.
+
+        A long-lived server pays the multi-second compile once at boot;
+        a benchmark or test that skips this charges it to the first
+        measured window instead (and, fed by a live ring, drops the
+        seconds of records that arrive meanwhile).  The batch's meta
+        row carries n_valid=0, so every row is masked — table, stats,
+        and verdicts are unchanged.  Call before attaching a live
+        stream; must not be called with batches in flight."""
+        words = (schema.COMPACT_RECORD_WORDS
+                 if self.wire == schema.WIRE_COMPACT16
+                 else schema.RECORD_WORDS)
+        warm = np.zeros((self.cfg.batch.max_batch + 1, words), np.uint32)
+        self._dispatch(warm, time.perf_counter())
+        self._reap(0)
+
     # -- stream rebinding ---------------------------------------------------
 
     def reset_stream(

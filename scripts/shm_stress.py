@@ -1,0 +1,291 @@
+"""Shm-transport stress: push daemon → shm ring → batcher → engine to
+the Mpps regime.
+
+VERDICT r4 "what's weak" #7: SERVE artifacts report ~1.6 k records/s
+through the real pipeline, but that number is SCENARIO-bound — once a
+source is blacklisted the kernel stops emitting records for it, so a
+mitigation scenario converges to a trickle by design.  Nobody had
+measured the transport's actual ceiling.  This harness does, in two
+phases against a free-running `fsxd --sim` producer (no pacing beyond
+ring backpressure; the C++ generator is the same record statistics the
+daemon integration tests use):
+
+* **drain** — ShmRingSource.poll in a bare loop, no engine: the shm
+  ring + numpy-copy ceiling of the Python consumer side.
+* **engine** — the real Engine (micro-batcher → fused step → verdict
+  writeback to the verdict ring) consuming the same stream.  Runs on
+  CPU (JAX_PLATFORMS=cpu) so the artifact measures the host pipeline
+  independent of the axon tunnel state, and never contends with a
+  concurrent TPU bench.
+
+Traffic is benign-only by default (attack_fraction 0) so blacklist
+suppression cannot throttle the stream mid-measurement; a mixed run
+exercises the verdict path too and reports suppression separately.
+
+Writes SHMSTRESS_r05.json at the repo root.
+Reference seam: the rebuilt analog of AmruthSD/FlowSentryX's intended
+ringbuf → userspace ML hand-off (src/fsx_load.py:5-12), which the
+reference never drove at rate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# Force, not setdefault: the session environment pins JAX_PLATFORMS=axon
+# (the tunneled TPU), and this harness must measure the host pipeline on
+# CPU regardless — and must never contend with a concurrent TPU bench.
+# sitecustomize force-registers axon and overrides the env var, so the
+# config API below (before any backend init) is the binding setting.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from flowsentryx_tpu.core import schema  # noqa: E402
+from flowsentryx_tpu.core.config import (  # noqa: E402
+    BatchConfig, FsxConfig, ModelConfig, TableConfig,
+)
+
+FSXD = REPO / "daemon" / "build" / "fsxd"
+DUR = float(os.environ.get("FSX_STRESS_DUR", "20"))
+
+
+def start_daemon(fring: str, vring: str, duration: float,
+                 attack_fraction: float, rate_pps: float,
+                 ring_capacity: int = 1 << 17,
+                 pace: bool = False) -> subprocess.Popen:
+    # Benign pool scales with the SIM clock rate so per-source pps stays
+    # ~250 (benign-plausible): at a fixed 1024-source pool a 1e6-pps sim
+    # clock makes every benign source timestamp out to ~1 kpps, which
+    # the model/limiters rightly treat as attack traffic — a generator
+    # artifact, not a benign-FPR signal.
+    n_benign = max(1024, int(rate_pps * (1.0 - attack_fraction) / 250))
+    cmd = [str(FSXD), "--sim",
+           "--duration", str(duration),
+           "--rate", str(rate_pps),
+           "--attack-fraction", str(attack_fraction),
+           "--attack-ips", "64",
+           "--benign-ips", str(n_benign),
+           "--feature-ring", fring, "--verdict-ring", vring,
+           "--ring-capacity", str(ring_capacity),
+           "--seed", "7"]
+    if pace:
+        cmd.append("--pace")
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+
+
+def daemon_result(proc: subprocess.Popen) -> dict:
+    out, _ = proc.communicate(timeout=30)
+    for line in out.splitlines()[::-1]:
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {}
+
+
+def phase_drain(duration: float) -> dict:
+    """Bare ring-drain ceiling: no batcher, no step."""
+    from flowsentryx_tpu.engine.shm import ShmRingSource
+
+    with tempfile.TemporaryDirectory() as td:
+        fring, vring = f"{td}/fring", f"{td}/vring"
+        proc = start_daemon(fring, vring, duration + 1.0,
+                            attack_fraction=0.0, rate_pps=1e7)
+        try:
+            src = ShmRingSource(fring)
+            n = 0
+            polls = 0
+            t0 = time.perf_counter()
+            deadline = t0 + duration
+            while time.perf_counter() < deadline:
+                chunk = src.poll(8192)
+                polls += 1
+                if len(chunk):
+                    n += len(chunk)
+                else:
+                    time.sleep(0.0002)
+            wall = time.perf_counter() - t0
+        finally:
+            proc.terminate()
+        d = daemon_result(proc)
+        return {
+            "records_drained": n,
+            "wall_s": round(wall, 3),
+            "drain_mpps": round(n / wall / 1e6, 4),
+            "polls": polls,
+            "daemon": d,
+        }
+
+
+class _IdleSource:
+    """Placeholder source so engines can be built (and their step
+    compiled) before the daemon's rings exist."""
+
+    def poll(self, max_records: int):
+        import numpy as np
+
+        return np.zeros(0, schema.FLOW_RECORD_DTYPE)
+
+    def exhausted(self) -> bool:
+        return True
+
+
+def get_engine(max_batch: int, _cache: dict = {}):
+    """Build + WARM a cached engine for ``max_batch``.
+
+    The pristine table/stats checkpoint is taken first; ``Engine.warm``
+    then triggers the step's XLA compile OUTSIDE any measured window
+    (the first sweep row would otherwise eat multi-second compile while
+    the daemon floods the ring), and the checkpoint is restored so
+    every row starts from identical state."""
+    got = _cache.get(max_batch)
+    if got is not None:
+        return got
+    from flowsentryx_tpu.engine.engine import Engine
+    from flowsentryx_tpu.engine.writeback import NullSink
+
+    cfg = FsxConfig(
+        table=TableConfig(capacity=1 << 20),
+        batch=BatchConfig(max_batch=max_batch, deadline_us=10_000),
+        model=ModelConfig(vote_k=4, vote_m=2),
+    )
+    eng = Engine(cfg, _IdleSource(), NullSink(), readback_depth=8)
+    ckpt = eng.checkpoint(
+        tempfile.mktemp(prefix=f"fsx_stress_ckpt_{max_batch}_"))
+    eng.warm()
+    eng.restore(ckpt)
+    _cache[max_batch] = (eng, ckpt)
+    return eng, ckpt
+
+
+def phase_engine(duration: float, attack_fraction: float,
+                 max_batch: int, label: str,
+                 rate_pps: float = 1e7, pace: bool = False) -> dict:
+    """Real pipeline: ring → MicroBatcher → fused step → verdict ring.
+
+    ``pace=True`` offers records at ``rate_pps`` in real time (the
+    achieved/offered view — a real data plane delivers at line rate);
+    ``pace=False`` free-runs against ring backpressure (the ceiling
+    view, generator and engine contending for the same host).  Engines
+    are cached per batch size (reset_stream between runs) so each
+    compile is paid once, as a long-lived server would — and each row
+    RESTORES the pristine table/clock checkpoint taken at construction:
+    every fsxd restart rewinds simulated time to ~1 s, so carrying the
+    previous row's table (last-seen stamps ahead of the new stream)
+    would feed the IAT/vote logic negative time deltas.  A 10 ms flush
+    deadline keeps batches full at low offered loads (this harness
+    measures throughput; latency artifacts are DISPATCH/BENCH's job).
+    """
+    from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
+
+    from flowsentryx_tpu.engine.writeback import NullSink
+
+    eng, ckpt = get_engine(max_batch)
+    # Reset + restore BEFORE the daemon exists: restoring the 1M-row
+    # table costs seconds on this host, and a daemon already producing
+    # into a 131072-slot ring would overflow it during that window —
+    # startup loss masquerading as steady-state loss.  The live
+    # source/sink swap in afterwards without touching engine state.
+    eng.reset_stream(_IdleSource(), NullSink())
+    eng.restore(ckpt)
+    with tempfile.TemporaryDirectory() as td:
+        fring, vring = f"{td}/fring", f"{td}/vring"
+        proc = start_daemon(fring, vring, duration + 2.0,
+                            attack_fraction=attack_fraction,
+                            rate_pps=rate_pps, pace=pace)
+        try:
+            src = ShmRingSource(fring)
+            sink = ShmVerdictSink(vring)
+            eng.source = src
+            eng.sink = sink
+            t0 = time.perf_counter()
+            rep = eng.run(max_seconds=duration)
+            wall = time.perf_counter() - t0
+            ring_left = src.ring.readable()
+        finally:
+            proc.terminate()
+        d = daemon_result(proc)
+        offered = d.get("produced", 0) - d.get("suppressed", 0)
+        # NOTE on daemon counters: the daemon outlives the engine's
+        # measurement window (duration+2 plus terminate latency), so its
+        # dropped_ring_full is dominated by the post-run tail when the
+        # engine keeps up — achieved/offered over the ENGINE's window is
+        # the loss signal, not ring_drop.
+        return {
+            "label": label,
+            "attack_fraction": attack_fraction,
+            "max_batch": max_batch,
+            "paced": pace,
+            "offered_mpps": (round(rate_pps / 1e6, 3) if pace
+                             else round(offered / max(wall, 1e-9) / 1e6, 4)),
+            "wire": eng.wire,
+            "engine_records": rep.records,
+            # rep.wall_s covers the serving loop + final reap and
+            # EXCLUDES the end-of-report 1M-row table summary (~3 s on
+            # this host), which the outer wall would misattribute as
+            # serving time.
+            "engine_wall_s": rep.wall_s,
+            "outer_wall_s": round(wall, 3),
+            "ring_readable_at_stop": int(ring_left),
+            "engine_mpps": round(rep.records_per_s / 1e6, 4),
+            "records_per_s": rep.records_per_s,
+            "stages_ms": {k: {"p50": v["p50"], "p99": v["p99"]}
+                          for k, v in rep.stages_ms.items()},
+            "blocked_sources": rep.blocked_sources,
+            "stats": rep.stats,
+            "daemon": d,
+        }
+
+
+def main() -> None:
+    r = subprocess.run(["make", "-C", str(REPO / "daemon")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    out = {
+        "round": 5,
+        "purpose": ("shm ring -> batcher -> engine throughput ceiling "
+                    "(VERDICT r4 weakness #7: the ~1.6k records/s in SERVE "
+                    "artifacts is scenario-bound, not a transport limit)"),
+        "engine_backend": "cpu (tunnel-independent; see BENCH for TPU rates)",
+        "duration_s_per_phase": DUR,
+        "drain_only": phase_drain(DUR),
+    }
+    rows = [
+        phase_engine(DUR, 0.0, 2048, "paced_0.25mpps", 0.25e6, pace=True),
+        phase_engine(DUR, 0.0, 2048, "paced_0.5mpps", 0.5e6, pace=True),
+        phase_engine(DUR, 0.0, 2048, "paced_1.0mpps", 1.0e6, pace=True),
+        # Freerun rows pin the SIM clock to 1e6 pps: the generator runs
+        # at memcpy speed regardless, but record timestamps must keep
+        # per-source rates benign-plausible (at --rate 1e7 every benign
+        # source timestamps out to ~10 k pps and the model correctly
+        # blocks it — a sim-clock artifact, not a benign-FPR signal).
+        phase_engine(DUR, 0.0, 2048, "freerun_b2048", 1e6),
+        phase_engine(DUR, 0.0, 1024, "freerun_b1024", 1e6),
+        phase_engine(DUR, 0.2, 2048, "freerun_mixed_attack20", 1e6),
+    ]
+    out["engine_rows"] = rows
+    best = max(rows, key=lambda r: r["engine_mpps"])
+    out["headline"] = {
+        "drain_mpps": out["drain_only"]["drain_mpps"],
+        "engine_mpps": best["engine_mpps"],
+        "engine_config": best["label"],
+        "host_cores": os.cpu_count(),
+        "vs_serve_r04_records_per_s": 1628.8,
+    }
+    Path(REPO / "SHMSTRESS_r05.json").write_text(json.dumps(out, indent=1))
+    print(json.dumps(out["headline"]))
+
+
+if __name__ == "__main__":
+    main()
