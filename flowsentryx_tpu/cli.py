@@ -252,9 +252,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.models.registry import load_artifact
 
         params = load_artifact(cfg.model.name, args.artifact)
-    eng = Engine(cfg, source, sink, params=params, mesh=mesh)
+    eng = Engine(cfg, source, sink, params=params, mesh=mesh,
+                 mega_n=args.mega or 0)
     if args.restore:
         eng.restore(args.restore)
+    if args.mega:
+        # pay both compiles at boot, not on the first traffic backlog
+        eng.warm()
     import contextlib
 
     if args.profile:
@@ -596,6 +600,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--seconds", type=float, default=0, help="stop after S seconds")
     s.add_argument("--mesh", type=int, default=0,
                    help="serve sharded over an N-device mesh (N>1)")
+    s.add_argument("--mega", type=int, default=0,
+                   help="group N backlogged batches into one lax.scan "
+                        "dispatch (amortizes per-dispatch cost on "
+                        "tunneled/high-rate links; single-device "
+                        "compact16 only)")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
     s.add_argument("--profile",
                    help="write a jax.profiler trace to this directory")

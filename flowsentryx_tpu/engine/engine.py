@@ -56,6 +56,7 @@ class _InFlight(NamedTuple):
     out: Any            # StepOutput of device futures
     t_enqueue: float    # when the batch's first record entered the batcher
     n_records: int      # valid records in the batch (wire meta row)
+    n_chunks: int = 1   # batches in this entry (mega_n for a mega dispatch)
 
 
 class Engine:
@@ -78,6 +79,7 @@ class Engine:
         t0_ns: int | None = None,
         mesh: Any | None = None,
         wire: str | None = None,
+        mega_n: int = 0,
     ):
         self.cfg = cfg
         self.source = source
@@ -164,10 +166,33 @@ class Engine:
             self.table = jax.device_put(schema.make_table(cfg.table.capacity))
         self.stats = jax.device_put(schema.make_stats())
         self.readback_depth = readback_depth
+        # Mega-dispatch (SURVEY.md §7.4.1 brought into SERVING): when
+        # the source backlog holds ≥ mega_n sealed batches, they go to
+        # the device as ONE lax.scan dispatch — the fixed per-dispatch
+        # cost (the tunneled runtime's RPC floor above all) is paid once
+        # per group instead of per batch.  Purely backlog-triggered: the
+        # moment a poll comes back short the pending batches dispatch
+        # singly, so low-load latency behavior is unchanged.
+        self.mega_n = int(mega_n)
+        self.megastep = None
+        if self.mega_n > 0:
+            if self.mesh is not None:
+                raise ValueError("mega_n requires a single-device engine "
+                                 "(the sharded step dispatches per batch)")
+            if wire != schema.WIRE_COMPACT16:
+                raise ValueError("mega_n requires the compact16 wire")
+            self.megastep = fused.make_jitted_compact_megastep(
+                cfg, spec.classify_batch, self.mega_n, donate=donate,
+                **quant,
+            )
+        #: Sealed-but-undispatched (raw, t_seal) group candidates.
+        self._pending: list[tuple[np.ndarray, float]] = []
         # A wire buffer may be reused only after its batch is off the
-        # in-flight queue: keep more buffers than in-flight batches.
+        # in-flight queue (or, for a pending group member, dispatched):
+        # keep more buffers than in-flight batches + the pending group.
         self.batcher = MicroBatcher(
-            cfg.batch, t0_ns=t0_ns or 0, n_buffers=readback_depth + 2,
+            cfg.batch, t0_ns=t0_ns or 0,
+            n_buffers=readback_depth + 2 + self.mega_n,
             wire=wire, quant=quant,
         )
         # t0 anchors the device clock (f32 seconds).  None = auto: take
@@ -215,14 +240,42 @@ class Engine:
             )
         self._inflight.append(_InFlight(out, t_enqueue, n_records))
 
+    def _dispatch_mega(self, group: list[tuple[np.ndarray, float]]) -> None:
+        """One lax.scan dispatch over ``mega_n`` sealed wire buffers.
+
+        Queued as ONE in-flight entry whose StepOutput fields are
+        stacked ``[N, B]`` (``now``/``route_drop``: ``[N]``) —
+        :meth:`_sink_group` ravels, so verdict extraction is unchanged.
+        e2e is anchored at the OLDEST member's first-record arrival (the
+        honest group latency: earlier members waited for the group)."""
+        b = self.cfg.batch.max_batch
+        raws = np.stack([raw for raw, _ in group])
+        n_records = int(sum(int(raw[b, 0]) for raw, _ in group))
+        with self.metrics.dispatch.time():
+            self.table, self.stats, out = self.megastep(
+                self.table, self.stats, self.params, raws
+            )
+        self._inflight.append(
+            _InFlight(out, min(t for _, t in group), n_records,
+                      n_chunks=len(group)))
+
     def _reap(self, down_to: int) -> None:
-        """Fetch + sink verdicts until only ``down_to`` batches remain
-        queued — BLOCKING on device completion if needed.  This is the
-        pipeline-depth cap; the latency path is :meth:`_reap_ready`."""
-        n = len(self._inflight) - down_to
-        if n <= 0:
-            return
-        self._sink_group([self._inflight.pop(0) for _ in range(n)])
+        """Fetch + sink verdicts until at most ``down_to`` BATCHES
+        remain queued — BLOCKING on device completion if needed.  This
+        is the pipeline-depth cap; the latency path is
+        :meth:`_reap_ready`.  Counted in batches, not queue entries: a
+        mega dispatch is one entry of ``mega_n`` batches, and letting
+        it count as one would silently multiply the configured pipe
+        depth (and its device output memory / tail latency) by
+        ``mega_n``."""
+        total = sum(g.n_chunks for g in self._inflight)
+        group: list[_InFlight] = []
+        while self._inflight and total > down_to:
+            g = self._inflight.pop(0)
+            total -= g.n_chunks
+            group.append(g)
+        if group:
+            self._sink_group(group)
 
     def _reap_ready(self) -> None:
         """Sink every batch the device has ALREADY finished, oldest
@@ -260,22 +313,28 @@ class Engine:
         switch back to one device-side concat so the per-readback fixed
         cost — the RPC floor on tunneled runtimes — is paid per group,
         not per batch."""
+        # .reshape(-1) everywhere: a mega-dispatch entry carries stacked
+        # [N, B] fields (now/route_drop [N]); single entries are [B]/[].
         with self.metrics.readback.time():
             if len(group) <= 2:
                 keys = np.concatenate(
-                    [np.asarray(g.out.block_key) for g in group]) \
-                    if len(group) > 1 else np.asarray(group[0].out.block_key)
+                    [np.asarray(g.out.block_key).reshape(-1)
+                     for g in group]) \
+                    if len(group) > 1 \
+                    else np.asarray(group[0].out.block_key).reshape(-1)
                 untils = np.concatenate(
-                    [np.asarray(g.out.block_until) for g in group]) \
-                    if len(group) > 1 else np.asarray(group[0].out.block_until)
+                    [np.asarray(g.out.block_until).reshape(-1)
+                     for g in group]) \
+                    if len(group) > 1 \
+                    else np.asarray(group[0].out.block_until).reshape(-1)
             else:
                 import jax.numpy as jnp
 
-                keys = np.asarray(
-                    jnp.concatenate([g.out.block_key for g in group]))
-                untils = np.asarray(
-                    jnp.concatenate([g.out.block_until for g in group]))
-            now = float(np.asarray(group[-1].out.now))
+                keys = np.asarray(jnp.concatenate(
+                    [g.out.block_key.reshape(-1) for g in group]))
+                untils = np.asarray(jnp.concatenate(
+                    [g.out.block_until.reshape(-1) for g in group]))
+            now = float(np.max(np.asarray(group[-1].out.now)))
             # routing-overflow fail-opens (sharded step): single-device
             # steps carry a module-level numpy zero here — free, no
             # device fetch.  Sharded jax scalars: per-batch fetch on the
@@ -287,12 +346,15 @@ class Engine:
                    for rd in rds):
                 self._route_drop += sum(int(rd) for rd in rds)
             elif len(group) <= 2:
-                self._route_drop += sum(int(np.asarray(rd)) for rd in rds)
+                # .sum() not int(): a mega entry's route_drop is [N]
+                self._route_drop += sum(
+                    int(np.asarray(rd).sum()) for rd in rds)
             else:
                 import jax.numpy as jnp
 
-                self._route_drop += int(np.asarray(
-                    jnp.sum(jnp.stack([jnp.asarray(rd) for rd in rds]))))
+                self._route_drop += int(np.asarray(jnp.sum(
+                    jnp.concatenate([jnp.ravel(jnp.asarray(rd))
+                                     for rd in rds]))))
         upd = extract_updates(keys, untils)
         self.sink.apply(upd)
         self._blocked.update(upd.key.tolist())
@@ -320,6 +382,10 @@ class Engine:
         warm = np.zeros((self.cfg.batch.max_batch + 1, words), np.uint32)
         self._dispatch(warm, time.perf_counter())
         self._reap(0)
+        if self.megastep is not None:
+            self._dispatch_mega(
+                [(warm, time.perf_counter())] * self.mega_n)
+            self._reap(0)
 
     # -- stream rebinding ---------------------------------------------------
 
@@ -348,7 +414,7 @@ class Engine:
         Per-stream report counters (metrics, blocked set, route drops)
         reset; ``_device_now`` survives, being a high-water mark on the
         persisting clock.  Must not be called with batches in flight."""
-        if self._inflight:
+        if self._inflight or self._pending:
             raise RuntimeError("reset_stream with batches in flight")
         self.source = source
         if sink is not None:
@@ -360,7 +426,7 @@ class Engine:
         self.batcher = MicroBatcher(
             self.cfg.batch,
             t0_ns=keep_t0,
-            n_buffers=self.readback_depth + 2,
+            n_buffers=self.readback_depth + 2 + self.mega_n,
             wire=self.wire,
             quant=quant,
         )
@@ -451,7 +517,12 @@ class Engine:
 
         while not bounded():
             with self.metrics.fill.time():
-                records = self.source.poll(cfg_b.max_batch - self.batcher.fill)
+                # Mega mode polls up to the remaining GROUP capacity so
+                # a deep source backlog can seal several batches in one
+                # drain; otherwise exactly one batch's worth.
+                group_room = max(self.mega_n - len(self._pending), 1)
+                requested = group_room * cfg_b.max_batch - self.batcher.fill
+                records = self.source.poll(requested)
                 if self._t0_auto and len(records):
                     if self.precompact:
                         t0 = int(schema.unwrap_kernel_ts16(
@@ -486,9 +557,27 @@ class Engine:
                         and self.batcher.flush_due()):
                     took = self.batcher.take()
                     sealed = [took] if took is not None else []
-            for raw in sealed:
-                self._dispatch(raw, self.batcher.pop_seal_time())
-                self._reap(self.readback_depth)
+            if self.mega_n > 0:
+                # Backlog-triggered grouping: full groups go as one
+                # dispatch; the moment the source comes back short (no
+                # deep backlog) the stragglers dispatch singly, so mega
+                # only ever ADDS latency to batches that were queueing
+                # behind a backlog anyway.
+                for raw in sealed:
+                    self._pending.append((raw, self.batcher.pop_seal_time()))
+                while len(self._pending) >= self.mega_n:
+                    self._dispatch_mega(self._pending[:self.mega_n])
+                    del self._pending[:self.mega_n]
+                    self._reap(self.readback_depth)
+                if self._pending and len(records) < requested:
+                    for raw, t_seal in self._pending:
+                        self._dispatch(raw, t_seal)
+                        self._reap(self.readback_depth)
+                    self._pending.clear()
+            else:
+                for raw in sealed:
+                    self._dispatch(raw, self.batcher.pop_seal_time())
+                    self._reap(self.readback_depth)
             # Latency path: sink whatever the device has finished, every
             # iteration — including iterations that sealed nothing (the
             # depth cap above only bounds the pipe; waiting for it to
@@ -505,6 +594,15 @@ class Engine:
                 # well under the flush budget.
                 time.sleep(min(cfg_b.deadline_us / 4, 200) / 1e6)
 
+        # A bounded exit (max_batches/max_seconds) can in principle trip
+        # with sealed group candidates still pending (span-boundary
+        # partial seals make the per-iteration invariants fragile):
+        # dispatch them singly — their records are already counted in
+        # records_emitted, and leaving them would also wedge a later
+        # reset_stream on a genuinely idle engine.
+        for raw, t_seal in self._pending:
+            self._dispatch(raw, t_seal)
+        self._pending.clear()
         self._reap(0)
         wall = time.perf_counter() - t_start
 

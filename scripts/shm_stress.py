@@ -140,7 +140,7 @@ class _IdleSource:
         return True
 
 
-def get_engine(max_batch: int, _cache: dict = {}):
+def get_engine(max_batch: int, mega_n: int = 0, _cache: dict = {}):
     """Build + WARM a cached engine for ``max_batch``.
 
     The pristine table/stats checkpoint is taken first; ``Engine.warm``
@@ -148,7 +148,7 @@ def get_engine(max_batch: int, _cache: dict = {}):
     (the first sweep row would otherwise eat multi-second compile while
     the daemon floods the ring), and the checkpoint is restored so
     every row starts from identical state."""
-    got = _cache.get(max_batch)
+    got = _cache.get((max_batch, mega_n))
     if got is not None:
         return got
     from flowsentryx_tpu.engine.engine import Engine
@@ -159,18 +159,22 @@ def get_engine(max_batch: int, _cache: dict = {}):
         batch=BatchConfig(max_batch=max_batch, deadline_us=10_000),
         model=ModelConfig(vote_k=4, vote_m=2),
     )
-    eng = Engine(cfg, _IdleSource(), NullSink(), readback_depth=8)
+    # readback_depth counts BATCHES: a mega engine needs 2 groups'
+    # worth so one group can fill/dispatch while the previous runs.
+    eng = Engine(cfg, _IdleSource(), NullSink(),
+                 readback_depth=max(8, 2 * mega_n), mega_n=mega_n)
     ckpt = eng.checkpoint(
         tempfile.mktemp(prefix=f"fsx_stress_ckpt_{max_batch}_"))
     eng.warm()
     eng.restore(ckpt)
-    _cache[max_batch] = (eng, ckpt)
+    _cache[(max_batch, mega_n)] = (eng, ckpt)
     return eng, ckpt
 
 
 def phase_engine(duration: float, attack_fraction: float,
                  max_batch: int, label: str,
-                 rate_pps: float = 1e7, pace: bool = False) -> dict:
+                 rate_pps: float = 1e7, pace: bool = False,
+                 mega_n: int = 0) -> dict:
     """Real pipeline: ring → MicroBatcher → fused step → verdict ring.
 
     ``pace=True`` offers records at ``rate_pps`` in real time (the
@@ -190,7 +194,7 @@ def phase_engine(duration: float, attack_fraction: float,
 
     from flowsentryx_tpu.engine.writeback import NullSink
 
-    eng, ckpt = get_engine(max_batch)
+    eng, ckpt = get_engine(max_batch, mega_n)
     # Reset + restore BEFORE the daemon exists: restoring the 1M-row
     # table costs seconds on this host, and a daemon already producing
     # into a 131072-slot ring would overflow it during that window —
@@ -225,6 +229,7 @@ def phase_engine(duration: float, attack_fraction: float,
             "label": label,
             "attack_fraction": attack_fraction,
             "max_batch": max_batch,
+            "mega_n": mega_n,
             "paced": pace,
             "offered_mpps": (round(rate_pps / 1e6, 3) if pace
                              else round(offered / max(wall, 1e-9) / 1e6, 4)),
@@ -271,6 +276,11 @@ def main() -> None:
         # source timestamps out to ~10 k pps and the model correctly
         # blocks it — a sim-clock artifact, not a benign-FPR signal).
         phase_engine(DUR, 0.0, 2048, "freerun_b2048", 1e6),
+        # mega-dispatch engine on the same freerun stream: the
+        # backlog-grouped lax.scan path (Engine mega_n) amortizing
+        # per-dispatch overhead
+        phase_engine(DUR, 0.0, 2048, "freerun_b2048_mega8", 1e6,
+                     mega_n=8),
         phase_engine(DUR, 0.0, 1024, "freerun_b1024", 1e6),
         phase_engine(DUR, 0.2, 2048, "freerun_mixed_attack20", 1e6),
     ]

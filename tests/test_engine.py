@@ -330,6 +330,51 @@ class TestEngineLoop:
         assert rep.stats["dropped_rate"] == 0
         assert rep.stats["allowed"] > rep.records * 0.9
 
+    def test_mega_dispatch_matches_single(self):
+        """Engine(mega_n=4): backlog-grouped lax.scan dispatch must
+        reproduce the single-dispatch engine's verdicts, stats, and
+        final table EXACTLY (the megastep is trajectory-identical by
+        construction; this pins the ENGINE's grouping/flattening
+        plumbing), while actually grouping (fewer dispatch timings
+        than batches)."""
+        import jax
+
+        # ONE pregenerated stream: TrafficGen's rng consumption depends
+        # on the poll chunk size, and the mega engine polls group-sized
+        # chunks — polling the generator live would feed the two
+        # engines different records, not different processing.
+        recs = TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=32, attack_fraction=0.8, seed=11)
+        ).next_records(256 * 32)
+
+        def run(mega_n):
+            cfg = small_cfg(batch=256, pps_threshold=200.0,
+                            bps_threshold=1e9)
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         readback_depth=4, mega_n=mega_n)
+            rep = eng.run()
+            return rep, sink, eng
+
+        rep1, sink1, eng1 = run(0)
+        rep4, sink4, eng4 = run(4)
+        assert rep4.records == rep1.records
+        assert rep4.stats == rep1.stats
+        assert sink4.blocked == sink1.blocked
+        # grouping actually happened: 32 batches in ≤ 8 + stragglers
+        assert (rep4.stages_ms["dispatch"]["n"]
+                < rep1.stages_ms["dispatch"]["n"])
+        for a, b in zip(jax.tree_util.tree_leaves(eng1.table),
+                        jax.tree_util.tree_leaves(eng4.table)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mega_requires_compact_wire(self):
+        cfg = small_cfg(batch=256)
+        with pytest.raises(ValueError, match="compact16"):
+            Engine(cfg, TrafficSource(TrafficSpec(), total=256),
+                   NullSink(), wire=schema.WIRE_RAW48, mega_n=4)
+
     def test_meshed_engine_matches_single_device(self):
         """Engine(mesh=8 devices) serves through the IP-hash-sharded
         step (VERDICT r2 item 4) and reproduces the single-device run
