@@ -6,7 +6,38 @@ wire-schema or step-signature change lands here once.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+
+def setup_backend(force_cpu_env: str = "FSX_FORCE_CPU"):
+    """Select the JAX platform and (conditionally) the compile cache.
+
+    * ``FSX_FORCE_CPU=1`` pins the CPU backend via the config API —
+      sitecustomize force-registers axon and overrides JAX_PLATFORMS
+      from the environment, so the config API is the binding setting.
+    * The persistent compile cache is enabled ONLY off-CPU (the
+      tunneled TPU, where a recompile costs 5-20 s per shape).
+      XLA:CPU caches AOT machine code keyed loosely enough that
+      entries written under a different detected CPU feature set still
+      LOAD here ("could lead to execution errors such as SIGILL" per
+      its own error log) and measurably distort latency profiles —
+      observed on this host when the VM's reported CPU flags changed
+      between sessions.  Checked AFTER platform selection so a
+      TPU-unreachable CPU fallback also skips the cache.
+
+    Returns the initialized ``jax`` module."""
+    import jax
+
+    if os.environ.get(force_cpu_env):
+        jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu":
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+    return jax
 
 
 def make_step_fixture(B: int, cap: int, donate: bool = False):

@@ -44,11 +44,11 @@ try:
     import jax
     import jax.numpy as jnp
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"),
-    )
+    from _probe_common import setup_backend
+
+    # cache gated off-CPU: a tunnel-down CPU fallback must not load
+    # stale AOT CPU entries (SIGILL / distorted-latency hazard)
+    setup_backend()
     dev = jax.devices()[0]
     out["init_s"] = round(time.perf_counter() - t0, 1)
     out["backend"] = dev.platform

@@ -34,12 +34,9 @@ K = 64  # total micro-batches timed per variant
 def main() -> int:
     import jax
 
-    if os.environ.get("FSX_FORCE_CPU"):
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"))
+    from _probe_common import setup_backend
+
+    setup_backend()
 
     from flowsentryx_tpu.core import schema
     from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig

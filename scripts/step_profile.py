@@ -36,14 +36,9 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    if os.environ.get("FSX_FORCE_CPU"):
-        # sitecustomize force-registers axon and overrides JAX_PLATFORMS
-        # from the environment; the config API wins
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"))
+    from _probe_common import setup_backend
+
+    setup_backend()
 
     from flowsentryx_tpu.core import schema
     from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
