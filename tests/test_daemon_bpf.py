@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import pathlib
+import socket
 import struct
 import subprocess
 import time
@@ -145,6 +146,39 @@ def test_daemon_bpf_end_to_end(fsxd_bin, prog_image, tmp_path):
             time.sleep(0.1)
         assert loader.prog_test_run(prog_fd, ip4(0x0A000100))[0] == 1, \
             "verdict never reached the kernel blacklist map"
+
+        # operator surface: fsx top reads the per-flow/per-IP tables
+        # (reference README.md:143-146 "print it in a nice format")
+        import contextlib
+        import io
+        import json as js
+
+        from flowsentryx_tpu import cli
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli.main(["top", "--pin", PIN_DIR, "--json"]) == 0
+        top = js.loads(buf.getvalue())
+        by_ip = {r["ip"]: r for r in top["flows"]}
+        # same key→dotted-quad convention as blacklist.Entry rendering
+        flood_ip = socket.inet_ntoa(struct.pack("<I", 0xC0A80001))
+        benign_ip = socket.inet_ntoa(struct.pack("<I", 0x0A000100))
+        flood_row = by_ip.get(flood_ip)
+        assert flood_row is not None, top
+        # stats accumulate for ALLOWED packets only: 5 of the 10 flood
+        # packets passed before the limiter tripped
+        assert flood_row["pkts"] >= 5
+        assert flood_row["dport"] == 53        # host-order display
+        assert flood_row["blocked_s"] > 0      # kernel-limiter block
+        assert benign_ip in by_ip              # benign source tracked
+        assert top["n_blocked"] >= 2           # flood + ML verdict
+        # human format renders a header + one line per flow
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli.main(["top", "--pin", PIN_DIR, "-n", "3"]) == 0
+        lines = buf.getvalue().strip().splitlines()
+        assert lines[0].split()[:2] == ["ip", "dport"]
+        assert len(lines) == 5  # header + 3 rows + summary
     finally:
         proc.terminate()
         out, err = proc.communicate(timeout=10)
