@@ -713,8 +713,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--mega", type=int, default=0,
                    help="group N backlogged batches into one lax.scan "
                         "dispatch (amortizes per-dispatch cost on "
-                        "tunneled/high-rate links; single-device "
-                        "compact16 only)")
+                        "tunneled/high-rate links; compact16 wire; "
+                        "composes with --mesh via the sharded mega-step)")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
     s.add_argument("--profile",
                    help="write a jax.profiler trace to this directory")

@@ -176,15 +176,20 @@ class Engine:
         self.mega_n = int(mega_n)
         self.megastep = None
         if self.mega_n > 0:
-            if self.mesh is not None:
-                raise ValueError("mega_n requires a single-device engine "
-                                 "(the sharded step dispatches per batch)")
             if wire != schema.WIRE_COMPACT16:
                 raise ValueError("mega_n requires the compact16 wire")
-            self.megastep = fused.make_jitted_compact_megastep(
-                cfg, spec.classify_batch, self.mega_n, donate=donate,
-                **quant,
-            )
+            if self.mesh is not None:
+                from flowsentryx_tpu import parallel as par
+
+                self.megastep = par.make_sharded_compact_megastep(
+                    cfg, spec.classify_batch, self.mesh, self.mega_n,
+                    donate=donate, **quant,
+                )
+            else:
+                self.megastep = fused.make_jitted_compact_megastep(
+                    cfg, spec.classify_batch, self.mega_n, donate=donate,
+                    **quant,
+                )
         #: Sealed-but-undispatched (raw, t_seal) group candidates.
         self._pending: list[tuple[np.ndarray, float]] = []
         # A wire buffer may be reused only after its batch is off the

@@ -543,6 +543,14 @@ def make_jitted_compact_megastep(
     if donate is None:
         donate = donation_supported()
     base = make_compact_step(cfg, classify_batch, **quant)
+    return wrap_megastep(base, n_chunks, (0, 1) if donate else ())
+
+
+def wrap_megastep(base, n_chunks: int, donate_argnums: tuple):
+    """Shared mega-dispatch wrapper: ``lax.scan`` of ``base`` over a
+    ``[N, ...]`` stacked wire group, carrying (table, stats).  Both the
+    single-device and the sharded mega factories build on this, so the
+    chunk-count guard and scan-carry logic cannot drift."""
 
     def mega(table, stats, params, raws):
         if raws.shape[0] != n_chunks:
@@ -559,7 +567,7 @@ def make_jitted_compact_megastep(
         (table, stats), outs = jax.lax.scan(body, (table, stats), raws)
         return table, stats, outs
 
-    return jax.jit(mega, donate_argnums=(0, 1) if donate else ())
+    return jax.jit(mega, donate_argnums=donate_argnums)
 
 
 def donation_supported() -> bool:

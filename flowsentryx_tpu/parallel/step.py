@@ -299,3 +299,32 @@ def make_sharded_compact_step(
         cfg, classify_batch, mesh, donate,
         functools.partial(schema.decode_compact, **quant),
     )
+
+
+def make_sharded_compact_megastep(
+    cfg: FsxConfig,
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    n_chunks: int,
+    donate: bool | None = None,
+    **quant,
+):
+    """N micro-batches in ONE dispatch over the device mesh — the
+    multi-device twin of
+    :func:`~flowsentryx_tpu.ops.fused.make_jitted_compact_megastep`.
+
+    A ``lax.scan`` carries the SHARDED (table, stats) through N
+    shard-mapped steps inside one jit: the per-dispatch fixed cost is
+    paid once per group while every chunk still runs the full
+    owner-routed all_to_all/psum pipeline, so trajectory parity with N
+    sequential sharded dispatches holds by construction (test-pinned).
+    Outs fields stack to ``[N, ...]`` exactly like the single-device
+    megastep, which is what the serving engine's group sink expects.
+    Donation matches the module's table-only policy (the replicated
+    stats output cannot alias a single-device input buffer anyway).
+    """
+    if donate is None:
+        donate = fused.donation_supported()
+    base = make_sharded_compact_step(cfg, classify_batch, mesh,
+                                     donate=False, **quant)
+    return fused.wrap_megastep(base, n_chunks, (0,) if donate else ())

@@ -403,6 +403,36 @@ class TestEngineLoop:
         assert rep_m.blocked_sources == rep_s.blocked_sources
         assert rep_m.batches == rep_s.batches == 24
 
+    def test_meshed_mega_engine_matches_meshed_single(self):
+        """Engine(mesh=8, mega_n=4): the sharded mega-step (lax.scan of
+        shard-mapped steps) must reproduce the per-batch meshed engine
+        exactly — same stats, blocked set, and batch count — while
+        grouping dispatches."""
+        from flowsentryx_tpu.parallel import make_mesh
+
+        recs = TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=32, attack_fraction=0.8, seed=13)
+        ).next_records(512 * 16)
+
+        def run(mega_n):
+            cfg = small_cfg(batch=512, cap=1 << 12, pps_threshold=200.0,
+                            bps_threshold=1e9)
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         readback_depth=8, mesh=make_mesh(8),
+                         mega_n=mega_n)
+            rep = eng.run()
+            return rep, sink
+
+        rep1, sink1 = run(0)
+        rep4, sink4 = run(4)
+        assert rep4.stats == rep1.stats
+        assert sink4.blocked == sink1.blocked
+        assert rep4.batches == rep1.batches == 16
+        assert (rep4.stages_ms["dispatch"]["n"]
+                < rep1.stages_ms["dispatch"]["n"])
+
     def test_meshed_engine_single_device_mesh_falls_back(self):
         from flowsentryx_tpu.parallel import make_mesh
 
