@@ -17,6 +17,10 @@ live for rules added post-start.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
+import hashlib
+import os
 import struct
 from dataclasses import dataclass
 
@@ -82,20 +86,49 @@ def remove(m: loader.Map, rule: RuleConfig) -> bool:
     return bool(m.delete(struct.pack("<I", rule.key())))
 
 
+_CONFIG_NAMES = [n for n, _, _ in FsxConfig.KERNEL_CONFIG_FIELDS]
+
+
+@contextlib.contextmanager
+def config_map_edit(pin_dir: str):
+    """Advisory-locked read-modify-write of the pinned kernel config.
+
+    BPF array-map updates replace the WHOLE value, so two concurrent
+    field updaters (``fsx rules`` bumping ``rule_count``, ``fsx config
+    --set`` rewriting limiter policy) would clobber each other's fields
+    through a bare read-modify-write.  An flock on a /tmp lockfile
+    keyed by the pin path serializes this repo's own writers; the
+    daemon writes the map only at startup, so operator-time races are
+    exactly these two commands.  Yields the unpacked field dict;
+    writes back on clean exit ONLY if the dict changed (a pure read
+    must not re-publish a stale snapshot over a concurrent writer —
+    that would reintroduce the clobber it exists to prevent)."""
+    lockpath = "/tmp/fsx_cfg_%s.lock" % hashlib.sha1(
+        os.path.abspath(pin_dir).encode()).hexdigest()[:16]
+    with open(lockpath, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        fd = loader.obj_get(f"{pin_dir}/config_map")
+        m = loader.Map(fd, loader.MAP_TYPE_ARRAY, 4,
+                       FsxConfig.KERNEL_CONFIG_SIZE, 0, "config_map")
+        try:
+            blob = m.lookup(struct.pack("<I", 0))
+            if blob is None:  # ARRAY lookups can't ENOENT; belt+braces
+                raise RuntimeError(
+                    "no config pushed yet (daemon not started?)")
+            vals = dict(zip(_CONFIG_NAMES, struct.unpack(
+                FsxConfig.KERNEL_CONFIG_FMT, blob)))
+            before = dict(vals)
+            yield vals
+            if vals != before:
+                m.update(struct.pack("<I", 0), struct.pack(
+                    FsxConfig.KERNEL_CONFIG_FMT,
+                    *(vals[n] for n in _CONFIG_NAMES)))
+        finally:
+            m.close()
+
+
 def set_enabled(pin_dir: str, count: int) -> None:
     """Update ``rule_count`` in the pinned config map so runtime-added
     rules take effect (the kernel gate; module docstring)."""
-    fd = loader.obj_get(f"{pin_dir}/config_map")
-    m = loader.Map(fd, loader.MAP_TYPE_ARRAY, 4,
-                   FsxConfig.KERNEL_CONFIG_SIZE, 0, "config_map")
-    try:
-        blob = m.lookup(struct.pack("<I", 0))
-        if blob is None:
-            raise RuntimeError("no config pushed yet (daemon not started?)")
-        vals = list(struct.unpack(FsxConfig.KERNEL_CONFIG_FMT, blob))
-        # rule_count is the second-to-last field (KERNEL_CONFIG_FIELDS)
-        vals[-2] = count
-        m.update(struct.pack("<I", 0),
-                 struct.pack(FsxConfig.KERNEL_CONFIG_FMT, *vals))
-    finally:
-        m.close()
+    with config_map_edit(pin_dir) as vals:
+        vals["rule_count"] = count

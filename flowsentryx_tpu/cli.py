@@ -26,9 +26,114 @@ def _cmd_codegen(args: argparse.Namespace) -> int:
     return 0
 
 
+#: fsx config --set surface: the runtime-tunable limiter policy fields.
+#: ``valid`` (daemon lifecycle), ``rule_count`` (owned by fsx rules),
+#: and ``hash_salt`` (fixed at serve boot; changing it live would strand
+#: every user-plane table row) are deliberately NOT settable.
+_CONFIG_SETTABLE = {
+    "limiter_kind", "pps_threshold", "bps_threshold", "window_ns",
+    "block_ns", "bucket_rate_pps", "bucket_burst", "bucket_rate_bps",
+    "bucket_burst_bytes",
+}
+
+
+def _limiter_codes() -> dict:
+    """CLI short name → wire code, derived from the canonical mapping
+    (``FsxConfig._KIND_CODE``) so a future limiter kind appears here
+    automatically: "fixed_window" → "fixed" etc."""
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    return {k.value.split("_")[0]: code
+            for k, code in FsxConfig._KIND_CODE.items()}
+
+
+def _validate_kernel_config(vals: dict) -> str | None:
+    """Range checks mirroring ``FsxConfig.__post_init__`` — the live
+    path must not admit policy the offline path forbids."""
+    if vals["limiter_kind"] not in set(_limiter_codes().values()):
+        return f"limiter_kind {vals['limiter_kind']} unknown"
+    if vals["window_ns"] <= 0 or vals["block_ns"] <= 0:
+        return "window and block durations must be positive"
+    for f in ("pps_threshold", "bps_threshold", "bucket_rate_pps",
+              "bucket_burst", "bucket_rate_bps", "bucket_burst_bytes"):
+        if not 0 <= vals[f] < 1 << 64:
+            return f"{f} must be a u64 (got {vals[f]})"
+    if (vals["bucket_rate_bps"] == 0) != (vals["bucket_burst_bytes"] == 0):
+        return ("bucket_rate_bps and bucket_burst_bytes must be both "
+                "zero or both positive")
+    return None
+
+
 def _cmd_config(args: argparse.Namespace) -> int:
+    """Show/pack a config — or, with ``--pin``, read and live-update the
+    KERNEL's config map (the reference's "configure the XDP program
+    parameters" line, README.md:145; the program re-reads the map per
+    packet, so updates take effect on the next packet, no reload)."""
     from flowsentryx_tpu.core.config import DEFAULT_CONFIG, FsxConfig
 
+    if args.pin:
+        from flowsentryx_tpu.bpf import rules as fsx_rules
+
+        if args.pack:
+            print("fsx config: --pack reads a config FILE; it does not "
+                  "combine with --pin", file=sys.stderr)
+            return 1
+        kinds = _limiter_codes()
+        # Parse every --set spec BEFORE touching the map: an error
+        # mid-application inside config_map_edit would otherwise exit
+        # the context cleanly and publish a half-applied config.
+        pending: dict = {}
+        for spec in args.set or ():
+            field, eq, raw = spec.partition("=")
+            if not eq:
+                print(f"fsx config: --set wants FIELD=VALUE, got "
+                      f"{spec!r}", file=sys.stderr)
+                return 1
+            # seconds-friendly aliases for the ns fields
+            mult = 1.0
+            if field in ("window_s", "block_s"):
+                field = field[:-2] + "_ns"
+                mult = 1e9
+            if field not in _CONFIG_SETTABLE:
+                print(f"fsx config: field {field!r} is not "
+                      f"runtime-settable (choose from "
+                      f"{sorted(_CONFIG_SETTABLE)})", file=sys.stderr)
+                return 1
+            if field == "limiter_kind" and raw in kinds:
+                pending[field] = kinds[raw]
+            else:
+                try:
+                    pending[field] = int(float(raw) * mult)
+                except ValueError:
+                    print(f"fsx config: {field} value {raw!r} is not "
+                          f"a number", file=sys.stderr)
+                    return 1
+        try:
+            with fsx_rules.config_map_edit(args.pin) as vals:
+                vals.update(pending)
+                if pending:
+                    err = _validate_kernel_config(vals)
+                    if err:
+                        # raising skips config_map_edit's write-back
+                        raise ValueError(err)
+                shown = dict(vals)
+        except ValueError as e:
+            print(f"fsx config: rejected: {e}", file=sys.stderr)
+            return 1
+        except (OSError, RuntimeError) as e:
+            print(f"fsx config: cannot read config_map under "
+                  f"{args.pin}: {e}", file=sys.stderr)
+            return 1
+        shown["window_s"] = shown["window_ns"] / 1e9
+        shown["block_s"] = shown["block_ns"] / 1e9
+        print(json.dumps({"pin": args.pin, "updated": bool(args.set),
+                          "kernel_config": shown}, indent=2))
+        return 0
+
+    if args.set:
+        print("fsx config: --set requires --pin (live kernel update)",
+              file=sys.stderr)
+        return 1
     if args.file:
         cfg = FsxConfig.from_json(Path(args.file).read_text())
     else:
@@ -313,49 +418,126 @@ def _cmd_status(args: argparse.Namespace) -> int:
     if args.pin:
         # live kernel counters off the pinned maps (the reference's
         # planned "display network statistics", README.md:143-146)
-        import struct as _struct
-
-        from flowsentryx_tpu.bpf import blacklist, loader
-
-        # layout derived from the same schema the C struct is
-        # generated from — field names AND types
-        _STRUCT_CH = {"u64": "Q", "u32": "I", "u16": "H", "u8": "B"}
-        names = [n for n, _ in schema.KERNEL_STATS_FIELDS]
-        fmt = "<" + "".join(_STRUCT_CH[t] for _, t in
-                            schema.KERNEL_STATS_FIELDS)
-        vsize = _struct.calcsize(fmt)
-        kern: dict = {}
-        try:
-            fd = loader.obj_get(f"{args.pin}/stats_map")
-            m = loader.Map(fd, loader.MAP_TYPE_PERCPU_ARRAY, 4, vsize,
-                           1, "stats_map")
-            tot = [0] * len(names)
-            for v in m.lookup_percpu(b"\x00\x00\x00\x00"):
-                for i, x in enumerate(_struct.unpack(fmt, v)):
-                    tot[i] += x
-            m.close()
-            kern["stats"] = dict(zip(names, tot))
-        except OSError as e:
-            kern["stats"] = {"error": str(e)}
-        try:
-            bm = blacklist.open_map(args.pin)
-            n = len(blacklist.entries(bm))
-            bm.close()
-            # v6 blocks live exclusively in the exact-match v6 map; a
-            # status that counted only the folded map would report 0
-            # while dropped_blacklist climbs under a v6 flood.  Images
-            # predating the v6 map simply have no pinned map: count 0.
-            try:
-                bm6 = blacklist.open_v6_map(args.pin)
-                n += len(blacklist.entries(bm6))
-                bm6.close()
-            except OSError:
-                pass
-            kern["blacklist_entries"] = n
-        except OSError as e:
-            kern["blacklist_entries"] = {"error": str(e)}
-        out["kernel"] = kern
+        out["kernel"] = _read_kernel(args.pin)
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def _read_kernel(pin: str) -> dict:
+    """Aggregated kernel counters + blacklist size off a bpffs pin dir
+    (shared by ``fsx status`` and ``fsx monitor``).  Layout derived
+    from the same schema the C struct is generated from — field names
+    AND types."""
+    import struct as _struct
+
+    from flowsentryx_tpu.bpf import blacklist, loader
+    from flowsentryx_tpu.core import schema
+
+    _STRUCT_CH = {"u64": "Q", "u32": "I", "u16": "H", "u8": "B"}
+    names = [n for n, _ in schema.KERNEL_STATS_FIELDS]
+    fmt = "<" + "".join(_STRUCT_CH[t] for _, t in
+                        schema.KERNEL_STATS_FIELDS)
+    vsize = _struct.calcsize(fmt)
+    kern: dict = {}
+    # try/finally around every map: fsx monitor calls this in an
+    # unbounded loop, so an error path that skipped close() would leak
+    # one fd per tick until EMFILE.
+    m = None
+    try:
+        fd = loader.obj_get(f"{pin}/stats_map")
+        m = loader.Map(fd, loader.MAP_TYPE_PERCPU_ARRAY, 4, vsize,
+                       1, "stats_map")
+        tot = [0] * len(names)
+        for v in m.lookup_percpu(b"\x00\x00\x00\x00"):
+            for i, x in enumerate(_struct.unpack(fmt, v)):
+                tot[i] += x
+        kern["stats"] = dict(zip(names, tot))
+    except OSError as e:
+        kern["stats"] = {"error": str(e)}
+    finally:
+        if m is not None:
+            m.close()
+    # v6 blocks live exclusively in the exact-match v6 map; a status
+    # that counted only the folded map would report 0 while
+    # dropped_blacklist climbs under a v6 flood.  Images predating the
+    # v6 map simply have no pinned map: count 0.
+    n = 0
+    err = None
+    for i, opener in enumerate((blacklist.open_map,
+                                blacklist.open_v6_map)):
+        bm = None
+        try:
+            bm = opener(pin)
+            n += len(blacklist.entries(bm))
+        except OSError as e:
+            if i == 0:
+                err = e
+        finally:
+            if bm is not None:
+                bm.close()
+    kern["blacklist_entries"] = n if err is None else {"error": str(err)}
+    return kern
+
+
+def _cmd_monitor(args: argparse.Namespace) -> int:
+    """Periodic kernel-counter snapshots → JSONL + threshold alerts.
+
+    The reference's "Reporting and Logging" line (README.md:146: store
+    logs, generate alerts, maintain historical data).  Each tick
+    appends one JSON line with absolute counters, per-second deltas,
+    and the blacklist size; alert conditions print to stderr and are
+    flagged in the record, so `fsx monitor --out history.jsonl` is both
+    the log store and the alert source."""
+    import time as _time
+
+    prev: dict | None = None
+    prev_t = 0.0
+    fh = open(args.out, "a") if args.out else None
+    try:
+        for tick in range(args.count) if args.count else iter(int, 1):
+            t = _time.time()
+            kern = _read_kernel(args.pin)
+            rec: dict = {"ts": round(t, 3), "kernel": kern}
+            stats = kern.get("stats", {})
+            alerts = []
+            if prev is not None and "error" not in stats:
+                dt = max(t - prev_t, 1e-9)
+                rec["per_s"] = {
+                    k: round((stats[k] - prev.get(k, 0)) / dt, 1)
+                    for k in stats
+                }
+                drop_pps = (rec["per_s"].get("dropped_blacklist", 0)
+                            + rec["per_s"].get("dropped_rate", 0)
+                            + rec["per_s"].get("dropped_ml", 0)
+                            + rec["per_s"].get("dropped_rule", 0))
+                if args.alert_drop_pps and drop_pps >= args.alert_drop_pps:
+                    alerts.append(f"drop rate {drop_pps:.0f} pps >= "
+                                  f"{args.alert_drop_pps}")
+            # absolute gauge: must fire even on a one-shot first tick
+            nbl = kern.get("blacklist_entries", 0)
+            if (args.alert_blacklist and isinstance(nbl, int)
+                    and nbl >= args.alert_blacklist):
+                alerts.append(f"blacklist size {nbl} >= "
+                              f"{args.alert_blacklist}")
+            if alerts:
+                rec["alerts"] = alerts
+                for a in alerts:
+                    print(f"fsx monitor: ALERT {a}", file=sys.stderr)
+            if "error" not in stats:
+                prev, prev_t = stats, t
+            line = json.dumps(rec)
+            print(line)
+            if fh:
+                fh.write(line + "\n")
+                fh.flush()
+            if args.count and tick == args.count - 1:
+                break
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if fh:
+            fh.close()
     return 0
 
 
@@ -650,6 +832,14 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--file", help="JSON config file (default: built-in defaults)")
     c.add_argument("--pack", action="store_true",
                    help="emit the binary kernel config-map blob to stdout")
+    c.add_argument("--pin",
+                   help="read (and with --set, live-update) the KERNEL "
+                        "config map off this bpffs pin dir")
+    c.add_argument("--set", action="append", metavar="FIELD=VALUE",
+                   help="update a limiter field in the pinned kernel "
+                        "config (repeatable; e.g. pps_threshold=5000, "
+                        "window_s=2, limiter_kind=token); takes effect "
+                        "on the next packet")
     c.set_defaults(fn=_cmd_config)
 
     v = sub.add_parser("version", help="print version")
@@ -727,6 +917,21 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("-n", type=int, default=20, help="show top N flows")
     tp.add_argument("--json", action="store_true")
     tp.set_defaults(fn=_cmd_top)
+
+    mo = sub.add_parser("monitor",
+                        help="periodic kernel snapshots -> JSONL + alerts")
+    mo.add_argument("--pin", default="/sys/fs/bpf/fsx",
+                    help="bpffs pin dir of a live fsxd deployment")
+    mo.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between snapshots")
+    mo.add_argument("--count", type=int, default=0,
+                    help="stop after N snapshots (0 = run until ^C)")
+    mo.add_argument("--out", help="append JSONL history to this file")
+    mo.add_argument("--alert-drop-pps", type=float, default=0,
+                    help="alert when total drop rate reaches N pps")
+    mo.add_argument("--alert-blacklist", type=int, default=0,
+                    help="alert when blacklist size reaches N sources")
+    mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")
     st.add_argument("--feature-ring", default="/tmp/fsx_feature_ring")

@@ -179,6 +179,43 @@ def test_daemon_bpf_end_to_end(fsxd_bin, prog_image, tmp_path):
         lines = buf.getvalue().strip().splitlines()
         assert lines[0].split()[:2] == ["ip", "dport"]
         assert len(lines) == 5  # header + 3 rows + summary
+
+        # operator surface: fsx config --set updates the LIVE kernel
+        # config map (re-read per packet, effective on the next one)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli.main(["config", "--pin", PIN_DIR,
+                             "--set", "pps_threshold=2"]) == 0
+        got = js.loads(buf.getvalue())
+        assert got["kernel_config"]["pps_threshold"] == 2
+        assert got["kernel_config"]["valid"] == 1  # untouched
+        fresh = 0x0A000700  # source unseen so far
+        res = [loader.prog_test_run(prog_fd, ip4(fresh))[0]
+               for _ in range(5)]
+        assert res == [2, 2, 1, 1, 1]  # new threshold, next packet
+        # non-settable fields refuse
+        assert cli.main(["config", "--pin", PIN_DIR,
+                         "--set", "hash_salt=1"]) == 1
+
+        # operator surface: fsx monitor appends JSONL history + alerts
+        hist = tmp_path / "history.jsonl"
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli.main(["monitor", "--pin", PIN_DIR,
+                             "--interval", "0.2", "--count", "2",
+                             "--out", str(hist),
+                             "--alert-blacklist", "1"]) == 0
+        ticks = [js.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert len(ticks) == 2
+        assert ticks[0]["kernel"]["stats"]["allowed"] > 0
+        assert "per_s" in ticks[1]          # deltas from tick 2 on
+        # absolute-gauge alert fires on the FIRST tick (one-shot cron
+        # usage) and on later ones
+        for tk in ticks:
+            assert any("blacklist size" in a
+                       for a in tk.get("alerts", []))
+        assert len(hist.read_text().strip().splitlines()) == 2
     finally:
         proc.terminate()
         out, err = proc.communicate(timeout=10)
