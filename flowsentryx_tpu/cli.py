@@ -285,6 +285,16 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
     ``--feature-ring`` consumes the daemon's shm ring (production);
     ``--scenario`` runs an in-process synthetic scenario (no daemon)."""
+    # Argument validation BEFORE any engine work: rejecting a flag
+    # combination after the multi-second JAX boot + compile is hostile.
+    if args.checkpoint_every and not args.checkpoint:
+        print("fsx serve: --checkpoint-every requires --checkpoint PATH",
+              file=sys.stderr)
+        return 1
+    if args.checkpoint_every < 0:
+        print("fsx serve: --checkpoint-every must be positive",
+              file=sys.stderr)
+        return 1
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
@@ -375,11 +385,47 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     else:
         ctx = contextlib.nullcontext()
     with ctx:
-        rep = eng.run(
-            max_batches=args.batches or None,
-            max_seconds=args.seconds or None,
-        )
-    if args.checkpoint:
+        if args.checkpoint and args.checkpoint_every:
+            # Periodic checkpointing (SURVEY.md §5.4 made operational):
+            # run in checkpoint_every-second chunks, snapshotting the
+            # table/stats/clock between chunks so a crash loses at most
+            # one interval of flow memory.  Engine counters and the
+            # batch bound accumulate across run() calls, so chunking
+            # does not change serving semantics; the printed report is
+            # rebuilt over the TOTAL wall clock.
+            import time as _time
+
+            t0 = _time.perf_counter()
+            rep = None
+            while True:
+                sec = float(args.checkpoint_every)
+                if args.seconds:
+                    left = args.seconds - (_time.perf_counter() - t0)
+                    if left <= 0:
+                        break
+                    sec = min(sec, left)
+                rep = eng.run(max_batches=args.batches or None,
+                              max_seconds=sec)
+                eng.checkpoint(args.checkpoint)
+                if args.batches and rep.batches >= args.batches:
+                    break
+                if eng.source.exhausted():
+                    break
+            if rep is None:  # non-positive --seconds: nothing served
+                rep = eng.run(max_batches=0)
+                eng.checkpoint(args.checkpoint)
+            wall = _time.perf_counter() - t0
+            rep = rep._replace(
+                wall_s=round(wall, 4),
+                records_per_s=round(rep.records / max(wall, 1e-9), 1),
+            )
+        else:
+            rep = eng.run(
+                max_batches=args.batches or None,
+                max_seconds=args.seconds or None,
+            )
+    if args.checkpoint and not args.checkpoint_every:
+        # the chunked loop's last iteration already saved this state
         eng.checkpoint(args.checkpoint)
     print(json.dumps(rep._asdict(), indent=2))
     return 0
@@ -549,7 +595,14 @@ def _cmd_top(args: argparse.Namespace) -> int:
     per-IP state was ``struct ip_stats`` (fsx_struct.h:17-22).  Reads
     the pinned LRU maps directly via raw bpf(2) — works against a live
     ``fsxd --pin`` deployment with no daemon cooperation.  Flow keys
-    are ``saddr ^ (dport << 16)``; the stored dst_port recovers saddr."""
+    are ``saddr ^ (dport << 16)``; the stored dst_port recovers saddr.
+
+    IPv6 caveat: the kernel keys v6 flows by the 32-bit FOLD of the
+    source address (the flow/limiter maps are fold-keyed by design;
+    only the blacklist has an exact-v6 map), and a fold is not
+    invertible — v6 rows therefore display their fold in dotted-quad
+    form.  The ``ip`` column is the map key, not always a routable v4
+    address."""
     import socket as _socket
     import struct as _struct
 
@@ -906,6 +959,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "tunneled/high-rate links; compact16 wire; "
                         "composes with --mesh via the sharded mega-step)")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
+    s.add_argument("--checkpoint-every", type=float, default=0,
+                   help="ALSO checkpoint every S seconds while serving "
+                        "(crash loses at most one interval; requires "
+                        "--checkpoint)")
     s.add_argument("--profile",
                    help="write a jax.profiler trace to this directory")
     s.add_argument("--restore", help="resume from a checkpoint file")

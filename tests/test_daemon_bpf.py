@@ -216,6 +216,34 @@ def test_daemon_bpf_end_to_end(fsxd_bin, prog_image, tmp_path):
             assert any("blacklist size" in a
                        for a in tk.get("alerts", []))
         assert len(hist.read_text().strip().splitlines()) == 2
+
+        # delta-based drop-rate alert: pump a blacklisted source while
+        # the monitor ticks, so dropped_blacklist climbs between
+        # snapshots
+        import threading
+
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                loader.prog_test_run(prog_fd, ip4(0xC0A80001), repeat=50)
+                time.sleep(0.01)
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        try:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                assert cli.main(["monitor", "--pin", PIN_DIR,
+                                 "--interval", "0.4", "--count", "3",
+                                 "--alert-drop-pps", "10"]) == 0
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        ticks = [js.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert any("drop rate" in a for tk in ticks[1:]
+                   for a in tk.get("alerts", []))
     finally:
         proc.terminate()
         out, err = proc.communicate(timeout=10)

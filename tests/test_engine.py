@@ -455,6 +455,37 @@ class TestEngineLoop:
         assert rep.batches == 2  # 256 + padded 44
 
 
+class TestServeCheckpointEvery:
+    def test_periodic_checkpoint_and_restore(self, tmp_path, capsys):
+        """fsx serve --checkpoint-every snapshots mid-serve (crash loses
+        at most one interval) and the final report spans the total
+        wall; the snapshot restores into a fresh serve run."""
+        import json as js
+
+        from flowsentryx_tpu import cli
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        path = tmp_path / "state.npz"
+        assert cli.main(["serve", "--scenario", "syn_benign_mix",
+                         "--rate", "1e6", "--packets", "20480",
+                         "--checkpoint", str(path),
+                         "--checkpoint-every", "0.2"]) == 0
+        rep = js.loads(capsys.readouterr().out)
+        assert rep["records"] == 20480
+        assert path.exists()
+        table, stats, t0_ns, salt, missing = ckpt.load_state(path)
+        assert not missing
+        # --checkpoint-every without --checkpoint refuses
+        assert cli.main(["serve", "--scenario", "syn_benign_mix",
+                         "--packets", "512",
+                         "--checkpoint-every", "1"]) == 1
+        capsys.readouterr()
+        # the snapshot restores (salt adoption = the serve --restore path)
+        assert cli.main(["serve", "--scenario", "syn_benign_mix",
+                         "--rate", "1e6", "--packets", "2048",
+                         "--restore", str(path)]) == 0
+
+
 class TestPallasModelFamily:
     def test_engine_with_pallas_scorer(self):
         """The registered Pallas scorer drives the full serving loop
