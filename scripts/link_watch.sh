@@ -11,7 +11,7 @@
 # Usage: nohup bash scripts/link_watch.sh >/tmp/link_watch.log 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 MON=artifacts/link_monitor_r05.jsonl
-for _ in $(seq 1 60); do
+for _ in $(seq 1 120); do
   out=$(timeout 180 python scripts/link_probe.py 2>/dev/null | tail -1)
   if [ -z "$out" ]; then
     out="{\"ts\": $(date +%s), \"state\": \"wedged\", \"error\": \"probe timeout/empty\"}"
