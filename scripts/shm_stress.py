@@ -270,6 +270,13 @@ def main() -> None:
         phase_engine(DUR, 0.0, 2048, "paced_0.25mpps", 0.25e6, pace=True),
         phase_engine(DUR, 0.0, 2048, "paced_0.5mpps", 0.5e6, pace=True),
         phase_engine(DUR, 0.0, 2048, "paced_1.0mpps", 1.0e6, pace=True),
+        # overload pair: offered above the single-dispatch ceiling, with
+        # and without mega grouping — backlog forms, groups fire, and
+        # the dispatch amortization shows up as achieved throughput
+        # (at the documented group-latency trade)
+        phase_engine(DUR, 0.0, 2048, "paced_1.5mpps", 1.5e6, pace=True),
+        phase_engine(DUR, 0.0, 2048, "paced_1.5mpps_mega8", 1.5e6,
+                     pace=True, mega_n=8),
         # Freerun rows pin the SIM clock to 1e6 pps: the generator runs
         # at memcpy speed regardless, but record timestamps must keep
         # per-source rates benign-plausible (at --rate 1e7 every benign
