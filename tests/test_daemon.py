@@ -165,6 +165,49 @@ class TestEndToEnd:
         assert rep.records < stats["produced"]
         assert rep.records > 0
 
+    def test_paced_replay_produces_at_rate(self, fsxd_bin, tmp_path):
+        """--replay FILE --pace: a recorded stream (fsx pcap output)
+        replays at --rate in real time instead of at fread speed — the
+        'replay an attack capture against the live pipeline' mode."""
+        from flowsentryx_tpu.engine.shm import ShmRingSource
+        from flowsentryx_tpu.engine.traffic import TrafficGen, TrafficSpec
+
+        rec = TrafficGen(TrafficSpec(seed=2)).next_records(100_000)
+        rfile = tmp_path / "records.bin"
+        rfile.write_bytes(rec.tobytes())
+        fring, vring = _rings(tmp_path)
+        rate = 2e4
+        proc = subprocess.Popen(
+            [str(fsxd_bin), "--replay", str(rfile), "--pace",
+             "--rate", str(rate), "--duration", "3",
+             "--feature-ring", fring, "--verdict-ring", vring],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            src = ShmRingSource(fring)
+            got = []
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and proc.poll() is None:
+                chunk = src.poll(4096)
+                if len(chunk):
+                    got.append(chunk.copy())
+                time.sleep(0.002)
+            tail = src.poll(100_000)
+            if len(tail):
+                got.append(tail.copy())
+        finally:
+            out, _ = proc.communicate(timeout=20)
+        stats = json.loads(out.strip().splitlines()[-1])
+        drained = np.concatenate(got) if got else rec[:0]
+        n = len(drained)
+        # ~rate*duration produced, NOT the whole 100k file at once
+        # (generous band: shared-CI scheduling skews the pacing clock)
+        assert 0.5 * rate * 3 <= stats["produced"] <= 1.5 * rate * 3, stats
+        assert n == stats["produced"]  # all forwarded records drained
+        # content pins the REPLAY path: drained records are the file's
+        # leading records verbatim (sim mode would emit different data)
+        np.testing.assert_array_equal(drained, rec[:n])
+
     def test_paced_throughput_keeps_up(self, fsxd_bin, tmp_path):
         """VERDICT r4 weakness: the shm→batcher→engine path had never
         been driven at rate.  The daemon's --pace mode offers benign
