@@ -994,6 +994,36 @@ def main() -> int:
     try:
         forced_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
 
+        # -- sharded host-ingest mode (--host-ingest=N) ---------------------
+        # Tunnel-independent: the sharded ingest subsystem
+        # (flowsentryx_tpu/ingest/) is a HOST ceiling, so it is measured
+        # by the shm stress harness on CPU and merged into this round's
+        # evidence.  Opt-in — the default bench spends its whole budget
+        # on the accelerator phases.
+        host_ingest_n = int(_argval("host-ingest", 0))
+        if host_ingest_n > 0:
+            hi_dur = _argval("host-ingest-dur", 8.0)
+            log(f"host-ingest phase: {host_ingest_n} drain workers, "
+                f"{hi_dur:.0f}s per row")
+            env = dict(os.environ, FSX_STRESS_DUR=str(hi_dur),
+                       JAX_PLATFORMS="cpu")
+            r = subprocess.run(
+                [sys.executable,
+                 str(Path(__file__).parent / "scripts" / "shm_stress.py"),
+                 "--shards", str(host_ingest_n)],
+                capture_output=True, text=True, env=env,
+                timeout=max(120.0, 20 * hi_dur + 120),
+            )
+            for line in r.stdout.splitlines()[::-1]:
+                if line.strip().startswith("{"):
+                    detail["host_ingest"] = json.loads(line)
+                    detail["host_ingest"]["artifact"] = (
+                        "artifacts/SHMSTRESS_sharded_r06.json")
+                    break
+            else:
+                detail["host_ingest"] = {
+                    "error": (r.stderr or "no output").strip()[-500:]}
+
         # -- healthy-window gate (VERDICT r3 next #1) -----------------------
         # Probe the tunnel before committing the run.  On a degraded
         # link, sleep/retry while enough budget remains for a full

@@ -58,6 +58,14 @@ struct Options {
     std::string mode = "sim";
     std::string feature_ring = "/tmp/fsx_feature_ring";
     std::string verdict_ring = "/tmp/fsx_verdict_ring";
+    // --shards N: fan feature records out over N shm rings by source-IP
+    // hash (<feature_ring>.<k>), one per ingest drain worker.  A flow's
+    // records land on exactly one shard, so their relative order
+    // survives the parallel host ingest stage — the per-CPU-ringbuf
+    // production semantics, reproduced at the shm seam.  The verdict
+    // ring stays single (verdict volume is tiny and map writes are
+    // idempotent).  N=1 keeps the unsuffixed single-ring layout.
+    uint32_t shards = 1;
     std::string replay_file;
     uint64_t ring_capacity = 1 << 16;  // feature-ring record slots
     double rate_pps = 1e6;             // sim packet rate
@@ -103,6 +111,9 @@ struct Options {
                  "  --feature-ring PATH   shm feature ring (default /tmp/fsx_feature_ring)\n"
                  "  --verdict-ring PATH   shm verdict ring (default /tmp/fsx_verdict_ring)\n"
                  "  --ring-capacity N     feature ring slots, power of 2 (default 65536)\n"
+                 "  --shards N            fan features out over N rings by source-IP\n"
+                 "                        hash (<feature-ring>.<k>, one per ingest\n"
+                 "                        drain worker; default 1 = single ring)\n"
                  "  --rate PPS            sim packet rate (default 1e6)\n"
                  "  --pace                sim produces at --rate in REAL time\n"
                  "                        (default: free-run vs ring backpressure)\n"
@@ -129,6 +140,79 @@ struct Options {
                  argv0);
     std::exit(2);
 }
+
+// Shard index of a folded source address — MUST mirror
+// flowsentryx_tpu.core.schema.shard_of (Fibonacci hash) so Python
+// tests and tools can predict a flow's shard.
+uint32_t fsx_shard_of(uint32_t saddr, uint32_t n) {
+    return (uint32_t)((((uint64_t)saddr * 2654435761ULL) >> 16) % n);
+}
+
+std::string shard_path(const std::string &base, uint32_t k, uint32_t n) {
+    return n <= 1 ? base : base + "." + std::to_string(k);
+}
+
+// N feature rings + the IP-hash router (the --shards fan-out).  The
+// router partitions each drained chunk into per-shard lanes first so
+// every ring sees one contiguous produce() per chunk, not per record.
+class ShardedRings {
+public:
+    ShardedRings(const std::string &base, uint32_t n, uint64_t capacity,
+                 size_t rec_size, size_t saddr_off)
+        : rec_size_(rec_size), saddr_off_(saddr_off), lanes_(n) {
+        rings_.reserve(n);
+        for (uint32_t k = 0; k < n; k++)
+            rings_.push_back(
+                fsx::ShmRing::create(shard_path(base, k, n), capacity,
+                                     rec_size));
+    }
+
+    // Route + push n records; returns how many fit (per-shard rings
+    // apply the same fail-open drop policy as the single ring).
+    uint64_t produce(const void *records, uint64_t n) {
+        const uint32_t ns = (uint32_t)rings_.size();
+        if (ns == 1)
+            return rings_[0].produce(records, n);
+        for (auto &l : lanes_)
+            l.clear();
+        const char *p = (const char *)records;
+        for (uint64_t i = 0; i < n; i++) {
+            uint32_t saddr;
+            std::memcpy(&saddr, p + i * rec_size_ + saddr_off_, 4);
+            auto &lane = lanes_[fsx_shard_of(saddr, ns)];
+            lane.insert(lane.end(), p + i * rec_size_,
+                        p + (i + 1) * rec_size_);
+        }
+        uint64_t pushed = 0;
+        for (uint32_t k = 0; k < ns; k++)
+            if (!lanes_[k].empty())
+                pushed += rings_[k].produce(
+                    lanes_[k].data(), lanes_[k].size() / rec_size_);
+        return pushed;
+    }
+
+    uint64_t total_readable() const {
+        uint64_t r = 0;
+        for (const auto &ring : rings_)
+            r += ring.readable();
+        return r;
+    }
+
+    // Backpressure signal: any shard close to full (a single hot shard
+    // must throttle a paced/free-running generator just like the
+    // single-ring layout did).
+    bool nearly_full(uint64_t margin) const {
+        for (const auto &ring : rings_)
+            if (ring.readable() >= ring.capacity() - margin)
+                return true;
+        return false;
+    }
+
+private:
+    size_t rec_size_, saddr_off_;
+    std::vector<std::vector<char>> lanes_;
+    std::vector<fsx::ShmRing> rings_;
+};
 
 // Per-CPU map lookups copy one value per POSSIBLE cpu into the user
 // buffer; undersizing it is a kernel write past the end (heap smash).
@@ -244,8 +328,8 @@ int run_bpf(const Options &o) {
 
     const size_t rec_size = o.compact ? sizeof(fsx_compact_record)
                                       : sizeof(fsx_flow_record);
-    auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
-                                      rec_size);
+    ShardedRings frings(o.feature_ring, o.shards, o.ring_capacity, rec_size,
+                        o.compact ? 0 : offsetof(fsx_flow_record, saddr));
     auto vring = fsx::ShmRing::create(o.verdict_ring, 1 << 14,
                                       sizeof(fsx_verdict_record));
 
@@ -269,7 +353,7 @@ int run_bpf(const Options &o) {
         buf.clear();
         size_t n = rb.drain(buf, rec_size, 4096);
         if (n) {
-            uint64_t pushed = fring.produce(buf.data(), n);
+            uint64_t pushed = frings.produce(buf.data(), n);
             dropped_ring_full += n - pushed;
             forwarded += pushed;
         }
@@ -429,6 +513,8 @@ Options parse(int argc, char **argv) {
             o.verdict_ring = next();
         else if (a == "--ring-capacity")
             o.ring_capacity = std::stoull(next());
+        else if (a == "--shards")
+            o.shards = (uint32_t)std::stoul(next());
         else if (a == "--rate")
             o.rate_pps = std::stod(next());
         else if (a == "--pace")
@@ -452,6 +538,10 @@ Options parse(int argc, char **argv) {
         std::fprintf(stderr, "fsxd: --bucket-rate-bytes and "
                      "--bucket-burst-bytes must be both zero or both "
                      "positive\n");
+        std::exit(1);
+    }
+    if (o.shards < 1 || o.shards > 64) {
+        std::fprintf(stderr, "fsxd: --shards must be in [1, 64]\n");
         std::exit(1);
     }
     if (o.n_attack_ips == 0 || o.n_benign_ips == 0) {
@@ -557,13 +647,16 @@ int main(int argc, char **argv) {
         return 2;
     }
 
-    auto fring = fsx::ShmRing::create(o.feature_ring, o.ring_capacity,
-                                      sizeof(fsx_flow_record));
+    ShardedRings frings(o.feature_ring, o.shards, o.ring_capacity,
+                        sizeof(fsx_flow_record),
+                        offsetof(fsx_flow_record, saddr));
     auto vring = fsx::ShmRing::create(o.verdict_ring, 1 << 14,
                                       sizeof(fsx_verdict_record));
 
-    std::fprintf(stderr, "fsxd: mode=%s feature_ring=%s verdict_ring=%s\n",
-                 o.mode.c_str(), o.feature_ring.c_str(), o.verdict_ring.c_str());
+    std::fprintf(stderr,
+                 "fsxd: mode=%s feature_ring=%s shards=%u verdict_ring=%s\n",
+                 o.mode.c_str(), o.feature_ring.c_str(), o.shards,
+                 o.verdict_ring.c_str());
 
     uint64_t produced = 0, dropped_ring_full = 0, verdicts = 0, suppressed = 0;
     std::unordered_map<uint32_t, uint64_t> blacklist;  // saddr -> until_ns
@@ -598,8 +691,13 @@ int main(int argc, char **argv) {
                 target = (uint64_t)((double)(now_ns() - t_start) *
                                     o.rate_pps / 1e9);
             }
+            // Catch-up cap of 8 chunks, not 1: on a contended host the
+            // 100 µs sleep stretches to ~1 ms, and a single-CHUNK cap
+            // silently clips the offered rate to CHUNK per wake-up
+            // (~2 Mpps) — a paced source must be allowed to burst back
+            // to schedule, like a real NIC queue after a stall.
             want = produced < target
-                       ? std::min<uint64_t>(CHUNK, target - produced)
+                       ? std::min<uint64_t>(8 * CHUNK, target - produced)
                        : 0;
         }
         if (o.total_packets && produced + want > o.total_packets)
@@ -634,7 +732,7 @@ int main(int argc, char **argv) {
                 w++;
             }
 
-            uint64_t pushed = fring.produce(batch.data(), w);
+            uint64_t pushed = frings.produce(batch.data(), w);
             dropped_ring_full += w - pushed;
             produced += batch.size();
         }
@@ -651,7 +749,7 @@ int main(int argc, char **argv) {
             // wait (bounded) for the consumer to drain + send verdicts
             if (drain_deadline == 0)
                 drain_deadline = t + 3'000'000'000ULL;
-            if (fring.readable() == 0 || t > drain_deadline) {
+            if (frings.total_readable() == 0 || t > drain_deadline) {
                 uint64_t extra = vring.consume(vbatch.data(), vbatch.size());
                 for (uint64_t i = 0; i < extra; i++)
                     blacklist[vbatch[i].saddr] = vbatch[i].until_ns;
@@ -672,7 +770,7 @@ int main(int argc, char **argv) {
                          blacklist.size(), suppressed);
             next_report = t + 1'000'000'000ULL;
         }
-        if (fring.readable() >= fring.capacity() - CHUNK)
+        if (frings.nearly_full(CHUNK))
             std::this_thread::sleep_for(std::chrono::microseconds(200));
     }
 

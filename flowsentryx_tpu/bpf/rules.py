@@ -88,6 +88,72 @@ def remove(m: loader.Map, rule: RuleConfig) -> bool:
 
 _CONFIG_NAMES = [n for n, _, _ in FsxConfig.KERNEL_CONFIG_FIELDS]
 
+#: How long a config writer waits on the advisory lock before erroring
+#: (LOCK_NB + retry: a wedged or hostile holder must produce a loud
+#: failure, not an indefinite hang of a root CLI).
+LOCK_TIMEOUT_S = 5.0
+
+
+def _lock_path(pin_dir: str) -> str:
+    """Per-pin lockfile path under a caller-owned, non-world-writable
+    directory.
+
+    The previous scheme — a predictable name in /tmp opened with
+    ``open(..., "w")`` — let any local user pre-create the file and
+    hold the flock (wedging root's ``fsx rules``/``fsx config --set``
+    forever) or, on kernels without ``fs.protected_symlinks``, plant a
+    symlink that root then truncates.  bpffs cannot hold regular files,
+    so "beside the pin" is not an option; instead the lock lives under
+    ``/run/fsx`` for root (tmpfs, root-owned, 0700) or a uid-suffixed
+    0700 dir for unprivileged test runs, and the directory's ownership
+    is verified so a squatter is an error rather than an acquisition."""
+    base = os.environ.get("FSX_LOCK_DIR")
+    if base is None:
+        if os.geteuid() == 0:
+            base = "/run/fsx"
+        else:
+            import tempfile
+
+            base = os.path.join(tempfile.gettempdir(),
+                                f"fsx-lock-{os.getuid()}")
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    st = os.stat(base)
+    if st.st_uid != os.geteuid():
+        raise RuntimeError(
+            f"lock dir {base} is owned by uid {st.st_uid}, not "
+            f"{os.geteuid()} — refusing to take a lock a different "
+            "user controls (set FSX_LOCK_DIR to override)")
+    return os.path.join(base, "cfg_%s.lock" % hashlib.sha1(
+        os.path.abspath(pin_dir).encode()).hexdigest()[:16])
+
+
+@contextlib.contextmanager
+def _locked(pin_dir: str):
+    """Acquire the per-pin advisory lock: O_NOFOLLOW + 0600 creation
+    (no symlink traversal, no world-writable file) and a bounded
+    LOCK_EX|LOCK_NB retry so a held lock ERRORS after
+    :data:`LOCK_TIMEOUT_S` instead of hanging."""
+    import time
+
+    fd = os.open(_lock_path(pin_dir),
+                 os.O_CREAT | os.O_RDWR | os.O_NOFOLLOW, 0o600)
+    try:
+        deadline = time.monotonic() + LOCK_TIMEOUT_S
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except (BlockingIOError, InterruptedError):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"config lock for {pin_dir} held by another "
+                        f"process for > {LOCK_TIMEOUT_S:.0f}s"
+                    ) from None
+                time.sleep(0.05)
+        yield
+    finally:
+        os.close(fd)  # releases the flock
+
 
 @contextlib.contextmanager
 def config_map_edit(pin_dir: str):
@@ -96,17 +162,15 @@ def config_map_edit(pin_dir: str):
     BPF array-map updates replace the WHOLE value, so two concurrent
     field updaters (``fsx rules`` bumping ``rule_count``, ``fsx config
     --set`` rewriting limiter policy) would clobber each other's fields
-    through a bare read-modify-write.  An flock on a /tmp lockfile
-    keyed by the pin path serializes this repo's own writers; the
-    daemon writes the map only at startup, so operator-time races are
-    exactly these two commands.  Yields the unpacked field dict;
-    writes back on clean exit ONLY if the dict changed (a pure read
-    must not re-publish a stale snapshot over a concurrent writer —
-    that would reintroduce the clobber it exists to prevent)."""
-    lockpath = "/tmp/fsx_cfg_%s.lock" % hashlib.sha1(
-        os.path.abspath(pin_dir).encode()).hexdigest()[:16]
-    with open(lockpath, "w") as lk:
-        fcntl.flock(lk, fcntl.LOCK_EX)
+    through a bare read-modify-write.  An flock keyed by the pin path
+    (:func:`_lock_path`; owner-verified dir, O_NOFOLLOW, bounded wait)
+    serializes this repo's own writers; the daemon writes the map only
+    at startup, so operator-time races are exactly these two commands.
+    Yields the unpacked field dict; writes back on clean exit ONLY if
+    the dict changed (a pure read must not re-publish a stale snapshot
+    over a concurrent writer — that would reintroduce the clobber it
+    exists to prevent)."""
+    with _locked(pin_dir):
         fd = loader.obj_get(f"{pin_dir}/config_map")
         m = loader.Map(fd, loader.MAP_TYPE_ARRAY, 4,
                        FsxConfig.KERNEL_CONFIG_SIZE, 0, "config_map")

@@ -287,12 +287,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     ``--scenario`` runs an in-process synthetic scenario (no daemon)."""
     # Argument validation BEFORE any engine work: rejecting a flag
     # combination after the multi-second JAX boot + compile is hostile.
+    # Negativity first: `--checkpoint-every -1` without --checkpoint
+    # must name ITS problem, not the unrelated missing-path one.
+    if args.checkpoint_every < 0:
+        print("fsx serve: --checkpoint-every must be >= 0 (0 disables)",
+              file=sys.stderr)
+        return 1
     if args.checkpoint_every and not args.checkpoint:
         print("fsx serve: --checkpoint-every requires --checkpoint PATH",
               file=sys.stderr)
         return 1
-    if args.checkpoint_every < 0:
-        print("fsx serve: --checkpoint-every must be positive",
+    if args.ingest_workers < 0:
+        print("fsx serve: --ingest-workers must be >= 0 (0 = inline)",
+              file=sys.stderr)
+        return 1
+    if args.ingest_workers and not args.feature_ring:
+        print("fsx serve: --ingest-workers requires --feature-ring "
+              "(the sharded drain fronts the daemon's shm rings)",
               file=sys.stderr)
         return 1
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
@@ -303,7 +314,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.feature_ring:
         from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
 
-        source = ShmRingSource(args.feature_ring)
+        if args.ingest_workers:
+            # Sharded parallel ingest (flowsentryx_tpu/ingest/): N drain
+            # workers front N ring shards (fsxd --shards N; N=1 fronts
+            # an unsharded daemon) and hand the engine sealed batches.
+            from flowsentryx_tpu.ingest import ShardedIngest
+
+            source = ShardedIngest(args.feature_ring, args.ingest_workers)
+        else:
+            source = ShmRingSource(args.feature_ring)
         sink = (
             ShmVerdictSink(args.verdict_ring) if args.verdict_ring else NullSink()
         )
@@ -367,6 +386,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.models.registry import load_artifact
 
         params = load_artifact(cfg.model.name, args.artifact)
+    if args.mega:
+        # Mirror Engine's wire choice up front: --mega needs compact16,
+        # which the engine picks only for a compact-emit ring or an
+        # observer-carrying artifact.  Catching it here turns a
+        # post-compile ValueError traceback into a clean refusal.
+        from flowsentryx_tpu.models import get_model
+
+        probe = params if params is not None else get_model(cfg.model.name).init()
+        if not (getattr(source, "precompact", False)
+                or hasattr(probe, "in_scale")):
+            print(
+                "fsx serve: --mega requires the compact16 wire, but the "
+                "selected model exposes no input observer so the engine "
+                "would serve raw48; pass an observer-carrying artifact "
+                "(e.g. --artifact artifacts/logreg_int8.npz) or drop "
+                "--mega", file=sys.stderr)
+            return 1
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
                  mega_n=args.mega or 0)
     if args.restore:
@@ -427,6 +463,14 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.checkpoint and not args.checkpoint_every:
         # the chunked loop's last iteration already saved this state
         eng.checkpoint(args.checkpoint)
+    if hasattr(source, "close"):
+        source.close()  # stop + join the ingest worker fleet
+        if rep.ingest is not None and hasattr(source, "ingest_stats"):
+            # close() is what counts drain-on-shutdown losses
+            # (dropped_tail_batches, late emit_drops): re-snapshot so
+            # the printed report carries them instead of the stale
+            # zeros captured while the fleet was still live.
+            rep = rep._replace(ingest=source.ingest_stats())
     print(json.dumps(rep._asdict(), indent=2))
     return 0
 
@@ -943,6 +987,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "artifacts/logreg_int8.npz for a working detector")
     s.add_argument("--feature-ring", help="daemon shm feature ring path")
     s.add_argument("--verdict-ring", help="daemon shm verdict ring path")
+    s.add_argument("--ingest-workers", type=int, default=0,
+                   help="drain the feature ring with N parallel worker "
+                        "processes that hand the engine sealed batches "
+                        "(pair with fsxd --shards N; N=1 fronts an "
+                        "unsharded daemon; 0 = the inline single-"
+                        "threaded drain, bit-identical to pre-ingest "
+                        "engines)")
     s.add_argument("--records",
                    help="replay a raw fsx_flow_record file (fsx pcap output)")
     s.add_argument("--scenario", default="syn_benign_mix",

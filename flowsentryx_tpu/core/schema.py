@@ -27,10 +27,17 @@ drift.
 from __future__ import annotations
 
 import enum
-from typing import NamedTuple
+from typing import TYPE_CHECKING, NamedTuple
 
-import jax.numpy as jnp
 import numpy as np
+
+# jax is imported lazily inside the functions that build device arrays:
+# this module is the wire-contract ground truth for EVERY process in the
+# pipeline, including the ingest drain workers (flowsentryx_tpu/ingest/)
+# which are pure-numpy and must spawn in ~0.3 s, not pay the multi-second
+# jax import for dtypes and integer pack functions.
+if TYPE_CHECKING:  # annotations only; `from __future__ import annotations`
+    import jax.numpy as jnp
 
 # ---------------------------------------------------------------------------
 # Feature vector
@@ -199,6 +206,90 @@ SHM_RECORD_SIZE_OFFSET = 16     # u64: bytes per record
 SHM_HEAD_OFFSET = 64            # u64: producer cursor (records written)
 SHM_TAIL_OFFSET = 128           # u64: consumer cursor (records read)
 
+# -- Sealed-batch queues (ingest worker -> engine transport) ---------------
+#
+# The sharded ingest subsystem (flowsentryx_tpu/ingest/) moves SEALED
+# wire buffers — not raw records — from each drain worker to the engine
+# over one SPSC shared-memory queue per worker.  A queue reuses the ring
+# header geometry above (magic/capacity/"record"-size, head and tail on
+# their own cache lines) with `capacity` fixed-size batch SLOTS, plus a
+# control block in the spare bytes of the meta cache line (all u64,
+# plain-store published under the same x86-TSO discipline as the
+# cursors; each field has exactly one writer):
+#
+#   HBEAT     worker-written CLOCK_MONOTONIC ns, bumped every drain
+#             loop — the engine's liveness signal (stall detection).
+#   FIRST_TS  worker-written: absolute ts_ns of the first record this
+#             shard saw (0 = none yet).  Input to the t0 handshake.
+#   T0        engine-written: the agreed epoch t0_ns.  Workers buffer
+#             records until it is published — every worker must seal
+#             batches against ONE epoch or cross-shard timestamps (and
+#             the device flow windows built on them) would skew.
+#   STOP      engine-written: nonzero asks the worker to drain its ring
+#             to empty, flush the partial batch, and exit cleanly.
+#   WSTATE    worker-written lifecycle: SPAWNING -> RUNNING -> DONE
+#             (clean exit) / FAILED (crashed with a traceback).
+#
+# Each slot is an 8-word header followed by one wire buffer
+# ``[max_batch+1, words]`` (raw48 or compact16, `wire_id` says which):
+#
+#   word 0/1  seq lo/hi    1-based per-worker batch sequence number —
+#                          the engine detects gaps (corruption or a
+#                          worker restart) instead of silently
+#                          misordering flow updates.
+#   word 2    n_records    valid records (mirrors the meta row).
+#   word 3    wire_id      WIRE_ID_* of the payload.
+#   word 4/5  seal ns lo/hi  CLOCK_MONOTONIC at seal (queue-residency
+#                          and e2e accounting; same clock as
+#                          time.perf_counter on Linux).
+#   word 6    fill_dur_us  first-record-arrival -> seal duration.
+#   word 7    reserved (0)
+
+SHM_BATCHQ_MAGIC = 0x4653584241545131  # "FSXBATQ1"
+SHM_HBEAT_OFFSET = 24
+SHM_FIRST_TS_OFFSET = 32
+SHM_T0_OFFSET = 40
+SHM_STOP_OFFSET = 48
+SHM_WSTATE_OFFSET = 56
+#: u64, producer-written (lives on the producer-cursor cache line, same
+#: writer side): sealed batches the worker gave up enqueueing during
+#: stop-drain because the queue stayed full past its bounded wait.  The
+#: worker un-burns the batch's seq first, so a seq gap remains a pure
+#: corruption/restart signal and this counter is the ONLY place such a
+#: loss shows up.
+SHM_EMIT_DROP_OFFSET = 72
+
+WSTATE_SPAWNING = 0
+WSTATE_RUNNING = 1
+WSTATE_DONE = 2
+WSTATE_FAILED = 3
+
+BATCHQ_SLOT_HDR_WORDS = 8
+WIRE_ID_RAW48 = 0
+WIRE_ID_COMPACT16 = 1
+
+
+def wire_id_of(wire: str) -> int:
+    return WIRE_ID_COMPACT16 if wire == WIRE_COMPACT16 else WIRE_ID_RAW48
+
+
+def shard_ring_path(base: str, shard: int, n_shards: int) -> str:
+    """Feature-ring path of one shard — the naming contract with
+    ``fsxd --shards N`` (and the sharded test producers).  N=1 keeps
+    the unsuffixed path so one worker can front an unsharded daemon."""
+    return str(base) if n_shards <= 1 else f"{base}.{shard}"
+
+
+def shard_of(saddr, n_shards: int):
+    """Shard index of a folded source address — the IP-hash affinity
+    both producers use (Fibonacci hash; mirrors ``fsx_shard_of`` in the
+    daemon).  Keeping a flow's records on ONE shard preserves their
+    relative order through the parallel ingest stage, matching the
+    kernel's per-CPU production semantics."""
+    h = (np.asarray(saddr, np.uint64) * np.uint64(2654435761)) >> np.uint64(16)
+    return (h % np.uint64(n_shards)).astype(np.uint32)
+
+
 #: One verdict-ring entry (engine -> daemon): newly blacklisted source.
 VERDICT_RECORD_DTYPE = np.dtype(
     [
@@ -359,6 +450,8 @@ def make_table(capacity: int) -> IpTableState:
     """Fresh, empty state table with ``capacity`` slots (power of two)."""
     if capacity & (capacity - 1):
         raise ValueError(f"capacity must be a power of two, got {capacity}")
+    import jax.numpy as jnp
+
     return IpTableState(
         key=jnp.zeros((capacity,), jnp.uint32),
         state=jnp.zeros((capacity, NUM_TABLE_COLS), jnp.float32),
@@ -403,6 +496,8 @@ class GlobalStats(NamedTuple):
 def u64_add(field: jnp.ndarray, inc: jnp.ndarray) -> jnp.ndarray:
     """Add a non-negative scalar to a ``[2]`` uint32 (lo, hi) counter,
     with carry — jit-safe 64-bit accumulation on a 32-bit-only backend."""
+    import jax.numpy as jnp
+
     inc = inc.astype(jnp.uint32)
     lo = field[0] + inc
     carry = (lo < field[0]).astype(jnp.uint32)
@@ -417,6 +512,8 @@ def stat_value(field: jnp.ndarray) -> int:
 
 def make_stats() -> GlobalStats:
     # Distinct arrays per field — see make_table's donation note.
+    import jax.numpy as jnp
+
     return GlobalStats(*(jnp.zeros((2,), jnp.uint32) for _ in range(5)))
 
 
@@ -576,8 +673,9 @@ def quantize_feat_model(
     return np.clip(q, 0, 255).astype(np.uint32)
 
 
-def quantize_feat_minifloat(feat: np.ndarray) -> np.ndarray:
-    """u32 → u8 e5m3, round-to-nearest: values ≤ 8 verbatim; above,
+def _minifloat_ref(feat: np.ndarray) -> np.ndarray:
+    """Reference e5m3 encoder (the spec; builds the hot-path LUT and
+    anchors the equivalence tests): values ≤ 8 verbatim; above,
     ``q = 8·e + m̂`` with ``feat ≈ (8 + m̂)·2^(e-1)``."""
     f = feat.astype(np.uint64)
     bl = np.zeros(f.shape, np.int64)
@@ -596,6 +694,59 @@ def quantize_feat_minifloat(feat: np.ndarray) -> np.ndarray:
     r = np.where(r == 16, np.uint64(8), r)
     q = np.where(bl <= 3, f, (e + np.uint64(1)) * 8 + (r - 8))
     return np.minimum(q, 255).astype(np.uint32)
+
+
+#: Concatenated encode tables: ``[0, 2^16)`` maps f directly,
+#: ``[2^16, 2^16 + 2^20)`` maps ``f >> 12`` for f ≥ 2^16 — valid
+#: because the encoder's rounding bit sits at position e-1 ≥ 12 there,
+#: so the low 12 bits can never influence the result.  Built lazily
+#: (once per process) from the reference encoder, so equivalence is by
+#: construction.
+_MINIFLOAT_LUT: np.ndarray | None = None
+
+
+def _minifloat_lut() -> np.ndarray:
+    global _MINIFLOAT_LUT
+    if _MINIFLOAT_LUT is None:
+        lo = _minifloat_ref(np.arange(1 << 16, dtype=np.uint64))
+        hi = _minifloat_ref(np.arange(1 << 20, dtype=np.uint64) << 12)
+        _MINIFLOAT_LUT = np.concatenate([lo, hi]).astype(np.uint8)
+    return _MINIFLOAT_LUT
+
+
+def _minifloat_q8(f: np.ndarray) -> np.ndarray:
+    """LUT encode → u8 (the seal hot path; explicit u32 scalars keep
+    the index math in 4-byte lanes on the common u32 feature input)."""
+    if f.dtype == np.uint32:
+        idx = np.where(f < np.uint32(1 << 16), f,
+                       (f >> np.uint32(12)) + np.uint32(1 << 16))
+    else:
+        # The LUT covers the u32 domain.  Lanes >= 2^32 (including
+        # signed negatives wrapped by the cast) must still encode
+        # exactly as the reference / C fsx_minifloat8 (u64) do — the
+        # ramp to the 255 clamp is gradual above 2^32, not a constant —
+        # so route those (cold, u64-counter-mirror only) lanes through
+        # the reference encoder instead of indexing out of bounds.
+        f = f.astype(np.uint64)
+        big = f >= np.uint64(1 << 32)
+        safe = np.minimum(f, np.uint64((1 << 32) - 1))
+        idx = np.where(safe < np.uint64(1 << 16), safe,
+                       (safe >> np.uint64(12)) + np.uint64(1 << 16))
+        out = _minifloat_lut()[idx]
+        if big.any():
+            out = out.copy()
+            out[big] = _minifloat_ref(f[big]).astype(np.uint8)
+        return out
+    return _minifloat_lut()[idx]
+
+
+def quantize_feat_minifloat(feat: np.ndarray) -> np.ndarray:
+    """u32 → u8 e5m3, round-to-nearest (see :func:`_minifloat_ref` for
+    the spec).  One-gather LUT hot path: this runs per record×feature
+    in every compact16 seal, and at Mpps rates the ~25 full-array
+    passes of the branch-free reference were the single largest host
+    cost in the ingest stage."""
+    return _minifloat_q8(np.asarray(feat)).astype(np.uint32)
 
 
 def _dequant_feat_model(q, in_scale: float, in_zp: int, log1p: bool):
@@ -654,14 +805,19 @@ def compact_pack(
     n = len(rec)
     out = np.empty((n, COMPACT_RECORD_WORDS), np.uint32)
     if feat_mode == "model":
-        q = quantize_feat_model(rec["feat"], in_scale, in_zp, log1p)
+        q8 = quantize_feat_model(
+            rec["feat"], in_scale, in_zp, log1p).astype(np.uint8)
     elif feat_mode == "minifloat":
-        q = quantize_feat_minifloat(rec["feat"])
+        q8 = _minifloat_q8(rec["feat"])
     else:
         raise ValueError(f"unknown feat_mode {feat_mode!r}")
     out[:, 0] = rec["saddr"]
-    out[:, 1] = q[:, 0] | (q[:, 1] << 8) | (q[:, 2] << 16) | (q[:, 3] << 24)
-    out[:, 2] = q[:, 4] | (q[:, 5] << 8) | (q[:, 6] << 16) | (q[:, 7] << 24)
+    # [n, 8] u8 reinterpreted as [n, 2] u32 IS the little-endian byte
+    # pack q0|q1<<8|…  (the shm seam already requires x86-TSO, so LE is
+    # given) — one view instead of six shift/or passes per seal.
+    qw = np.ascontiguousarray(q8).view(np.uint32)
+    out[:, 1] = qw[:, 0]
+    out[:, 2] = qw[:, 1]
     len8 = np.minimum((rec["pkt_len"].astype(np.uint32) + 4) >> 3, 2047)
     # records can arrive slightly out of order; clamp below base to 0
     dt = rec["ts_ns"].astype(np.int64) - np.int64(base_ns)
@@ -800,6 +956,8 @@ def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch
     Records stamped slightly before ``t0_ns`` yield small negative
     times (signed arithmetic; no uint64 wrap).
     """
+    import jax.numpy as jnp
+
     n = min(len(buf), batch_size)
     key = np.zeros((batch_size,), np.uint32)
     feat = np.zeros((batch_size, NUM_FEATURES), np.float32)

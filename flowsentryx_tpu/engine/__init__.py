@@ -18,17 +18,35 @@ Stage latencies are tracked per batch (:mod:`.metrics`) — the reference
 has no profiling at all (SURVEY.md §5.1).
 """
 
-from flowsentryx_tpu.engine.batcher import MicroBatcher  # noqa: F401
-from flowsentryx_tpu.engine.engine import Engine, EngineReport  # noqa: F401
-from flowsentryx_tpu.engine.sources import (  # noqa: F401
-    ArraySource,
-    PacedSource,
-    RecordSource,
-    TrafficSource,
-)
-from flowsentryx_tpu.engine.writeback import (  # noqa: F401
-    BlacklistUpdate,
-    CollectSink,
-    NullSink,
-    VerdictSink,
-)
+# Lazy re-exports (PEP 562): the ingest drain workers
+# (flowsentryx_tpu/ingest/worker.py) import engine.shm / engine.batcher
+# in freshly spawned pure-numpy processes; an eager `from .engine import
+# Engine` here would tax every worker spawn with the multi-second jax
+# import for code the worker never runs.
+_EXPORTS = {
+    "MicroBatcher": "flowsentryx_tpu.engine.batcher",
+    "Engine": "flowsentryx_tpu.engine.engine",
+    "EngineReport": "flowsentryx_tpu.engine.engine",
+    "ArraySource": "flowsentryx_tpu.engine.sources",
+    "PacedSource": "flowsentryx_tpu.engine.sources",
+    "RecordSource": "flowsentryx_tpu.engine.sources",
+    "TrafficSource": "flowsentryx_tpu.engine.sources",
+    "BlacklistUpdate": "flowsentryx_tpu.engine.writeback",
+    "CollectSink": "flowsentryx_tpu.engine.writeback",
+    "NullSink": "flowsentryx_tpu.engine.writeback",
+    "VerdictSink": "flowsentryx_tpu.engine.writeback",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

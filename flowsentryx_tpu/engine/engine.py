@@ -50,6 +50,10 @@ class EngineReport(NamedTuple):
     #: in the sharded step (adversarial hash skew; parallel/step.py
     #: module docstring).  Always 0 single-device.
     route_drop: int = 0
+    #: Sharded-ingest summary (per-worker batches/records/seq-gaps and
+    #: fill/queue p50/p99) when the source is a sealed-batch fleet
+    #: (flowsentryx_tpu/ingest/); None on the inline record path.
+    ingest: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -192,6 +196,16 @@ class Engine:
                 )
         #: Sealed-but-undispatched (raw, t_seal) group candidates.
         self._pending: list[tuple[np.ndarray, float]] = []
+        # Sealed-batch sources (flowsentryx_tpu/ingest/ShardedIngest)
+        # deliver finished wire buffers instead of raw records: the run
+        # loop switches to dequeue → dispatch → reap, and the worker
+        # fleet is spawned HERE, after the engine has fixed the wire and
+        # quantizer — the workers must seal with exactly the engine's
+        # choices or the N=0 inline path and the sharded path would
+        # score differently.
+        self.sealed = bool(getattr(source, "provides_sealed", False))
+        if self.sealed:
+            source.start(cfg.batch, self.wire, quant)
         # A wire buffer may be reused only after its batch is off the
         # in-flight queue (or, for a pending group member, dispatched):
         # keep more buffers than in-flight batches + the pending group.
@@ -422,6 +436,10 @@ class Engine:
         if self._inflight or self._pending:
             raise RuntimeError("reset_stream with batches in flight")
         self.source = source
+        self.sealed = bool(getattr(source, "provides_sealed", False))
+        if self.sealed and not getattr(source, "started", False):
+            source.start(self.cfg.batch, self.wire,
+                         self.batcher.quant or None)
         if sink is not None:
             self.sink = sink
         if readback_depth is not None:
@@ -510,6 +528,8 @@ class Engine:
         max_seconds: float | None = None,
     ) -> EngineReport:
         """Run until the source is exhausted (or a bound trips)."""
+        if self.sealed:
+            return self._run_sealed(max_batches, max_seconds)
         t_start = time.perf_counter()
         cfg_b = self.cfg.batch
 
@@ -609,8 +629,89 @@ class Engine:
             self._dispatch(raw, t_seal)
         self._pending.clear()
         self._reap(0)
-        wall = time.perf_counter() - t_start
+        return self._build_report(time.perf_counter() - t_start)
 
+    def _run_sealed(
+        self,
+        max_batches: int | None = None,
+        max_seconds: float | None = None,
+    ) -> EngineReport:
+        """The sharded-ingest serving loop: dequeue → dispatch → reap.
+
+        Everything per-record — ring drain, decode, quantization, batch
+        assembly — already happened in the drain workers; what is left
+        on this thread is one queue-slot copy and the async dispatch
+        per batch, so the loop's cost scales with BATCHES, not records
+        (the whole point of the ingest subsystem).  Semantics otherwise
+        mirror :meth:`run`: depth-capped pipe, readiness reaping, mega
+        grouping on backlog, deadline behavior delegated to the workers
+        (they own the micro-batchers now)."""
+        t_start = time.perf_counter()
+        src = self.source
+        if not self._t0_auto and hasattr(src, "set_t0"):
+            # A fixed epoch (explicit t0_ns, or a restored checkpoint's
+            # via restore()) must reach the worker fleet before its
+            # min-first_ts handshake resolves: the workers seal device
+            # times against THEIR t0, the sink translates until-ns with
+            # OURS, and nothing downstream can reconcile the two.
+            src.set_t0(self.batcher.t0_ns)
+
+        def bounded() -> bool:
+            if (max_batches is not None
+                    and self.batcher.batches_emitted >= max_batches):
+                return True
+            if (max_seconds is not None
+                    and time.perf_counter() - t_start >= max_seconds):
+                return True
+            return False
+
+        while not bounded():
+            with self.metrics.fill.time():
+                want = (max(self.mega_n - len(self._pending), 1)
+                        if self.mega_n > 0 else 4)
+                batches = src.poll_batches(want)
+                if self._t0_auto and batches and src.t0_ns:
+                    # the fleet's epoch handshake picked t0; adopt it for
+                    # the device clock and the sink's ns translation
+                    self.batcher.t0_ns = src.t0_ns
+                    if hasattr(self.sink, "t0_ns"):
+                        self.sink.t0_ns = src.t0_ns
+                    self._t0_auto = False
+                for sb in batches:
+                    # workers sealed these; mirror into the engine-side
+                    # counters the report and bounds are built on
+                    self.batcher.batches_emitted += 1
+                    self.batcher.records_emitted += sb.n_records
+            if self.mega_n > 0:
+                for sb in batches:
+                    self._pending.append((sb.raw, sb.t_enqueue))
+                while len(self._pending) >= self.mega_n:
+                    self._dispatch_mega(self._pending[: self.mega_n])
+                    del self._pending[: self.mega_n]
+                    self._reap(self.readback_depth)
+                if self._pending and len(batches) < want:
+                    for raw, t_seal in self._pending:
+                        self._dispatch(raw, t_seal)
+                        self._reap(self.readback_depth)
+                    self._pending.clear()
+            else:
+                for sb in batches:
+                    self._dispatch(sb.raw, sb.t_enqueue)
+                    self._reap(self.readback_depth)
+            self._reap_ready()
+            if not batches:
+                if src.exhausted():
+                    break
+                if not self._inflight:
+                    time.sleep(
+                        min(self.cfg.batch.deadline_us / 4, 200) / 1e6)
+        for raw, t_seal in self._pending:
+            self._dispatch(raw, t_seal)
+        self._pending.clear()
+        self._reap(0)
+        return self._build_report(time.perf_counter() - t_start)
+
+    def _build_report(self, wall: float) -> EngineReport:
         # "now" on the device clock (t0-anchored stream seconds, not wall
         # time) comes from the reaped step outputs — no extra reduction.
         table_sum = pallas_kernels.table_summary(
@@ -629,4 +730,7 @@ class Engine:
             table=table_sum,
             ts_wrap_risk_polls=self.batcher.ts_wrap_risk_polls,
             route_drop=self._route_drop,
+            ingest=(self.source.ingest_stats()
+                    if self.sealed and hasattr(self.source, "ingest_stats")
+                    else None),
         )

@@ -55,6 +55,26 @@ class _Timing:
         return False
 
 
+class WorkerIngestMetrics:
+    """Per-drain-worker stage timers of the sharded ingest subsystem
+    (flowsentryx_tpu/ingest/): ``fill`` is first-record-arrival → seal
+    inside the worker (the parallelized decode/assembly stage), ``queue``
+    is seal → engine dequeue (sealed-batch queue residency — the
+    pipelining debt the engine's dispatch loop imposes).  Surfaced per
+    worker in the engine report's ``ingest`` block."""
+
+    def __init__(self, worker: int):
+        self.worker = worker
+        self.fill = StageTimer(f"w{worker}.fill")
+        self.queue = StageTimer(f"w{worker}.queue")
+
+    def to_dict(self) -> dict:
+        return {
+            "fill_ms": self.fill.percentiles_ms(),
+            "queue_ms": self.queue.percentiles_ms(),
+        }
+
+
 class PipelineMetrics:
     """The engine's stage set."""
 

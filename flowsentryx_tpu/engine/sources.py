@@ -34,6 +34,38 @@ class RecordSource(Protocol):
         ...
 
 
+class SealedBatchSource(Protocol):
+    """A producer of SEALED wire buffers instead of raw records.
+
+    Marked by ``provides_sealed = True``; the engine then runs its
+    dequeue → dispatch → reap loop (``Engine._run_sealed``) and never
+    touches a raw record.  The one implementation is
+    :class:`~flowsentryx_tpu.ingest.ShardedIngest` (kept in its own
+    package so importing the engine never spawns processes); the
+    protocol lives here so the engine stays implementation-blind.
+    """
+
+    provides_sealed: bool
+
+    def start(self, batch_cfg, wire: str, quant: dict | None) -> None:
+        """Called once by the engine with ITS batch geometry, wire
+        format and quantizer — sealing must happen with exactly the
+        engine's parameters or inline and sharded serving diverge."""
+        ...
+
+    def poll_batches(self, max_batches: int) -> list:
+        """Up to ``max_batches`` sealed batches (``ingest.SealedBatch``);
+        empty while none are ready."""
+        ...
+
+    @property
+    def t0_ns(self) -> int | None:
+        """Agreed stream epoch; None until known."""
+        ...
+
+    def exhausted(self) -> bool: ...
+
+
 class TrafficSource:
     """Synthetic scenario traffic, optionally bounded to ``total`` packets."""
 

@@ -1,0 +1,407 @@
+"""Engine-side half of the sharded ingest subsystem.
+
+:class:`ShardedIngest` is a *sealed-batch* source: instead of the
+``RecordSource.poll`` record protocol it hands the engine finished
+``[B+1, words]`` wire buffers dequeued from N per-worker SPSC queues
+(:class:`~flowsentryx_tpu.engine.shm.SealedBatchQueue`).  The engine's
+hot loop shrinks to dequeue → dispatch → reap; all per-record Python
+cost (ring drain, decode, quantize, batch assembly) runs in the worker
+processes, in parallel, on cores the dispatch loop never blocks.
+
+Responsibilities here:
+
+* **lifecycle** — spawn one :func:`~flowsentryx_tpu.ingest.worker
+  .worker_main` process per shard, watch heartbeats, detect crashes,
+  request drain-on-shutdown, join/terminate on close.
+* **t0 handshake** — collect each shard's first-record timestamp,
+  publish the minimum as the shared epoch (grace-bounded so an idle
+  shard cannot stall the fleet).
+* **ordering** — batches dequeue round-robin across workers; within a
+  worker they are strictly FIFO and carry a per-worker sequence number,
+  so a gap (corruption, torn restart) is *detected and counted* rather
+  than silently reordering a flow's updates.  Cross-worker order is
+  intentionally unordered: the IP-hash fan-out guarantees no flow spans
+  workers (``schema.shard_of``).
+* **fail-open** — a dead worker's queue is drained to empty and then
+  ignored; the remaining shards keep serving (the kernel limiter stands
+  alone for the dead shard's flows, the same posture as every other
+  degradation in this system).
+* **metrics** — per-worker fill and queue-residency timers
+  (:class:`~flowsentryx_tpu.engine.metrics.WorkerIngestMetrics`)
+  surfaced through the engine report.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import platform
+import time
+from pathlib import Path
+from typing import NamedTuple
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.engine.metrics import WorkerIngestMetrics
+from flowsentryx_tpu.engine.shm import SealedBatchQueue
+
+
+class SealedBatch(NamedTuple):
+    """One dequeued wire buffer plus its cross-process header fields."""
+
+    raw: np.ndarray       # [B+1, words] u32 (private copy, dispatch-safe)
+    n_records: int
+    t_enqueue: float      # first-record arrival, perf_counter domain
+    t_seal: float         # worker seal time, perf_counter domain
+    worker: int
+    seq: int
+
+
+class SeqTracker:
+    """Per-worker batch sequence bookkeeping (pure, unit-testable).
+
+    Sequences are 1-based and strictly consecutive per worker; any jump
+    counts the *missing* batches, a step backwards counts one gap event
+    (a torn restart re-emitting old numbers must not hide behind a
+    negative delta)."""
+
+    def __init__(self, n_workers: int):
+        self.next_seq = [1] * n_workers
+        self.gaps = [0] * n_workers
+        self.missing = [0] * n_workers
+
+    def note(self, worker: int, seq: int) -> bool:
+        """Record one observed sequence number; True when in order."""
+        expected = self.next_seq[worker]
+        ok = seq == expected
+        if not ok:
+            self.gaps[worker] += 1
+            if seq > expected:
+                self.missing[worker] += seq - expected
+        self.next_seq[worker] = seq + 1
+        return ok
+
+
+class ShardedIngest:
+    """N drain workers feeding the engine over sealed-batch queues.
+
+    Construction only records geometry (and probes the shard-0 ring
+    header for the compact-emit flag); the workers spawn in
+    :meth:`start`, which the Engine calls once it has fixed the wire
+    format and quantizer — those are the engine's decisions and the
+    workers must seal with exactly the same ones or N=0 and N>0 would
+    diverge.
+    """
+
+    #: Engine-facing capability marker (see Engine.__init__).
+    provides_sealed = True
+
+    def __init__(
+        self,
+        ring_base: str | Path,
+        n_workers: int,
+        *,
+        queue_slots: int = 8,
+        timeout_s: float = 10.0,
+        heartbeat_timeout_s: float = 2.0,
+        t0_grace_s: float = 0.5,
+        precompact: bool | None = None,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        if platform.system() != "Linux":
+            # seal/e2e accounting assumes perf_counter == CLOCK_MONOTONIC
+            raise RuntimeError("ShardedIngest requires Linux")
+        self.ring_base = str(ring_base)
+        self.n_workers = n_workers
+        self.queue_slots = queue_slots
+        self.timeout_s = timeout_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.t0_grace_s = t0_grace_s
+        self.ring_paths = [
+            schema.shard_ring_path(self.ring_base, k, n_workers)
+            for k in range(n_workers)
+        ]
+        # ``precompact=None`` probes the shard-0 ring header (blocks
+        # until the daemon publishes it — the serve path, where the
+        # daemon always precedes the engine).  An explicit value skips
+        # the probe so a harness can spawn the fleet BEFORE its
+        # producer exists and measure from a ready state.
+        self.precompact = (
+            self._probe_record_size(self.ring_paths[0], timeout_s)
+            == schema.COMPACT_RECORD_SIZE
+        ) if precompact is None else bool(precompact)
+        self._queues: list[SealedBatchQueue] = []
+        self._procs: list[mp.process.BaseProcess] = []
+        self._seqs: SeqTracker | None = None
+        self._dead: set[int] = set()
+        self._stalled: set[int] = set()
+        self._t0: int | None = None
+        self._t0_first_seen: float | None = None
+        self._rr = 0
+        self._batches = [0] * n_workers
+        self._records = [0] * n_workers
+        self._dropped_tail = 0
+        self._metrics = [WorkerIngestMetrics(k) for k in range(n_workers)]
+        self._started = False
+        self._stopped = False
+
+    @staticmethod
+    def _probe_record_size(path: str, timeout_s: float) -> int:
+        """Record size off a ring header without consuming anything
+        (the engine needs the compact-emit flag before it can choose a
+        wire, i.e. before workers exist)."""
+        import mmap
+
+        deadline = time.monotonic() + timeout_s
+        p = Path(path)
+        while True:
+            if p.exists() and p.stat().st_size >= schema.SHM_HDR_SIZE:
+                with open(p, "rb") as f:
+                    mm = mmap.mmap(f.fileno(), schema.SHM_HDR_SIZE,
+                                   prot=mmap.PROT_READ)
+                hdr = np.frombuffer(mm, np.uint64, 3, 0)
+                magic, rec = int(hdr[0]), int(hdr[2])
+                del hdr
+                mm.close()
+                if magic == schema.SHM_MAGIC:
+                    return rec
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"feature ring shard {path} did not appear (is the "
+                    "daemon running with a matching --shards count?)"
+                )
+            time.sleep(0.01)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, batch_cfg, wire: str, quant: dict | None) -> None:
+        """Spawn the worker fleet (Engine calls this; idempotence is a
+        bug — two engines must not share one ingest)."""
+        if self._started:
+            raise RuntimeError("ShardedIngest already started")
+        self._started = True
+        self.wire = wire
+        words = (schema.COMPACT_RECORD_WORDS
+                 if wire == schema.WIRE_COMPACT16 else schema.RECORD_WORDS)
+        payload_words = (batch_cfg.max_batch + 1) * words
+        self._payload_shape = (batch_cfg.max_batch + 1, words)
+        ctx = mp.get_context("spawn")  # never fork a jax/XLA process
+        from flowsentryx_tpu.ingest.worker import worker_main
+
+        self._seqs = SeqTracker(self.n_workers)
+        for k in range(self.n_workers):
+            qpath = f"{self.ring_paths[k]}.batchq"
+            self._queues.append(
+                SealedBatchQueue.create(qpath, self.queue_slots, payload_words)
+            )
+            spec = {
+                "shard": k,
+                "ring_path": self.ring_paths[k],
+                "queue_path": qpath,
+                "max_batch": batch_cfg.max_batch,
+                "deadline_us": batch_cfg.deadline_us,
+                "wire": wire,
+                "quant": dict(quant) if quant else None,
+                "timeout_s": self.timeout_s,
+            }
+            p = ctx.Process(
+                target=worker_main, args=(spec,),
+                name=f"fsx-ingest-{k}", daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def wait_ready(self, timeout_s: float = 30.0) -> None:
+        """Block until every worker has booted (first heartbeat
+        published; spawn cost — interpreter + numpy import — is paid).
+        Optional: the engine's poll loop tolerates a booting fleet, but
+        a measurement harness wants boot excluded from its window."""
+        deadline = time.monotonic() + timeout_s
+        for k, q in enumerate(self._queues):
+            while q.ctl_get("hbeat") == 0:
+                if not self._procs[k].is_alive():
+                    raise RuntimeError(f"ingest worker {k} died during boot")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"ingest worker {k} not ready in {timeout_s:.0f}s")
+                time.sleep(0.01)
+
+    @property
+    def t0_ns(self) -> int | None:
+        """The agreed stream epoch; None until the handshake resolves."""
+        return self._t0
+
+    def set_t0(self, t0_ns: int) -> None:
+        """Impose an EXTERNAL epoch (an explicit ``t0_ns`` or a restored
+        checkpoint's) on the fleet instead of the min-first_ts
+        handshake.  Must run before the handshake resolves — i.e.
+        before the first :meth:`poll_batches` observes traffic — or the
+        workers would already be sealing against a different epoch than
+        the engine/sink translate with; that inconsistency is
+        unrecoverable for sealed batches, so it errors loudly."""
+        if not self._started:
+            raise RuntimeError("set_t0 before start()")
+        t0_ns = int(t0_ns)
+        if t0_ns <= 0:
+            raise ValueError("t0_ns must be positive")
+        if self._t0 is not None and self._t0 != t0_ns:
+            raise RuntimeError(
+                f"ingest epoch already resolved to {self._t0}; an "
+                f"external t0 {t0_ns} must be imposed before the first "
+                "poll_batches sees traffic"
+            )
+        self._t0 = t0_ns
+        for q in self._queues:
+            q.ctl_set("t0", self._t0)
+
+    def _ensure_t0(self) -> bool:
+        if self._t0 is not None:
+            return True
+        firsts = [q.ctl_get("first_ts") for q in self._queues]
+        seen = [f for f in firsts if f > 0]
+        if not seen:
+            return False
+        now = time.monotonic()
+        if self._t0_first_seen is None:
+            self._t0_first_seen = now
+        live_unseen = sum(
+            1 for k, f in enumerate(firsts)
+            if f == 0 and k not in self._dead
+        )
+        if live_unseen and now - self._t0_first_seen < self.t0_grace_s:
+            return False  # give idle shards a moment to report
+        self._t0 = min(seen)
+        for q in self._queues:
+            q.ctl_set("t0", self._t0)
+        return True
+
+    def _check_health(self) -> None:
+        now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        for k, (p, q) in enumerate(zip(self._procs, self._queues)):
+            if k in self._dead:
+                continue
+            state = q.ctl_get("wstate")
+            if not p.is_alive() and state not in (schema.WSTATE_DONE,):
+                # fail-open: note it, keep serving the other shards (the
+                # queue keeps draining until empty — sealed batches that
+                # made it out of the worker are still good).
+                self._dead.add(k)
+                continue
+            hbeat = q.ctl_get("hbeat")
+            if (p.is_alive() and hbeat
+                    and now_ns - hbeat > self.heartbeat_timeout_s * 1e9):
+                self._stalled.add(k)
+            else:
+                self._stalled.discard(k)
+
+    def request_stop(self) -> None:
+        """Ask every worker to drain its ring and exit (drain-on-
+        shutdown).  The caller keeps consuming batches until
+        :meth:`exhausted` so the tail of the stream is served, then
+        calls :meth:`close`."""
+        self._stopped = True
+        for q in self._queues:
+            q.ctl_set("stop", 1)
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop + join the fleet; undelivered batches are dropped and
+        counted (``ingest_stats()["dropped_tail_batches"]``)."""
+        if not self._started:
+            return
+        self.request_stop()
+        deadline = time.monotonic() + timeout_s
+        for p in self._procs:
+            p.join(timeout=max(0.0, deadline - time.monotonic()))
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+        for q in self._queues:
+            self._dropped_tail += q.readable()
+
+    # -- the sealed-batch source protocol -----------------------------------
+
+    def poll_batches(self, max_batches: int) -> list[SealedBatch]:
+        """Up to ``max_batches`` sealed batches, round-robin across the
+        worker queues (fairness: a hot shard must not starve the rest)."""
+        if not self._started:
+            raise RuntimeError("ShardedIngest.start() was never called")
+        self._check_health()
+        if not self._ensure_t0():
+            return []
+        out: list[SealedBatch] = []
+        n_q = self.n_workers
+        empty_streak = 0
+        wid = self._rr
+        while len(out) < max_batches and empty_streak < n_q:
+            got = self._queues[wid].consume_batch()
+            if got is None:
+                empty_streak += 1
+            else:
+                empty_streak = 0
+                hdr, payload = got
+                seq = int(hdr[0]) | (int(hdr[1]) << 32)
+                n = int(hdr[2])
+                seal_ns = int(hdr[4]) | (int(hdr[5]) << 32)
+                fill_s = int(hdr[6]) * 1e-6
+                t_seal = seal_ns * 1e-9
+                self._seqs.note(wid, seq)
+                self._batches[wid] += 1
+                self._records[wid] += n
+                m = self._metrics[wid]
+                m.fill.add(fill_s)
+                m.queue.add(max(0.0, time.perf_counter() - t_seal))
+                out.append(SealedBatch(
+                    raw=payload.reshape(self._payload_shape),
+                    n_records=n,
+                    t_enqueue=t_seal - fill_s,
+                    t_seal=t_seal,
+                    worker=wid,
+                    seq=seq,
+                ))
+            wid = (wid + 1) % n_q
+        self._rr = wid
+        return out
+
+    def exhausted(self) -> bool:
+        """True only once every worker is gone (clean exit or crash)
+        and every queue is drained — a live fleet is a live source."""
+        if not self._started:
+            return False
+        for k, (p, q) in enumerate(zip(self._procs, self._queues)):
+            done = (not p.is_alive()) or (
+                q.ctl_get("wstate") == schema.WSTATE_DONE and self._stopped
+            )
+            if not done or q.readable():
+                return False
+        return True
+
+    # -- reporting ----------------------------------------------------------
+
+    def ingest_stats(self) -> dict:
+        assert self._seqs is not None
+        workers = {}
+        for k in range(self.n_workers):
+            workers[str(k)] = {
+                "batches": self._batches[k],
+                "records": self._records[k],
+                "seq_gaps": self._seqs.gaps[k],
+                "seq_missing": self._seqs.missing[k],
+                "dropped_emit_batches": self._queues[k].ctl_get("emit_drop"),
+                "dead": k in self._dead,
+                "stalled": k in self._stalled,
+                **self._metrics[k].to_dict(),
+            }
+        return {
+            "n_workers": self.n_workers,
+            "t0_ns": self._t0,
+            "dead_workers": sorted(self._dead),
+            "dropped_tail_batches": self._dropped_tail,
+            "dropped_emit_batches": sum(
+                w["dropped_emit_batches"] for w in workers.values()),
+            "workers": workers,
+        }

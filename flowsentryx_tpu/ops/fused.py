@@ -304,7 +304,14 @@ def update_stats_from_counts(
 ) -> GlobalStats:
     """Fold a ``[4]`` count vector (:data:`STAT_VERDICT_ORDER`) plus one
     batch into the u64 counters — shared by the single-device step
-    (local counts) and the sharded step (psum'd counts)."""
+    (local counts) and the sharded step (psum'd counts).
+
+    ``batches`` bumps only for a NON-EMPTY batch: the verdict classes
+    partition the valid records, so ``counts.sum()`` is ``n_valid``, and
+    an all-masked dispatch — exactly ``Engine.warm()``'s compile
+    trigger — must leave every counter untouched (warm's documented
+    contract; unconditional bumping skewed ``fsx serve --mega`` reports
+    by 1 + mega_n device batches vs the report's own batch count)."""
     from flowsentryx_tpu.core.schema import u64_add
 
     return GlobalStats(
@@ -312,7 +319,8 @@ def update_stats_from_counts(
         dropped_blacklist=u64_add(stats.dropped_blacklist, counts[1]),
         dropped_rate=u64_add(stats.dropped_rate, counts[2]),
         dropped_ml=u64_add(stats.dropped_ml, counts[3]),
-        batches=u64_add(stats.batches, jnp.uint32(1)),
+        batches=u64_add(stats.batches,
+                        (counts.sum() > 0).astype(jnp.uint32)),
     )
 
 

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flowsentryx_tpu.core.config import FsxConfig
+from flowsentryx_tpu.parallel import mesh as mesh_lib
 from flowsentryx_tpu.core.schema import (
     GlobalStats, IpTableState, Verdict, make_table,
 )
@@ -236,7 +237,7 @@ def make_sharded_step(
         now=P(), route_drop=P(),
     )
 
-    sharded = jax.shard_map(
+    sharded = mesh_lib.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(table_specs, stats_specs, P(), P()),

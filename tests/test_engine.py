@@ -454,6 +454,110 @@ class TestEngineLoop:
         assert rep.records == 300
         assert rep.batches == 2  # 256 + padded 44
 
+    @staticmethod
+    def _run_sharded(recs, n_workers, base, **eng_kw):
+        """Serve ``recs`` through a real ShardedIngest fleet over
+        Python-created ring shards; returns (report, sink)."""
+        import time as _time
+
+        from flowsentryx_tpu.engine.shm import ShmRing
+        from flowsentryx_tpu.ingest import ShardedIngest
+
+        shard = schema.shard_of(recs["saddr"], n_workers)
+        for k in range(n_workers):
+            ring = ShmRing.create(
+                schema.shard_ring_path(base, k, n_workers),
+                1 << 12, schema.FLOW_RECORD_DTYPE)
+            part = recs[shard == k]
+            assert ring.produce(part) == len(part)
+        src = ShardedIngest(base, n_workers, queue_slots=16,
+                            precompact=False, t0_grace_s=0.2)
+        sink = CollectSink()
+        eng = Engine(small_cfg(batch=256, cap=1 << 14,
+                               pps_threshold=200.0, bps_threshold=1e9),
+                     src, sink, readback_depth=4, **eng_kw)
+        try:
+            deadline = _time.monotonic() + 30
+            while src.t0_ns is None:  # epoch handshake, then drain-stop
+                src.poll_batches(0)
+                assert _time.monotonic() < deadline
+                _time.sleep(0.01)
+            src.request_stop()
+            rep = eng.run()
+        finally:
+            src.close()
+        return rep, sink
+
+    @staticmethod
+    def _flood_records(n):
+        return TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=8, n_benign_ips=24,
+                        attack_fraction=0.8, seed=13)
+        ).next_records(n)
+
+    def test_sharded_ingest_one_worker_bit_identical(self, tmp_path):
+        """N=1 sharded vs the inline N=0 path on the SAME stream: one
+        worker preserves the exact record order AND batch composition,
+        so everything — verdict counts, blocked set, until-times, batch
+        count — must be bit-identical through the queue transport (the
+        N=0-equivalence acceptance gate of the ingest subsystem, on the
+        lossless raw48 wire)."""
+        import platform
+
+        if platform.system() != "Linux":
+            pytest.skip("shm ingest requires Linux")
+        recs = self._flood_records(256 * 8)
+        sink0 = CollectSink()
+        rep0 = Engine(small_cfg(batch=256, cap=1 << 14,
+                                pps_threshold=200.0, bps_threshold=1e9),
+                      ArraySource(recs.copy()), sink0,
+                      readback_depth=4, wire=schema.WIRE_RAW48).run()
+        rep1, sink1 = self._run_sharded(
+            recs, 1, str(tmp_path / "fring"), wire=schema.WIRE_RAW48)
+        assert rep1.records == rep0.records == len(recs)
+        assert rep1.batches == rep0.batches
+        assert sink1.blocked == sink0.blocked  # keys AND until, exact
+        assert rep1.stats == rep0.stats
+        assert rep1.ingest["n_workers"] == 1
+        assert rep1.ingest["workers"]["0"]["seq_gaps"] == 0
+
+    def test_sharded_ingest_two_workers_equivalent(self, tmp_path):
+        """N=2 regroups records into per-shard batches, and the table
+        updates are batch-granular — so records at a flow's decision
+        boundary may legally move between verdict classes, and
+        until-times (stamped off the sealing batch's device clock) may
+        shift by one batch span.  What MUST hold: the same sources end
+        up blocked, per-flow order is preserved (seq_gaps 0), every
+        record is classified exactly once, and the class drift stays
+        within a few batch boundaries' worth."""
+        import platform
+
+        if platform.system() != "Linux":
+            pytest.skip("shm ingest requires Linux")
+        recs = self._flood_records(256 * 8)
+        sink0 = CollectSink()
+        rep0 = Engine(small_cfg(batch=256, cap=1 << 14,
+                                pps_threshold=200.0, bps_threshold=1e9),
+                      ArraySource(recs.copy()), sink0,
+                      readback_depth=4, wire=schema.WIRE_RAW48).run()
+        rep2, sink2 = self._run_sharded(
+            recs, 2, str(tmp_path / "fring"), wire=schema.WIRE_RAW48)
+        assert rep2.records == rep0.records == len(recs)
+        assert sink2.blocked.keys() == sink0.blocked.keys()
+        for ip, until in sink0.blocked.items():
+            assert abs(sink2.blocked[ip] - until) < 1e-3
+        classes = ("allowed", "dropped_blacklist", "dropped_rate",
+                   "dropped_ml")
+        assert (sum(rep2.stats[k] for k in classes)
+                == sum(rep0.stats[k] for k in classes) == len(recs))
+        for k in classes:
+            assert abs(rep2.stats[k] - rep0.stats[k]) <= 0.05 * len(recs), k
+        ing = rep2.ingest
+        assert ing is not None and ing["n_workers"] == 2
+        assert ing["dead_workers"] == []
+        assert all(w["seq_gaps"] == 0 for w in ing["workers"].values())
+
 
 class TestServeCheckpointEvery:
     def test_periodic_checkpoint_and_restore(self, tmp_path, capsys):

@@ -1,0 +1,322 @@
+"""Sharded ingest subsystem tests (flowsentryx_tpu/ingest/).
+
+Covers the cross-process transport (SealedBatchQueue wraparound and
+backpressure), the ordering contract (SeqTracker gap/missing
+accounting, IP-hash shard affinity), and the worker lifecycle against
+REAL spawned drain workers over Python-created ring shards: lossless
+drain-on-stop, and crash → engine fail-open on the remaining shards.
+The engine-level N=0 vs N=2 verdict equivalence lives in
+tests/test_engine.py (it needs the full Engine).
+"""
+
+import platform
+import time
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import BatchConfig
+from flowsentryx_tpu.engine.shm import SealedBatchQueue, ShmRing
+from flowsentryx_tpu.ingest import SeqTracker, ShardedIngest
+
+pytestmark = pytest.mark.skipif(
+    platform.system() != "Linux",
+    reason="shm ingest assumes Linux (TSO + CLOCK_MONOTONIC contract)",
+)
+
+
+def make_records(n, t0_ns=1_000_000_000, seed=0, n_ips=64):
+    rng = np.random.default_rng(seed)
+    rec = np.zeros(n, schema.FLOW_RECORD_DTYPE)
+    pool = rng.integers(1, 1 << 24, n_ips).astype(np.uint32)
+    rec["saddr"] = pool[rng.integers(0, n_ips, n)]
+    rec["ts_ns"] = t0_ns + np.arange(n, dtype=np.uint64) * 1000
+    rec["pkt_len"] = 64
+    rec["ip_proto"] = 17
+    rec["feat"] = rng.integers(0, 1 << 20, (n, schema.NUM_FEATURES))
+    return rec
+
+
+class TestSealedBatchQueue:
+    def test_roundtrip_and_wraparound(self, tmp_path):
+        """Far more batches than slots through a 4-slot queue: payloads
+        and headers must survive the index wrap exactly."""
+        payload_words = 3 * 4
+        q = SealedBatchQueue.create(tmp_path / "q", 4, payload_words)
+        consumer = SealedBatchQueue(tmp_path / "q", payload_words)
+        sent = 0
+        got = []
+        while sent < 23 or consumer.readable():
+            if sent < 23:
+                payload = np.arange(
+                    payload_words, dtype=np.uint32) + 1000 * sent
+                if q.produce_batch(payload, seq=sent + 1, n_records=sent,
+                                   wire_id=schema.WIRE_ID_RAW48,
+                                   seal_ns=10**9 + sent,
+                                   fill_dur_us=sent * 7):
+                    sent += 1
+            out = consumer.consume_batch()
+            if out is not None:
+                got.append(out)
+        assert len(got) == 23
+        for i, (hdr, payload) in enumerate(got):
+            assert int(hdr[0]) | (int(hdr[1]) << 32) == i + 1
+            assert int(hdr[2]) == i
+            assert int(hdr[4]) | (int(hdr[5]) << 32) == 10**9 + i
+            assert int(hdr[6]) == i * 7
+            np.testing.assert_array_equal(
+                payload, np.arange(payload_words, dtype=np.uint32) + 1000 * i)
+
+    def test_full_queue_backpressure(self, tmp_path):
+        q = SealedBatchQueue.create(tmp_path / "q", 2, 8)
+        payload = np.zeros(8, np.uint32)
+
+        def push(seq):
+            return q.produce_batch(payload, seq=seq, n_records=1,
+                                   wire_id=0, seal_ns=1, fill_dur_us=0)
+
+        assert push(1) and push(2)
+        assert not push(3)  # full: producer must retry, not overwrite
+        assert q.consume_batch() is not None
+        assert push(3)
+
+    def test_payload_shape_mismatch_rejected(self, tmp_path):
+        SealedBatchQueue.create(tmp_path / "q", 4, 16)
+        with pytest.raises(ValueError, match="payload"):
+            SealedBatchQueue(tmp_path / "q", expect_payload_words=32)
+
+    def test_control_block_fields_are_independent(self, tmp_path):
+        q = SealedBatchQueue.create(tmp_path / "q", 2, 4)
+        for i, name in enumerate(("hbeat", "first_ts", "t0", "stop",
+                                  "wstate", "emit_drop")):
+            q.ctl_set(name, 100 + i)
+        for i, name in enumerate(("hbeat", "first_ts", "t0", "stop",
+                                  "wstate", "emit_drop")):
+            assert q.ctl_get(name) == 100 + i
+
+    def test_emit_drop_unburns_seq_and_counts(self, tmp_path, monkeypatch):
+        """A stop-drain give-up on a full queue must NOT look like
+        corruption: the batch's seq is un-burned (later emits stay
+        consecutive, no gap) and the loss lands in the emit_drop
+        counter instead."""
+        from flowsentryx_tpu.ingest import worker as worker_mod
+
+        monkeypatch.setattr(worker_mod, "EMIT_STOP_TIMEOUT_S", 0.05)
+        max_batch, words = 2, 4
+        payload_words = (max_batch + 1) * words
+        q = SealedBatchQueue.create(tmp_path / "q", 2, payload_words)
+
+        class _StubBatcher:
+            def pop_seal_time(self):
+                return time.perf_counter()
+
+        em = worker_mod._Emitter(
+            q, _StubBatcher(), schema.WIRE_ID_RAW48, max_batch)
+        buf = np.zeros((max_batch + 1, words), np.uint32)
+        buf[max_batch, 0] = 2
+        em.emit(buf, stopping=False)  # seq 1
+        em.emit(buf, stopping=False)  # seq 2 — queue now full
+        em.emit(buf, stopping=True)   # full + stopping: bounded, dropped
+        assert em.seq == 2
+        assert q.ctl_get("emit_drop") == 1
+        consumer = SealedBatchQueue(tmp_path / "q", payload_words)
+        assert consumer.consume_batch() is not None  # frees a slot
+        em.emit(buf, stopping=True)   # enqueues as seq 3
+        assert em.seq == 3 and q.ctl_get("emit_drop") == 1
+        hdr, _ = consumer.consume_batch()
+        assert int(hdr[0]) == 2
+        hdr, _ = consumer.consume_batch()
+        assert int(hdr[0]) == 3  # consecutive across the drop: no gap
+
+
+class TestSeqTracker:
+    def test_in_order(self):
+        t = SeqTracker(2)
+        assert t.note(0, 1) and t.note(0, 2) and t.note(1, 1)
+        assert t.gaps == [0, 0] and t.missing == [0, 0]
+
+    def test_forward_jump_counts_missing(self):
+        t = SeqTracker(1)
+        t.note(0, 1)
+        assert not t.note(0, 4)  # 2 and 3 never arrived
+        assert t.gaps[0] == 1 and t.missing[0] == 2
+        assert t.note(0, 5)  # resynced
+
+    def test_backward_step_counts_gap_not_missing(self):
+        t = SeqTracker(1)
+        for s in (1, 2, 3, 4, 5):
+            t.note(0, s)
+        assert not t.note(0, 2)  # torn restart re-emitting old numbers
+        assert t.gaps[0] == 1 and t.missing[0] == 0
+
+
+class TestShardAffinity:
+    def test_shard_of_mirrors_daemon_hash(self):
+        """Python and fsxd must route identically; the formula is the
+        contract (Fibonacci hash, fsx_shard_of in daemon/fsxd.cpp)."""
+        saddr = np.random.default_rng(3).integers(
+            0, 1 << 32, 4096, dtype=np.uint64).astype(np.uint32)
+        for n in (1, 2, 3, 4, 8):
+            expect = ((saddr.astype(np.uint64) * 2654435761) >> 16) % n
+            np.testing.assert_array_equal(
+                schema.shard_of(saddr, n), expect.astype(np.uint32))
+
+    def test_flow_affinity(self):
+        """All records of one source land on one shard — the ordering
+        guarantee the subsystem is built on."""
+        rec = make_records(4096, n_ips=32)
+        sh = schema.shard_of(rec["saddr"], 4)
+        for ip in np.unique(rec["saddr"]):
+            assert len(np.unique(sh[rec["saddr"] == ip])) == 1
+
+    def test_shard_ring_path(self):
+        assert schema.shard_ring_path("/tmp/r", 0, 1) == "/tmp/r"
+        assert schema.shard_ring_path("/tmp/r", 2, 4) == "/tmp/r.2"
+
+
+def _make_shard_rings(base, n_shards, capacity=1 << 14):
+    return [
+        ShmRing.create(schema.shard_ring_path(base, k, n_shards),
+                       capacity, schema.FLOW_RECORD_DTYPE)
+        for k in range(n_shards)
+    ]
+
+
+def _route(rec, n_shards):
+    sh = schema.shard_of(rec["saddr"], n_shards)
+    return [rec[sh == k] for k in range(n_shards)]
+
+
+def _start_fleet(base, n_workers, max_batch=256):
+    ing = ShardedIngest(base, n_workers, queue_slots=16, precompact=False,
+                        t0_grace_s=0.2)
+    ing.start(BatchConfig(max_batch=max_batch, deadline_us=10_000),
+              schema.WIRE_RAW48, None)
+    ing.wait_ready()
+    return ing
+
+
+def _drain(ing, deadline_s=30.0):
+    out = []
+    deadline = time.monotonic() + deadline_s
+    while not ing.exhausted():
+        got = ing.poll_batches(8)
+        out.extend(got)
+        if not got:
+            assert time.monotonic() < deadline, "fleet never drained"
+            time.sleep(0.005)
+    out.extend(ing.poll_batches(64))
+    return out
+
+
+class TestWorkerFleet:
+    def test_lossless_drain_on_stop(self, tmp_path):
+        """Produce → stop → every record comes back sealed, in per-
+        worker seq order, including the partial tail batches."""
+        base = str(tmp_path / "fring")
+        rings = _make_shard_rings(base, 2)
+        rec = make_records(256 * 5 + 37, n_ips=64)
+        parts = _route(rec, 2)
+        for ring, part in zip(rings, parts):
+            assert ring.produce(part) == len(part)
+        ing = _start_fleet(base, 2)
+        try:
+            # engine-side epoch handshake, then ask for drain-on-stop
+            deadline = time.monotonic() + 20
+            while ing.t0_ns is None:
+                ing.poll_batches(0)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert ing.t0_ns == int(rec["ts_ns"].min())
+            ing.request_stop()
+            batches = _drain(ing)
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        assert sum(sb.n_records for sb in batches) == len(rec)
+        per_worker = [sum(1 for sb in batches if sb.worker == k)
+                      for k in range(2)]
+        for k in range(2):
+            w = stats["workers"][str(k)]
+            assert w["records"] == len(parts[k])
+            assert w["batches"] == per_worker[k]
+            assert w["seq_gaps"] == 0 and w["seq_missing"] == 0
+            assert not w["dead"]
+        assert stats["dropped_tail_batches"] == 0
+
+    def test_external_t0_imposed_before_handshake(self, tmp_path):
+        """A restored checkpoint's epoch (Engine.restore → _run_sealed →
+        set_t0) must reach the workers instead of their min-first_ts
+        handshake, so sealed device times and the sink's ns translation
+        share one epoch."""
+        base = str(tmp_path / "fring")
+        rings = _make_shard_rings(base, 2)
+        rec = make_records(512, n_ips=64)
+        parts = _route(rec, 2)
+        ing = _start_fleet(base, 2)
+        try:
+            epoch = int(rec["ts_ns"].min()) - 12_345
+            ing.set_t0(epoch)
+            for ring, part in zip(rings, parts):
+                assert ring.produce(part) == len(part)
+            ing.request_stop()
+            batches = _drain(ing)
+            assert ing.t0_ns == epoch  # not overwritten by the handshake
+            assert sum(sb.n_records for sb in batches) == len(rec)
+        finally:
+            ing.close()
+
+    def test_external_t0_after_handshake_errors(self, tmp_path):
+        """Imposing a DIFFERENT epoch after batches were already sealed
+        against the handshake's is unrecoverable — it must error loudly,
+        not skew silently."""
+        base = str(tmp_path / "fring")
+        rings = _make_shard_rings(base, 2)
+        rec = make_records(512, n_ips=64)
+        for ring, part in zip(rings, _route(rec, 2)):
+            ring.produce(part)
+        ing = _start_fleet(base, 2)
+        try:
+            deadline = time.monotonic() + 20
+            while ing.t0_ns is None:
+                ing.poll_batches(0)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            with pytest.raises(RuntimeError, match="already resolved"):
+                ing.set_t0(ing.t0_ns + 999)
+            ing.set_t0(ing.t0_ns)  # same epoch: idempotent no-op
+        finally:
+            ing.close()
+
+    def test_worker_crash_fails_open(self, tmp_path):
+        """Kill one worker mid-stream: the engine keeps consuming the
+        remaining shard, and the death is surfaced, not raised."""
+        base = str(tmp_path / "fring")
+        rings = _make_shard_rings(base, 2)
+        rec = make_records(256 * 4, n_ips=64)
+        parts = _route(rec, 2)
+        for ring, part in zip(rings, parts):
+            ring.produce(part[: len(part) // 2])
+        ing = _start_fleet(base, 2)
+        try:
+            deadline = time.monotonic() + 20
+            while ing.t0_ns is None:
+                ing.poll_batches(0)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            ing._procs[0].terminate()
+            ing._procs[0].join(timeout=10)
+            # shard 1 keeps flowing after the crash
+            rings[1].produce(parts[1][len(parts[1]) // 2:])
+            ing.request_stop()
+            batches = _drain(ing)
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        assert stats["dead_workers"] == [0]
+        assert stats["workers"]["1"]["dead"] is False
+        # every shard-1 record was served despite the shard-0 corpse
+        got1 = sum(sb.n_records for sb in batches if sb.worker == 1)
+        assert got1 == len(parts[1])
+        assert stats["workers"]["1"]["seq_gaps"] == 0

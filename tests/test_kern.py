@@ -109,3 +109,21 @@ def test_minifloat_c_python_lockstep(tmp_path):
     c_q = np.array([int(x) for x in out.stdout.split()], np.uint32)
     py_q = schema.quantize_feat_minifloat(vals.astype(np.uint32))
     np.testing.assert_array_equal(c_q, py_q)
+
+    # u64 inputs (fsx_minifloat8 takes unsigned long long — kernel
+    # counters mirrored through the encoder are 64-bit): lockstep must
+    # hold through and past the 2^32 boundary, where the python LUT
+    # fast path hands off to the reference ramp into the 255 clamp.
+    vals64 = np.concatenate([
+        np.array([2**32 - 1, 2**32, 2**32 + 1, 2**33, 2**40, 2**63,
+                  np.iinfo(np.uint64).max], np.uint64),
+        (np.uint64(1) << rng.integers(32, 63, 500).astype(np.uint64))
+        + rng.integers(0, 1 << 20, 500).astype(np.uint64),
+    ])
+    out = subprocess.run(
+        [str(exe)], input="\n".join(str(int(v)) for v in vals64) + "\n",
+        capture_output=True, text=True,
+    )
+    c_q64 = np.array([int(x) for x in out.stdout.split()], np.uint32)
+    np.testing.assert_array_equal(
+        c_q64, schema.quantize_feat_minifloat(vals64))
