@@ -11,6 +11,13 @@ docs/BPF_BUILD.md) with a first-party toolchain:
   crafted packets, and an mmap'd ringbuf consumer;
 * :mod:`progs` — the fsx XDP fast path, hand-assembled, mirroring
   kern/fsx_kern.c instruction for instruction in semantics;
+* :mod:`verifier` — an in-repo static verifier: the kernel verifier's
+  safety contract (packet bounds proofs, stack init, map-value bounds,
+  helper contracts, CFG checks) checkable with no kernel in the loop;
+  runs automatically before every prog_load and image seal;
+* :mod:`contracts` — the cross-layer wire-format contract checker
+  (schema ↔ generated header ↔ baked progs.py offsets ↔ sealed
+  images), surfaced with the verifier as ``fsx check``;
 * :mod:`elf` — emits a standard relocatable ELF object (kern/fsx_kern.o
   successor of the reference's checked-in src/fsx_kern.o).
 

@@ -19,12 +19,12 @@ Regenerate with:  python -m flowsentryx_tpu.bpf.image [out.img]
 
 from __future__ import annotations
 
+import os
 import struct
 import sys
 from dataclasses import dataclass
 
 from flowsentryx_tpu.bpf import progs
-from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.bpf.asm import Program
 
 MAGIC = int.from_bytes(b"FSXPROG1", "little")
@@ -49,8 +49,20 @@ def emit(prog: Program | None = None,
     """Serialize the fsx program (or a custom one) to an image blob.
     ``compact`` assembles the 16 B kernel-quantized emit variant
     (progs.build(compact=True)); the daemon must then be started with
-    --compact so ring record sizes agree."""
+    --compact so ring record sizes agree.
+
+    The program is statically verified before the image is sealed
+    (``bpf/verifier.py``; one cached pass per distinct program per
+    process) — a daemon must never be handed bytecode the kernel
+    verifier would reject at attach time, in an environment where the
+    rejection cannot be reproduced.  ``FSX_SKIP_STATIC_VERIFY=1``
+    skips the pass.
+    """
     prog = prog or progs.build(compact=compact)
+    if os.environ.get("FSX_SKIP_STATIC_VERIFY") != "1":
+        from flowsentryx_tpu.bpf import verifier
+
+        verifier.check_program_cached(prog)
     names = prog.map_names
     specs = []
     for name in names:
@@ -72,11 +84,16 @@ def emit(prog: Program | None = None,
 
 
 def parse(blob: bytes) -> tuple[list[ImageMap], list[tuple[int, int]], bytes]:
-    """Inverse of emit (used by tests to cross-check the C++ reader)."""
+    """Inverse of emit (used by tests to cross-check the C++ reader).
+    Raises ValueError (never struct.error) on a truncated/corrupt blob."""
+    if len(blob) < _HDR.size:
+        raise ValueError("truncated FSXPROG image")
     magic, ver, n_maps, n_relocs, n_insns = _HDR.unpack_from(blob, 0)
     if magic != MAGIC or ver != VERSION:
         raise ValueError("bad FSXPROG image")
     off = _HDR.size
+    if len(blob) < off + n_maps * _MAP.size + n_relocs * _REL.size:
+        raise ValueError("truncated FSXPROG image")
     maps = []
     for _ in range(n_maps):
         nm, mt, ks, vs, me = _MAP.unpack_from(blob, off)
@@ -84,12 +101,36 @@ def parse(blob: bytes) -> tuple[list[ImageMap], list[tuple[int, int]], bytes]:
         off += _MAP.size
     relocs = []
     for _ in range(n_relocs):
-        relocs.append(_REL.unpack_from(blob, off))
+        slot, mi = _REL.unpack_from(blob, off)
+        if mi >= n_maps:
+            raise ValueError(f"FSXPROG relocation references map "
+                             f"#{mi} of {n_maps}")
+        relocs.append((slot, mi))
         off += _REL.size
     insns = blob[off: off + 8 * n_insns]
     if len(insns) != 8 * n_insns:
         raise ValueError("truncated FSXPROG image")
     return maps, relocs, insns
+
+
+def to_program(blob: bytes, name: str = "image",
+               ) -> tuple[Program, list[ImageMap]]:
+    """Decode an image back to an assemblable :class:`Program` plus its
+    embedded map specs — the full inverse of :func:`emit`, shared by
+    ``fsx check --image`` and the verifier tests so the instruction
+    wire decode lives in exactly one place."""
+    from flowsentryx_tpu.bpf.asm import MapReloc
+    from flowsentryx_tpu.bpf.isa import Insn
+
+    maps, relocs, insn_bytes = parse(blob)
+    # "<BBhi": off and imm are signed on the Insn (pack masks them), so
+    # decode sign-extended for a lossless emit -> to_program roundtrip
+    insns = [Insn(op, sd & 0x0F, sd >> 4, off, imm)
+             for op, sd, off, imm in struct.iter_unpack("<BBhi",
+                                                        insn_bytes)]
+    prog = Program(insns, [MapReloc(slot, maps[mi].name)
+                           for slot, mi in relocs], name=name)
+    return prog, maps
 
 
 def main(argv: list[str]) -> int:

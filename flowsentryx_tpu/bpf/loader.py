@@ -228,7 +228,20 @@ def map_create(map_type: int, key_size: int, value_size: int,
 def prog_load(prog: Program | bytes, prog_type: int = PROG_TYPE_XDP,
               map_fds: dict[str, int] | None = None, license_: str = "GPL",
               log_size: int = 1 << 20, name: str = "") -> int:
-    """Load through the verifier; raises VerifierError with the log."""
+    """Load through the verifier; raises VerifierError with the log.
+
+    A :class:`Program` is first run through the IN-REPO static verifier
+    (``bpf/verifier.py``), so a generation bug surfaces as a precise
+    instruction-level diagnostic instead of a kernel ``EACCES`` — and
+    surfaces at all in environments where bpf(2) is unavailable.  Raw
+    bytes skip the static pass (no relocation table to interpret);
+    ``FSX_SKIP_STATIC_VERIFY=1`` skips it explicitly.
+    """
+    if isinstance(prog, Program) and \
+            os.environ.get("FSX_SKIP_STATIC_VERIFY") != "1":
+        from flowsentryx_tpu.bpf import verifier
+
+        verifier.check_program_cached(prog)
     code = prog.pack(map_fds) if isinstance(prog, Program) else prog
     insn_cnt = len(code) // 8
     ib = ctypes.create_string_buffer(code, len(code))

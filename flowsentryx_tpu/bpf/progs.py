@@ -111,6 +111,13 @@ FLAG_IPV6, FLAG_TCP_SYN, FLAG_TCP, FLAG_UDP, FLAG_ICMP = 1, 2, 4, 8, 16
 FSX_TCP_SYN = 0x02  # tcp header flags byte (kern/parsing.h:187)
 
 IPPROTO_ICMP, IPPROTO_TCP, IPPROTO_UDP, IPPROTO_ICMPV6 = 1, 6, 17, 58
+#: IPv6 extension headers the parser walks through to reach L4 (an
+#: attacker must not hide a SYN flood behind one hop-by-hop header).
+#: FRAGMENT (44) is deliberately NOT walked: a non-first fragment
+#: carries no L4 header at all, so the walk stops and the packet is
+#: classified by its L3 facts alone.
+IPPROTO_HOPOPTS, IPPROTO_ROUTING, IPPROTO_DSTOPTS = 0, 43, 60
+IPV6_EXT_WALK_DEPTH = 4  # bounded unroll; real chains are 1-2 deep
 
 # ---- stack frame layout (r10-relative; eBPF allows [-512, 0)) ----
 S_KEY = -4          # u32: zero key, then saddr key for hash maps
@@ -382,7 +389,35 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64(BPF_XOR, R1, R0)
     a += stx(BPF_DW, R10, S_SADDR, R1)
     a += st_imm(BPF_DW, R10, S_IS6, 1)
-    # r5 already = l4 start (fixed 40 B header; ext hdrs not walked)
+    # r5 = l4 start (after the fixed 40 B header); walk up to
+    # IPV6_EXT_WALK_DEPTH extension headers so L4 classification (and
+    # the SYN/port features built on it) cannot be evaded by a
+    # hop-by-hop/routing/dstopts prefix.  Each hop advances the cursor
+    # by a VARIABLE amount read from the packet — (hdr_ext_len + 1) * 8
+    # — which invalidates any prior bounds proof, so every hop re-checks
+    # its fixed 8-byte window against data_end before the loads and the
+    # L4 parsers re-check their own headers after the final advance.
+    # This mask-bound-advance-recheck shape is exactly what the static
+    # verifier (bpf/verifier.py) proves; a missing re-check here is the
+    # canonical rejection in tests/test_verifier.py.
+    for i in range(IPV6_EXT_WALK_DEPTH):
+        a += ldx(BPF_DW, R1, R10, S_L4)  # current next-header value
+        a.jmp_imm(BPF_JEQ, R1, IPPROTO_HOPOPTS, f"ext{i}_walk")
+        a.jmp_imm(BPF_JEQ, R1, IPPROTO_ROUTING, f"ext{i}_walk")
+        a.jmp_imm(BPF_JEQ, R1, IPPROTO_DSTOPTS, f"ext{i}_walk")
+        a.ja("l4")  # not an extension header: r5 is the L4 start
+        a.label(f"ext{i}_walk")
+        a += mov64(R4, R5)
+        a += alu64_imm(BPF_ADD, R4, 8)
+        a.jmp_reg(BPF_JGT, R4, R3, "drop")  # truncated ext hdr → drop
+        a += ldx(BPF_B, R1, R5, 0)  # next header
+        a += stx(BPF_DW, R10, S_L4, R1)
+        a += ldx(BPF_B, R1, R5, 1)  # hdr_ext_len (8 B units past the 1st)
+        a += alu64_imm(BPF_ADD, R1, 1)
+        a += alu64_imm(BPF_LSH, R1, 3)  # advance = (len + 1) * 8 ≤ 2048
+        a += alu64(BPF_ADD, R5, R1)  # variable advance: proof reset
+    # depth exhausted with another ext header pending: fall to the L4
+    # dispatch, which finds no match and classifies on L3 facts
 
     # ---- L4 dispatch (parsing.h:249-264); r5 = l4 start, r3 = end ----
     a.label("l4")

@@ -152,6 +152,87 @@ def _cmd_version(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_check(args: argparse.Namespace) -> int:
+    """Static verification of the data plane, no kernel needed.
+
+    Two halves (see docs/VERIFIER.md):
+
+    * every hand-assembled program (both emit variants, plus any
+      ``--image`` blob) runs through the in-repo abstract-interpreter
+      verifier — packet bounds proofs, stack initialization, map-value
+      bounds, helper contracts, CFG/reference checks;
+    * the cross-layer contract checker diffs the struct offsets baked
+      into the bytecode against core.schema, the generated
+      kern/fsx_schema.h (which the C daemon compiles), and the sealed
+      images under kern/build/.
+
+    Exit 0 only when everything agrees; rejections carry the failing
+    instruction index, its disassembly and the abstract register file.
+    """
+    import struct as _struct
+
+    from flowsentryx_tpu.bpf import contracts, image, progs, verifier
+
+    out: dict = {"programs": [], "ok": True}
+    jobs: list[tuple[str, object]] = [
+        ("fsx[raw48]", lambda: progs.build()),
+        ("fsx[compact16]", lambda: progs.build(compact=True)),
+    ]
+    for path in args.image or ():
+        def _from_image(p: str = path):
+            prog, maps = image.to_program(Path(p).read_bytes(), name=p)
+            infos = {m.name: verifier.MapInfo(m.name, m.map_type,
+                                              m.key_size, m.value_size)
+                     for m in maps}
+            return prog, infos
+        jobs.append((path, _from_image))
+
+    for name, build in jobs:
+        try:
+            built = build()
+            prog, infos = built if isinstance(built, tuple) else (built,
+                                                                  None)
+            if infos is None:
+                rep = verifier.check_program_cached(prog,
+                                                    budget=args.budget)
+            else:
+                rep = verifier.check_program(prog, infos, name=name,
+                                             budget=args.budget)
+            out["programs"].append({"ok": True, **rep.to_json(),
+                                    "program": name})
+            if not args.json:
+                print(f"fsx check: {name}: OK ({rep.n_insns} insns, "
+                      f"{rep.insns_visited} states explored)")
+        except (verifier.StaticVerifierError, OSError, ValueError,
+                _struct.error) as e:
+            out["ok"] = False
+            entry = {"ok": False, "program": name, "error": str(e)}
+            if isinstance(e, verifier.StaticVerifierError):
+                entry.update(insn=e.insn_idx, insn_txt=e.insn_txt,
+                             reason=e.reason, state=e.state_dump)
+            out["programs"].append(entry)
+            if not args.json:
+                print(f"fsx check: {name}: REJECTED\n  {e}",
+                      file=sys.stderr)
+
+    crep = contracts.run_all(with_images=not args.no_images)
+    out["contracts"] = crep.to_json()
+    out["ok"] = out["ok"] and crep.ok
+    if not args.json:
+        for cname, msgs in crep.checks.items():
+            if msgs:
+                print(f"fsx check: contract {cname}: FAILED",
+                      file=sys.stderr)
+                for msg in msgs:
+                    print(f"  {msg}", file=sys.stderr)
+            else:
+                print(f"fsx check: contract {cname}: OK")
+        print(f"fsx check: {'PASS' if out['ok'] else 'FAIL'}")
+    else:
+        print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
 def _cmd_block(args: argparse.Namespace) -> int:
     """Manually blacklist a source (reference README.md:70-74: "Block
     specified IP addresses").  v6 addresses block EXACTLY (the 16-byte
@@ -941,6 +1022,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("version", help="print version")
     v.set_defaults(fn=_cmd_version)
+
+    ck = sub.add_parser(
+        "check",
+        help="statically verify the BPF fast path + cross-layer "
+             "schema contracts (no kernel needed)")
+    ck.add_argument("--image", action="append", metavar="PATH",
+                    help="also verify this sealed FSXPROG image "
+                         "(repeatable)")
+    ck.add_argument("--no-images", action="store_true",
+                    help="skip the checked-in kern/build image "
+                         "freshness contract")
+    ck.add_argument("--budget", type=int, default=1_000_000,
+                    help="verifier state budget per program (mirrors "
+                         "the kernel's 1M-insn analysis cap)")
+    ck.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ck.set_defaults(fn=_cmd_check)
 
     # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
     # construction never imports the bpf loader (lazy-import rule).

@@ -169,6 +169,105 @@ KERNEL_STATS_FIELDS: tuple[tuple[str, str], ...] = (
 )
 
 # ---------------------------------------------------------------------------
+# Machine-readable struct layouts (the cross-layer contract surface)
+# ---------------------------------------------------------------------------
+#
+# Everything below the kernel/user seam speaks PACKED structs whose
+# single source of truth is this module: codegen renders them into
+# kern/fsx_schema.h (compiled by the C daemon and the BPF C twin),
+# progs.py bakes their offsets into bytecode immediates, and the ingest
+# decoders read them back.  ``struct_layouts()`` exposes those layouts
+# as data so the static contract checker (``flowsentryx_tpu.bpf.
+# contracts``, surfaced as ``fsx check``) can diff every layer against
+# this one definition instead of each pair drifting independently.
+
+_TYPE_SIZES = {"u64": 8, "u32": 4, "u16": 2, "u8": 1}
+
+
+class FieldLayout(NamedTuple):
+    """One field of a packed struct: byte offset + element size/count."""
+
+    name: str
+    offset: int
+    size: int       # size of ONE element
+    count: int = 1  # > 1 for array fields
+
+
+class StructLayout(NamedTuple):
+    """A packed struct: total size plus per-field offsets."""
+
+    name: str
+    size: int
+    fields: tuple[FieldLayout, ...]
+
+    def offset_of(self, field: str) -> int:
+        for f in self.fields:
+            if f.name == field:
+                return f.offset
+        raise KeyError(f"{self.name} has no field {field!r}")
+
+
+def _layout_from_fields(
+    cname: str, fields: tuple[tuple[str, str], ...]
+) -> StructLayout:
+    out, off = [], 0
+    for name, tp in fields:
+        size = _TYPE_SIZES[tp]
+        out.append(FieldLayout(name, off, size))
+        off += size
+    return StructLayout(cname, off, tuple(out))
+
+
+def _layout_from_dtype(cname: str, dt: np.dtype) -> StructLayout:
+    out = []
+    for name in dt.names:
+        ft, off = dt.fields[name][:2]
+        if ft.subdtype is not None:
+            base, shape = ft.subdtype
+            out.append(FieldLayout(name, off, base.itemsize, shape[0]))
+        else:
+            out.append(FieldLayout(name, off, ft.itemsize))
+    return StructLayout(cname, dt.itemsize, tuple(out))
+
+
+def struct_layouts() -> dict[str, StructLayout]:
+    """Every packed struct of the kernel/user/device seam, keyed by its
+    C name — the layouts codegen generates, progs.py bakes, and the
+    decoders parse.  ``fsx check`` diffs all of them against this."""
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    shm_hdr = StructLayout(
+        "fsx_shm_ring_hdr", SHM_HDR_SIZE, (
+            FieldLayout("magic", 0, 8),
+            FieldLayout("capacity", SHM_CAPACITY_OFFSET, 8),
+            FieldLayout("record_size", SHM_RECORD_SIZE_OFFSET, 8),
+            FieldLayout("_meta_pad", 24, 8, 5),
+            FieldLayout("head", SHM_HEAD_OFFSET, 8),
+            FieldLayout("_head_pad", SHM_HEAD_OFFSET + 8, 8, 7),
+            FieldLayout("tail", SHM_TAIL_OFFSET, 8),
+            FieldLayout("_tail_pad", SHM_TAIL_OFFSET + 8, 8, 7),
+        ))
+    return {
+        "fsx_config": _layout_from_fields(
+            "fsx_config",
+            tuple((n, t) for n, t, _ in FsxConfig.KERNEL_CONFIG_FIELDS)),
+        "fsx_ip_state": _layout_from_fields("fsx_ip_state",
+                                            IP_STATE_FIELDS),
+        "fsx_flow_stats": _layout_from_fields("fsx_flow_stats",
+                                              FLOW_STATS_FIELDS),
+        "fsx_stats": _layout_from_fields("fsx_stats",
+                                         KERNEL_STATS_FIELDS),
+        "fsx_flow_record": _layout_from_dtype("fsx_flow_record",
+                                              FLOW_RECORD_DTYPE),
+        "fsx_compact_record": _layout_from_dtype("fsx_compact_record",
+                                                 COMPACT_RECORD_DTYPE),
+        "fsx_verdict_record": _layout_from_dtype("fsx_verdict_record",
+                                                 VERDICT_RECORD_DTYPE),
+        "fsx_shm_ring_hdr": shm_hdr,
+    }
+
+
+# ---------------------------------------------------------------------------
 # Stateless firewall rules (the reference's planned "basic firewall",
 # README.md:70-74: config-file rules to drop certain packets)
 # ---------------------------------------------------------------------------

@@ -100,6 +100,17 @@ def parse_frame(data: bytes) -> tuple[int, int, int, int, int] | None:
         saddr = w[0] ^ w[1] ^ w[2] ^ w[3]  # fsx_fold_ip6
         l4_off = 54
         flags |= schema.FLAG_IPV6
+        # bounded extension-header walk (kern/parsing.h twin): L4
+        # classification must not be evadable via a hop-by-hop/routing/
+        # dstopts prefix.  FRAGMENT (44) stops the walk — a non-first
+        # fragment has no L4 header.
+        for _ in range(4):  # FSX_IPV6_EXT_WALK_DEPTH
+            if proto not in (0, 43, 60):
+                break
+            if len(data) < l4_off + 8:
+                return None  # truncated ext header -> drop
+            proto = data[l4_off]
+            l4_off += (data[l4_off + 1] + 1) * 8
     else:
         return None
 

@@ -33,7 +33,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from flowsentryx_tpu.core.config import TableConfig
-from flowsentryx_tpu.ops.agg import INVALID_KEY
 
 # numpy scalar, not jnp: a closure-captured concrete jax.Array poisons
 # the axon runtime's dispatch path for the whole process (see

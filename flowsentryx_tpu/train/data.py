@@ -22,8 +22,6 @@ from pathlib import Path
 
 import numpy as np
 
-from flowsentryx_tpu.core import schema
-
 #: CSV column → feature index.  CICFlowMeter emits these with
 #: inconsistent leading spaces; names are matched after strip().
 #: Slots 3/4 are the flow-age features (schema.FEATURE_NAMES): CIC's

@@ -160,6 +160,51 @@ static void test_parse_ip6(void)
 	CHECK(memcmp(pkt.saddr6, ip6 + 8, 16) == 0, "ip6 exact saddr6");
 }
 
+static void test_parse_ip6_ext_walk(void)
+{
+	unsigned char buf[256];
+	size_t off = build_eth(buf, 0x86DD);
+	unsigned char *ip6 = buf + off;
+	struct fsx_pkt pkt;
+
+	memset(ip6, 0, 40);
+	ip6[0] = 0x60;
+	ip6[6] = IPPROTO_HOPOPTS;      /* hop-by-hop first */
+	ip6[7] = 64;
+	for (int i = 0; i < 16; i++)
+		ip6[8 + i] = i + 1;
+	off += 40;
+	/* hop-by-hop: next = routing, hdr_ext_len 0 (8 bytes) */
+	memset(buf + off, 0, 8);
+	buf[off] = IPPROTO_ROUTING;
+	off += 8;
+	/* routing: next = TCP, hdr_ext_len 1 (16 bytes) */
+	memset(buf + off, 0, 16);
+	buf[off] = 6;
+	buf[off + 1] = 1;
+	off += 16;
+	size_t l4 = off;
+	off += build_tcp(buf + off, 1234, 443, FSX_TCP_SYN);
+
+	/* the walk reaches TCP behind two extension headers */
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0, "ip6+ext parses");
+	CHECK(pkt.l4_proto == 6, "ip6+ext walks to tcp");
+	CHECK(pkt.dport == ((443 >> 8) | ((443 & 0xFF) << 8)),
+	      "ip6+ext tcp dport");
+	CHECK(pkt.tcp_flags == FSX_TCP_SYN, "ip6+ext syn visible");
+
+	/* truncated extension header must refuse, not read OOB */
+	CHECK(fsx_parse_packet(buf, buf + l4 - 12, &pkt) < 0,
+	      "truncated ext hdr -> drop");
+
+	/* a fragment header stops the walk: L3-only classification */
+	ip6[6] = 44;                   /* IPPROTO_FRAGMENT */
+	CHECK(fsx_parse_packet(buf, buf + off, &pkt) == 0,
+	      "ip6+frag parses");
+	CHECK(pkt.l4_proto == 44, "fragment not walked");
+	CHECK(pkt.dport == 0, "fragment: no L4 port");
+}
+
 static void test_parse_icmp6(void)
 {
 	unsigned char buf[128];
@@ -375,6 +420,7 @@ int main(void)
 	test_truncated_drops();
 	test_non_ip_passes();
 	test_parse_ip6();
+	test_parse_ip6_ext_walk();
 	test_parse_icmp6();
 	test_fixed_window();
 	test_sliding_window();

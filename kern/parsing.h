@@ -141,12 +141,35 @@ FSX_INLINE int fsx_parse_ip4(struct fsx_cursor *cur, void *data_end,
 	return ip.protocol;
 }
 
-/* Parse IPv6 fixed header (parsing_helper.h:69-107 equivalent;
- * extension headers are not walked, matching the reference). */
+/* IPv6 extension headers the parser walks through to reach L4 (the
+ * bytecode twin: progs.py IPV6 ext walk).  FRAGMENT (44) is NOT
+ * walked — a non-first fragment carries no L4 header, so the walk
+ * stops and the packet is classified by its L3 facts alone. */
+#define FSX_IPV6_EXT_WALK_DEPTH 4
+#ifndef IPPROTO_HOPOPTS
+#define IPPROTO_HOPOPTS 0
+#endif
+#ifndef IPPROTO_ROUTING
+#define IPPROTO_ROUTING 43
+#endif
+#ifndef IPPROTO_DSTOPTS
+#define IPPROTO_DSTOPTS 60
+#endif
+
+/* Parse IPv6: fixed header, then a bounded extension-header walk so
+ * L4 classification cannot be evaded by a hop-by-hop/routing/dstopts
+ * prefix (parsing_helper.h:69-107 equivalent; the reference did not
+ * walk extension headers).  Every hop re-checks its fixed 8-byte
+ * window against data_end BEFORE reading, because the variable
+ * advance invalidates any prior bounds proof — the discipline the
+ * in-repo static verifier (flowsentryx_tpu/bpf/verifier.py) enforces
+ * on the bytecode twin. */
 FSX_INLINE int fsx_parse_ip6(struct fsx_cursor *cur, void *data_end,
 			     struct fsx_pkt *pkt)
 {
 	fsx_ip6hdr ip6;
+	unsigned char exthdr[2];
+	int i;
 
 	if ((char *)cur->pos + sizeof(ip6) > (char *)data_end)
 		return -1;
@@ -164,6 +187,18 @@ FSX_INLINE int fsx_parse_ip6(struct fsx_cursor *cur, void *data_end,
 #endif
 	pkt->is_ipv6 = 1;
 	cur->pos = (char *)cur->pos + sizeof(ip6);
+	for (i = 0; i < FSX_IPV6_EXT_WALK_DEPTH; i++) {
+		if (pkt->l4_proto != IPPROTO_HOPOPTS &&
+		    pkt->l4_proto != IPPROTO_ROUTING &&
+		    pkt->l4_proto != IPPROTO_DSTOPTS)
+			break;
+		if ((char *)cur->pos + 8 > (char *)data_end)
+			return -1;  /* truncated ext header -> drop */
+		__builtin_memcpy(exthdr, cur->pos, 2);
+		pkt->l4_proto = exthdr[0];
+		/* (hdr_ext_len + 1) * 8 bytes, <= 2048 */
+		cur->pos = (char *)cur->pos + ((int)exthdr[1] + 1) * 8;
+	}
 	return pkt->l4_proto;
 }
 

@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Lint gate for the Python plane (the C plane is gated by
+``-Wall -Wextra -Werror`` in both Makefiles already).
+
+Stages, in order; the gate fails if any stage fails:
+
+1. **syntax** — ``compileall`` over every tracked Python tree (always
+   available; a SyntaxError in a lazily-imported module must not wait
+   for the first operator to hit that code path).
+2. **unused imports** — an AST pass with the same contract as
+   pyflakes F401 (``# noqa`` lines and ``__init__.py`` re-exports are
+   exempt).  Runs everywhere, even without ruff.
+3. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+   when ruff is installed; SKIPPED (loudly, not silently) when not.
+   The container this repo grows in has no ruff and nothing may be
+   pip-installed, so the gate degrades to stages 1-2 there.
+4. **mypy** — same availability contract as ruff.
+
+Usage::
+
+    python scripts/lint.py          # gate: exit 1 on any finding
+    python scripts/lint.py --json   # machine-readable report
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import compileall
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+PY_TREES = ("flowsentryx_tpu", "tests", "scripts")
+RUFF_MYPY_SCOPE = "flowsentryx_tpu"
+
+
+def stage_syntax() -> list[str]:
+    fails = []
+    for tree in PY_TREES:
+        ok = compileall.compile_dir(str(REPO / tree), quiet=2,
+                                    force=True, workers=1)
+        if not ok:
+            fails.append(f"{tree}: compileall found syntax errors "
+                         "(re-run verbosely for details)")
+    return fails
+
+
+def _unused_imports(path: Path) -> list[str]:
+    """F401-shaped unused-import findings for one module."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    imported: dict[str, int] = {}  # bound name -> line number
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    if not imported:
+        return []
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # the root of a dotted use is a Name and already collected
+            pass
+    # __all__ re-exports count as uses
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            used.add(elt.value)
+    out = []
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used:
+            continue
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        if "noqa" in line:
+            continue
+        out.append(f"{path.relative_to(REPO)}:{lineno}: "
+                   f"unused import {name!r}")
+    return out
+
+
+def stage_unused_imports() -> list[str]:
+    fails = []
+    for tree in PY_TREES:
+        for path in sorted((REPO / tree).rglob("*.py")):
+            if path.name == "__init__.py":
+                continue  # re-export surface
+            fails.extend(_unused_imports(path))
+    return fails
+
+
+def _run_tool(cmd: list[str]) -> list[str]:
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+    if r.returncode == 0:
+        return []
+    out = (r.stdout + r.stderr).strip()
+    return out.splitlines()[-40:] or [f"{cmd[0]} failed "
+                                      f"(exit {r.returncode})"]
+
+
+def stage_ruff() -> list[str] | None:
+    if shutil.which("ruff") is None:
+        return None
+    return _run_tool(["ruff", "check", RUFF_MYPY_SCOPE])
+
+
+def stage_mypy() -> list[str] | None:
+    if shutil.which("mypy") is None:
+        return None
+    return _run_tool(["mypy", RUFF_MYPY_SCOPE])
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    stages: dict[str, list[str] | None] = {
+        "syntax": stage_syntax(),
+        "unused_imports": stage_unused_imports(),
+        "ruff": stage_ruff(),
+        "mypy": stage_mypy(),
+    }
+    ok = not any(stages.values())
+    if args.json:
+        print(json.dumps({
+            "ok": ok,
+            "stages": {n: ("skipped (tool not installed)" if v is None
+                           else {"ok": not v, "findings": v})
+                       for n, v in stages.items()},
+        }, indent=2))
+    else:
+        for name, findings in stages.items():
+            if findings is None:
+                print(f"lint: {name}: SKIPPED (tool not installed)")
+            elif findings:
+                print(f"lint: {name}: FAILED")
+                for f in findings:
+                    print(f"  {f}")
+            else:
+                print(f"lint: {name}: OK")
+        print(f"lint: {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

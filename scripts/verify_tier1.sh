@@ -4,10 +4,47 @@
 # lockstep with the "Tier-1 verify" line in ROADMAP.md; if they ever
 # disagree, ROADMAP.md wins and this file is the bug.
 #
-# Usage: scripts/verify_tier1.sh   (from anywhere; cds to the repo root)
-# Exit code: pytest's.  Prints DOTS_PASSED=<n> as a tamper-evident
-# passed-test count derived from the progress dots, not the summary.
+# Usage: scripts/verify_tier1.sh                (from anywhere)
+#        scripts/verify_tier1.sh --sanitizers   (ALSO run the opt-in
+#            C-plane sanitizer stage first: the daemon's TSAN shm-ring
+#            torture plus ASan/UBSan builds+runs of kern/host_test,
+#            kern/prop_driver and an fsxd --sim smoke)
+# Exit code: pytest's (a sanitizer-stage failure exits early).  Prints
+# DOTS_PASSED=<n> as a tamper-evident passed-test count derived from
+# the progress dots, not the summary.
 set -u
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--sanitizers" ]; then
+    shift
+    echo "== sanitizers: daemon TSAN torture (shm-ring protocol) =="
+    make -C daemon tsan || exit 1
+
+    SAN="-fsanitize=address,undefined -fno-sanitize-recover=all -O1 -g"
+    export ASAN_OPTIONS=detect_leaks=1
+
+    echo "== sanitizers: kern/host_test under ASan+UBSan =="
+    mkdir -p kern/build
+    gcc $SAN -Wall -Wextra -Werror -DFSX_HOST_BUILD -Ikern \
+        kern/host_test.c -o kern/build/host_test_asan -lm || exit 1
+    kern/build/host_test_asan || exit 1
+
+    echo "== sanitizers: kern/prop_driver under ASan+UBSan =="
+    gcc $SAN -Wall -Wextra -Werror -DFSX_HOST_BUILD -Ikern \
+        kern/prop_driver.c -o kern/build/prop_driver_asan || exit 1
+    # tiny smoke trace: fixed-window limiter, 3 aggregated ticks
+    printf '0 100 1000000 1000000000 200 200 0 0\n3\n1 100 0\n200 20000 500000000\n1 100 2000000000\n' \
+        | kern/build/prop_driver_asan > /dev/null || exit 1
+
+    echo "== sanitizers: fsxd --sim smoke under ASan+UBSan =="
+    mkdir -p daemon/build
+    g++ $SAN -std=c++17 -Wall -Wextra -Werror -Ikern \
+        daemon/fsxd.cpp -o daemon/build/fsxd_asan -lpthread || exit 1
+    daemon/build/fsxd_asan --sim --duration 2 --rate 2e5 \
+        --feature-ring /tmp/fsx_t1_asan_ring \
+        --verdict-ring /tmp/fsx_t1_asan_verdicts > /dev/null || exit 1
+    rm -f /tmp/fsx_t1_asan_ring /tmp/fsx_t1_asan_verdicts
+    echo "== sanitizers: all clean =="
+fi
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

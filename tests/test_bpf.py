@@ -82,6 +82,30 @@ def ip6_pkt(saddr_words: tuple[int, int, int, int], nexthdr: int = 17,
     return pkt + b"X" * max(0, plen - len(pkt))
 
 
+def ip6_ext_pkt(saddr_words: tuple[int, int, int, int],
+                ext_chain: tuple[tuple[int, int], ...],
+                l4_proto: int = 6, dport: int = 443,
+                tcp_flags: int = 0x02, plen: int = 160) -> bytes:
+    """v6 frame whose L4 hides behind ``ext_chain`` extension headers
+    (each entry ``(proto_of_header, hdr_ext_len)``; the chain is linked
+    automatically, ending at ``l4_proto``)."""
+    hdr = b"\x60\x00\x00\x00" + struct.pack(">H", plen - 54) + \
+        bytes([ext_chain[0][0] if ext_chain else l4_proto, 64])
+    hdr += b"".join(struct.pack("<I", w) for w in saddr_words)
+    hdr += b"\xaa" * 16
+    body = b""
+    for i, (_, elen) in enumerate(ext_chain):
+        nxt = ext_chain[i + 1][0] if i + 1 < len(ext_chain) else l4_proto
+        body += bytes([nxt, elen]) + b"\x00" * ((elen + 1) * 8 - 2)
+    if l4_proto == 6:
+        body += struct.pack(">HH", 1234, dport) + b"\x00" * 9 + \
+            bytes([tcp_flags]) + b"\x00" * 6
+    elif l4_proto == 17:
+        body += struct.pack(">HHHH", 1234, dport, 8, 0)
+    pkt = eth(0x86DD) + hdr + body
+    return pkt + b"X" * max(0, plen - len(pkt))
+
+
 def saddr_key(saddr: int) -> bytes:
     return struct.pack("<I", saddr)
 
@@ -242,6 +266,44 @@ def test_icmp6_truncated_drops(fsx):
     out of bounds (same bounds discipline as every other parser)."""
     pkt = ip6_pkt((1, 2, 3, 4), nexthdr=58, plen=58)  # 54 + 4 < 54 + 8
     assert fsx.run(pkt[:58]) == XDP_DROP
+
+
+def test_ipv6_ext_header_walk(fsx):
+    """A TCP SYN behind hop-by-hop + routing extension headers is
+    classified as TCP SYN on port 443 — the walk an attacker would
+    otherwise use to hide a SYN flood from L4 features (regression for
+    the ext-header cursor the static verifier proves bounds-safe)."""
+    words = (0x77777777, 1, 2, 3)
+    pkt = ip6_ext_pkt(words, ext_chain=((0, 0), (43, 1)))
+    assert fsx.run(pkt) == XDP_PASS
+    rec = fsx.records()
+    assert len(rec) == 1
+    assert rec["ip_proto"][0] == 6
+    assert rec["flags"][0] & schema.FLAG_TCP
+    assert rec["flags"][0] & schema.FLAG_TCP_SYN
+    assert rec["feat"][0][0] == 443
+
+
+def test_ipv6_truncated_ext_header_drops(fsx):
+    """An extension header whose bounds-checked 8-byte window hangs off
+    the end of the frame drops (the re-check after every variable
+    cursor advance — the exact load the static verifier guards)."""
+    pkt = ip6_ext_pkt((0x88888888, 1, 2, 3), ext_chain=((0, 0), (43, 1)))
+    # cut inside the SECOND ext header: eth14 + ip40 + hbh8 + 4
+    assert fsx.run(pkt[:66]) == XDP_DROP
+
+
+def test_ipv6_fragment_stops_walk(fsx):
+    """A fragment header is NOT walked (no L4 header in non-first
+    fragments): the packet passes with L3-only classification."""
+    pkt = ip6_ext_pkt((0x99999999, 1, 2, 3), ext_chain=((44, 0),),
+                      l4_proto=6)
+    assert fsx.run(pkt) == XDP_PASS
+    rec = fsx.records()
+    assert len(rec) == 1
+    assert rec["ip_proto"][0] == 44
+    assert not rec["flags"][0] & (schema.FLAG_TCP | schema.FLAG_UDP)
+    assert rec["feat"][0][0] == 0  # no dport harvested
 
 
 # ---- blacklist gate (verdict ingress seam) ---------------------------

@@ -44,6 +44,42 @@ def udp6(words, dport=443, plen=120):
     return p + b"X" * max(0, plen - len(p))
 
 
+def tcp6_ext(words, ext_chain=((0, 0), (43, 1)), dport=443, plen=160):
+    """v6 TCP SYN behind a chain of (proto, hdr_ext_len) ext headers."""
+    first = ext_chain[0][0] if ext_chain else 6
+    hdr = b"\x60\x00\x00\x00" + struct.pack(">H", plen - 54) + \
+        bytes([first, 64])
+    hdr += b"".join(struct.pack("<I", w) for w in words) + b"\xaa" * 16
+    body = b""
+    for i, (_, elen) in enumerate(ext_chain):
+        nxt = ext_chain[i + 1][0] if i + 1 < len(ext_chain) else 6
+        body += bytes([nxt, elen]) + b"\x00" * ((elen + 1) * 8 - 2)
+    body += struct.pack(">HH", 1234, dport) + b"\x00" * 9 + b"\x02" \
+        + b"\x00" * 6
+    p = eth(0x86DD) + hdr + body
+    return p + b"X" * max(0, plen - len(p))
+
+
+def test_parse_frame_ipv6_ext_walk():
+    """kern/parsing.h twin: the bounded ext-header walk reaches the TCP
+    SYN, a truncated ext header refuses, a fragment stops the walk."""
+    words = (5, 6, 7, 8)
+    f = pcap.parse_frame(tcp6_ext(words))
+    assert f is not None
+    saddr, dport, proto, flags, _ = f
+    assert proto == 6 and dport == 443
+    assert flags & schema.FLAG_TCP_SYN and flags & schema.FLAG_IPV6
+    assert saddr == 5 ^ 6 ^ 7 ^ 8
+    # truncated inside the second ext header -> refused like the kernel
+    assert pcap.parse_frame(tcp6_ext(words)[:66]) is None
+    # fragment (44) is not walked: L3-only facts
+    f = pcap.parse_frame(tcp6_ext(words, ext_chain=((44, 0),)))
+    assert f is not None
+    _, dport, proto, flags, _ = f
+    assert proto == 44 and dport == 0
+    assert not flags & (schema.FLAG_TCP | schema.FLAG_UDP)
+
+
 def write_pcap(path, frames, t0_s=1000, dt_us=100, nanos=False):
     """Classic pcap: little-endian, µs (or ns) timestamp format."""
     magic = 0xA1B23C4D if nanos else 0xA1B2C3D4

@@ -1,0 +1,611 @@
+"""In-repo static verifier + cross-layer contract checker tests.
+
+Two halves of ``fsx check`` (ISSUE 2):
+
+* the abstract-interpreter verifier accepts every shipped program and
+  rejects each table-driven violation — missing packet bounds check,
+  uninitialized stack read, map-value overflow, bad exit, pointer
+  leaks, ringbuf reference bugs — with an instruction-level diagnostic;
+* the contract checker catches every flavor of cross-layer drift
+  (stale generated header, baked progs.py offset vs schema, stale
+  sealed image) loudly, in pytest, with no kernel in the loop.
+
+None of this needs bpf(2): that is the point.
+"""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from flowsentryx_tpu.bpf import contracts, image, loader, progs, verifier
+from flowsentryx_tpu.bpf.asm import Asm, Program
+from flowsentryx_tpu.bpf.isa import (
+    BPF_ADD, BPF_AND, BPF_B, BPF_DIV, BPF_DW, BPF_JEQ, BPF_JGT, BPF_JNE,
+    BPF_LSH, BPF_W,
+    FN_map_lookup_elem, FN_ringbuf_reserve, FN_ringbuf_submit,
+    R0, R1, R2, R3, R4, R5, R6, R7, R10,
+    XDP_MD_DATA, XDP_MD_DATA_END,
+    alu64, alu64_imm, call, exit_, ldx, mov32, mov64, mov64_imm, st_imm,
+    stx,
+)
+
+# ---- acceptance: every shipped program verifies clean ----------------
+
+
+@pytest.mark.parametrize("compact", [False, True])
+def test_accepts_shipped_programs(compact):
+    prog = progs.build(compact=compact)
+    rep = verifier.check_program_cached(prog)
+    assert rep.n_insns == len(prog.insns)
+    assert rep.insns_visited > rep.n_insns  # real exploration, not a stub
+    assert rep.subprog_entries  # the isqrt bpf-to-bpf callee
+    assert set(rep.map_names) == set(prog.map_names)
+
+
+def test_accepts_checked_in_images():
+    """The sealed daemon hand-off images decode back to verifiable
+    programs under their own embedded map specs."""
+    for path in contracts.IMAGE_PATHS.values():
+        prog, maps = image.to_program(path.read_bytes(), name=path.name)
+        infos = {m.name: verifier.MapInfo(m.name, m.map_type, m.key_size,
+                                          m.value_size) for m in maps}
+        rep = verifier.check_program(prog, infos)
+        assert rep.n_insns == len(prog.insns)
+
+
+def test_image_roundtrip_is_lossless():
+    """to_program(emit(p)) reproduces p's instructions and relocations
+    exactly — the decode the CLI trusts for --image verification."""
+    prog = progs.build()
+    back, maps = image.to_program(image.emit(prog=prog))
+    assert back.insns == prog.insns
+    assert [(r.slot, r.map_name) for r in back.relocs] == \
+        [(r.slot, r.map_name) for r in prog.relocs]
+    assert {m.name for m in maps} == set(prog.map_names)
+
+
+def test_corrupt_image_raises_value_error():
+    """Truncated/corrupt blobs reject with ValueError (never a raw
+    struct.error), so fsx check --image reports instead of crashing."""
+    good = image.emit()
+    for blob in (b"", good[:10], good[:60], b"XXXXXXXX" + good[8:],
+                 good[:-4]):
+        with pytest.raises(ValueError):
+            image.to_program(blob)
+
+
+def test_bad_register_number_rejected():
+    """A 4-bit reg nibble of 11-15 (corrupt image, hand-built insn)
+    rejects with a diagnostic, not an IndexError."""
+    from flowsentryx_tpu.bpf.isa import BPF_ALU64, BPF_K, BPF_MOV, Insn
+
+    bad = [Insn(BPF_ALU64 | BPF_MOV | BPF_K, dst=13, imm=1)] + exit_()
+    with pytest.raises(verifier.StaticVerifierError,
+                       match="invalid register number"):
+        verifier.check_program(bad)
+
+
+def test_ldx_into_frame_pointer_rejected():
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 8)
+    a.jmp_reg(BPF_JGT, R4, R3, "out")
+    a += ldx(BPF_B, R10, R2, 0)  # overwrite the frame pointer
+    a.label("out")
+    _ret0(a)
+    with pytest.raises(verifier.StaticVerifierError,
+                       match="frame pointer"):
+        verifier.check_program(a.assemble())
+
+
+def test_cache_is_content_addressed():
+    prog = progs.build()
+    assert verifier.check_program_cached(prog) is \
+        verifier.check_program_cached(prog)
+
+
+# ---- negative table: each violation rejects with a diagnostic --------
+
+
+def _pkt_prologue(a: Asm) -> None:
+    """r2 = data, r3 = data_end (r1 = ctx on entry)."""
+    a += ldx(BPF_W, R2, R1, XDP_MD_DATA)
+    a += ldx(BPF_W, R3, R1, XDP_MD_DATA_END)
+
+
+def _ret0(a: Asm) -> None:
+    a += mov64_imm(R0, 0)
+    a += exit_()
+
+
+def missing_bounds_check() -> Program:
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += ldx(BPF_B, R0, R2, 12)  # no compare against data_end
+    _ret0(a)
+    return a.assemble()
+
+
+def bounds_check_too_small() -> Program:
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 14)
+    a.jmp_reg(BPF_JGT, R4, R3, "out")  # proves 14 bytes
+    a += ldx(BPF_B, R0, R2, 14)        # reads the 15th
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def stale_proof_after_variable_advance() -> Program:
+    """The IPv6 ext-header cursor bug the walk in progs.py must not
+    have: advance by a packet-derived amount, then reuse the OLD
+    bounds proof without re-checking."""
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 8)
+    a.jmp_reg(BPF_JGT, R4, R3, "out")  # proves 8 bytes
+    a += ldx(BPF_B, R5, R2, 1)         # in bounds
+    a += alu64_imm(BPF_AND, R5, 0xFF)
+    a += alu64_imm(BPF_LSH, R5, 3)     # bounded advance, [0, 2040]
+    a += alu64(BPF_ADD, R2, R5)        # cursor moves: proof invalid
+    a += ldx(BPF_B, R0, R2, 0)         # no re-check -> reject
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def unbounded_variable_advance() -> Program:
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 8)
+    a.jmp_reg(BPF_JGT, R4, R3, "out")
+    a += ldx(BPF_W, R5, R2, 0)
+    a += alu64_imm(BPF_LSH, R5, 4)     # umax 2^36: no sane bound
+    a += alu64(BPF_ADD, R2, R5)
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def uninitialized_stack_read() -> Program:
+    a = Asm("neg")
+    a += ldx(BPF_DW, R0, R10, -8)  # never written
+    a += exit_()
+    return a.assemble()
+
+
+def partially_initialized_stack_read() -> Program:
+    a = Asm("neg")
+    a += st_imm(BPF_W, R10, -8, 7)   # bytes [-8,-4) only
+    a += ldx(BPF_DW, R0, R10, -8)    # reads [-8,0)
+    a += exit_()
+    return a.assemble()
+
+
+def stack_out_of_frame() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R1, 1)
+    a += stx(BPF_DW, R10, -520, R1)
+    _ret0(a)
+    return a.assemble()
+
+
+def _lookup(a: Asm, map_name: str) -> None:
+    a += st_imm(BPF_W, R10, -4, 0)
+    a.ld_map(R1, map_name)
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, -4)
+    a += call(FN_map_lookup_elem)
+
+
+def map_value_overflow() -> Program:
+    a = Asm("neg")
+    _lookup(a, "config_map")
+    a.jmp_imm(BPF_JEQ, R0, 0, "out")
+    a += ldx(BPF_DW, R1, R0, progs.CFG_SIZE)  # one past the end
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def map_value_null_deref() -> Program:
+    a = Asm("neg")
+    _lookup(a, "config_map")
+    a += ldx(BPF_DW, R1, R0, 0)  # no == 0 check
+    _ret0(a)
+    return a.assemble()
+
+
+def uninit_key_lookup() -> Program:
+    a = Asm("neg")
+    a.ld_map(R1, "config_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, -4)  # key bytes never written
+    a += call(FN_map_lookup_elem)
+    _ret0(a)
+    return a.assemble()
+
+
+def fall_off_the_end() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R0, 0)  # no exit
+    return a.assemble()
+
+
+def r0_uninit_at_exit() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R1, 1)
+    a += exit_()
+    return a.assemble()
+
+
+def unreachable_insn() -> Program:
+    a = Asm("neg")
+    _ret0(a)
+    a += mov64_imm(R0, 1)  # dead
+    a += exit_()
+    return a.assemble()
+
+
+def jump_into_ld_imm64() -> list:
+    from flowsentryx_tpu.bpf.isa import ja, ld_imm64
+
+    return ja(1) + ld_imm64(R0, 7) + exit_()  # lands on the low slot
+
+
+def pointer_leak_to_map() -> Program:
+    a = Asm("neg")
+    _lookup(a, "config_map")
+    a.jmp_imm(BPF_JEQ, R0, 0, "out")
+    a += stx(BPF_DW, R0, 0, R10)  # frame pointer into a map value
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def write_to_ctx() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R2, 1)
+    a += stx(BPF_W, R1, 0, R2)
+    _ret0(a)
+    return a.assemble()
+
+
+def ringbuf_reference_leak() -> Program:
+    a = Asm("neg")
+    a.ld_map(R1, "feature_ring")
+    a += mov64_imm(R2, 16)
+    a += mov64_imm(R3, 0)
+    a += call(FN_ringbuf_reserve)
+    _ret0(a)  # record neither submitted nor discarded
+    return a.assemble()
+
+
+def _spill_submit_reload(a: Asm) -> None:
+    """reserve; spill the record pointer; submit; reload the spill into
+    r1.  Register aliases die at the submit and the spill is scrubbed
+    (release_reference semantics), so r1 comes back an unknown scalar —
+    any use of it as the record must reject."""
+    a.ld_map(R1, "feature_ring")
+    a += mov64_imm(R2, 16)
+    a += mov64_imm(R3, 0)
+    a += call(FN_ringbuf_reserve)
+    a.jmp_imm(BPF_JEQ, R0, 0, "out")
+    a += stx(BPF_DW, R10, -16, R0)  # spill the record pointer
+    a += mov64(R1, R0)
+    a += mov64_imm(R2, 0)
+    a += call(FN_ringbuf_submit)
+    a += ldx(BPF_DW, R1, R10, -16)  # stale pointer back
+
+
+def ringbuf_double_submit() -> Program:
+    a = Asm("neg")
+    _spill_submit_reload(a)
+    a += mov64_imm(R2, 0)
+    a += call(FN_ringbuf_submit)  # reference already released
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def ringbuf_use_after_release() -> Program:
+    """Store through the record pointer AFTER submit — the kernel
+    invalidates every copy (including spills) at release_reference and
+    rejects; the static pass must too."""
+    a = Asm("neg")
+    _spill_submit_reload(a)
+    a += mov64_imm(R2, 1)
+    a += stx(BPF_DW, R1, 0, R2)  # write through the released record
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def ringbuf_record_overflow() -> Program:
+    a = Asm("neg")
+    a.ld_map(R1, "feature_ring")
+    a += mov64_imm(R2, 16)
+    a += mov64_imm(R3, 0)
+    a += call(FN_ringbuf_reserve)
+    a.jmp_imm(BPF_JEQ, R0, 0, "out")
+    a += mov64(R6, R0)
+    a += mov64_imm(R1, 1)
+    a += stx(BPF_DW, R6, 16, R1)  # reserved 16, writes [16, 24)
+    a += mov64(R1, R6)
+    a += mov64_imm(R2, 0)
+    a += call(FN_ringbuf_submit)
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def atomic_stale_spill_bounds_abuse() -> Program:
+    """Atomic add into a stack slot must invalidate its tracked spill:
+    otherwise the slot reloads as the old constant 0 and a packet
+    pointer advanced by the (actually unknown) value keeps the stale
+    1-byte bounds proof."""
+    from flowsentryx_tpu.bpf.isa import FN_ktime_get_ns, atomic_add64
+
+    a = Asm("neg")
+    a += stx(BPF_DW, R10, -16, R1)  # park ctx across the helper call
+    a += mov64_imm(R1, 0)
+    a += stx(BPF_DW, R10, -8, R1)   # spill const 0
+    a += call(FN_ktime_get_ns)
+    a += atomic_add64(R10, -8, R0)  # slot += unknown scalar
+    a += ldx(BPF_DW, R1, R10, -16)  # ctx back
+    _pkt_prologue(a)
+    a += mov64(R4, R2)
+    a += alu64_imm(BPF_ADD, R4, 1)
+    a.jmp_reg(BPF_JGT, R4, R3, "out")  # proves 1 byte
+    a += ldx(BPF_DW, R5, R10, -8)   # must be unknown now, not const 0
+    a += alu64(BPF_ADD, R2, R5)     # variable advance: proof reset
+    a += ldx(BPF_B, R0, R2, 0)      # stale proof may not be reused
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+def division_by_zero() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R0, 7)
+    a += alu64_imm(BPF_DIV, R0, 0)
+    a += exit_()
+    return a.assemble()
+
+
+def unknown_helper() -> Program:
+    a = Asm("neg")
+    a += call(999)
+    _ret0(a)
+    return a.assemble()
+
+
+def read_uninit_register() -> Program:
+    a = Asm("neg")
+    a += mov64_imm(R0, 0)
+    a += alu64(BPF_ADD, R0, R7)  # r7 never initialized
+    a += exit_()
+    return a.assemble()
+
+
+def truncate_pointer_32bit() -> Program:
+    a = Asm("neg")
+    _pkt_prologue(a)
+    a += mov32(R0, R2)  # 32-bit move of a packet pointer
+    a += exit_()
+    return a.assemble()
+
+
+def branch_on_uninit() -> Program:
+    a = Asm("neg")
+    a.jmp_imm(BPF_JNE, R6, 0, "out")
+    a.label("out")
+    _ret0(a)
+    return a.assemble()
+
+
+NEGATIVE_CASES = [
+    ("missing_bounds_check", missing_bounds_check,
+     r"invalid packet access.*data_end"),
+    ("bounds_check_too_small", bounds_check_too_small,
+     r"invalid packet access.*proven range=14"),
+    ("stale_proof_after_variable_advance",
+     stale_proof_after_variable_advance,
+     r"invalid packet access.*proven range=none"),
+    ("unbounded_variable_advance", unbounded_variable_advance,
+     r"variable packet advance unbounded"),
+    ("uninitialized_stack_read", uninitialized_stack_read,
+     r"uninitialized stack byte fp-8"),
+    ("partially_initialized_stack_read", partially_initialized_stack_read,
+     r"uninitialized stack byte fp-4"),
+    ("stack_out_of_frame", stack_out_of_frame,
+     r"stack access out of frame"),
+    ("map_value_overflow", map_value_overflow,
+     r"map value access out of bounds.*config_map.*value_size=88"),
+    ("map_value_null_deref", map_value_null_deref,
+     r"possible NULL map-value dereference"),
+    ("uninit_key_lookup", uninit_key_lookup,
+     r"map_lookup_elem arg2.*uninitialized stack byte"),
+    ("fall_off_the_end", fall_off_the_end,
+     r"falls off the end"),
+    ("r0_uninit_at_exit", r0_uninit_at_exit,
+     r"R0 not initialized at exit"),
+    ("unreachable_insn", unreachable_insn,
+     r"unreachable instruction"),
+    ("jump_into_ld_imm64", jump_into_ld_imm64,
+     r"into a ld_imm64"),
+    ("pointer_leak_to_map", pointer_leak_to_map,
+     r"pointer leak"),
+    ("write_to_ctx", write_to_ctx,
+     r"write to ctx"),
+    ("ringbuf_reference_leak", ringbuf_reference_leak,
+     r"reference leak.*ringbuf"),
+    ("ringbuf_double_submit", ringbuf_double_submit,
+     r"expected the reserved ringbuf record pointer"),
+    ("ringbuf_use_after_release", ringbuf_use_after_release,
+     r"invalid write"),
+    ("ringbuf_record_overflow", ringbuf_record_overflow,
+     r"ringbuf record access out of bounds"),
+    ("atomic_stale_spill_bounds_abuse", atomic_stale_spill_bounds_abuse,
+     r"invalid packet access|variable packet advance unbounded"),
+    ("division_by_zero", division_by_zero,
+     r"division by zero"),
+    ("unknown_helper", unknown_helper,
+     r"unknown/unsupported helper id 999"),
+    ("read_uninit_register", read_uninit_register,
+     r"read of uninitialized r"),
+    ("truncate_pointer_32bit", truncate_pointer_32bit,
+     r"truncates a pointer"),
+    ("branch_on_uninit", branch_on_uninit,
+     r"branch on uninitialized r6"),
+]
+
+
+@pytest.mark.parametrize("name,build,pattern",
+                         NEGATIVE_CASES, ids=[c[0] for c in NEGATIVE_CASES])
+def test_negative_cases_reject_with_diagnostics(name, build, pattern):
+    with pytest.raises(verifier.StaticVerifierError) as ei:
+        verifier.check_program(build())
+    e = ei.value
+    assert re.search(pattern, str(e)), f"{name}: {e}"
+    # instruction-level diagnostics: index + disassembly of the slot
+    assert 0 <= e.insn_idx
+    assert e.insn_txt
+
+
+def test_complexity_budget_enforced():
+    with pytest.raises(verifier.StaticVerifierError, match="budget"):
+        verifier.check_program(progs.build(), budget=500)
+
+
+def test_empty_program_rejected():
+    with pytest.raises(verifier.StaticVerifierError, match="empty"):
+        verifier.check_program([])
+
+
+def test_unknown_map_rejected():
+    a = Asm("neg")
+    a.ld_map(R1, "no_such_map")
+    _ret0(a)
+    with pytest.raises(verifier.StaticVerifierError, match="unknown maps"):
+        verifier.check_program(a.assemble())
+
+
+# ---- seal/load hooks -------------------------------------------------
+
+
+def test_loader_refuses_bad_program_before_any_syscall():
+    """prog_load runs the static verifier FIRST: a mis-assembled
+    program dies with a precise diagnostic even where bpf(2) itself is
+    unavailable (this container)."""
+    with pytest.raises(verifier.StaticVerifierError):
+        loader.prog_load(missing_bounds_check())
+
+
+def test_image_emit_refuses_bad_program():
+    with pytest.raises(verifier.StaticVerifierError):
+        image.emit(prog=map_value_null_deref())
+
+
+def test_skip_env_var(monkeypatch):
+    monkeypatch.setenv("FSX_SKIP_STATIC_VERIFY", "1")
+    blob = image.emit(prog=ringbuf_reference_leak())
+    assert blob  # sealed unchecked, explicitly
+
+
+# ---- cross-layer contracts -------------------------------------------
+
+
+def test_contracts_clean_tree():
+    rep = contracts.run_all()
+    assert rep.ok, rep.failures
+
+
+def test_header_drift_detected(tmp_path):
+    """A hand edit (or un-regenerated schema change) in fsx_schema.h
+    fails both the freshness and the layout diff."""
+    bad = tmp_path / "fsx_schema.h"
+    text = contracts.HEADER_PATH.read_text()
+    assert "\t__u64 block_ns;" in text
+    bad.write_text(text.replace("\t__u64 block_ns;", "\t__u32 block_ns;"))
+    assert contracts.check_header_fresh(bad)
+    fails = contracts.check_header_layouts(bad)
+    assert any("fsx_config" in f for f in fails)
+
+
+def test_header_define_drift_detected(tmp_path):
+    bad = tmp_path / "fsx_schema.h"
+    text = contracts.HEADER_PATH.read_text()
+    bad.write_text(text.replace("#define FSX_FLAG_TCP 4",
+                                "#define FSX_FLAG_TCP 2"))
+    fails = contracts.check_header_defines(bad)
+    assert any("FSX_FLAG_TCP" in f for f in fails)
+
+
+def test_progs_offset_drift_detected(monkeypatch):
+    """A struct edit that forgot the assembler: progs constant vs the
+    schema layout."""
+    monkeypatch.setattr(progs, "CFG_BLOCK_NS", progs.CFG_BLOCK_NS + 8)
+    fails = contracts.check_progs_offsets()
+    assert any("CFG_BLOCK_NS" in f and "offsetof(fsx_config, block_ns)"
+               in f for f in fails)
+
+
+def test_map_spec_drift_detected(monkeypatch):
+    specs = dict(progs.MAP_SPECS)
+    mtype, ks, vs, ent = specs["ip_state_map"]
+    specs["ip_state_map"] = (mtype, ks, vs - 8, ent)
+    monkeypatch.setattr(progs, "MAP_SPECS", specs)
+    fails = contracts.check_map_specs()
+    assert any("ip_state_map" in f for f in fails)
+
+
+def test_stale_image_detected(tmp_path):
+    stale = tmp_path / "fsx_prog.img"
+    stale.write_bytes(image.emit(sizes=progs.MapSizes(max_track_ips=64)))
+    fails = contracts.check_images({False: stale})
+    assert fails and "stale" in fails[0]
+
+
+def test_missing_image_detected(tmp_path):
+    fails = contracts.check_images({True: tmp_path / "nope.img"})
+    assert fails and "missing" in fails[0]
+
+
+def test_cli_check_reports_corrupt_image(tmp_path, capsys):
+    """fsx check --image on garbage exits 1 with a report entry, not a
+    traceback."""
+    import json
+
+    from flowsentryx_tpu import cli
+
+    bad = tmp_path / "corrupt.img"
+    bad.write_bytes(b"\x00" * 10)
+    rc = cli.main(["check", "--json", "--no-images",
+                   "--image", str(bad)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["ok"]
+    entry = next(p for p in out["programs"] if p["program"] == str(bad))
+    assert not entry["ok"] and "truncated" in entry["error"]
+
+
+def test_cli_check_passes_on_clean_tree(capsys):
+    """`fsx check` — the operator surface — exits 0 and reports every
+    program + contract on the current tree."""
+    import json
+
+    from flowsentryx_tpu import cli
+
+    rc = cli.main(["check", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    assert {p["program"] for p in out["programs"]} == \
+        {"fsx[raw48]", "fsx[compact16]"}
+    assert all(c["ok"] for c in out["contracts"]["checks"].values())
