@@ -631,7 +631,10 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     side.emit("sync_floor", sync_floor_ms=round(sync_floor_ms, 1))
     log(f"sync floor: {sync_floor_ms:.1f} ms")
 
-    # verdict D2H for the decomposition batch (includes the floor once)
+    # verdict D2H for the decomposition batch (includes the floor once):
+    # the steady-state readback is the COMPACT verdict wire — one
+    # [2K+4]-word buffer per batch; the full-array fetch is also timed
+    # as the overflow-fallback cost.
     cfg = FsxConfig(table=TableConfig(capacity=TABLE_CAP),
                     batch=BatchConfig(max_batch=decomp_b))
     step = fused.make_jitted_compact_step(
@@ -641,16 +644,23 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
     stats = jax.device_put(schema.make_stats())
     feed = jax.device_put(wire)
     table, stats, out = step(table, stats, params, feed)
-    np.asarray(out.block_key)
-    d2h = []
+    np.asarray(out.wire)
+    d2h, d2h_full = [], []
     for _ in range(reps):
         table, stats, out = step(table, stats, params, feed)
-        jax.block_until_ready(out.block_key)
+        jax.block_until_ready(out.wire)
+        t0 = time.perf_counter()
+        np.asarray(out.wire)
+        d2h.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         np.asarray(out.block_key)
         np.asarray(out.block_until)
-        d2h.append(time.perf_counter() - t0)
+        d2h_full.append(time.perf_counter() - t0)
     result["micro"]["d2h_ms"] = round(float(np.median(d2h)) * 1e3, 4)
+    result["micro"]["d2h_wire_bytes"] = int(
+        fused.verdict_wire_words(cfg.batch.verdict_k) * 4)
+    result["micro"]["d2h_fallback_ms"] = round(
+        float(np.median(d2h_full)) * 1e3, 4)
     side.emit("micro", **result["micro"])
 
     # -- 4. paced per-record latency through the real engine ---------------
@@ -693,7 +703,7 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
             eng.stats = jax.device_put(schema.make_stats())
         from flowsentryx_tpu.benchmarks import paced_latency_run
 
-        lats, wall = paced_latency_run(eng, src, readback_depth=depth)
+        lats, wall, erep = paced_latency_run(eng, src, readback_depth=depth)
         if not len(lats):
             return None
         a = lats * 1e3
@@ -707,6 +717,10 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
             # source: a run stopped by the wall cap can leave a batcher
             # residue that was offered but never classified.
             "offered_all_consumed": bool(len(lats) >= total),
+            # verdict-readback accounting: D2H bytes per sunk batch,
+            # compact vs K_MAX-overflow-fallback sink counts, and the
+            # sink thread's busy fraction of the run wall
+            "readback": erep.readback,
         }
         if auto:
             rec["auto_load"] = True
@@ -1222,7 +1236,9 @@ def main() -> int:
             if micro and comp_ms is not None:
                 bl = _load_link_baseline()
                 healthy = bl.get("h2d_mbps_best") or HEALTHY_H2D_MBPS
-                d2h_bytes = micro["batch"] * 8  # block_key u32 + until f32
+                # steady-state readback = the compact verdict wire (the
+                # 8 B/record full fetch is the overflow fallback only)
+                d2h_bytes = micro.get("d2h_wire_bytes", micro["batch"] * 8)
                 h2d_healthy = micro["wire_bytes"] / (healthy * 1e6) * 1e3
                 d2h_healthy = d2h_bytes / (healthy * 1e6) * 1e3
                 floor = lat.get("sync_floor_ms") or 0.0

@@ -212,7 +212,9 @@ def paced_latency_run(eng, src, readback_depth=None, max_seconds=6.0):
     (``bench.py`` phase_latency and ``scripts/paced_profile.py`` both
     call it): rebind the stream, attach the reap hook that pairs each
     sunk record with its scheduled arrival, run, return
-    ``(lats_s ndarray, wall_s)``.  The caller compiles the engine
+    ``(lats_s ndarray, wall_s, EngineReport)`` — the report carries the
+    run's ``readback`` block (D2H bytes/batch, compact vs fallback sink
+    counts, sink-thread occupancy).  The caller compiles the engine
     outside the paced clock (the open-loop clock starts at the first
     poll, so XLA compile inside the run would read as queueing)."""
     eng.reset_stream(src, readback_depth=readback_depth)
@@ -220,9 +222,9 @@ def paced_latency_run(eng, src, readback_depth=None, max_seconds=6.0):
     eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
         t - s.pop_scheduled(n))
     t0 = time.perf_counter()
-    eng.run(max_seconds=max_seconds)
+    rep = eng.run(max_seconds=max_seconds)
     wall = time.perf_counter() - t0
-    return np.asarray(lats), wall
+    return np.asarray(lats), wall, rep
 
 
 def run_scaling(

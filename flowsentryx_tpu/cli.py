@@ -387,11 +387,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "(the sharded drain fronts the daemon's shm rings)",
               file=sys.stderr)
         return 1
+    if args.verdict_k is not None and args.verdict_k < 0:
+        print("fsx serve: --verdict-k must be >= 0 (0 disables the "
+              "compact verdict wire)", file=sys.stderr)
+        return 1
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
     _honor_jax_platform()
     cfg = _load_cfg(args)
+    if args.verdict_k is not None:
+        import dataclasses as _dck
+
+        cfg = _dck.replace(cfg, batch=_dck.replace(
+            cfg.batch, verdict_k=args.verdict_k))
     if args.feature_ring:
         from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
 
@@ -485,7 +494,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 "--mega", file=sys.stderr)
             return 1
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
-                 mega_n=args.mega or 0)
+                 mega_n=args.mega or 0,
+                 sink_thread=False if args.no_sink_thread else None)
     if args.restore:
         eng.restore(args.restore)
     if args.mega:
@@ -1115,6 +1125,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--profile",
                    help="write a jax.profiler trace to this directory")
     s.add_argument("--restore", help="resume from a checkpoint file")
+    s.add_argument("--verdict-k", type=int, default=None,
+                   help="compact verdict-wire slots per batch (overrides "
+                        "config batch.verdict_k; default 64): the step "
+                        "compacts newly-blocked flows into a K-slot D2H "
+                        "buffer, falling back to the full [B] fetch only "
+                        "on overflow; 0 = disable compaction (full fetch "
+                        "every batch)")
+    s.add_argument("--no-sink-thread", action="store_true",
+                   help="run the verdict sink on the dispatch thread "
+                        "(the pre-threaded single-loop engine). Default "
+                        "auto: a dedicated sink thread — so fetch/"
+                        "writeback/metrics never block dispatch — on "
+                        "hosts with >=3 cores, single-thread below that "
+                        "(the extra thread would only contend)")
     s.set_defaults(fn=_cmd_serve)
 
     tp = sub.add_parser("top", help="per-IP kernel table, formatted")

@@ -207,10 +207,23 @@ class BatchConfig:
 
     max_batch: int = 2048
     deadline_us: int = 200
+    #: Slots in the compact device→host verdict wire (ops/fused.py
+    #: ``pack_verdict_wire``): the step compacts newly-blocked
+    #: ``(key, until)`` pairs into a fixed ``[verdict_k]`` buffer plus a
+    #: count, so the steady-state readback is O(verdict_k) bytes instead
+    #: of 8 B/record.  A batch blocking more than ``verdict_k`` flows
+    #: sets the wire's overflow flag and the engine falls back to the
+    #: full-array fetch for that batch — a block is never lost, it just
+    #: costs the old readback once.  0 disables compaction entirely
+    #: (every batch fetches the full ``[B]`` arrays — the pre-compaction
+    #: wire, kept for parity tests and measurement baselines).
+    verdict_k: int = 64
 
     def __post_init__(self) -> None:
         if self.max_batch <= 0 or self.deadline_us <= 0:
             raise ValueError("max_batch and deadline_us must be positive")
+        if self.verdict_k < 0:
+            raise ValueError("verdict_k must be >= 0 (0 disables compaction)")
 
 
 @dataclass(frozen=True)

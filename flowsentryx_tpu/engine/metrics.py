@@ -14,31 +14,46 @@ import numpy as np
 
 
 class StageTimer:
-    """Rolling record of one stage's per-batch durations (seconds)."""
+    """Rolling record of one stage's per-batch durations (seconds).
+
+    A RING of the most recent ``keep`` samples: once full, new samples
+    overwrite the oldest, so a week-long serve reports percentiles of
+    its recent window — not of its first 100k batches (the old
+    stop-at-keep behavior silently froze the distribution early in long
+    runs).  ``percentiles_ms()["n"]`` stays the TOTAL sample count ever
+    recorded; ``max`` likewise tracks the all-time maximum (a one-off
+    stall must not age out of the report)."""
 
     def __init__(self, name: str, keep: int = 100_000):
         self.name = name
         self.keep = keep
-        self._samples: list[float] = []
+        self._samples: list[float] = []  # grows to keep, then ring-writes
+        self._n = 0                       # total ever recorded
+        self._max = 0.0
 
     def add(self, seconds: float) -> None:
         if len(self._samples) < self.keep:
             self._samples.append(seconds)
+        else:
+            self._samples[self._n % self.keep] = seconds
+        self._n += 1
+        if seconds > self._max:
+            self._max = seconds
 
     def time(self):
         """Context manager: ``with timer.time(): ...``"""
         return _Timing(self)
 
     def percentiles_ms(self) -> dict[str, float]:
-        if not self._samples:
+        if not self._n:
             return {}
         a = np.asarray(self._samples) * 1e3
         return {
             "p50": round(float(np.percentile(a, 50)), 4),
             "p99": round(float(np.percentile(a, 99)), 4),
-            "max": round(float(a.max()), 4),
+            "max": round(self._max * 1e3, 4),
             "mean": round(float(a.mean()), 4),
-            "n": len(a),
+            "n": self._n,
         }
 
 

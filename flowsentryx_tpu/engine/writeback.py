@@ -38,6 +38,40 @@ def extract_updates(block_key: np.ndarray, block_until: np.ndarray) -> Blacklist
     )
 
 
+class VerdictWire(NamedTuple):
+    """Host-side view of one decoded compact verdict wire
+    (:func:`flowsentryx_tpu.ops.fused.pack_verdict_wire`)."""
+
+    key: np.ndarray      # [count] uint32 newly-blocked keys (in order)
+    until_s: np.ndarray  # [count] f32 matching expiries
+    count: int           # TRUE newly-blocked count (may exceed len(key))
+    overflow: bool       # count > k_max: fall back to the full fetch
+    route_drop: int      # sharded routing fail-opens (0 single-device)
+    now: float           # batch device clock (t0-relative seconds)
+
+
+def decode_verdict_wire(wire: np.ndarray) -> VerdictWire:
+    """Decode a fetched ``[2K+4]`` uint32 verdict wire (numpy only —
+    the layout is self-describing, K = (len - 4) / 2).
+
+    When ``overflow`` is set the key/until slots are INCOMPLETE (the
+    device parked the tail): the caller must fetch the full
+    ``block_key``/``block_until`` arrays for that batch instead, so a
+    block is never lost."""
+    wire = np.asarray(wire)
+    k = (wire.shape[0] - 4) // 2
+    count = int(wire[2 * k])
+    n = min(count, k)
+    return VerdictWire(
+        key=wire[:n],
+        until_s=wire[k:k + n].view(np.float32),
+        count=count,
+        overflow=bool(wire[2 * k + 1]),
+        route_drop=int(wire[2 * k + 2]),
+        now=float(wire[2 * k + 3:2 * k + 4].view(np.float32)[0]),
+    )
+
+
 class VerdictSink(Protocol):
     def apply(self, update: BlacklistUpdate) -> None: ...
 
@@ -56,5 +90,9 @@ class CollectSink:
 
     def apply(self, update: BlacklistUpdate) -> None:
         self.updates += 1
-        for k, u in zip(update.key.tolist(), update.until_s.tolist()):
-            self.blocked[k] = u
+        # dict.update over zip is the vectorized last-wins write: zip
+        # yields pairs in array order, and dict assignment keeps the
+        # LAST value per key — the same semantics the per-key loop had
+        # and the kernel map's overwrite-on-update gives.
+        self.blocked.update(zip(update.key.tolist(),
+                                update.until_s.tolist()))

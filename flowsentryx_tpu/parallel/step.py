@@ -70,6 +70,7 @@ def make_sharded_step(
     classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
     mesh: Mesh,
     donate: bool | None = None,
+    emit_score: bool = False,
 ):
     """Build the jitted multi-device step.
 
@@ -217,24 +218,57 @@ def make_sharded_step(
         )
         new_stats = fused.update_stats_from_counts(stats, counts[:4])
 
+        blk_key = jnp.where(dec.newly_blocked, m_key,
+                            agg.INVALID_KEY)                      # owner-side
+        blk_until = jnp.where(dec.newly_blocked,
+                              dec.new_blocked_until, 0.0)
+        # Compact verdict wire, the sharded way: each owner shard
+        # compacts ITS newly-blocked flows (a flow blocks only on its
+        # owner, so shards never duplicate keys), one all_gather moves
+        # the K-slot buffers — O(n·K) over ICI, tiny next to the two
+        # batch all_to_alls — and a second compaction folds them into
+        # ONE replicated wire.  route_drop and the batch clock ride the
+        # same buffer, so the host's steady-state readback is a single
+        # O(K) fetch with no extra scalar round trips.  Overflow
+        # (total > K) is exact from the psum'd true counts: a shard
+        # losing entries locally implies total > K.
+        k_max = cfg.batch.verdict_k
+        if k_max:
+            lk, lu, lcount = fused.compact_blocklist(blk_key, blk_until,
+                                                     k_max)
+            gk = jax.lax.all_gather(lk, axis)              # [n_dev, K]
+            gu = jax.lax.all_gather(lu, axis)
+            total = jax.lax.psum(lcount, axis)
+            ck, cu, _ = fused.compact_blocklist(
+                gk.reshape(-1), gu.reshape(-1), k_max)
+            bits2 = jax.lax.bitcast_convert_type
+            wire = jnp.concatenate([
+                ck, bits2(cu, jnp.uint32),
+                jnp.stack([total, (total > k_max).astype(jnp.uint32),
+                           counts[4],
+                           bits2(now, jnp.uint32)]),
+            ])
+        else:
+            wire = None
+
         out = fused.StepOutput(
-            verdict=verdict_l,                                    # P(axis)→[B]
-            score=score_l,                                        # P(axis)→[B]
-            block_key=jnp.where(dec.newly_blocked, m_key,
-                                agg.INVALID_KEY),                 # owner-side
-            block_until=jnp.where(dec.newly_blocked,
-                                  dec.new_blocked_until, 0.0),
+            verdict=verdict_l.astype(jnp.uint8),                  # P(axis)→[B]
+            score=score_l if emit_score else None,                # P(axis)→[B]
+            block_key=blk_key,
+            block_until=blk_until,
             now=now,
             route_drop=counts[4],
+            wire=wire,
         )
         return new_shard, new_stats, out
 
     table_specs = IpTableState(*([P(axis)] * len(IpTableState._fields)))
     stats_specs = GlobalStats(*([P()] * len(GlobalStats._fields)))
     out_specs = fused.StepOutput(
-        verdict=P(axis), score=P(axis),
+        verdict=P(axis), score=P(axis) if emit_score else None,
         block_key=P(axis), block_until=P(axis),
         now=P(), route_drop=P(),
+        wire=P() if cfg.batch.verdict_k else None,
     )
 
     sharded = mesh_lib.shard_map(
@@ -247,14 +281,16 @@ def make_sharded_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
-def _make_sharded_wire_step(cfg, classify_batch, mesh, donate, decode):
+def _make_sharded_wire_step(cfg, classify_batch, mesh, donate, decode,
+                            emit_score=False):
     """Shared wrapper: replicated wire buffer → on-device ``decode`` →
     the shard-mapped step.  The wire enters as ONE contiguous H2D
     transfer (tiny next to the sharded state); all field extraction
     fuses into the jit."""
     if donate is None:
         donate = fused.donation_supported()
-    base = make_sharded_step(cfg, classify_batch, mesh, donate=False)
+    base = make_sharded_step(cfg, classify_batch, mesh, donate=False,
+                             emit_score=emit_score)
 
     def step(table, stats, params, raw):
         return base(table, stats, params, decode(raw))
@@ -267,6 +303,7 @@ def make_sharded_raw_step(
     classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
     mesh: Mesh,
     donate: bool | None = None,
+    emit_score: bool = False,
 ):
     """Sharded step over the RAW ring wire format — the multi-device
     twin of :func:`~flowsentryx_tpu.ops.fused.make_jitted_raw_step`,
@@ -276,7 +313,8 @@ def make_sharded_raw_step(
     from flowsentryx_tpu.core import schema
 
     return _make_sharded_wire_step(cfg, classify_batch, mesh, donate,
-                                   schema.decode_raw)
+                                   schema.decode_raw,
+                                   emit_score=emit_score)
 
 
 def make_sharded_compact_step(
@@ -284,6 +322,7 @@ def make_sharded_compact_step(
     classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
     mesh: Mesh,
     donate: bool | None = None,
+    emit_score: bool = False,
     **quant,
 ):
     """Sharded step over the COMPACT 16 B wire format — the multi-device
@@ -299,6 +338,7 @@ def make_sharded_compact_step(
     return _make_sharded_wire_step(
         cfg, classify_batch, mesh, donate,
         functools.partial(schema.decode_compact, **quant),
+        emit_score=emit_score,
     )
 
 

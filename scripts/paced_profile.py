@@ -2,12 +2,21 @@
 
 Drives the real Engine with PacedSource at a grid of offered loads and
 prints ONE JSON line per config with achieved rate and per-record
-arrival→verdict-sunk latency percentiles, plus a final summary line.
+arrival→verdict-sunk latency percentiles, a ``readback`` block (D2H
+bytes per sunk batch, compact vs fallback sink counts, sink-thread
+occupancy), plus a final summary line.
+
+``--baseline`` serves through the PRE-compaction engine configuration —
+single-thread sink, full [B] verdict fetch (verdict_k=0) — so the same
+build measures both sides of the threaded-sink/compact-wire change.
+``--loads`` extends/overrides the B=2048 load column (Mpps, comma
+separated) to find where achieved≈offered stops holding.
 
 The engine compiles OUTSIDE the paced clock (reset_stream reuse).
 Run on CPU (FSX_FORCE_CPU=1) or the live backend.
 
-Usage: [FSX_FORCE_CPU=1] python scripts/paced_profile.py [out.json]
+Usage: [FSX_FORCE_CPU=1] python scripts/paced_profile.py
+           [--baseline] [--loads=0.8,1.0,1.5] [out.json]
 """
 from __future__ import annotations
 
@@ -39,11 +48,29 @@ def main() -> int:
 
     from flowsentryx_tpu.core import schema
     from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+
     from flowsentryx_tpu.engine import Engine, NullSink, PacedSource
+
+    argv = [a for a in sys.argv[1:]]
+    baseline = "--baseline" in argv
+    if baseline:
+        argv.remove("--baseline")
+    loads_override = None
+    for a in list(argv):
+        if a.startswith("--loads="):
+            loads_override = [float(x) for x in a.split("=", 1)[1].split(",")]
+            argv.remove(a)
+
+    grid = list(GRID)
+    if loads_override:
+        # replace the B=2048 rows with the requested load column
+        grid = [g for g in grid if g[0] != 2048]
+        grid += [(2048, 4, ld, 2000) for ld in loads_override]
 
     dev = jax.devices()[0]
     out = {"ts": time.time(), "backend": dev.platform,
-           "device_kind": dev.device_kind, "rows": []}
+           "device_kind": dev.device_kind, "baseline": baseline,
+           "rows": []}
 
     rng = np.random.default_rng(0)
     pool = np.zeros(1 << 14, dtype=schema.FLOW_RECORD_DTYPE)
@@ -52,9 +79,11 @@ def main() -> int:
     pool["feat"] = rng.integers(0, 1 << 20, (len(pool), 8))
 
     engines: dict = {}
-    for bsz, depth, load, dl in GRID:
-        cfg = FsxConfig(table=TableConfig(capacity=1 << 16),
-                        batch=BatchConfig(max_batch=bsz, deadline_us=dl))
+    for bsz, depth, load, dl in grid:
+        batch_cfg = (BatchConfig(max_batch=bsz, deadline_us=dl, verdict_k=0)
+                     if baseline
+                     else BatchConfig(max_batch=bsz, deadline_us=dl))
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 16), batch=batch_cfg)
         rate = load * 1e6
         total = int(max(rate * 3, 1))
         src = PacedSource(pool, rate_pps=rate, total=total)
@@ -62,7 +91,8 @@ def main() -> int:
         eng = engines.get(key)
         if eng is None:
             eng = Engine(cfg, src, NullSink(), donate=None,
-                         readback_depth=depth, wire=schema.WIRE_COMPACT16)
+                         readback_depth=depth, wire=schema.WIRE_COMPACT16,
+                         sink_thread=False if baseline else None)
             quant = schema.wire_quant_for(eng.params)
             warm = schema.encode_compact(pool[:bsz], bsz, t0_ns=0, **quant)
             eng.table, eng.stats, o = eng.step(
@@ -71,7 +101,7 @@ def main() -> int:
             engines[key] = eng
         from flowsentryx_tpu.benchmarks import paced_latency_run
 
-        lats, wall = paced_latency_run(eng, src, readback_depth=depth)
+        lats, wall, erep = paced_latency_run(eng, src, readback_depth=depth)
         a = lats * 1e3
         row = {
             "batch": bsz, "depth": depth, "load_mpps": load,
@@ -81,15 +111,17 @@ def main() -> int:
             "p90_ms": round(float(np.percentile(a, 90)), 2),
             "p99_ms": round(float(np.percentile(a, 99)), 2),
             "offered_all_consumed": bool(len(lats) >= total),
+            "readback": erep.readback,
         }
         out["rows"].append(row)
         print(json.dumps(row), flush=True)
 
     print(json.dumps({"summary": True, **{k: out[k] for k in
-                                          ("backend", "device_kind")},
+                                          ("backend", "device_kind",
+                                           "baseline")},
                       "n_rows": len(out["rows"])}))
-    if len(sys.argv) > 1:
-        with open(sys.argv[1], "w") as f:
+    if argv:
+        with open(argv[0], "w") as f:
             json.dump(out, f, indent=2)
             f.write("\n")
     return 0

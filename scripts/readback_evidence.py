@@ -1,0 +1,88 @@
+"""Measure the PR 3 acceptance evidence: steady-state D2H bytes per
+sunk batch at B=2048, compact verdict wire vs the full-array fetch.
+
+Runs the SAME pregenerated flood stream through three engines —
+full-fetch single-thread (the PR 2 readback), compact wire, and compact
+wire with an overflow-forcing tiny K — and prints one JSON object with
+each run's ``readback`` block plus the reduction ratio and a parity
+check (identical blocked sets + verdict stats across all three).
+
+Usage: JAX_PLATFORMS=cpu python scripts/readback_evidence.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import jax
+
+    from flowsentryx_tpu.core.config import (
+        BatchConfig, FsxConfig, LimiterConfig, TableConfig,
+    )
+    from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficGen, TrafficSpec
+
+    B = 2048
+    recs = TrafficGen(
+        TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                    n_attack_ips=64, attack_fraction=0.8, seed=31)
+    ).next_records(B * 48)
+
+    def run(verdict_k: int, sink_thread: bool) -> tuple[dict, dict, dict]:
+        cfg = FsxConfig(
+            limiter=LimiterConfig(pps_threshold=500.0, bps_threshold=1e9),
+            table=TableConfig(capacity=1 << 16),
+            batch=BatchConfig(max_batch=B, verdict_k=verdict_k),
+        )
+        sink = CollectSink()
+        eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                     readback_depth=4, sink_thread=sink_thread)
+        t0 = time.perf_counter()
+        rep = eng.run()
+        wall = time.perf_counter() - t0
+        return ({**rep.readback, "wall_s": round(wall, 2),
+                 "batches": rep.batches,
+                 "blocked_sources": rep.blocked_sources},
+                rep.stats, dict(sink.blocked))
+
+    full, st_full, bl_full = run(verdict_k=0, sink_thread=False)
+    comp, st_comp, bl_comp = run(verdict_k=64, sink_thread=True)
+    ovf, st_ovf, bl_ovf = run(verdict_k=4, sink_thread=True)
+
+    out = {
+        "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "backend": jax.devices()[0].platform,
+        "batch": B,
+        "records": len(recs),
+        "full_fetch": full,
+        "compact_k64": comp,
+        "compact_k4_overflow": ovf,
+        "d2h_reduction_x": round(
+            full["bytes_per_batch"] / comp["bytes_per_batch"], 1),
+        "parity": {
+            "blocked_sets_identical": bl_full == bl_comp == bl_ovf,
+            "stats_identical": st_full == st_comp == st_ovf,
+            "blocked_sources": len(bl_full),
+        },
+    }
+    print(json.dumps(out, indent=2))
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    assert out["parity"]["blocked_sets_identical"]
+    assert out["parity"]["stats_identical"]
+    assert out["d2h_reduction_x"] >= 8.0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,12 +24,12 @@ from flowsentryx_tpu.engine.writeback import extract_updates
 from flowsentryx_tpu.ops.agg import INVALID_KEY
 
 
-def small_cfg(batch=256, cap=1 << 12, **lim) -> FsxConfig:
+def small_cfg(batch=256, cap=1 << 12, verdict_k=64, **lim) -> FsxConfig:
     from flowsentryx_tpu.core.config import LimiterConfig
 
     return FsxConfig(
         table=TableConfig(capacity=cap),
-        batch=BatchConfig(max_batch=batch),
+        batch=BatchConfig(max_batch=batch, verdict_k=verdict_k),
         limiter=LimiterConfig(**lim) if lim else LimiterConfig(),
     )
 
@@ -288,6 +288,101 @@ class TestWriteback:
         upd = extract_updates(bk, bu)
         assert upd.key.tolist() == [5, 9]
         assert upd.until_s.tolist() == [1.5, 2.5]
+
+    def test_collect_sink_last_wins_semantics(self):
+        """The vectorized dict update must keep the per-key-loop
+        semantics: LAST expiry wins for a key repeated within one
+        update, and later updates overwrite earlier ones."""
+        from flowsentryx_tpu.engine.writeback import BlacklistUpdate
+
+        sink = CollectSink()
+        sink.apply(BlacklistUpdate(
+            key=np.array([7, 9, 7], np.uint32),
+            until_s=np.array([1.0, 2.0, 3.0], np.float32)))
+        assert sink.blocked[7] == 3.0 and sink.blocked[9] == 2.0
+        sink.apply(BlacklistUpdate(
+            key=np.array([9], np.uint32),
+            until_s=np.array([5.0], np.float32)))
+        assert sink.blocked[9] == 5.0
+        assert sink.updates == 2
+
+
+class TestVerdictWire:
+    """The compact device→host verdict wire (ops/fused.pack_verdict_wire
+    ↔ engine/writeback.decode_verdict_wire)."""
+
+    def test_pack_decode_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flowsentryx_tpu.engine.writeback import decode_verdict_wire
+        from flowsentryx_tpu.ops import fused
+
+        bk = np.full(32, INVALID_KEY, np.uint32)
+        bu = np.zeros(32, np.float32)
+        bk[[3, 7, 20]] = [111, 222, 333]
+        bu[[3, 7, 20]] = [1.5, 2.5, 3.5]
+        wire = np.asarray(jax.jit(
+            lambda k, u: fused.pack_verdict_wire(
+                k, u, jnp.float32(9.25), np.uint32(4), 8)
+        )(bk, bu))
+        assert wire.shape == (fused.verdict_wire_words(8),)
+        vw = decode_verdict_wire(wire)
+        assert vw.key.tolist() == [111, 222, 333]
+        assert vw.until_s.tolist() == [1.5, 2.5, 3.5]
+        assert vw.count == 3 and not vw.overflow
+        assert vw.route_drop == 4 and vw.now == 9.25
+
+    def test_overflow_flag_and_true_count(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flowsentryx_tpu.engine.writeback import decode_verdict_wire
+        from flowsentryx_tpu.ops import fused
+
+        bk = np.arange(1, 13, dtype=np.uint32)  # 12 blocked flows
+        bu = np.arange(12, dtype=np.float32)
+        vw = decode_verdict_wire(np.asarray(jax.jit(
+            lambda k, u: fused.pack_verdict_wire(
+                k, u, jnp.float32(0.0), np.uint32(0), 8)
+        )(bk, bu)))
+        assert vw.overflow and vw.count == 12
+        # the K slots still carry the FIRST 8 in order (order-preserving
+        # compaction), but the overflow flag tells the host they are
+        # incomplete — it must fall back to the full fetch
+        assert vw.key.tolist() == list(range(1, 9))
+
+    def test_merge_preserves_chunk_order_last_wins(self):
+        """Merged mega wires keep chunk order so a key re-blocked in a
+        later chunk resolves to the LATER expiry downstream."""
+        import jax
+        import jax.numpy as jnp
+
+        from flowsentryx_tpu.engine.writeback import decode_verdict_wire
+        from flowsentryx_tpu.ops import fused
+
+        def mk(keys, untils, now):
+            bk = np.full(16, INVALID_KEY, np.uint32)
+            bu = np.zeros(16, np.float32)
+            bk[:len(keys)] = keys
+            bu[:len(keys)] = untils
+            return fused.pack_verdict_wire(
+                jnp.asarray(bk), jnp.asarray(bu), jnp.float32(now),
+                np.uint32(1), 8)
+
+        merged = np.asarray(jax.jit(lambda: fused.merge_verdict_wires(
+            jnp.stack([mk([5, 6], [1.0, 2.0], 0.5),
+                       mk([5], [9.0], 0.8)])))())
+        vw = decode_verdict_wire(merged)
+        assert vw.key.tolist() == [5, 6, 5]  # chunk order preserved
+        assert vw.until_s.tolist() == [1.0, 2.0, 9.0]
+        assert vw.count == 3 and not vw.overflow
+        assert vw.route_drop == 2
+        assert vw.now == pytest.approx(0.8)
+        upd = extract_updates(vw.key, vw.until_s)
+        sink = CollectSink()
+        sink.apply(upd)
+        assert sink.blocked[5] == 9.0  # last wins
 
 
 class TestEngineLoop:
@@ -557,6 +652,171 @@ class TestEngineLoop:
         assert ing is not None and ing["n_workers"] == 2
         assert ing["dead_workers"] == []
         assert all(w["seq_gaps"] == 0 for w in ing["workers"].values())
+
+
+class TestCompactReadback:
+    """The compact verdict wire through the ENGINE: the compacted
+    readback must produce byte-identical BlacklistUpdates and verdict
+    counts vs the full-fetch path on single-device, sharded, and
+    megastep configurations — including the K_MAX-overflow fallback
+    (verdict_k far below the per-batch block count)."""
+
+    @staticmethod
+    def _recs(n, seed=17):
+        return TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=32, attack_fraction=0.8, seed=seed)
+        ).next_records(n)
+
+    @staticmethod
+    def _run(recs, verdict_k, sink_thread=True, **eng_kw):
+        cfg = small_cfg(batch=512, cap=1 << 12, verdict_k=verdict_k,
+                        pps_threshold=200.0, bps_threshold=1e9)
+        sink = CollectSink()
+        eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                     readback_depth=4, sink_thread=sink_thread, **eng_kw)
+        rep = eng.run()
+        return rep, sink
+
+    def test_single_device_parity_and_overflow_fallback(self):
+        recs = self._recs(512 * 24)
+        rep_full, sink_full = self._run(recs, verdict_k=0)
+        rep_c, sink_c = self._run(recs, verdict_k=64)
+        rep_o, sink_o = self._run(recs, verdict_k=2)  # forces overflow
+        assert len(sink_full.blocked) > 2  # overflow case is exercised
+        # byte-identical updates: same keys AND same until expiries
+        assert sink_c.blocked == sink_full.blocked
+        assert sink_o.blocked == sink_full.blocked
+        assert rep_c.stats == rep_full.stats == rep_o.stats
+        assert rep_full.readback["mode"] == "full"
+        assert rep_c.readback["mode"] == "compact"
+        assert rep_c.readback["fallback_sinks"] == 0
+        assert rep_c.readback["compact_sinks"] > 0
+        assert rep_o.readback["fallback_sinks"] > 0  # overflow fell back
+        # the point of the wire: steady-state D2H per batch shrinks
+        assert (rep_c.readback["bytes_per_batch"]
+                < rep_full.readback["bytes_per_batch"] / 4)
+
+    def test_single_thread_sink_parity(self):
+        """sink_thread=False (the single-loop engine) must decide
+        identically — threading changes scheduling, never verdicts."""
+        recs = self._recs(512 * 8)
+        rep_t, sink_t = self._run(recs, verdict_k=64, sink_thread=True)
+        rep_s, sink_s = self._run(recs, verdict_k=64, sink_thread=False)
+        assert sink_t.blocked == sink_s.blocked
+        assert rep_t.stats == rep_s.stats
+        assert rep_s.readback["sink_occupancy"] is None
+
+    def test_sharded_parity_with_overflow(self):
+        from flowsentryx_tpu.parallel import make_mesh
+
+        recs = self._recs(512 * 24)
+        rep_full, sink_full = self._run(recs, verdict_k=0,
+                                        mesh=make_mesh(8))
+        rep_c, sink_c = self._run(recs, verdict_k=2, mesh=make_mesh(8))
+        assert len(sink_full.blocked) > 2
+        assert sink_c.blocked == sink_full.blocked
+        assert rep_c.stats == rep_full.stats
+        assert rep_c.readback["fallback_sinks"] > 0
+
+    def test_megastep_parity_with_overflow(self):
+        recs = self._recs(512 * 16)
+        rep_full, sink_full = self._run(recs, verdict_k=0, mega_n=4)
+        rep_c, sink_c = self._run(recs, verdict_k=2, mega_n=4)
+        assert len(sink_full.blocked) > 2
+        assert sink_c.blocked == sink_full.blocked
+        assert rep_c.stats == rep_full.stats
+        assert rep_c.readback["fallback_sinks"] > 0
+
+
+class TestSinkThread:
+    """The two-thread engine's failure/shutdown contract."""
+
+    def test_sink_crash_fails_engine_loudly(self):
+        class BoomSink:
+            def apply(self, update):
+                if len(update.key):
+                    raise ValueError("boom: verdict ring gone")
+
+        cfg = small_cfg(batch=256, pps_threshold=200.0, bps_threshold=1e9)
+        src = TrafficSource(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=8, attack_fraction=0.8, seed=7),
+            total=256 * 30,
+        )
+        eng = Engine(cfg, src, BoomSink(), readback_depth=4,
+                     sink_thread=True)
+        with pytest.raises(RuntimeError, match="sink thread crashed"):
+            eng.run()
+        # joined, not wedged: the engine did not leave a live thread
+        assert not eng._sink_active
+
+    def test_drain_on_shutdown_with_inflight_batches(self):
+        """A deep pipe at source exhaustion: the shutdown drain must
+        sink EVERY dispatched batch, in record-FIFO order, before the
+        report is built."""
+        cfg = small_cfg(batch=128)
+        src = TrafficSource(TrafficSpec(seed=5), total=128 * 10)
+        eng = Engine(cfg, src, CollectSink(), readback_depth=8,
+                     sink_thread=True)
+        seen, times = [], []
+        eng.on_reap = lambda n, t: (seen.append(n), times.append(t))
+        rep = eng.run()
+        assert rep.records == 128 * 10
+        assert sum(seen) == 128 * 10
+        assert times == sorted(times)  # FIFO sink order preserved
+        rb = rep.readback
+        assert rb["compact_sinks"] + rb["fallback_sinks"] >= 1
+        assert rep.stages_ms["e2e"]["n"] == len(seen)
+
+    def test_threaded_sink_stress(self):
+        """Fast tier-1 stress: a closed-loop flood burst through the
+        two-thread engine — every record classified exactly once,
+        attackers blocked, and the readback accounting consistent."""
+        cfg = small_cfg(batch=256, pps_threshold=500.0, bps_threshold=1e9)
+        spec = TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                           n_attack_ips=16, attack_fraction=0.7, seed=23)
+        sink = CollectSink()
+        eng = Engine(cfg, TrafficSource(spec, total=256 * 40), sink,
+                     readback_depth=4, sink_thread=True)
+        rep = eng.run()
+        assert rep.records == 256 * 40
+        classes = ("allowed", "dropped_blacklist", "dropped_rate",
+                   "dropped_ml")
+        assert sum(rep.stats[k] for k in classes) == rep.records
+        assert sink.blocked  # verdicts actually landed
+        rb = rep.readback
+        assert rb["sink_thread"] is True
+        assert 0.0 <= rb["sink_occupancy"] <= 1.0
+        assert rb["mode"] == "compact" and rb["k_max"] == 64
+        assert rb["d2h_bytes"] > 0
+        # compact steady state: bytes/batch bounded by wire size + the
+        # occasional overflow fallback
+        assert rb["compact_sinks"] > 0
+
+
+class TestStageTimer:
+    def test_ring_late_samples_influence_percentiles(self):
+        """The old StageTimer stopped recording at ``keep`` samples —
+        long runs reported percentiles of only their first window.  The
+        ring must let late samples move the percentiles."""
+        from flowsentryx_tpu.engine.metrics import StageTimer
+
+        t = StageTimer("x", keep=8)
+        for _ in range(8):
+            t.add(0.001)
+        assert t.percentiles_ms()["p50"] == pytest.approx(1.0)
+        for _ in range(8):
+            t.add(0.1)  # overwrites the ring — must dominate now
+        p = t.percentiles_ms()
+        assert p["p50"] == pytest.approx(100.0)
+        assert p["n"] == 16  # total ever, not ring length
+        # the all-time max survives aging out of the ring
+        t2 = StageTimer("y", keep=4)
+        t2.add(0.5)
+        for _ in range(8):
+            t2.add(0.001)
+        assert t2.percentiles_ms()["max"] == pytest.approx(500.0)
 
 
 class TestServeCheckpointEvery:

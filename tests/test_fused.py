@@ -465,8 +465,12 @@ class TestCompactWire:
         raw = schema.encode_raw(buf, n, t0)
         comp = schema.encode_compact(buf, n, t0, **qa)
 
-        sr = jax.jit(fused.make_raw_step(CFG, spec.classify_batch))
-        sc = jax.jit(fused.make_compact_step(CFG, spec.classify_batch, **qa))
+        # emit_score=True: the [B] f32 score output is opt-in now (the
+        # serving loop never fetches it); this parity test compares it
+        sr = jax.jit(fused.make_raw_step(CFG, spec.classify_batch,
+                                         emit_score=True))
+        sc = jax.jit(fused.make_compact_step(CFG, spec.classify_batch,
+                                             emit_score=True, **qa))
         tb, st = make_table(CFG.table.capacity), make_stats()
         _, _, o_r = sr(tb, st, params, raw)
         _, _, o_c = sc(tb, st, params, comp)

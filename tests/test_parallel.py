@@ -139,9 +139,12 @@ class TestOwnerRouting:
         to the single-device step on a big random batch."""
         spec = get_model(CFG.model.name)
         params = spec.init()
+        # emit_score=True: scores are opt-in debug/parity outputs now —
+        # this test compares them across the two paths
         sharded = pstep.make_sharded_step(CFG, spec.classify_batch, mesh,
-                                          donate=False)
-        single = fused.make_jitted_step(CFG, spec.classify_batch, donate=False)
+                                          donate=False, emit_score=True)
+        single = fused.make_jitted_step(CFG, spec.classify_batch,
+                                        donate=False, emit_score=True)
         batch = _random_batch(1024, n_ips=200, seed=7)  # ~5 pkts/flow,
         # scattered positions → nearly every flow spans multiple slices
 
