@@ -1,0 +1,19 @@
+"""Static dtype/donation/transfer auditor for the staged step graphs.
+
+The TPU-plane twin of :mod:`flowsentryx_tpu.bpf.verifier` (``fsx
+check``): where the BPF verifier proves the *kernel* fast path safe
+before load, this package proves the *device* fast path's serving
+contracts on the compiled artifact itself — jaxpr and HLO level, no
+batch ever executed.  See :mod:`flowsentryx_tpu.audit.graph` for the
+individual contract checks and :mod:`flowsentryx_tpu.audit.runner` for
+variant staging, the JSON report, and the engine-boot hook.
+"""
+
+from flowsentryx_tpu.audit.graph import (  # noqa: F401
+    AuditError, Finding, check_callbacks, check_collectives,
+    check_donation, check_dtypes, check_quantized_lane,
+    iter_eqns, parse_alias_map, staging_cache_check,
+)
+from flowsentryx_tpu.audit.runner import (  # noqa: F401
+    AuditReport, VariantReport, audit_serving, boot_audit, run_audit,
+)

@@ -1,0 +1,415 @@
+"""Jaxpr/HLO-level contract checks — the auditor's instruction layer.
+
+Every check here takes a staged artifact (a ``ClosedJaxpr`` from
+``jitted.trace(...)`` or the compiled module's HLO text) and returns a
+list of :class:`Finding`\\s, each naming the offending equation (by its
+path through the nested jaxpr) or executable parameter — the same
+diagnostic shape :mod:`flowsentryx_tpu.bpf.verifier` gives for rejected
+BPF instructions.  Nothing in this module executes device code: the
+point is that the contracts are *properties of the compiled graph*,
+provable before the first batch is dispatched.
+
+Contract catalog (docs/AUDIT.md has the operator view):
+
+* :func:`check_dtypes` — no f64/complex anywhere in the graph (the
+  all-quantized-lanes claim; one stray ``float(...)`` promotion doubles
+  every buffer it touches).
+* :func:`check_quantized_lane` — the int8 classifier matmul really is
+  integer-domain ``dot_general`` (a silent dequantize-then-float-dot
+  keeps the numbers and loses the MXU int path).
+* :func:`check_callbacks` — no ``pure_callback``/``io_callback``/
+  ``debug_callback``/infeed/outfeed host round-trips hiding in the hot
+  step.
+* :func:`check_collectives` — the sharded step's cross-device traffic
+  is exactly the designed set: two routing ``all_to_all``\\s, the
+  O(verdict_k) ``all_gather`` on the compact wire, scalar reductions.
+* :func:`check_donation` — ``donate_argnums`` buffers actually appear
+  in the executable's ``input_output_alias`` map (a dropped donation is
+  a silent HBM copy of the 1M-row table per batch).
+* :func:`staging_cache_check` — staging twice under identical
+  host-side construction hits the jit tracing cache (weak_type /
+  dtype / static-arg drift means the serving loop recompiles forever).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Iterator
+
+#: Primitives that round-trip through the host mid-graph.  Any of these
+#: in a serving step turns the "one D2H wire per batch" budget into an
+#: unbounded sync point (and wedges donation on tunneled runtimes).
+CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+})
+
+#: Cross-device primitives the sharded step is *designed* to contain.
+#: Anything else crossing devices is accidental traffic.
+EXPECTED_COLLECTIVES = frozenset({
+    "all_to_all",   # flow partials out + verdicts back (2 per step)
+    "all_gather",   # the compact verdict wire only (K-sized operands)
+    "psum", "pmax", "pmin",  # scalar stat/clock reductions
+    "axis_index",   # device id, no traffic at all
+})
+
+#: All primitives we classify as collectives (superset of the expected
+#: set — an unexpected member is a finding, not a crash).
+COLLECTIVE_PRIMITIVES = EXPECTED_COLLECTIVES | frozenset({
+    "ppermute", "pbroadcast", "all_gather_invariant", "reduce_scatter",
+    "psum_scatter", "pgather", "pdot", "collective_permute",
+})
+
+#: Scalar-reduction operand ceiling (elements): psum/pmax carry the
+#: [4+1] stat-count vector and the batch clock, never per-record data.
+REDUCTION_MAX_ELEMS = 8
+
+#: all_to_all count per staged step graph: partials out, verdicts back.
+MAX_ALL_TO_ALL = 2
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violated contract, pinned to an equation or parameter."""
+
+    contract: str   # dtype | quantized | transfer | donation | ...
+    reason: str     # human-actionable sentence
+    where: str = ""  # eqn path ("eqns[3]:convert_element_type/...") or
+    #                  output/param name ("table.key", "out.wire")
+    eqn: str = ""   # the offending equation's text (trimmed)
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v}
+
+    def __str__(self) -> str:
+        loc = f" at {self.where}" if self.where else ""
+        eqn = f"\n    {self.eqn}" if self.eqn else ""
+        return f"[{self.contract}]{loc}: {self.reason}{eqn}"
+
+
+class AuditError(RuntimeError):
+    """Raised when an audited variant violates a contract (engine boot
+    refuses to serve on it; ``fsx audit`` exits 1)."""
+
+    def __init__(self, variant: str, findings: list[Finding]):
+        self.variant = variant
+        self.findings = findings
+        lines = "\n  ".join(str(f) for f in findings)
+        super().__init__(
+            f"fsx audit: step variant {variant!r} violates "
+            f"{len(findings)} contract(s):\n  {lines}")
+
+
+# -- jaxpr traversal --------------------------------------------------------
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    """Yield nested Jaxprs hiding inside one eqn param value (pjit /
+    scan carry ClosedJaxpr, shard_map carries a bare Jaxpr, cond
+    carries lists of branches)."""
+    items = value if isinstance(value, (list, tuple)) else (value,)
+    for v in items:
+        if hasattr(v, "eqns"):           # bare Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+            yield v.jaxpr                # ClosedJaxpr
+
+
+def iter_eqns(jaxpr: Any, path: str = "") -> Iterator[tuple[str, Any]]:
+    """Depth-first ``(path, eqn)`` walk over a (possibly closed) jaxpr,
+    descending into every nested sub-jaxpr (pjit bodies, scan bodies,
+    shard_map bodies, cond branches)."""
+    if hasattr(jaxpr, "jaxpr"):          # ClosedJaxpr -> Jaxpr
+        jaxpr = jaxpr.jaxpr
+    for i, eqn in enumerate(jaxpr.eqns):
+        where = f"{path}eqns[{i}]:{eqn.primitive.name}"
+        yield where, eqn
+        for pname, pval in eqn.params.items():
+            for sub in _sub_jaxprs(pval):
+                yield from iter_eqns(sub, f"{where}/{pname}/")
+
+
+def _eqn_txt(eqn: Any, limit: int = 160) -> str:
+    txt = " ".join(str(eqn).split())
+    return txt if len(txt) <= limit else txt[: limit - 3] + "..."
+
+
+def _avals(vars_: Any) -> Iterator[Any]:
+    for v in vars_:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "dtype"):
+            yield aval
+
+
+# -- contract 1: dtype / precision ------------------------------------------
+
+#: Width-doubling dtypes that must never appear in a serving graph.
+BANNED_DTYPES = ("float64", "complex64", "complex128")
+
+
+def dtype_histogram(closed_jaxpr: Any) -> dict[str, int]:
+    """``dtype name -> eqn-output count`` over the whole graph (the
+    report's precision inventory)."""
+    hist: dict[str, int] = {}
+    for _, eqn in iter_eqns(closed_jaxpr):
+        for aval in _avals(eqn.outvars):
+            name = str(aval.dtype)
+            hist[name] = hist.get(name, 0) + 1
+    return hist
+
+
+def check_dtypes(closed_jaxpr: Any,
+                 banned: tuple[str, ...] = BANNED_DTYPES) -> list[Finding]:
+    """No banned dtype may appear on any equation input or output."""
+    out: list[Finding] = []
+    for where, eqn in iter_eqns(closed_jaxpr):
+        for aval in _avals(list(eqn.outvars) + list(eqn.invars)):
+            if str(aval.dtype) in banned:
+                out.append(Finding(
+                    contract="dtype", where=where, eqn=_eqn_txt(eqn),
+                    reason=(f"{aval.dtype} value of shape "
+                            f"{tuple(aval.shape)} in the step graph — "
+                            "the serving plane is quantized/f32-only"),
+                ))
+                break  # one finding per eqn is enough to act on
+    return out
+
+
+def check_quantized_lane(closed_jaxpr: Any) -> list[Finding]:
+    """A quantized model's classifier matmul must be an integer-domain
+    ``dot_general`` — if every dot in the graph runs on floats, the int8
+    weights were silently dequantized before the MXU."""
+    saw_dot = False
+    for _, eqn in iter_eqns(closed_jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        saw_dot = True
+        if any(str(a.dtype).startswith(("int", "uint"))
+               for a in _avals(eqn.invars)):
+            return []
+    if not saw_dot:
+        return []  # no matmul at all (non-MXU model): nothing to pin
+    return [Finding(
+        contract="quantized",
+        reason=("model is configured quantized but no integer-domain "
+                "dot_general exists in the graph — the int8 lane was "
+                "silently promoted to float before the matmul"),
+    )]
+
+
+# -- contract 3b: host round-trips ------------------------------------------
+
+def check_callbacks(closed_jaxpr: Any) -> list[Finding]:
+    """No host-callback / infeed / outfeed primitive may hide in the
+    step: each one is an unbounded mid-graph host sync."""
+    out = []
+    for where, eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES or "callback" in name:
+            out.append(Finding(
+                contract="transfer", where=where, eqn=_eqn_txt(eqn),
+                reason=(f"host round-trip primitive {name!r} in the "
+                        "step graph — the serving step's only host "
+                        "contact is the post-step wire fetch"),
+            ))
+    return out
+
+
+# -- contract 5: collectives ------------------------------------------------
+
+def check_collectives(closed_jaxpr: Any, verdict_k: int,
+                      expect_sharded: bool) -> tuple[list[Finding], dict]:
+    """Enumerate cross-device primitives and hold them to the design:
+
+    * single-device variants contain none at all;
+    * sharded variants contain at most :data:`MAX_ALL_TO_ALL`
+      ``all_to_all``\\s (flow routing), ``all_gather`` only on
+      verdict_k-sized operands (the compact wire fold), and scalar
+      ``psum``/``pmax`` reductions — nothing may gather or reduce a
+      ``[B]``-shaped per-record array across the mesh.
+    """
+    findings: list[Finding] = []
+    counts: dict[str, int] = {}
+    for where, eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMITIVES:
+            continue
+        counts[name] = counts.get(name, 0) + 1
+        sizes = [int(a.size) for a in _avals(eqn.invars)]
+        if not expect_sharded:
+            findings.append(Finding(
+                contract="collectives", where=where, eqn=_eqn_txt(eqn),
+                reason=(f"collective {name!r} in a single-device step "
+                        "variant"),
+            ))
+            continue
+        if name not in EXPECTED_COLLECTIVES:
+            findings.append(Finding(
+                contract="collectives", where=where, eqn=_eqn_txt(eqn),
+                reason=(f"unexpected collective {name!r} — the sharded "
+                        "step's traffic is all_to_all routing, the "
+                        "wire all_gather, and scalar reductions only"),
+            ))
+        elif name == "all_gather":
+            bad = [s for s in sizes if s != verdict_k]
+            if bad:
+                findings.append(Finding(
+                    contract="collectives", where=where,
+                    eqn=_eqn_txt(eqn),
+                    reason=(f"all_gather on a {bad[0]}-element operand; "
+                            f"only the [{verdict_k}]-slot compact wire "
+                            "may be gathered (per-record arrays stay "
+                            "on their shard)"),
+                ))
+        elif name in ("psum", "pmax", "pmin"):
+            bad = [s for s in sizes if s > REDUCTION_MAX_ELEMS]
+            if bad:
+                findings.append(Finding(
+                    contract="collectives", where=where,
+                    eqn=_eqn_txt(eqn),
+                    reason=(f"{name} over a {bad[0]}-element operand "
+                            f"(> {REDUCTION_MAX_ELEMS}): cross-device "
+                            "reductions carry stat counts and clocks, "
+                            "never batch data"),
+                ))
+    if counts.get("all_to_all", 0) > MAX_ALL_TO_ALL:
+        findings.append(Finding(
+            contract="collectives",
+            reason=(f"{counts['all_to_all']} all_to_all ops in one step "
+                    f"(design: {MAX_ALL_TO_ALL} — flow partials out, "
+                    "verdicts back); extra ones double-route the batch"),
+        ))
+    return findings, counts
+
+
+# -- contract 2: donation ---------------------------------------------------
+
+_ALIAS_RE = re.compile(r"\(\s*(\d+)\s*,")
+_SHAPE_TOKEN = re.compile(
+    r"(?:pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|f8\w*|f16|bf16|f32|f64|"
+    r"c64|c128)\[[^\]]*\]")
+
+
+def parse_alias_map(hlo_text: str) -> tuple[set[int], int]:
+    """Parse the compiled module header: returns (aliased parameter
+    numbers from ``input_output_alias``, total entry parameter count
+    from ``entry_computation_layout``)."""
+    aliased: set[int] = set()
+    i = hlo_text.find("input_output_alias={")
+    if i >= 0:
+        # entries look like "{out_idx}: (param, {param_idx}, kind)" —
+        # scan forward to the balanced close of the outer map
+        depth, k = 0, i + len("input_output_alias=")
+        start = k
+        while k < len(hlo_text):
+            if hlo_text[k] == "{":
+                depth += 1
+            elif hlo_text[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        body = hlo_text[start:k + 1]
+        aliased = {int(m.group(1)) for m in _ALIAS_RE.finditer(body)}
+    n_params = 0
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text,
+                  re.DOTALL)
+    if m:
+        n_params = len(_SHAPE_TOKEN.findall(m.group(1)))
+    return aliased, n_params
+
+
+def check_donation(hlo_text: str, donated_names: list[str],
+                   donated_avals: list[Any],
+                   n_inputs: int) -> tuple[list[Finding], dict]:
+    """Every donated input leaf must appear as an alias source in the
+    executable's ``input_output_alias`` map.
+
+    Donated leaves are the *first* ``len(donated_names)`` flattened
+    parameters (``donate_argnums`` always covers the leading table/stats
+    args here); ``n_inputs`` is the flattened input count, used to
+    detect parameter dropping (``keep_unused=False`` elides unused
+    params, which would shift numbering — that itself is a finding: a
+    donated buffer the graph never reads means the state isn't
+    threading through the step at all)."""
+    findings: list[Finding] = []
+    aliased, n_params = parse_alias_map(hlo_text)
+    if n_params and n_params != n_inputs:
+        findings.append(Finding(
+            contract="donation",
+            reason=(f"executable has {n_params} parameters for "
+                    f"{n_inputs} traced inputs — unused (dropped) "
+                    "arguments; donated state must be live in the "
+                    "graph for in-place updates to mean anything"),
+        ))
+        return findings, {"aliased_params": sorted(aliased),
+                          "n_params": n_params}
+    for idx, (name, aval) in enumerate(zip(donated_names, donated_avals)):
+        if idx not in aliased:
+            nbytes = int(aval.size) * aval.dtype.itemsize
+            findings.append(Finding(
+                contract="donation", where=name,
+                reason=(f"donated buffer {name} ({aval.dtype}"
+                        f"{tuple(aval.shape)}, {nbytes} B) is NOT in "
+                        "the executable's input_output_alias map — "
+                        "every batch would allocate and copy it "
+                        "instead of updating HBM in place"),
+            ))
+    return findings, {"aliased_params": sorted(aliased),
+                      "n_params": n_params or n_inputs}
+
+
+# -- contract 4: retrace sentinel -------------------------------------------
+
+def staging_cache_check(jitted: Any, make_args: Callable[[], tuple],
+                        arg_names: Callable[[int], str] = lambda i: f"arg[{i}]",
+                        ) -> tuple[list[Finding], Any]:
+    """Stage ``jitted`` twice with independently constructed inputs and
+    require the second trace to hit the tracing cache.
+
+    A miss means two host-side constructions of "the same" batch differ
+    in aval (dtype / shape / weak_type) or static metadata — exactly
+    the drift that makes a serving loop silently recompile per
+    dispatch.  Returns ``(findings, traced)`` with the first trace for
+    further graph checks.  The diagnostic names the first differing
+    input."""
+    t1 = jitted.trace(*make_args())
+    t2 = jitted.trace(*make_args())
+    if t2.jaxpr is t1.jaxpr:  # the tracing cache returns one object
+        return [], t1
+    diffs = []
+    a1, a2 = list(t1.jaxpr.in_avals), list(t2.jaxpr.in_avals)
+    for i, (x, y) in enumerate(zip(a1, a2)):
+        if (x.shape, x.dtype, getattr(x, "weak_type", False)) != (
+                y.shape, y.dtype, getattr(y, "weak_type", False)):
+            diffs.append(f"{arg_names(i)}: {x.str_short()} vs "
+                         f"{y.str_short()}")
+    if len(a1) != len(a2):
+        diffs.append(f"input leaf count {len(a1)} vs {len(a2)}")
+    reason = ("staging twice under one BatchConfig re-traced (jit cache "
+              "miss) — the serving loop would recompile every batch. ")
+    reason += ("Differing inputs: " + "; ".join(diffs[:4])) if diffs else (
+        "Avals identical: static-argument or donation metadata drift.")
+    return [Finding(contract="retrace", reason=reason)], t1
+
+
+def check_carry_avals(closed_jaxpr: Any, n_carry: int,
+                      names: list[str]) -> list[Finding]:
+    """The step's carried state (table, stats — outputs fed back as the
+    next batch's inputs) must come out with avals identical to how it
+    went in; any weak_type/dtype wobble retraces on the *second* batch
+    and every batch after."""
+    out = []
+    ins = list(closed_jaxpr.in_avals)[:n_carry]
+    outs = list(closed_jaxpr.out_avals)[:n_carry]
+    for name, i, o in zip(names, ins, outs):
+        if (i.shape, i.dtype, getattr(i, "weak_type", False)) != (
+                o.shape, o.dtype, getattr(o, "weak_type", False)):
+            out.append(Finding(
+                contract="retrace", where=name,
+                reason=(f"carried state {name} changes aval through the "
+                        f"step ({i.str_short()} in, {o.str_short()} "
+                        "out): feeding outputs back would retrace "
+                        "every serving iteration"),
+            ))
+    return out

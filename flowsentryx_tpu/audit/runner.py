@@ -1,0 +1,438 @@
+"""Variant staging + report assembly for ``fsx audit``.
+
+Stages every serving-step variant the engine can build — raw48 and
+compact16 single-device (:mod:`flowsentryx_tpu.ops.fused`), the
+IP-hash-sharded step (:mod:`flowsentryx_tpu.parallel.step`), and the
+``lax.scan`` megastep — down to its ClosedJaxpr and compiled
+executable, runs the :mod:`flowsentryx_tpu.audit.graph` contract checks
+on each, and folds the results into one JSON-able
+:class:`AuditReport` (the ``fsx check`` diagnostic idiom, aimed at the
+TPU plane).
+
+Nothing here executes a batch: ``jitted.trace`` stages the graph,
+``.lower().compile()`` builds the executable whose alias map and
+entry layout the donation/transfer contracts read.  The one
+engine-visible entry point is :func:`boot_audit`, which caches by
+(config, variant set) so a serving boot audits each compiled shape
+exactly once per process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from flowsentryx_tpu.audit import graph
+from flowsentryx_tpu.audit.graph import AuditError, Finding
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import FsxConfig
+from flowsentryx_tpu.models import get_model
+from flowsentryx_tpu.ops import fused
+
+#: Carried-state leaf names, in flattened (table, stats) order — the
+#: donated buffers and the serving loop's feedback carry.
+CARRY_NAMES = ["table.key", "table.state"] + [
+    f"stats.{f}" for f in schema.GlobalStats._fields]
+
+#: The auditable variants, in report order.  "sharded_megastep" is the
+#: scan-over-shard_map graph a mesh+mega engine actually serves — its
+#: contracts are NOT implied by sharded and megastep separately (the
+#: scan could drop the table donation or add a collective of its own).
+ALL_VARIANTS = ("raw", "compact", "sharded", "megastep",
+                "sharded_megastep")
+
+
+@dataclasses.dataclass
+class VariantReport:
+    """One staged step variant's audit result."""
+
+    name: str
+    ok: bool
+    findings: list[Finding]
+    outputs: list[dict]            # name/shape/dtype/bytes per output
+    n_eqns: int
+    steady_state_d2h_bytes: int | None  # the wire fetch; None if no wire
+    wire_words: int | None
+    donation: dict                 # aliased params / required leaves
+    collectives: dict              # collective primitive -> count
+    dtypes: dict                   # dtype -> eqn-output count
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["findings"] = [f.to_json() for f in self.findings]
+        return d
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """The full ``fsx audit`` result (one entry per staged variant)."""
+
+    ok: bool
+    variants: list[VariantReport]
+    config: dict
+    backend: str
+    jax_version: str
+    notes: list[str]
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "jax_version": self.jax_version,
+            "backend": self.backend,
+            "config": self.config,
+            "notes": self.notes,
+            "variants": [v.to_json() for v in self.variants],
+        }
+
+    def raise_if_failed(self) -> None:
+        for v in self.variants:
+            if not v.ok:
+                raise AuditError(v.name, v.findings)
+
+
+def _out_names(out_info: Any) -> list[str]:
+    """Semantic names for the flattened step outputs: the out tree is
+    ``(IpTableState, GlobalStats, StepOutput)`` for every variant."""
+    tops = {0: "table", 1: "stats", 2: "out"}
+    names = []
+    for path, _ in jax.tree_util.tree_flatten_with_path(out_info)[0]:
+        key = jax.tree_util.keystr(path)  # e.g. "[2].wire"
+        for idx, top in tops.items():
+            prefix = f"[{idx}]"
+            if key.startswith(prefix):
+                key = top + key[len(prefix):]
+                break
+        names.append(key)
+    return names
+
+
+def _arg_name(i: int, n_params: int) -> str:
+    if i < len(CARRY_NAMES):
+        return CARRY_NAMES[i]
+    if i < len(CARRY_NAMES) + n_params:
+        return f"params[{i - len(CARRY_NAMES)}]"
+    return "raw"
+
+
+def _audit_one(
+    name: str,
+    jitted: Any,
+    make_args: Callable[[], tuple],
+    *,
+    verdict_k: int,
+    expect_sharded: bool,
+    donate_leaves: int,
+    quantized: bool,
+    n_param_leaves: int,
+) -> VariantReport:
+    """Stage one variant and run every contract on it."""
+    findings: list[Finding] = []
+
+    # contract 4: retrace sentinel (also produces the staged trace)
+    f, traced = graph.staging_cache_check(
+        jitted, make_args, arg_names=lambda i: _arg_name(i, n_param_leaves))
+    findings += f
+    closed = traced.jaxpr
+    findings += graph.check_carry_avals(closed, len(CARRY_NAMES),
+                                        CARRY_NAMES)
+
+    # contract 1: dtype / precision
+    findings += graph.check_dtypes(closed)
+    if quantized:
+        findings += graph.check_quantized_lane(closed)
+    dtypes = graph.dtype_histogram(closed)
+
+    # contract 3: host round-trips + the steady-state D2H budget
+    findings += graph.check_callbacks(closed)
+    lowered = traced.lower()
+    out_leaves = jax.tree_util.tree_leaves(lowered.out_info)
+    names = _out_names(lowered.out_info)
+    outputs = []
+    wire_bytes = wire_words = None
+    for n, leaf in zip(names, out_leaves):
+        nbytes = int(np.prod(leaf.shape, dtype=np.int64)) * np.dtype(
+            leaf.dtype).itemsize
+        outputs.append({"name": n, "shape": list(leaf.shape),
+                        "dtype": str(np.dtype(leaf.dtype)),
+                        "bytes": int(nbytes)})
+        if n.endswith(".wire"):
+            wire_words = int(np.prod(leaf.shape, dtype=np.int64))
+            wire_bytes = int(nbytes)
+            if np.dtype(leaf.dtype) != np.uint32:
+                findings.append(Finding(
+                    contract="transfer", where=n,
+                    reason=f"verdict wire dtype {leaf.dtype}, expected "
+                           "uint32 (the host decoder bitcasts in place)"))
+    expect_words = fused.verdict_wire_words(verdict_k) if verdict_k else 0
+    if verdict_k <= 0:
+        findings.append(Finding(
+            contract="transfer",
+            reason=("verdict_k == 0 disables the compact wire: "
+                    "steady-state D2H is the full [B] block arrays — "
+                    "the audited transfer budget requires verdict_k "
+                    ">= 1")))
+    elif wire_words is None:
+        findings.append(Finding(
+            contract="transfer", where="out.wire",
+            reason="no compact verdict wire in the step outputs"))
+    elif wire_words != expect_words:
+        findings.append(Finding(
+            contract="transfer", where="out.wire",
+            reason=(f"wire is {wire_words} words, expected "
+                    f"2*{verdict_k}+{fused.VERDICT_WIRE_SCALARS} = "
+                    f"{expect_words}")))
+    for n, leaf in zip(names, out_leaves):
+        expected = {"out.verdict": np.uint8, "out.block_key": np.uint32,
+                    "out.block_until": np.float32, "out.now": np.float32}
+        want = expected.get(n)
+        if want is not None and np.dtype(leaf.dtype) != want:
+            findings.append(Finding(
+                contract="dtype", where=n,
+                reason=(f"step output {n} is {np.dtype(leaf.dtype)}, "
+                        f"contract says {np.dtype(want).name}")))
+
+    # contract 5: collectives
+    f, coll = graph.check_collectives(closed, verdict_k, expect_sharded)
+    findings += f
+
+    # contract 2: donation (needs the compiled executable's alias map)
+    donation: dict = {"checked": donate_leaves > 0,
+                      "required": CARRY_NAMES[:donate_leaves]}
+    if donate_leaves:
+        hlo = lowered.compile().as_text()
+        f, info = graph.check_donation(
+            hlo, CARRY_NAMES[:donate_leaves],
+            list(closed.in_avals)[:donate_leaves],
+            n_inputs=len(closed.in_avals))
+        findings += f
+        donation.update(info)
+
+    n_eqns = sum(1 for _ in graph.iter_eqns(closed))
+    return VariantReport(
+        name=name, ok=not findings, findings=findings, outputs=outputs,
+        n_eqns=n_eqns, steady_state_d2h_bytes=wire_bytes,
+        wire_words=wire_words, donation=donation, collectives=coll,
+        dtypes=dtypes,
+    )
+
+
+def _zeros_raw(cfg: FsxConfig, compact: bool) -> np.ndarray:
+    words = (schema.COMPACT_RECORD_WORDS if compact
+             else schema.RECORD_WORDS)
+    return np.zeros((cfg.batch.max_batch + 1, words), np.uint32)
+
+
+def run_audit(
+    cfg: FsxConfig,
+    params: Any | None = None,
+    mesh: Any | None = None,
+    mega_n: int = 2,
+    variants: tuple[str, ...] | None = None,
+    donate: bool | None = None,
+) -> AuditReport:
+    """Stage and audit the requested step variants under ``cfg``.
+
+    ``variants`` defaults to everything stageable here: raw + compact +
+    megastep always, sharded when ``mesh`` spans more than one device.
+    ``donate=None`` follows the backend
+    (:func:`~flowsentryx_tpu.ops.fused.donation_supported`) exactly as
+    the engine does; ``False`` skips the donation contract with a note
+    (axon's compute-only epochs), any other value is audited as given.
+    """
+    notes: list[str] = []
+    if donate is None:
+        donate = fused.donation_supported()
+        if not donate:
+            notes.append("backend does not support donation + readback "
+                         "(axon); donation contract skipped")
+    spec = get_model(cfg.model.name)
+    if params is None:
+        params = spec.init()
+    quant = schema.wire_quant_for(params)
+    n_param_leaves = len(jax.tree_util.tree_leaves(params))
+    shardable = mesh is not None and int(mesh.devices.size) > 1
+    mega_ok = mega_n >= 1
+    if variants is None:
+        variants = tuple(
+            v for v in ALL_VARIANTS
+            if (shardable or not v.startswith("sharded"))
+            and (mega_ok or "megastep" not in v))
+        if not shardable:
+            notes.append("sharded variants skipped: need a >1-device "
+                         "mesh (run under "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N or on a real slice)")
+        if not mega_ok:
+            notes.append("megastep variants skipped: mega_n < 1")
+    else:
+        bad = [v for v in variants
+               if ("megastep" in v and not mega_ok)
+               or (v.startswith("sharded") and not shardable)]
+        if bad:
+            raise ValueError(
+                f"variant(s) {bad} need "
+                + ("mega_n >= 1" if "megastep" in bad[0]
+                   else "a >1-device mesh"))
+
+    def table_args(sharded: bool):
+        table = schema.make_table(cfg.table.capacity)
+        if sharded:
+            from flowsentryx_tpu import parallel as par
+
+            table = par.shard_table(table, mesh)
+        return table, schema.make_stats()
+
+    reports: list[VariantReport] = []
+    for name in variants:
+        if name == "raw":
+            jitted = fused.make_jitted_raw_step(
+                cfg, spec.classify_batch, donate=donate)
+
+            def mk():
+                return (*table_args(False), params,
+                        _zeros_raw(cfg, compact=False))
+            sharded = False
+            donate_leaves = len(CARRY_NAMES) if donate else 0
+        elif name == "compact":
+            jitted = fused.make_jitted_compact_step(
+                cfg, spec.classify_batch, donate=donate, **quant)
+
+            def mk():
+                return (*table_args(False), params,
+                        _zeros_raw(cfg, compact=True))
+            sharded = False
+            donate_leaves = len(CARRY_NAMES) if donate else 0
+        elif name == "sharded":
+            if not shardable:
+                raise ValueError("sharded variant requires a >1-device "
+                                 "mesh")
+            from flowsentryx_tpu import parallel as par
+
+            jitted = par.make_sharded_compact_step(
+                cfg, spec.classify_batch, mesh, donate=donate, **quant)
+
+            def mk():
+                return (*table_args(True), params,
+                        _zeros_raw(cfg, compact=True))
+            sharded = True
+            donate_leaves = 2 if donate else 0  # table only (stats
+            #                                     replicate, cannot alias)
+        elif name in ("megastep", "sharded_megastep"):
+            is_sh = name == "sharded_megastep"
+            if is_sh:
+                from flowsentryx_tpu import parallel as par
+
+                jitted = par.make_sharded_compact_megastep(
+                    cfg, spec.classify_batch, mesh, mega_n,
+                    donate=donate, **quant)
+            else:
+                jitted = fused.make_jitted_compact_megastep(
+                    cfg, spec.classify_batch, mega_n, donate=donate,
+                    **quant)
+
+            def mk(is_sh=is_sh):
+                raws = np.zeros(
+                    (mega_n, cfg.batch.max_batch + 1,
+                     schema.COMPACT_RECORD_WORDS), np.uint32)
+                return (*table_args(is_sh), params, raws)
+            sharded = is_sh
+            donate_leaves = ((2 if is_sh else len(CARRY_NAMES))
+                             if donate else 0)
+        else:
+            raise ValueError(f"unknown audit variant {name!r}")
+        reports.append(_audit_one(
+            name, jitted, mk, verdict_k=cfg.batch.verdict_k,
+            expect_sharded=sharded, donate_leaves=donate_leaves,
+            quantized=cfg.model.quantized,
+            n_param_leaves=n_param_leaves))
+
+    return AuditReport(
+        ok=all(v.ok for v in reports),
+        variants=reports,
+        config={
+            "max_batch": cfg.batch.max_batch,
+            "verdict_k": cfg.batch.verdict_k,
+            "capacity": cfg.table.capacity,
+            "model": cfg.model.name,
+            "mesh_devices": int(mesh.devices.size) if mesh is not None
+            else 1,
+            "mega_n": mega_n,
+            "donate": bool(donate),
+        },
+        backend=jax.default_backend(),
+        jax_version=jax.__version__,
+        notes=notes,
+    )
+
+
+# -- engine boot hook -------------------------------------------------------
+
+#: Completed boot audits, keyed by the staged-shape signature — an
+#: engine restart (or a test constructing many engines) re-proves each
+#: compiled shape once per process, not once per construction.
+_BOOT_CACHE: dict[tuple, bool] = {}
+
+
+def boot_audit(
+    cfg: FsxConfig,
+    *,
+    wire: str,
+    mesh: Any | None,
+    mega_n: int,
+    params: Any | None = None,
+) -> AuditReport | None:
+    """Audit exactly the variants a booting engine is about to serve
+    and refuse the boot (raise :class:`AuditError`) on any violated
+    contract.  Returns None on a cache hit."""
+    shardable = mesh is not None and int(mesh.devices.size) > 1
+    variants: list[str] = []
+    if shardable:
+        variants.append("sharded")
+    else:
+        variants.append("compact" if wire == schema.WIRE_COMPACT16
+                        else "raw")
+    if mega_n > 0:
+        # the scan-over-shard_map graph is its own compiled artifact —
+        # auditing sharded + single-device megastep separately would
+        # leave the variant that actually serves unproved
+        variants.append("sharded_megastep" if shardable else "megastep")
+    # The cache key must cover everything that changes the STAGED
+    # graph: config, wire, mesh, group size — and the params leaves'
+    # shapes/dtypes (a later engine serving a different artifact, e.g.
+    # an f64-poisoned .npz, is a different graph and must re-audit).
+    if params is None:
+        params_sig = ("default", cfg.model.name)
+    else:
+        leaves = jax.tree_util.tree_leaves(params)
+        params_sig = tuple(
+            (str(np.dtype(getattr(l, "dtype", type(l)))),
+             tuple(getattr(l, "shape", ()))) for l in leaves)
+    key = (cfg.to_json(), wire, shardable and int(mesh.devices.size),
+           mega_n, tuple(variants), params_sig)
+    if _BOOT_CACHE.get(key):
+        return None
+    rep = run_audit(cfg, params=params, mesh=mesh,
+                    mega_n=mega_n or 2, variants=tuple(variants))
+    rep.raise_if_failed()
+    _BOOT_CACHE[key] = True
+    return rep
+
+
+def audit_serving(*args: Any, **kw: Any) -> AuditReport | None:
+    """Alias of :func:`boot_audit` (the engine-facing name)."""
+    return boot_audit(*args, **kw)
+
+
+def write_artifact(report: AuditReport, path: str) -> str:
+    """Write the machine-readable audit artifact (per-variant output
+    byte budgets + findings) and return the path."""
+    from pathlib import Path
+
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(report.to_json(), indent=2) + "\n")
+    return str(p)

@@ -233,6 +233,70 @@ def _cmd_check(args: argparse.Namespace) -> int:
     return 0 if out["ok"] else 1
 
 
+def _cmd_audit(args: argparse.Namespace) -> int:
+    """Static dtype/donation/transfer audit of the staged TPU step
+    graphs — the device-plane half of the static-analysis suite
+    (``fsx check`` is the kernel-plane half; docs/AUDIT.md).
+
+    Stages every step variant to jaxpr + compiled executable and proves
+    the serving contracts without executing a batch: no f64, donation
+    really aliases, the steady-state D2H is exactly the
+    ``[2*verdict_k+4]``-word wire, staging is retrace-stable, and the
+    sharded step's collectives are exactly the designed set."""
+    import dataclasses as _dc
+
+    _honor_jax_platform()
+    from flowsentryx_tpu.audit import run_audit, runner
+
+    cfg = _load_cfg(args)
+    if args.verdict_k is not None:
+        if args.verdict_k < 1:
+            print("fsx audit: --verdict-k must be >= 1 (the transfer "
+                  "contract is about the compact wire)", file=sys.stderr)
+            return 1
+        cfg = _dc.replace(cfg, batch=_dc.replace(
+            cfg.batch, verdict_k=args.verdict_k))
+    if args.quick:
+        # small shapes, same contracts: every check here is
+        # shape-generic except the byte budgets, which scale with the
+        # quick config and are labeled as such in the report
+        cfg = _dc.replace(
+            cfg,
+            table=_dc.replace(cfg.table, capacity=1 << 12),
+            batch=_dc.replace(cfg.batch, max_batch=256),
+        )
+    mesh = None
+    n_mesh = args.mesh
+    if n_mesh == 0:  # auto: all devices when they form a >1 pow2 mesh
+        import jax
+
+        n = len(jax.devices())
+        n_mesh = n if n > 1 and not (n & (n - 1)) else 1
+    if n_mesh > 1:
+        from flowsentryx_tpu.parallel import make_mesh
+
+        mesh = make_mesh(n_mesh)
+    rep = run_audit(cfg, mesh=mesh, mega_n=args.mega)
+    if args.out:
+        runner.write_artifact(rep, args.out)
+    if args.json:
+        print(json.dumps(rep.to_json(), indent=2))
+    else:
+        for note in rep.notes:
+            print(f"fsx audit: note: {note}")
+        for v in rep.variants:
+            if v.ok:
+                print(f"fsx audit: {v.name}: OK ({v.n_eqns} eqns, "
+                      f"steady-state D2H {v.steady_state_d2h_bytes} B "
+                      f"= [{v.wire_words}]-word wire)")
+            else:
+                print(f"fsx audit: {v.name}: FAILED", file=sys.stderr)
+                for f in v.findings:
+                    print(f"  {f}", file=sys.stderr)
+        print(f"fsx audit: {'PASS' if rep.ok else 'FAIL'}")
+    return 0 if rep.ok else 1
+
+
 def _cmd_block(args: argparse.Namespace) -> int:
     """Manually blacklist a source (reference README.md:70-74: "Block
     specified IP addresses").  v6 addresses block EXACTLY (the 16-byte
@@ -495,7 +559,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             return 1
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
                  mega_n=args.mega or 0,
-                 sink_thread=False if args.no_sink_thread else None)
+                 sink_thread=False if args.no_sink_thread else None,
+                 audit=True if args.audit else None)
     if args.restore:
         eng.restore(args.restore)
     if args.mega:
@@ -1050,6 +1115,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="machine-readable report")
     ck.set_defaults(fn=_cmd_check)
 
+    au = sub.add_parser(
+        "audit",
+        help="statically audit the staged TPU step graphs: dtypes, "
+             "donation aliasing, D2H transfer budget, retrace "
+             "stability, collectives (no batch executed)")
+    au.add_argument("--config", help="JSON config file")
+    au.add_argument("--verdict-k", type=int, default=None,
+                    help="audit with this compact-wire K (>= 1; "
+                         "default: config batch.verdict_k)")
+    au.add_argument("--mesh", type=int, default=0,
+                    help="stage the sharded variant over an N-device "
+                         "mesh (0 = auto: every visible device when "
+                         "they form a power-of-two mesh > 1)")
+    au.add_argument("--mega", type=int, default=2,
+                    help="chunk count for the staged megastep variant")
+    au.add_argument("--quick", action="store_true",
+                    help="small table/batch shapes (CI gate); the "
+                         "contracts are shape-generic, only the "
+                         "recorded byte budgets shrink")
+    au.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    au.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/AUDIT_*.json evidence file)")
+    au.set_defaults(fn=_cmd_audit)
+
     # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
     # construction never imports the bpf loader (lazy-import rule).
     DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
@@ -1132,6 +1223,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "buffer, falling back to the full [B] fetch only "
                         "on overflow; 0 = disable compaction (full fetch "
                         "every batch)")
+    s.add_argument("--audit", action="store_true",
+                   help="statically audit the serving step's graph "
+                        "contracts (dtypes/donation/transfer/retrace/"
+                        "collectives) at boot and refuse to serve on a "
+                        "violation; also on via FSX_AUDIT=1 (fsx audit "
+                        "is the standalone form)")
     s.add_argument("--no-sink-thread", action="store_true",
                    help="run the verdict sink on the dispatch thread "
                         "(the pre-threaded single-loop engine). Default "

@@ -218,12 +218,33 @@ class BatchConfig:
     #: (every batch fetches the full ``[B]`` arrays — the pre-compaction
     #: wire, kept for parity tests and measurement baselines).
     verdict_k: int = 64
+    #: Engine pipe depth: how many batches may be dispatched-but-unsunk
+    #: before the dispatch thread blocks on the sink (the backpressure
+    #: bound engine/engine.py waits on).  Must be >= 1 — a zero-depth
+    #: pipe can never dispatch, it deadlocks the loop on its first
+    #: batch.  ``Engine(readback_depth=...)`` overrides per instance.
+    readback_depth: int = 8
 
     def __post_init__(self) -> None:
         if self.max_batch <= 0 or self.deadline_us <= 0:
             raise ValueError("max_batch and deadline_us must be positive")
+        if not isinstance(self.verdict_k, int):
+            # a float K silently changes the jit cache key per config
+            # load AND miscomputes the [2K+4] wire length downstream
+            raise ValueError("verdict_k must be an int")
         if self.verdict_k < 0:
             raise ValueError("verdict_k must be >= 0 (0 disables compaction)")
+        if self.verdict_k > self.max_batch:
+            # at most max_batch flows can block per batch, so slots past
+            # that can never fill — a config asking for them is a typo'd
+            # K (or B), not a bigger wire
+            raise ValueError(
+                f"verdict_k ({self.verdict_k}) must be <= max_batch "
+                f"({self.max_batch}): a batch cannot block more flows "
+                "than it has records")
+        if self.readback_depth < 1:
+            raise ValueError("readback_depth must be >= 1 (the pipe "
+                             "needs at least one in-flight batch)")
 
 
 @dataclass(frozen=True)
@@ -278,8 +299,6 @@ class FsxConfig:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "FsxConfig":
-        import typing
-
         def dec(tp: type, v: Any) -> Any:
             origin = typing.get_origin(tp)
             if origin in (tuple, list):

@@ -98,7 +98,21 @@ class Engine:
     ``donate`` defaults to the backend capability; with donation the
     table updates in place in HBM (no 40 MB copy per batch).
     ``readback_depth`` is how many batches may be in flight before the
-    oldest verdicts are fetched and sunk.
+    oldest verdicts are fetched and sunk (``None`` = the config's
+    ``BatchConfig.readback_depth``).
+
+    ``audit`` (``None`` = on when ``FSX_AUDIT=1``) statically audits
+    the serving step's graph contracts at boot — dtypes, donation
+    aliasing, transfer budget, retrace stability, collectives
+    (:mod:`flowsentryx_tpu.audit`) — and raises rather than serve on a
+    violated contract.  Results are cached per staged shape, so a
+    fleet of engines in one process pays the audit trace once.
+
+    The engine's own host↔device boundary is EXPLICIT: batches enter
+    via ``jax.device_put`` and results leave via ``jax.device_get``,
+    so tests can run the whole loop under
+    ``jax.transfer_guard("disallow")`` and any *implicit* transfer that
+    sneaks into the hot path fails loudly in CI.
     """
 
     def __init__(
@@ -108,12 +122,13 @@ class Engine:
         sink: VerdictSink,
         params: Any | None = None,
         donate: bool | None = None,
-        readback_depth: int = 8,
+        readback_depth: int | None = None,
         t0_ns: int | None = None,
         mesh: Any | None = None,
         wire: str | None = None,
         mega_n: int = 0,
         sink_thread: bool | None = None,
+        audit: bool | None = None,
     ):
         self.cfg = cfg
         self.source = source
@@ -146,6 +161,19 @@ class Engine:
         # multi-device step (parallel/step.py) — state rows live
         # sharded across the mesh, the wire batch enters replicated.
         self.mesh = mesh if mesh is not None and mesh.devices.size > 1 else None
+        # The wire batch's device placement, made EXPLICIT (class
+        # docstring): replicated over the mesh when sharded, default
+        # device otherwise.  None = plain device_put.
+        if self.mesh is not None:
+            self._in_sharding = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec())
+        else:
+            self._in_sharding = None
+        # Params go to the device ONCE at boot.  A numpy artifact
+        # (load_artifact .npz leaves) passed straight through otherwise
+        # re-crosses the host->device link on EVERY dispatch — eight
+        # silent H2D transfers per batch of pure overhead.
+        self.params = jax.tree.map(self._put, self.params)
         # A compact-emit data plane (fsxd --compact) delivers records
         # the KERNEL already quantized to the minifloat wire: the
         # engine must speak compact16/minifloat end to end, whatever
@@ -220,7 +248,15 @@ class Engine:
                 cfg, spec.classify_batch, donate=donate
             )
             self.table = jax.device_put(schema.make_table(cfg.table.capacity))
-        self.stats = jax.device_put(schema.make_stats())
+        # _put, not bare device_put: sharded engines need the stats
+        # replicated OVER THE MESH from boot — committed to device 0
+        # they'd be implicitly resharded (a D2D transfer) on the first
+        # dispatch, which the transfer-guard contract forbids.
+        self.stats = self._put(schema.make_stats())
+        # None = the config's pipe depth (BatchConfig.readback_depth,
+        # validated >= 1 at construction); an explicit int overrides.
+        if readback_depth is None:
+            readback_depth = cfg.batch.readback_depth
         self.readback_depth = readback_depth
         # Mega-dispatch (SURVEY.md §7.4.1 brought into SERVING): when
         # the source backlog holds ≥ mega_n sealed batches, they go to
@@ -246,6 +282,21 @@ class Engine:
                     cfg, spec.classify_batch, self.mega_n, donate=donate,
                     **quant,
                 )
+        # Static graph audit at boot (class docstring): prove the
+        # serving variant's dtype/donation/transfer/retrace/collective
+        # contracts on the staged jaxpr + executable BEFORE the first
+        # batch, and refuse to serve on a violation.  Flag-gated (the
+        # audit trace+compile costs seconds) and cached per shape.
+        if audit is None:
+            import os as _os
+
+            audit = _os.environ.get("FSX_AUDIT", "").lower() in (
+                "1", "true", "on")
+        if audit:
+            from flowsentryx_tpu.audit import boot_audit
+
+            boot_audit(cfg, wire=self.wire, mesh=self.mesh,
+                       mega_n=self.mega_n, params=self.params)
         #: Sealed-but-undispatched (raw, t_seal) group candidates.
         self._pending: list[tuple[np.ndarray, float]] = []
         # Sealed-batch sources (flowsentryx_tpu/ingest/ShardedIngest)
@@ -325,11 +376,19 @@ class Engine:
 
     # -- pipeline stages ----------------------------------------------------
 
+    def _put(self, a):
+        """EXPLICIT H2D: wire buffers/params cross to the device via
+        device_put (replicated over the mesh when sharded), never as
+        implicit jit-argument transfers — the whole loop runs clean
+        under ``jax.transfer_guard("disallow")``."""
+        return (jax.device_put(a, self._in_sharding)
+                if self._in_sharding is not None else jax.device_put(a))
+
     def _dispatch(self, raw: np.ndarray, t_enqueue: float) -> None:
         n_records = int(raw[self.cfg.batch.max_batch, 0])
         with self.metrics.dispatch.time():
             self.table, self.stats, out = self.step(
-                self.table, self.stats, self.params, raw
+                self.table, self.stats, self.params, self._put(raw)
             )
         self._inflight.append(_InFlight(out, t_enqueue, n_records))
 
@@ -346,7 +405,7 @@ class Engine:
         n_records = int(sum(int(raw[b, 0]) for raw, _ in group))
         with self.metrics.dispatch.time():
             self.table, self.stats, out = self.megastep(
-                self.table, self.stats, self.params, raws
+                self.table, self.stats, self.params, self._put(raws)
             )
         self._inflight.append(
             _InFlight(out, min(t for _, t in group), n_records,
@@ -536,23 +595,25 @@ class Engine:
         # .reshape(-1) everywhere: a mega-dispatch entry carries stacked
         # [N, B] fields (now/route_drop [N]); single entries are [B]/[].
         with self.metrics.readback.time():
+            # jax.device_get, not np.asarray: the D2H boundary stays
+            # EXPLICIT (class docstring / transfer_guard contract)
             if len(group) <= 2:
                 keys = np.concatenate(
-                    [np.asarray(g.out.block_key).reshape(-1)
+                    [jax.device_get(g.out.block_key).reshape(-1)
                      for g in group]) \
                     if len(group) > 1 \
-                    else np.asarray(group[0].out.block_key).reshape(-1)
+                    else jax.device_get(group[0].out.block_key).reshape(-1)
                 untils = np.concatenate(
-                    [np.asarray(g.out.block_until).reshape(-1)
+                    [jax.device_get(g.out.block_until).reshape(-1)
                      for g in group]) \
                     if len(group) > 1 \
-                    else np.asarray(group[0].out.block_until).reshape(-1)
+                    else jax.device_get(group[0].out.block_until).reshape(-1)
             else:
-                keys = np.asarray(jnp.concatenate(
+                keys = jax.device_get(jnp.concatenate(
                     [g.out.block_key.reshape(-1) for g in group]))
-                untils = np.asarray(jnp.concatenate(
+                untils = jax.device_get(jnp.concatenate(
                     [g.out.block_until.reshape(-1) for g in group]))
-            now = float(np.max(np.asarray(group[-1].out.now)))
+            now = float(np.max(jax.device_get(group[-1].out.now)))
             self._d2h_bytes += keys.nbytes + untils.nbytes
             self._sink_fallback += len(group)
             # routing-overflow fail-opens (sharded step): single-device
@@ -568,9 +629,9 @@ class Engine:
             elif len(group) <= 2:
                 # .sum() not int(): a mega entry's route_drop is [N]
                 self._route_drop += sum(
-                    int(np.asarray(rd).sum()) for rd in rds)
+                    int(np.sum(jax.device_get(rd))) for rd in rds)
             else:
-                self._route_drop += int(np.asarray(jnp.sum(
+                self._route_drop += int(jax.device_get(jnp.sum(
                     jnp.concatenate([jnp.ravel(jnp.asarray(rd))
                                      for rd in rds]))))
         self._apply_updates(extract_updates(keys, untils), now, group)
@@ -579,9 +640,10 @@ class Engine:
         """The compact-wire sink (see :meth:`_sink_group`)."""
         with self.metrics.readback.time():
             if len(group) <= 2:
-                wires = [np.asarray(g.out.wire) for g in group]
+                wires = [jax.device_get(g.out.wire) for g in group]
             else:
-                wires = np.asarray(jnp.stack([g.out.wire for g in group]))
+                wires = jax.device_get(
+                    jnp.stack([g.out.wire for g in group]))
             parts_k: list[np.ndarray] = []
             parts_u: list[np.ndarray] = []
             now = 0.0
@@ -592,8 +654,8 @@ class Engine:
                     # K_MAX-overflow fallback: this batch condemned more
                     # flows than the wire holds — pay the full fetch
                     # once rather than lose a single block.
-                    fk = np.asarray(g.out.block_key).reshape(-1)
-                    fu = np.asarray(g.out.block_until).reshape(-1)
+                    fk = jax.device_get(g.out.block_key).reshape(-1)
+                    fu = jax.device_get(g.out.block_until).reshape(-1)
                     self._d2h_bytes += fk.nbytes + fu.nbytes
                     self._sink_fallback += 1
                     parts_k.append(fk)
@@ -735,8 +797,6 @@ class Engine:
             # flow's first batch (refill is elapsed-based, not full).
             # Occupied slots start with the full burst, matching the
             # is_new semantics their flows got on first sight.
-            import jax.numpy as jnp
-
             table = table.with_columns(tok_bytes=jnp.where(
                 table.key != 0,
                 jnp.float32(self.cfg.limiter.bucket_burst_bytes), 0.0))
@@ -760,7 +820,9 @@ class Engine:
             from flowsentryx_tpu import parallel as par
 
             table = par.shard_table(table, self.mesh)
-        self.table, self.stats = table, stats
+        # restored stats re-enter through _put for the same replication
+        # reason as the boot-time make_stats()
+        self.table, self.stats = table, self._put(stats)
         self.batcher.t0_ns = t0_ns
         self._t0_auto = False
         if hasattr(self.sink, "t0_ns"):
@@ -1013,7 +1075,8 @@ class Engine:
                 if self.sink_thread else None),
         }
 
-        st = schema.GlobalStats(*self.stats)
+        # explicit D2H for the report counters (transfer-guard contract)
+        st = schema.GlobalStats(*jax.device_get(tuple(self.stats)))
         return EngineReport(
             batches=self.batcher.batches_emitted,
             records=self.batcher.records_emitted,

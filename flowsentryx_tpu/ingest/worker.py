@@ -123,8 +123,13 @@ def worker_main(spec: dict) -> None:
             raise ValueError(
                 "compact-emit ring shard requires the compact16 wire"
             )
+        # verdict_k=0: the worker-side config only drives the
+        # micro-batcher (fill/deadline); the verdict wire is an
+        # engine-side device concern, and the default K could exceed a
+        # small max_batch and fail BatchConfig validation here.
         cfg = BatchConfig(
-            max_batch=spec["max_batch"], deadline_us=spec["deadline_us"]
+            max_batch=spec["max_batch"], deadline_us=spec["deadline_us"],
+            verdict_k=0,
         )
         poll_chunk = 2 * cfg.max_batch
         emitter = None

@@ -32,7 +32,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from flowsentryx_tpu.core.schema import NUM_FEATURES, IpTableState
+from flowsentryx_tpu.core.schema import NUM_FEATURES, IpTableState, TableCol
 from flowsentryx_tpu.models.logreg import LogRegParams
 
 
@@ -191,6 +191,17 @@ def _table_summary_device(
     return counts, jnp.max(lanes[3])
 
 
+@functools.partial(jax.jit, static_argnames=("stale_s", "use_pallas"))
+def _table_summary(key, state, now, stale_s, use_pallas):
+    """Column extraction + dispatch under ONE jit, so the host-side
+    caller never materializes slice constants eagerly (the engine's
+    transfer-guard contract)."""
+    blocked_until = state[..., int(TableCol.BLOCKED_UNTIL)]
+    last_seen = state[..., int(TableCol.LAST_SEEN)]
+    fn = _table_summary_device if use_pallas else _table_summary_xla
+    return fn(key, blocked_until, last_seen, now, stale_s)
+
+
 @functools.partial(jax.jit, static_argnames=("stale_s",))
 def _table_summary_xla(key, blocked_until, last_seen, now, stale_s):
     """XLA twin of the summary kernel (correctness oracle + fallback)."""
@@ -216,18 +227,29 @@ def table_summary(
     to the host.  Tables smaller than one kernel chunk (or misaligned)
     fall back to the XLA-composed reduction — same answer, no Pallas.
     """
-    if table.capacity % _CHUNK:
-        fn = _table_summary_xla
+    # device_put, not jnp.float32: the clock scalar's H2D hop stays an
+    # EXPLICIT transfer, so report building runs clean under
+    # jax.transfer_guard("disallow") (the engine's CI guard); same for
+    # the result fetch below.  Column extraction happens INSIDE the jit
+    # (_table_summary) for the same reason — the eager column-view
+    # properties materialize their slice indices host-side.  A SHARDED
+    # table needs the scalar replicated over its mesh up front, or the
+    # jit reshards it (an implicit D2D hop) on entry.
+    sh = getattr(table.key, "sharding", None)
+    if isinstance(sh, jax.sharding.NamedSharding):
+        dst = jax.sharding.NamedSharding(sh.mesh,
+                                         jax.sharding.PartitionSpec())
+        now_dev = jax.device_put(np.float32(now), dst)
     else:
-        fn = _table_summary_device
-    counts, newest = fn(
-        table.key, table.blocked_until, table.last_seen,
-        jnp.float32(now), float(stale_s),
+        now_dev = jax.device_put(np.float32(now))
+    counts, newest = _table_summary(
+        table.key, table.state, now_dev,
+        float(stale_s), use_pallas=not table.capacity % _CHUNK,
     )
-    counts = np.asarray(counts)
+    counts = jax.device_get(counts)
     return {
         "tracked": int(counts[0]),
         "blocked": int(counts[1]),
         "stale": int(counts[2]),
-        "newest_seen_s": float(newest),
+        "newest_seen_s": float(jax.device_get(newest)),
     }

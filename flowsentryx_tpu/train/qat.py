@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 from flowsentryx_tpu.core.schema import NUM_FEATURES
 from flowsentryx_tpu.models.logreg import LogRegParams, make_params
@@ -262,8 +263,6 @@ def train_logreg_qat_dp(
     """
     axis = mesh.axis_names[0]
     n_dev = int(mesh.devices.size)
-    from jax.sharding import PartitionSpec as P
-
     X = jnp.asarray(X, jnp.float32)
     if log_features:
         X = jnp.log1p(X)

@@ -10,11 +10,19 @@ Stages, in order; the gate fails if any stage fails:
 2. **unused imports** — an AST pass with the same contract as
    pyflakes F401 (``# noqa`` lines and ``__init__.py`` re-exports are
    exempt).  Runs everywhere, even without ruff.
-3. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+3. **local imports** — an AST pass over function bodies that bans the
+   duplicated-local-import pattern: a function-local ``import jax`` /
+   ``import jax.numpy`` in a module that ALREADY imports jax at module
+   level (lazy-importing jax in a jax-free module stays legal — that
+   is the CLI's multi-second-boot defense), and any local import that
+   shadows a name a module-level import bound (the drift PR 3 had to
+   clean out of the engine's sink paths by hand).  ``# noqa`` exempts
+   a line.
+4. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-2 there.
-4. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-3 there.
+5. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -111,6 +119,75 @@ def stage_unused_imports() -> list[str]:
     return fails
 
 
+def _import_bindings(node: ast.Import | ast.ImportFrom):
+    """``(bound name, root module)`` pairs one import statement binds."""
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            yield a.asname or a.name.split(".")[0], a.name.split(".")[0]
+    else:
+        if node.module is None or node.level:  # relative: no root claim
+            root = ""
+        else:
+            root = node.module.split(".")[0]
+        for a in node.names:
+            if a.name != "*":
+                yield a.asname or a.name, root
+
+
+def _local_import_findings(path: Path) -> list[str]:
+    """The duplicated-local-import findings for one module (stage 3
+    docstring: jax re-imports under a module-level jax import, and
+    local imports shadowing module-level import bindings)."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    module_binds: dict[str, int] = {}
+    module_has_jax = False
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for name, root in _import_bindings(node):
+                module_binds[name] = node.lineno
+                module_has_jax |= root == "jax"
+    out = []
+    seen: set[int] = set()  # nested defs re-walk their imports: dedupe
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if (not isinstance(node, (ast.Import, ast.ImportFrom))
+                    or id(node) in seen):
+                continue
+            seen.add(id(node))
+            line = (lines[node.lineno - 1]
+                    if node.lineno <= len(lines) else "")
+            if "noqa" in line:
+                continue
+            rel = path.relative_to(REPO)
+            for name, root in _import_bindings(node):
+                if root == "jax" and module_has_jax:
+                    out.append(
+                        f"{rel}:{node.lineno}: function-local jax "
+                        f"import ({name!r}) duplicates this module's "
+                        "module-level jax import — hoist it")
+                elif name in module_binds:
+                    out.append(
+                        f"{rel}:{node.lineno}: local import shadows "
+                        f"module-level import {name!r} (line "
+                        f"{module_binds[name]})")
+    return out
+
+
+def stage_local_imports() -> list[str]:
+    fails = []
+    for tree in PY_TREES:
+        for path in sorted((REPO / tree).rglob("*.py")):
+            fails.extend(_local_import_findings(path))
+    return fails
+
+
 def _run_tool(cmd: list[str]) -> list[str]:
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
     if r.returncode == 0:
@@ -140,6 +217,7 @@ def main(argv: list[str] | None = None) -> int:
     stages: dict[str, list[str] | None] = {
         "syntax": stage_syntax(),
         "unused_imports": stage_unused_imports(),
+        "local_imports": stage_local_imports(),
         "ruff": stage_ruff(),
         "mypy": stage_mypy(),
     }

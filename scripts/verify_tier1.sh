@@ -9,7 +9,13 @@
 #            C-plane sanitizer stage first: the daemon's TSAN shm-ring
 #            torture plus ASan/UBSan builds+runs of kern/host_test,
 #            kern/prop_driver and an fsxd --sim smoke)
-# Exit code: pytest's (a sanitizer-stage failure exits early).  Prints
+# Always-on pre-stages (each failure exits early, before pytest):
+#   * scripts/lint.py — syntax, unused-import, local-import gates
+#   * fsx audit       — static dtype/donation/transfer/retrace/
+#     collective contracts over every staged step variant (8 virtual
+#     CPU devices so the sharded variant stages too); writes the
+#     machine-readable artifacts/AUDIT_r08.json byte-budget artifact
+# Exit code: pytest's (a pre-stage failure exits early).  Prints
 # DOTS_PASSED=<n> as a tamper-evident passed-test count derived from
 # the progress dots, not the summary.
 set -u
@@ -46,5 +52,13 @@ if [ "${1:-}" = "--sanitizers" ]; then
     rm -f /tmp/fsx_t1_asan_ring /tmp/fsx_t1_asan_verdicts
     echo "== sanitizers: all clean =="
 fi
+
+echo "== lint gate (scripts/lint.py) =="
+python scripts/lint.py || exit 1
+
+echo "== fsx audit: static step-graph contracts (docs/AUDIT.md) =="
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
+    --out artifacts/AUDIT_r08.json || exit 1
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

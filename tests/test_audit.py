@@ -1,0 +1,300 @@
+"""Static auditor (`fsx audit`) tests.
+
+Acceptance: every step variant the engine can serve — raw48, compact16,
+sharded, megastep — stages clean under the five graph contracts, and
+the compact step's steady-state D2H is *statically* reported as exactly
+``(2*verdict_k + 4) * 4`` bytes.
+
+Negatives mirror tests/test_verifier.py's table-driven planted-defect
+style: a planted f64 leak, a dropped donation, a hidden io_callback,
+and a forced retrace must each be rejected with a diagnostic naming the
+offending equation / output / input.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import enable_x64, io_callback
+from jax.sharding import PartitionSpec as P
+
+from flowsentryx_tpu.audit import graph, runner
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+from flowsentryx_tpu.models import get_model
+from flowsentryx_tpu.ops import fused
+from flowsentryx_tpu.parallel import make_mesh
+
+CFG = FsxConfig(
+    table=TableConfig(capacity=1 << 12),
+    batch=BatchConfig(max_batch=256, verdict_k=16),
+)
+
+
+@pytest.fixture(scope="module")
+def report():
+    """One full audit over all four variants (module-cached: staging
+    is the expensive part, the assertions below are reads)."""
+    return runner.run_audit(CFG, mesh=make_mesh(8), mega_n=2)
+
+
+class TestAcceptance:
+    def test_all_variants_pass(self, report):
+        assert report.ok, [str(f) for v in report.variants
+                           for f in v.findings]
+        assert [v.name for v in report.variants] == [
+            "raw", "compact", "sharded", "megastep",
+            "sharded_megastep"]
+
+    def test_steady_state_d2h_is_exactly_the_wire(self, report):
+        want = (2 * CFG.batch.verdict_k + 4) * 4
+        for v in report.variants:
+            assert v.wire_words == 2 * CFG.batch.verdict_k + 4, v.name
+            assert v.steady_state_d2h_bytes == want, v.name
+            wire = [o for o in v.outputs if o["name"] == "out.wire"]
+            assert wire and wire[0]["dtype"] == "uint32"
+
+    def test_default_k_reports_528_bytes(self):
+        """The PR 3 headline number, pinned statically: K=64 → 528 B."""
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
+                        batch=BatchConfig(max_batch=256, verdict_k=64))
+        rep = runner.run_audit(cfg, variants=("compact",))
+        assert rep.ok
+        assert rep.variants[0].steady_state_d2h_bytes == 528
+
+    def test_donation_proved_on_every_variant(self, report):
+        for v in report.variants:
+            assert v.donation["checked"], v.name
+            # sharded variants donate the table only (stats replicate)
+            need = (2 if v.name.startswith("sharded")
+                    else len(runner.CARRY_NAMES))
+            assert v.donation["required"] == runner.CARRY_NAMES[:need]
+            assert set(range(need)) <= set(v.donation["aliased_params"]), (
+                v.name)
+
+    def test_sharded_collectives_are_the_designed_set(self, report):
+        coll = {v.name: v.collectives for v in report.variants}
+        for name in ("raw", "compact", "megastep"):
+            assert coll[name] == {}, name  # single-device: none at all
+        for name in ("sharded", "sharded_megastep"):
+            sh = coll[name]
+            assert sh["all_to_all"] == 2   # partials out, verdicts back
+            assert sh["all_gather"] == 2   # wire keys + untils, K each
+            assert set(sh) <= graph.EXPECTED_COLLECTIVES, name
+
+    def test_no_f64_and_quantized_lane_present(self, report):
+        for v in report.variants:
+            assert not any(d.startswith(("float64", "complex"))
+                           for d in v.dtypes), v.name
+            assert "uint8" in v.dtypes  # the packed verdict lane
+
+    def test_boot_audit_caches_per_shape(self):
+        runner._BOOT_CACHE.clear()
+        rep = runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
+                                mega_n=0)
+        assert rep is not None and rep.ok
+        assert runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
+                                 mega_n=0) is None  # cache hit
+
+    def test_report_json_shape(self, report):
+        d = report.to_json()
+        assert d["ok"] is True
+        assert d["config"]["verdict_k"] == CFG.batch.verdict_k
+        v0 = d["variants"][0]
+        assert {"name", "ok", "findings", "outputs",
+                "steady_state_d2h_bytes", "donation",
+                "collectives"} <= set(v0)
+
+
+def _staged(fn, *example_args):
+    return jax.jit(fn).trace(*example_args).jaxpr
+
+
+class TestNegatives:
+    """Planted defects, each caught with an instruction-level
+    diagnostic (the `fsx check` rejection idiom on the TPU plane)."""
+
+    def test_planted_f64_leak(self):
+        def leaky(x):
+            # the classic: a python float promotes the lane to f64
+            return (x.astype(jnp.float64) * 2.0).sum().astype(jnp.float32)
+
+        with enable_x64():
+            closed = _staged(leaky, np.ones((8,), np.float32))
+        finds = graph.check_dtypes(closed)
+        assert finds
+        f = finds[0]
+        assert f.contract == "dtype"
+        assert "float64" in f.reason
+        assert "eqns[" in f.where and f.eqn  # names the offending eqn
+
+    def test_dropped_donation(self):
+        spec = get_model(CFG.model.name)
+        step = fused.make_jitted_raw_step(CFG, spec.classify_batch,
+                                          donate=False)  # the defect
+        traced = step.trace(
+            schema.make_table(CFG.table.capacity), schema.make_stats(),
+            spec.init(),
+            np.zeros((CFG.batch.max_batch + 1, schema.RECORD_WORDS),
+                     np.uint32))
+        hlo = traced.lower().compile().as_text()
+        finds, info = graph.check_donation(
+            hlo, runner.CARRY_NAMES,
+            list(traced.jaxpr.in_avals)[:len(runner.CARRY_NAMES)],
+            n_inputs=len(traced.jaxpr.in_avals))
+        assert finds
+        assert finds[0].contract == "donation"
+        # diagnostic names the buffer that would be silently copied
+        assert any(f.where == "table.state" for f in finds)
+        tbl = next(f for f in finds if f.where == "table.state")
+        assert "input_output_alias" in tbl.reason
+
+    def test_hidden_io_callback(self):
+        def bad(x):
+            y = io_callback(lambda v: np.float32(np.sum(v)),
+                            jax.ShapeDtypeStruct((), jnp.float32), x)
+            return x + y
+
+        closed = _staged(bad, np.ones((8,), np.float32))
+        finds = graph.check_callbacks(closed)
+        assert finds
+        assert finds[0].contract == "transfer"
+        assert "io_callback" in finds[0].reason
+        assert "eqns[" in finds[0].where and finds[0].eqn
+
+    def test_hidden_debug_print(self):
+        def bad(x):
+            jax.debug.print("score {s}", s=x.sum())
+            return x * 2
+
+        finds = graph.check_callbacks(_staged(bad, np.ones((8,),
+                                                           np.float32)))
+        assert finds and "callback" in finds[0].reason
+
+    def test_forced_retrace(self):
+        j = jax.jit(lambda x: x * 2)
+        drift = iter([np.float32, np.int32])  # dtype wobble per batch
+
+        def mk():
+            return (np.zeros((8,), next(drift)),)
+
+        finds, _ = graph.staging_cache_check(
+            j, mk, arg_names=lambda i: f"batch[{i}]")
+        assert finds
+        f = finds[0]
+        assert f.contract == "retrace"
+        assert "recompile" in f.reason
+        assert "batch[0]" in f.reason  # names the drifting input
+        assert "float32[8]" in f.reason and "int32[8]" in f.reason
+
+    def test_stable_staging_is_quiet(self):
+        j = jax.jit(lambda x: x * 2)
+        finds, traced = graph.staging_cache_check(
+            j, lambda: (np.zeros((8,), np.float32),))
+        assert finds == [] and traced is not None
+
+    def test_carry_aval_drift(self):
+        # weak-typed carry out vs strong carry in: retraces on batch 2
+        closed = _staged(lambda s: jnp.asarray(1.0),
+                         np.zeros((), np.float32))
+        finds = graph.check_carry_avals(closed, 1, ["stats.allowed"])
+        assert finds
+        assert finds[0].contract == "retrace"
+        assert finds[0].where == "stats.allowed"
+
+    def test_unexpected_collective(self):
+        # a [B]-sized all_gather is exactly the accidental-traffic case
+        mesh = make_mesh(8)
+        from flowsentryx_tpu.parallel.mesh import shard_map
+
+        def body(x):
+            return jax.lax.all_gather(x, "ip").sum(axis=0)
+
+        f = shard_map(body, mesh=mesh, in_specs=P("ip"), out_specs=P("ip"),
+                      check_vma=False)
+        closed = _staged(f, np.zeros((256,), np.float32))
+        finds, _ = graph.check_collectives(closed, verdict_k=16,
+                                           expect_sharded=True)
+        assert finds
+        assert finds[0].contract == "collectives"
+        assert "all_gather" in finds[0].where or "all_gather" in finds[0].eqn
+
+    def test_mega_zero_skips_megastep_cleanly(self):
+        # operator typo (`fsx audit --mega 0`) must degrade to a noted
+        # skip, never a zero-size-scan staging crash
+        rep = runner.run_audit(CFG, mega_n=0, variants=None)
+        assert {v.name for v in rep.variants} == {"raw", "compact"}
+        assert any("mega" in n for n in rep.notes)
+        with pytest.raises(ValueError, match="mega_n"):
+            runner.run_audit(CFG, mega_n=0, variants=("megastep",))
+
+    def test_boot_cache_keys_on_params_signature(self):
+        """A different artifact (other leaf dtypes/shapes) is a
+        DIFFERENT staged graph: the boot cache must not serve engine B
+        a stale pass from engine A's params."""
+        runner._BOOT_CACHE.clear()
+        spec = get_model(CFG.model.name)
+        assert runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
+                                 mega_n=0, params=spec.init()) is not None
+        # same params signature → cache hit
+        assert runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
+                                 mega_n=0, params=spec.init()) is None
+        # params=None (model default marker) → distinct key, re-audits
+        assert runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
+                                 mega_n=0) is not None
+
+    def test_verdict_k_zero_fails_transfer_contract(self):
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
+                        batch=BatchConfig(max_batch=256, verdict_k=0))
+        rep = runner.run_audit(cfg, variants=("raw",))
+        assert not rep.ok
+        assert any(f.contract == "transfer" and "verdict_k" in f.reason
+                   for f in rep.variants[0].findings)
+
+
+class TestEngineBoot:
+    def test_engine_refuses_to_serve_on_violated_contract(self):
+        """`Engine(audit=True)` is a boot-time gate, not a log line: a
+        config whose steady-state D2H is NOT the compact wire
+        (verdict_k=0, the full-[B]-fetch mode) fails the transfer
+        contract before the first batch."""
+        from flowsentryx_tpu.audit.graph import AuditError
+        from flowsentryx_tpu.core.schema import FLOW_RECORD_DTYPE
+        from flowsentryx_tpu.engine import ArraySource, Engine, NullSink
+
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
+                        batch=BatchConfig(max_batch=256, verdict_k=0))
+        src = ArraySource(np.zeros(0, FLOW_RECORD_DTYPE))
+        with pytest.raises(AuditError, match="verdict_k"):
+            Engine(cfg, src, NullSink(), sink_thread=False, audit=True)
+
+    def test_engine_boots_with_audit_on_clean_config(self):
+        from flowsentryx_tpu.core.schema import FLOW_RECORD_DTYPE
+        from flowsentryx_tpu.engine import ArraySource, Engine, NullSink
+
+        eng = Engine(CFG, ArraySource(np.zeros(0, FLOW_RECORD_DTYPE)),
+                     NullSink(), sink_thread=False, audit=True)
+        # second engine on the same shape hits the boot-audit cache
+        Engine(CFG, ArraySource(np.zeros(0, FLOW_RECORD_DTYPE)),
+               NullSink(), sink_thread=False, audit=True)
+        assert eng.verdict_k == CFG.batch.verdict_k
+
+
+class TestCli:
+    def test_fsx_audit_cli_json(self, capsys):
+        import json
+
+        from flowsentryx_tpu.cli import main
+
+        rc = main(["audit", "--quick", "--mesh", "8", "--mega", "2",
+                   "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"] is True
+        names = {v["name"] for v in out["variants"]}
+        assert names == {"raw", "compact", "sharded", "megastep",
+                         "sharded_megastep"}
+        # --quick keeps the config's K, so the headline byte budget
+        # still pins: (2*64+4)*4 = 528
+        assert all(v["steady_state_d2h_bytes"] == 528
+                   for v in out["variants"])

@@ -79,8 +79,6 @@ class TestCheckpoint:
         column) must restore occupied slots with FULL byte credit under
         a byte-limited config — zero credit would spuriously rate-block
         every restored flow's first batch."""
-        import numpy as np
-
         from flowsentryx_tpu.core.config import LimiterKind
 
         cfg = FsxConfig(

@@ -110,6 +110,31 @@ class TestConfig:
         with pytest.raises(ValueError):
             BatchConfig(max_batch=0)
 
+    def test_batch_config_verdict_k_and_depth_validation(self):
+        """The wire/pipe knobs reject nonsense at CONSTRUCTION (the
+        vote_k/capacity idiom) — not deep inside the first dispatch."""
+        with pytest.raises(ValueError, match="verdict_k"):
+            BatchConfig(verdict_k=-1)
+        # slots past max_batch can never fill: a batch cannot block
+        # more flows than it has records
+        with pytest.raises(ValueError, match="max_batch"):
+            BatchConfig(max_batch=128, verdict_k=256)
+        with pytest.raises(ValueError, match="int"):
+            BatchConfig(verdict_k=64.0)
+        with pytest.raises(ValueError, match="readback_depth"):
+            BatchConfig(readback_depth=0)
+        with pytest.raises(ValueError, match="readback_depth"):
+            BatchConfig(readback_depth=-3)
+        # the documented modes stay constructible: 0 = compaction off,
+        # K = max_batch is the exhaustive wire
+        assert BatchConfig(verdict_k=0).verdict_k == 0
+        assert BatchConfig(max_batch=128, verdict_k=128).verdict_k == 128
+        assert BatchConfig().readback_depth == 8
+        # and the new field rides the JSON round-trip like every other
+        cfg = FsxConfig.from_json(
+            FsxConfig(batch=BatchConfig(readback_depth=3)).to_json())
+        assert cfg.batch.readback_depth == 3
+
     def test_pack_kernel_config(self):
         blob = DEFAULT_CONFIG.pack_kernel_config()
         assert len(blob) == FsxConfig.KERNEL_CONFIG_SIZE == 88
@@ -129,9 +154,6 @@ class TestConfig:
     def test_firewall_rules_config(self):
         """RuleConfig packing, validation, and JSON round-trip (the
         reference's planned config-file firewall, README.md:70-74)."""
-        import pytest
-
-        from flowsentryx_tpu.core import schema
         from flowsentryx_tpu.core.config import RuleConfig
 
         cfg = FsxConfig(rules=(

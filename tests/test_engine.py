@@ -123,8 +123,6 @@ class TestBatcher:
         )
 
     def test_compact_wire_rejects_long_deadline(self):
-        import pytest
-
         with pytest.raises(ValueError, match="65 ms"):
             MicroBatcher(BatchConfig(max_batch=64, deadline_us=100_000),
                          wire=schema.WIRE_COMPACT16)
@@ -160,7 +158,7 @@ class TestBatcher:
         dt fields batch-relative and monotone."""
         import time as _time
 
-        mb = MicroBatcher(BatchConfig(max_batch=32, deadline_us=10**4),
+        mb = MicroBatcher(BatchConfig(max_batch=32, deadline_us=10**4, verdict_k=32),
                           wire=schema.WIRE_COMPACT16,
                           quant=dict(feat_mode="minifloat"))
         now = _time.clock_gettime_ns(_time.CLOCK_MONOTONIC)
@@ -188,10 +186,7 @@ class TestBatcher:
         decisions — flood sources blocked, benign untouched."""
         import time as _time
 
-        from flowsentryx_tpu.core.config import (
-            FsxConfig, LimiterConfig, TableConfig,
-        )
-        from flowsentryx_tpu.engine import CollectSink, Engine
+        from flowsentryx_tpu.core.config import LimiterConfig
 
         class PrecompactSource:
             precompact = True
@@ -249,7 +244,7 @@ class TestBatcher:
     def test_buffer_reuse_masks_stale_tail(self):
         """A short batch reusing a buffer that previously held a full one
         must mask the stale tail via n_valid."""
-        mb = MicroBatcher(BatchConfig(max_batch=32, deadline_us=10**6))
+        mb = MicroBatcher(BatchConfig(max_batch=32, deadline_us=10**6, verdict_k=32))
         gen = TrafficGen(TrafficSpec(seed=4))
         # cycle through all buffers once with full batches
         for _ in range(mb.n_buffers):
@@ -1023,3 +1018,63 @@ class TestPacedLatency:
         # would time-shift every persisted expiry (engine.reset_stream)
         assert eng.batcher.t0_ns == t0_anchor
         assert eng._t0_auto is False
+
+
+class TestTransferGuard:
+    """The engine's host↔device boundary is EXPLICIT (device_put in,
+    device_get out), so the whole serving loop — dispatch, sink,
+    report — runs under ``jax.transfer_guard("disallow")``.  Any
+    *implicit* transfer someone later leaks into the hot path (a numpy
+    arg to the jit, a host scalar materializing on device, a stray
+    ``int(device_scalar)``) fails these tests in CI rather than
+    silently costing a sync per batch in production."""
+
+    @staticmethod
+    def _recs(n, seed=23):
+        return TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=8, attack_fraction=0.8, seed=seed)
+        ).next_records(n)
+
+    def test_loop_clean_under_disallow_guard(self):
+        import jax
+
+        cfg = small_cfg(batch=256, pps_threshold=200.0,
+                        bps_threshold=1e9)
+        recs = self._recs(256 * 16)
+        sink = CollectSink()
+        eng = Engine(cfg, ArraySource(recs), sink, sink_thread=False)
+        with jax.transfer_guard("disallow"):
+            rep = eng.run()
+        assert rep.records == len(recs)
+        assert len(sink.blocked) > 0        # verdicts really flowed
+        assert rep.table["tracked"] > 0     # report built under guard
+
+    def test_sharded_loop_clean_under_disallow_guard(self):
+        import jax
+
+        from flowsentryx_tpu.parallel import make_mesh
+
+        cfg = small_cfg(batch=256, pps_threshold=200.0,
+                        bps_threshold=1e9)
+        recs = self._recs(256 * 16)
+        sink = CollectSink()
+        eng = Engine(cfg, ArraySource(recs), sink, sink_thread=False,
+                     mesh=make_mesh(8))
+        with jax.transfer_guard("disallow"):
+            rep = eng.run()
+        assert rep.records == len(recs)
+        assert len(sink.blocked) > 0
+
+    def test_engine_readback_depth_defaults_from_config(self):
+        cfg = small_cfg(batch=256)
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, batch=dataclasses.replace(cfg.batch, readback_depth=3))
+        eng = Engine(cfg, ArraySource(self._recs(256)), NullSink(),
+                     sink_thread=False)
+        assert eng.readback_depth == 3
+        eng2 = Engine(cfg, ArraySource(self._recs(256)), NullSink(),
+                      sink_thread=False, readback_depth=5)
+        assert eng2.readback_depth == 5  # explicit arg still wins

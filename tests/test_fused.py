@@ -1,6 +1,7 @@
 """End-to-end tests of the fused micro-batch step (single device)."""
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -200,8 +201,6 @@ class TestFusedStep:
         counts, and repeat batches against evolving table state."""
         import dataclasses
 
-        from flowsentryx_tpu.core.schema import FeatureBatch, make_stats, make_table
-        from flowsentryx_tpu.models import get_model
         from flowsentryx_tpu.ops import agg as agg_mod
         from flowsentryx_tpu.ops import fused as fused_mod
 
@@ -214,8 +213,6 @@ class TestFusedStep:
                                           donate=False)
 
         def legacy_step(table, stats, batch):
-            import jax.numpy as jnp
-
             fa = agg_mod.aggregate(batch.key, batch.pkt_len, batch.ts,
                                    batch.valid)
             now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
@@ -275,8 +272,6 @@ class TestFusedStep:
         import dataclasses
 
         from flowsentryx_tpu.core import schema
-        from flowsentryx_tpu.core.schema import make_stats, make_table
-        from flowsentryx_tpu.models import get_model
 
         cfg = dataclasses.replace(
             CFG, table=TableConfig(capacity=1 << 10),
@@ -452,8 +447,6 @@ class TestCompactWire:
         return buf
 
     def test_model_mode_bit_exact_verdicts(self, rng):
-        import jax
-
         from flowsentryx_tpu.core import schema
 
         buf = self._records(rng)
@@ -484,8 +477,6 @@ class TestCompactWire:
         )
 
     def test_field_fidelity(self, rng):
-        import jax
-
         from flowsentryx_tpu.core import schema
 
         buf = self._records(rng)
@@ -527,8 +518,6 @@ class TestCompactWire:
         assert rel.max() <= 0.0625 + 1e-9
 
     def test_log1p_artifact_roundtrip(self, rng):
-        import jax
-
         from flowsentryx_tpu.core import schema
         from flowsentryx_tpu.models import logreg
 

@@ -1,0 +1,90 @@
+"""The lint gate's AST stages (scripts/lint.py) — above all the
+local-import stage the PR-3 cleanup motivated: function-local jax
+imports under a module-level jax import, and locals shadowing
+module-level import bindings."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "fsx_lint", Path(__file__).resolve().parents[1] / "scripts" / "lint.py")
+lint = importlib.util.module_from_spec(_spec)
+sys.modules["fsx_lint"] = lint
+_spec.loader.exec_module(lint)
+
+
+def _findings(tmp_path, src):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    # _local_import_findings reports paths relative to the repo root;
+    # point it at the temp module directly
+    old = lint.REPO
+    lint.REPO = tmp_path
+    try:
+        return lint._local_import_findings(p)
+    finally:
+        lint.REPO = old
+
+
+class TestLocalImportStage:
+    def test_local_jax_under_module_jax_flagged(self, tmp_path):
+        out = _findings(tmp_path, (
+            "import jax.numpy as jnp\n\n"
+            "def f():\n"
+            "    import jax\n"
+            "    return jax.devices()\n"))
+        assert len(out) == 1
+        assert "function-local jax import" in out[0]
+        assert "mod.py:4" in out[0]
+
+    def test_shadowing_local_import_flagged(self, tmp_path):
+        out = _findings(tmp_path, (
+            "from flowsentryx_tpu.core import schema\n\n"
+            "def f():\n"
+            "    from flowsentryx_tpu.core import schema\n"
+            "    return schema\n"))
+        assert len(out) == 1
+        assert "shadows module-level import 'schema'" in out[0]
+
+    def test_lazy_jax_in_jax_free_module_allowed(self, tmp_path):
+        # the CLI idiom: jax-free module lazily imports jax in the one
+        # command that needs it — NOT a finding
+        out = _findings(tmp_path, (
+            "import argparse\n\n"
+            "def serve():\n"
+            "    import jax\n"
+            "    return jax.devices()\n"))
+        assert out == []
+
+    def test_noqa_exempts(self, tmp_path):
+        out = _findings(tmp_path, (
+            "import jax\n\n"
+            "def f():\n"
+            "    import jax  # noqa: deliberate re-import\n"
+            "    return jax\n"))
+        assert out == []
+
+    def test_nested_function_reported_once(self, tmp_path):
+        out = _findings(tmp_path, (
+            "import jax\n\n"
+            "def outer():\n"
+            "    def inner():\n"
+            "        import jax.numpy as jnp\n"
+            "        return jnp\n"
+            "    return inner\n"))
+        assert len(out) == 1  # not duplicated by the nested-def walk
+
+    def test_module_level_conditional_import_not_flagged(self, tmp_path):
+        # mesh.py's version-portability idiom: module-level try/if
+        # imports are module-level, not function-local
+        out = _findings(tmp_path, (
+            "import jax\n"
+            "if hasattr(jax, 'shard_map'):\n"
+            "    from jax import shard_map\n"
+            "else:\n"
+            "    from jax.experimental.shard_map import shard_map\n"))
+        assert out == []
+
+    def test_repo_is_clean(self):
+        assert lint.stage_local_imports() == []

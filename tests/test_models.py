@@ -159,8 +159,6 @@ class TestMulticlass:
     serving contract, attribution, artifact roundtrip, engine serve."""
 
     def test_binary_contract_and_probs(self):
-        import jax
-
         from flowsentryx_tpu.models import multiclass as mc
 
         params = mc.init_params(jax.random.PRNGKey(1))
@@ -191,8 +189,6 @@ class TestMulticlass:
         assert rep["macro_f1"] > 0.6
 
     def test_artifact_roundtrip(self, tmp_path):
-        import jax
-
         from flowsentryx_tpu.models import multiclass as mc
 
         params = mc.init_params(jax.random.PRNGKey(3))
@@ -228,9 +224,7 @@ class TestMulticlass:
 
 class TestArtifactLoader:
     def test_load_artifact_dispatches_by_family(self, tmp_path):
-        import numpy as np
-
-        from flowsentryx_tpu.models import logreg, multiclass
+        from flowsentryx_tpu.models import multiclass
         from flowsentryx_tpu.models.registry import load_artifact
 
         p = logreg.golden_params()
@@ -239,14 +233,10 @@ class TestArtifactLoader:
             q = load_artifact(fam, path)
             np.testing.assert_array_equal(np.asarray(q.w_int8),
                                           np.asarray(p.w_int8))
-        import jax
-
         mp = multiclass.init_params(jax.random.PRNGKey(0))
         mpath = multiclass.save_params(mp, str(tmp_path / "mc"))
         q = load_artifact("multiclass", mpath)
         np.testing.assert_array_equal(np.asarray(q.w1), np.asarray(mp.w1))
-        import pytest
-
         with pytest.raises(KeyError):
             load_artifact("nope", path)
 
@@ -254,9 +244,6 @@ class TestArtifactLoader:
         """The committed retrained artifact (what `fsx serve --artifact`
         deploys) must actually flag flood features the golden params
         miss — the operational point of the flag."""
-        import numpy as np
-
-        from flowsentryx_tpu.models import logreg
         from flowsentryx_tpu.models.registry import load_artifact
 
         art = load_artifact("logreg_int8", "artifacts/logreg_int8.npz")

@@ -267,8 +267,7 @@ class TestFixture:
         assert n_impl.min() > 0.9
 
     def test_learnable_and_pipeline_roundtrip(self):
-        from flowsentryx_tpu.train import data, evaluate, fixture, qat
-        from flowsentryx_tpu.models import logreg
+        from flowsentryx_tpu.train import fixture
 
         X, y = fixture.cicids_fixture(n=30_000, seed=2)
         Xtr, Xte, ytr, yte = data.train_test_split(X, y)
