@@ -402,9 +402,21 @@ def phase_throughput(side: Sidecar, deadline_rel: float) -> dict:
         mega = _fused.make_jitted_compact_megastep(
             cfg, spec.classify_batch, n_chunks=n_mega, donate=True,
             **quant_m)
-        stacked = [np.stack([raws[(g * n_mega + i) % len(raws)]
-                             for i in range(n_mega)])
-                   for g in range(4)]
+        # groups staged in a page-aligned dispatch arena, exactly like
+        # the serving engine's zero-copy pipeline: the timed device_put
+        # below reads DMA-able memory, not an ad-hoc np.stack
+        # allocation (jax-free import: engine/arena.py is numpy+mmap)
+        from flowsentryx_tpu.engine.arena import DispatchArena
+
+        arena = DispatchArena(slots=4, group_max=n_mega,
+                              max_batch=cfg.batch.max_batch,
+                              words=schema.COMPACT_RECORD_WORDS)
+        stacked = []
+        for g in range(4):
+            rows = arena.rows(arena.claim())
+            for i in range(n_mega):
+                rows[i][...] = raws[(g * n_mega + i) % len(raws)]
+            stacked.append(rows[:n_mega])
         t0 = time.perf_counter()
         table, stats, outs = mega(table, stats, params,
                                   jax.device_put(stacked[0]))

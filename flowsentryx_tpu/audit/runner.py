@@ -220,6 +220,22 @@ def _audit_one(
     )
 
 
+def _normalize_mega_sizes(
+    mega_sizes: tuple[int, ...] | None, mega_n: int
+) -> tuple[int, ...]:
+    """THE one (dedup, sort-descending, validate) rule for the
+    megastep group-size ladder — shared by :func:`run_audit` (which
+    stages the set) and :func:`boot_audit` (which keys the cache on
+    it), so a cache hit can never vouch for a ladder that normalizes
+    differently from what was actually staged."""
+    if mega_sizes is not None:
+        sizes = tuple(sorted({int(s) for s in mega_sizes}, reverse=True))
+        if not sizes or min(sizes) < 1:
+            raise ValueError(f"mega_sizes must be >= 1, got {mega_sizes}")
+        return sizes
+    return (mega_n,) if mega_n >= 1 else ()
+
+
 def _zeros_raw(cfg: FsxConfig, compact: bool) -> np.ndarray:
     words = (schema.COMPACT_RECORD_WORDS if compact
              else schema.RECORD_WORDS)
@@ -233,6 +249,7 @@ def run_audit(
     mega_n: int = 2,
     variants: tuple[str, ...] | None = None,
     donate: bool | None = None,
+    mega_sizes: tuple[int, ...] | None = None,
 ) -> AuditReport:
     """Stage and audit the requested step variants under ``cfg``.
 
@@ -242,6 +259,16 @@ def run_audit(
     (:func:`~flowsentryx_tpu.ops.fused.donation_supported`) exactly as
     the engine does; ``False`` skips the donation contract with a note
     (axon's compute-only epochs), any other value is audited as given.
+
+    ``mega_sizes`` audits the megastep variants once PER group size —
+    the adaptive-coalescing engine's ladder
+    (:func:`~flowsentryx_tpu.ops.fused.pow2_group_sizes`), where every
+    rung is its own compiled scan artifact whose contracts (528 B wire
+    after ``merge_verdict_wires``, donation through the scan carry,
+    collective budget per chunk) must be proved individually.  With
+    more than one size the per-size reports are named
+    ``megastep@<n>``; ``None`` keeps the single-``mega_n`` staging and
+    plain names.
     """
     notes: list[str] = []
     if donate is None:
@@ -255,7 +282,8 @@ def run_audit(
     quant = schema.wire_quant_for(params)
     n_param_leaves = len(jax.tree_util.tree_leaves(params))
     shardable = mesh is not None and int(mesh.devices.size) > 1
-    mega_ok = mega_n >= 1
+    sizes = _normalize_mega_sizes(mega_sizes, mega_n)
+    mega_ok = bool(sizes)
     if variants is None:
         variants = tuple(
             v for v in ALL_VARIANTS
@@ -323,25 +351,35 @@ def run_audit(
             #                                     replicate, cannot alias)
         elif name in ("megastep", "sharded_megastep"):
             is_sh = name == "sharded_megastep"
-            if is_sh:
-                from flowsentryx_tpu import parallel as par
+            # one staged artifact — and one report — PER group size:
+            # an adaptive engine serves every rung of its ladder, so
+            # every rung's graph must be proved, not just the largest
+            for n_sz in sizes:
+                if is_sh:
+                    from flowsentryx_tpu import parallel as par
 
-                jitted = par.make_sharded_compact_megastep(
-                    cfg, spec.classify_batch, mesh, mega_n,
-                    donate=donate, **quant)
-            else:
-                jitted = fused.make_jitted_compact_megastep(
-                    cfg, spec.classify_batch, mega_n, donate=donate,
-                    **quant)
+                    jitted = par.make_sharded_compact_megastep(
+                        cfg, spec.classify_batch, mesh, n_sz,
+                        donate=donate, **quant)
+                else:
+                    jitted = fused.make_jitted_compact_megastep(
+                        cfg, spec.classify_batch, n_sz, donate=donate,
+                        **quant)
 
-            def mk(is_sh=is_sh):
-                raws = np.zeros(
-                    (mega_n, cfg.batch.max_batch + 1,
-                     schema.COMPACT_RECORD_WORDS), np.uint32)
-                return (*table_args(is_sh), params, raws)
-            sharded = is_sh
-            donate_leaves = ((2 if is_sh else len(CARRY_NAMES))
-                             if donate else 0)
+                def mk(is_sh=is_sh, n_sz=n_sz):
+                    raws = np.zeros(
+                        (n_sz, cfg.batch.max_batch + 1,
+                         schema.COMPACT_RECORD_WORDS), np.uint32)
+                    return (*table_args(is_sh), params, raws)
+                reports.append(_audit_one(
+                    name if len(sizes) == 1 else f"{name}@{n_sz}",
+                    jitted, mk, verdict_k=cfg.batch.verdict_k,
+                    expect_sharded=is_sh,
+                    donate_leaves=((2 if is_sh else len(CARRY_NAMES))
+                                   if donate else 0),
+                    quantized=cfg.model.quantized,
+                    n_param_leaves=n_param_leaves))
+            continue
         else:
             raise ValueError(f"unknown audit variant {name!r}")
         reports.append(_audit_one(
@@ -361,6 +399,7 @@ def run_audit(
             "mesh_devices": int(mesh.devices.size) if mesh is not None
             else 1,
             "mega_n": mega_n,
+            "mega_sizes": list(sizes),
             "donate": bool(donate),
         },
         backend=jax.default_backend(),
@@ -384,10 +423,16 @@ def boot_audit(
     mesh: Any | None,
     mega_n: int,
     params: Any | None = None,
+    mega_sizes: tuple[int, ...] | None = None,
 ) -> AuditReport | None:
     """Audit exactly the variants a booting engine is about to serve
     and refuse the boot (raise :class:`AuditError`) on any violated
-    contract.  Returns None on a cache hit."""
+    contract.  Returns None on a cache hit.
+
+    ``mega_sizes`` is the adaptive engine's group-size ladder: every
+    size stages (and is cached) as its own variant, and the cache key
+    includes the SET — an engine re-booting with a different ladder is
+    serving different compiled artifacts and must re-prove them."""
     shardable = mesh is not None and int(mesh.devices.size) > 1
     variants: list[str] = []
     if shardable:
@@ -395,15 +440,17 @@ def boot_audit(
     else:
         variants.append("compact" if wire == schema.WIRE_COMPACT16
                         else "raw")
-    if mega_n > 0:
+    sizes = _normalize_mega_sizes(mega_sizes, mega_n)
+    if sizes:
         # the scan-over-shard_map graph is its own compiled artifact —
         # auditing sharded + single-device megastep separately would
         # leave the variant that actually serves unproved
         variants.append("sharded_megastep" if shardable else "megastep")
     # The cache key must cover everything that changes the STAGED
-    # graph: config, wire, mesh, group size — and the params leaves'
-    # shapes/dtypes (a later engine serving a different artifact, e.g.
-    # an f64-poisoned .npz, is a different graph and must re-audit).
+    # graph: config, wire, mesh, the group-size set — and the params
+    # leaves' shapes/dtypes (a later engine serving a different
+    # artifact, e.g. an f64-poisoned .npz, is a different graph and
+    # must re-audit).
     if params is None:
         params_sig = ("default", cfg.model.name)
     else:
@@ -412,11 +459,12 @@ def boot_audit(
             (str(np.dtype(getattr(l, "dtype", type(l)))),
              tuple(getattr(l, "shape", ()))) for l in leaves)
     key = (cfg.to_json(), wire, shardable and int(mesh.devices.size),
-           mega_n, tuple(variants), params_sig)
+           sizes, tuple(variants), params_sig)
     if _BOOT_CACHE.get(key):
         return None
     rep = run_audit(cfg, params=params, mesh=mesh,
-                    mega_n=mega_n or 2, variants=tuple(variants))
+                    mega_n=mega_n or 2, variants=tuple(variants),
+                    mega_sizes=sizes or None)
     rep.raise_if_failed()
     _BOOT_CACHE[key] = True
     return rep

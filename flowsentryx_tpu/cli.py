@@ -19,6 +19,19 @@ import sys
 from pathlib import Path
 
 
+def _mega_arg(s: str):
+    """``--mega`` value: a fixed group size (int) or ``auto`` — the
+    adaptive power-of-two coalescing ladder (group sizes track the
+    instantaneous backlog; ``Engine(mega_n="auto")``)."""
+    if s == "auto":
+        return "auto"
+    try:
+        return int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--mega takes an integer or 'auto', got {s!r}")
+
+
 def _cmd_codegen(args: argparse.Namespace) -> int:
     from flowsentryx_tpu.core import codegen
 
@@ -276,7 +289,16 @@ def _cmd_audit(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.parallel import make_mesh
 
         mesh = make_mesh(n_mesh)
-    rep = run_audit(cfg, mesh=mesh, mega_n=args.mega)
+    if args.mega == "auto":
+        # audit the exact ladder an Engine(mega_n="auto") serves: one
+        # staged scan artifact per power-of-two group size
+        from flowsentryx_tpu.engine.engine import MEGA_AUTO_MAX
+        from flowsentryx_tpu.ops.fused import pow2_group_sizes
+
+        rep = run_audit(cfg, mesh=mesh, mega_n=MEGA_AUTO_MAX,
+                        mega_sizes=pow2_group_sizes(MEGA_AUTO_MAX))
+    else:
+        rep = run_audit(cfg, mesh=mesh, mega_n=args.mega)
     if args.out:
         runner.write_artifact(rep, args.out)
     if args.json:
@@ -1128,8 +1150,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="stage the sharded variant over an N-device "
                          "mesh (0 = auto: every visible device when "
                          "they form a power-of-two mesh > 1)")
-    au.add_argument("--mega", type=int, default=2,
-                    help="chunk count for the staged megastep variant")
+    au.add_argument("--mega", type=_mega_arg, default=2,
+                    help="chunk count for the staged megastep variant, "
+                         "or 'auto' to audit every rung of the "
+                         "adaptive power-of-two ladder (one staged "
+                         "artifact per group size)")
     au.add_argument("--quick", action="store_true",
                     help="small table/batch shapes (CI gate); the "
                          "contracts are shape-generic, only the "
@@ -1203,11 +1228,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--seconds", type=float, default=0, help="stop after S seconds")
     s.add_argument("--mesh", type=int, default=0,
                    help="serve sharded over an N-device mesh (N>1)")
-    s.add_argument("--mega", type=int, default=0,
+    s.add_argument("--mega", type=_mega_arg, default=0,
                    help="group N backlogged batches into one lax.scan "
                         "dispatch (amortizes per-dispatch cost on "
                         "tunneled/high-rate links; compact16 wire; "
-                        "composes with --mesh via the sharded mega-step)")
+                        "composes with --mesh via the sharded mega-step)."
+                        " 'auto' = adaptive coalescing: stage every "
+                        "power-of-two group size up to 8 and dispatch "
+                        "the largest the instantaneous backlog fills, "
+                        "so partial backlogs amortize too")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
     s.add_argument("--checkpoint-every", type=float, default=0,
                    help="ALSO checkpoint every S seconds while serving "

@@ -357,6 +357,17 @@ SHM_WSTATE_OFFSET = 56
 #: corruption/restart signal and this counter is the ONLY place such a
 #: loss shows up.
 SHM_EMIT_DROP_OFFSET = 72
+#: u64 pair, creator-written BEFORE the worker spawns (read-only
+#: thereafter, so the one-writer rule holds trivially): the worker's
+#: idle backoff policy.  SPIN_US is the budget of busy-spin polling
+#: after the ring goes empty (wakeup latency at high rates — a sleeping
+#: worker adds a whole scheduler quantum to the next record's path);
+#: IDLE_US is the sleep once the spin budget is exhausted (idle cores
+#: stop burning).  0 means "worker default" — a bare queue created by
+#: tests keeps the pre-backoff behavior.  They live on the consumer
+#: cache line: written once at create, never contended.
+SHM_SPIN_US_OFFSET = 136
+SHM_IDLE_US_OFFSET = 144
 
 WSTATE_SPAWNING = 0
 WSTATE_RUNNING = 1

@@ -48,6 +48,7 @@ import numpy as np
 
 from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.core.config import FsxConfig
+from flowsentryx_tpu.engine.arena import DispatchArena
 from flowsentryx_tpu.engine.batcher import MicroBatcher
 from flowsentryx_tpu.engine.metrics import PipelineMetrics
 from flowsentryx_tpu.engine.sources import RecordSource
@@ -56,6 +57,15 @@ from flowsentryx_tpu.engine.writeback import (
 )
 from flowsentryx_tpu.models import get_model
 from flowsentryx_tpu.ops import fused, pallas_kernels
+
+
+#: ``Engine(mega_n="auto")`` / ``fsx serve --mega auto``: the largest
+#: group size of the adaptive power-of-two coalescing ladder.  8 holds
+#: the staged-variant count at three scan artifacts (2/4/8) while
+#: already amortizing ~8x of the per-dispatch fixed cost — the
+#: measured knee of the mega-tier curves (bench.py; past 8 the tunnel
+#: RPC floor is no longer dominant).
+MEGA_AUTO_MAX = 8
 
 
 class EngineReport(NamedTuple):
@@ -83,6 +93,13 @@ class EngineReport(NamedTuple):
     #: fallback sink counts, D2H bytes per sunk batch, and sink-thread
     #: occupancy (busy fraction of the run wall; None single-threaded).
     readback: dict | None = None
+    #: Dispatch-pipeline accounting: coalescing mode and staged group
+    #: sizes, per-group-size dispatch histogram, dispatch rate, bytes
+    #: staged through the arena and HOST copies per dispatched batch
+    #: (the zero-copy pipeline's invariant: 1.0 on the sealed compact16
+    #: path — one shm-slot-view → arena memcpy, then the device_put
+    #: boundary), plus the arena geometry.  None before the first run.
+    dispatch: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -126,7 +143,8 @@ class Engine:
         t0_ns: int | None = None,
         mesh: Any | None = None,
         wire: str | None = None,
-        mega_n: int = 0,
+        mega_n: int | str = 0,
+        mega_auto: bool = False,
         sink_thread: bool | None = None,
         audit: bool | None = None,
     ):
@@ -259,29 +277,62 @@ class Engine:
             readback_depth = cfg.batch.readback_depth
         self.readback_depth = readback_depth
         # Mega-dispatch (SURVEY.md §7.4.1 brought into SERVING): when
-        # the source backlog holds ≥ mega_n sealed batches, they go to
-        # the device as ONE lax.scan dispatch — the fixed per-dispatch
-        # cost (the tunneled runtime's RPC floor above all) is paid once
-        # per group instead of per batch.  Purely backlog-triggered: the
-        # moment a poll comes back short the pending batches dispatch
-        # singly, so low-load latency behavior is unchanged.
+        # the source backlog holds ≥ a staged group size of sealed
+        # batches, they go to the device as ONE lax.scan dispatch — the
+        # fixed per-dispatch cost (the tunneled runtime's RPC floor
+        # above all) is paid once per group instead of per batch.
+        # Purely backlog-triggered: the moment a poll comes back short
+        # the pending batches dispatch through the largest staged group
+        # they still fill (adaptive mode) or singly, so low-load
+        # latency behavior is unchanged.
+        #
+        # ``mega_n="auto"`` (or ``mega_auto=True`` with an explicit
+        # cap) = ADAPTIVE coalescing: stage one megastep per
+        # power-of-two group size ≤ the cap (fused.pow2_group_sizes)
+        # and let each iteration dispatch the largest rung the
+        # instantaneous backlog fills — fixed-``mega_n`` amortization
+        # was all-or-nothing (backlog < mega_n ⇒ every batch paid the
+        # full per-dispatch tax as a single).
+        if mega_n == "auto":
+            mega_auto = True
+            mega_n = MEGA_AUTO_MAX
+        elif isinstance(mega_n, str):
+            raise ValueError(
+                f"mega_n must be an int or 'auto', got {mega_n!r}")
+        self.mega_auto = bool(mega_auto)
         self.mega_n = int(mega_n)
+        if self.mega_n < 0:
+            raise ValueError(f"mega_n must be >= 0, got {mega_n}")
+        if self.mega_auto and self.mega_n < 2:
+            raise ValueError(
+                "adaptive coalescing needs a group-size cap >= 2 "
+                f"(got mega_n={self.mega_n})")
+        if self.mega_auto:
+            mega_sizes = fused.pow2_group_sizes(self.mega_n)
+        elif self.mega_n > 0:
+            mega_sizes = (self.mega_n,)
+        else:
+            mega_sizes = ()
+        #: Staged group sizes, largest first — the coalescing ladder.
+        self._mega_sizes: tuple[int, ...] = mega_sizes
+        self.megasteps: dict[int, Any] = {}
         self.megastep = None
-        if self.mega_n > 0:
+        if mega_sizes:
             if wire != schema.WIRE_COMPACT16:
                 raise ValueError("mega_n requires the compact16 wire")
             if self.mesh is not None:
                 from flowsentryx_tpu import parallel as par
 
-                self.megastep = par.make_sharded_compact_megastep(
-                    cfg, spec.classify_batch, self.mesh, self.mega_n,
+                self.megasteps = par.make_sharded_compact_megastep_family(
+                    cfg, spec.classify_batch, self.mesh, mega_sizes,
                     donate=donate, **quant,
                 )
             else:
-                self.megastep = fused.make_jitted_compact_megastep(
-                    cfg, spec.classify_batch, self.mega_n, donate=donate,
+                self.megasteps = fused.make_compact_megastep_family(
+                    cfg, spec.classify_batch, mega_sizes, donate=donate,
                     **quant,
                 )
+            self.megastep = self.megasteps[max(self.megasteps)]
         # Static graph audit at boot (class docstring): prove the
         # serving variant's dtype/donation/transfer/retrace/collective
         # contracts on the staged jaxpr + executable BEFORE the first
@@ -295,8 +346,13 @@ class Engine:
         if audit:
             from flowsentryx_tpu.audit import boot_audit
 
+            # every staged group size is its own compiled scan
+            # artifact: each rung of the adaptive ladder is audited
+            # (and the boot cache keyed) individually
             boot_audit(cfg, wire=self.wire, mesh=self.mesh,
-                       mega_n=self.mega_n, params=self.params)
+                       mega_n=self.mega_n if self._mega_sizes else 0,
+                       mega_sizes=self._mega_sizes or None,
+                       params=self.params)
         #: Sealed-but-undispatched (raw, t_seal) group candidates.
         self._pending: list[tuple[np.ndarray, float]] = []
         # Sealed-batch sources (flowsentryx_tpu/ingest/ShardedIngest)
@@ -309,6 +365,36 @@ class Engine:
         self.sealed = bool(getattr(source, "provides_sealed", False))
         if self.sealed:
             source.start(cfg.batch, self.wire, quant)
+        # -- dispatch arena (engine/arena.py) ---------------------------
+        # Page-aligned staging rows for the zero-copy pipeline: sealed
+        # sources memcpy shm-slot VIEWS straight into arena rows (the
+        # ONE host copy) and mega groups assemble contiguously in one
+        # slot, so the device_put slice needs no np.stack.  Slot count
+        # follows the reuse safety rule (arena module docstring):
+        # readback_depth + 2 guarantees every batch staged in a slot is
+        # SUNK before the slot recycles.  Inline engines without
+        # grouping never stage, so they skip the allocation.
+        words = (schema.COMPACT_RECORD_WORDS
+                 if self.wire == schema.WIRE_COMPACT16
+                 else schema.RECORD_WORDS)
+        if self.sealed or self.megasteps:
+            group_max = max(self.megasteps) if self.megasteps else 1
+            self._arena = DispatchArena(
+                slots=readback_depth + 2,
+                # sealed singles still batch their queue drains: give
+                # the slot a few rows even when no megastep is staged
+                group_max=max(group_max, 4) if self.sealed else group_max,
+                max_batch=cfg.batch.max_batch,
+                words=words,
+            )
+        else:
+            self._arena = None
+        # dispatch-block accounting (EngineReport.dispatch)
+        self._group_hist: dict[int, int] = {}
+        self._dispatch_calls = 0
+        self._dispatched_chunks = 0
+        self._staged_batches = 0
+        self._staged_bytes = 0
         # A wire buffer may be reused only after its batch is off the
         # in-flight queue (or, for a pending group member, dispatched):
         # keep more buffers than in-flight batches + the pending group.
@@ -390,26 +476,82 @@ class Engine:
             self.table, self.stats, out = self.step(
                 self.table, self.stats, self.params, self._put(raw)
             )
+        self._dispatch_calls += 1
+        self._dispatched_chunks += 1
+        self._group_hist[1] = self._group_hist.get(1, 0) + 1
         self._inflight.append(_InFlight(out, t_enqueue, n_records))
 
-    def _dispatch_mega(self, group: list[tuple[np.ndarray, float]]) -> None:
-        """One lax.scan dispatch over ``mega_n`` sealed wire buffers.
+    def _dispatch_group(self, raws: np.ndarray, t_enqueue: float,
+                        n_records: int) -> None:
+        """One lax.scan dispatch over a CONTIGUOUS ``[g, B+1, words]``
+        staged wire group (a dispatch-arena slice — no np.stack copy).
 
         Queued as ONE in-flight entry whose StepOutput fields are
-        stacked ``[N, B]`` (``now``/``route_drop``: ``[N]``) —
+        stacked ``[g, B]`` (``now``/``route_drop``: ``[g]``) —
         :meth:`_sink_group` ravels, so verdict extraction is unchanged.
         e2e is anchored at the OLDEST member's first-record arrival (the
         honest group latency: earlier members waited for the group)."""
-        b = self.cfg.batch.max_batch
-        raws = np.stack([raw for raw, _ in group])
-        n_records = int(sum(int(raw[b, 0]) for raw, _ in group))
+        g = int(raws.shape[0])
         with self.metrics.dispatch.time():
-            self.table, self.stats, out = self.megastep(
+            self.table, self.stats, out = self.megasteps[g](
                 self.table, self.stats, self.params, self._put(raws)
             )
+        self._dispatch_calls += 1
+        self._dispatched_chunks += g
+        self._group_hist[g] = self._group_hist.get(g, 0) + 1
         self._inflight.append(
-            _InFlight(out, min(t for _, t in group), n_records,
-                      n_chunks=len(group)))
+            _InFlight(out, t_enqueue, n_records, n_chunks=g))
+
+    def _dispatch_mega(self, group: list[tuple[np.ndarray, float]]) -> None:
+        """Group dispatch of INLINE-path pending buffers: stage the
+        group's wire buffers into one arena slot (replacing the old
+        per-group ``np.stack`` allocation with the arena's reusable
+        page-aligned rows) and scan-dispatch the contiguous slice."""
+        b = self.cfg.batch.max_batch
+        g = len(group)
+        rows = self._arena.rows(self._arena.claim())
+        with self.metrics.stage.time():
+            for i, (raw, _) in enumerate(group):
+                rows[i][...] = raw
+        self._staged_batches += g
+        self._staged_bytes += int(rows[0].nbytes) * g
+        n_records = int(sum(int(raw[b, 0]) for raw, _ in group))
+        self._dispatch_group(rows[:g], min(t for _, t in group), n_records)
+
+    def _rung_for(self, backlog: int) -> int:
+        """THE coalescing policy, shared by the inline and sealed
+        loops so the two paths can never dispatch different group
+        shapes for the same backlog: the largest staged rung the
+        backlog fills, else 1 (a single)."""
+        return next((s for s in self._mega_sizes if s <= backlog), 1)
+
+    def _drain_pending(self, short: bool) -> None:
+        """Apply the coalescing ladder to the inline pending list.
+
+        Full TOP-rung groups always dispatch (a deep backlog keeps
+        amortization maximal); a short poll — no backlog left behind
+        the pending batches — flushes the remainder greedily through
+        the largest rung it still fills, then singles.  With a fixed
+        ``mega_n`` the ladder is one rung, which reduces to the
+        original all-or-nothing policy; adaptive mode
+        (``mega_n="auto"``) is where partial backlogs stop paying the
+        full per-dispatch tax batch by batch."""
+        top = self._mega_sizes[0]
+        while len(self._pending) >= top:
+            self._dispatch_mega(self._pending[:top])
+            del self._pending[:top]
+            self._reap(self.readback_depth)
+        if not short or not self._pending:
+            return
+        while self._pending:
+            g = self._rung_for(len(self._pending))
+            if g > 1:
+                self._dispatch_mega(self._pending[:g])
+                del self._pending[:g]
+            else:
+                raw, t_seal = self._pending.pop(0)
+                self._dispatch(raw, t_seal)
+            self._reap(self.readback_depth)
 
     @staticmethod
     def _out_ready(out) -> bool:
@@ -704,10 +846,22 @@ class Engine:
         warm = np.zeros((self.cfg.batch.max_batch + 1, words), np.uint32)
         self._dispatch(warm, time.perf_counter())
         self._reap(0)
-        if self.megastep is not None:
-            self._dispatch_mega(
-                [(warm, time.perf_counter())] * self.mega_n)
+        # every staged ladder rung is its own compiled scan artifact:
+        # warm each once so no group size pays its XLA compile on the
+        # first backlog that fills it
+        for g in self._mega_sizes:
+            self._dispatch_mega([(warm, time.perf_counter())] * g)
             self._reap(0)
+        # warm dispatches are compile triggers, not traffic — keep them
+        # out of the dispatch-block accounting
+        self._reset_dispatch_counters()
+
+    def _reset_dispatch_counters(self) -> None:
+        self._group_hist = {}
+        self._dispatch_calls = 0
+        self._dispatched_chunks = 0
+        self._staged_batches = 0
+        self._staged_bytes = 0
 
     # -- stream rebinding ---------------------------------------------------
 
@@ -770,6 +924,7 @@ class Engine:
         self._sink_compact = 0
         self._sink_fallback = 0
         self._sunk_batches = 0
+        self._reset_dispatch_counters()
         # A reap hook is per-stream plumbing: every current caller binds
         # it as a closure over the previous stream's source, so keeping
         # it across a rebind would yield silently wrong latencies (or a
@@ -914,22 +1069,15 @@ class Engine:
                     took = self.batcher.take()
                     sealed = [took] if took is not None else []
             if self.mega_n > 0:
-                # Backlog-triggered grouping: full groups go as one
-                # dispatch; the moment the source comes back short (no
-                # deep backlog) the stragglers dispatch singly, so mega
-                # only ever ADDS latency to batches that were queueing
-                # behind a backlog anyway.
+                # Backlog-triggered grouping: full top-rung groups go
+                # as one dispatch; the moment the source comes back
+                # short (no deep backlog) the stragglers flush through
+                # the largest staged rung they still fill (adaptive),
+                # then singly — so grouping only ever ADDS latency to
+                # batches that were queueing behind a backlog anyway.
                 for raw in sealed:
                     self._pending.append((raw, self.batcher.pop_seal_time()))
-                while len(self._pending) >= self.mega_n:
-                    self._dispatch_mega(self._pending[:self.mega_n])
-                    del self._pending[:self.mega_n]
-                    self._reap(self.readback_depth)
-                if self._pending and len(records) < requested:
-                    for raw, t_seal in self._pending:
-                        self._dispatch(raw, t_seal)
-                        self._reap(self.readback_depth)
-                    self._pending.clear()
+                self._drain_pending(short=len(records) < requested)
             else:
                 for raw in sealed:
                     self._dispatch(raw, self.batcher.pop_seal_time())
@@ -975,16 +1123,23 @@ class Engine:
         max_batches: int | None = None,
         max_seconds: float | None = None,
     ) -> EngineReport:
-        """The sharded-ingest serving loop: dequeue → dispatch → reap.
+        """The sharded-ingest serving loop: stage → dispatch → reap.
 
         Everything per-record — ring drain, decode, quantization, batch
         assembly — already happened in the drain workers; what is left
-        on this thread is one queue-slot copy and the async dispatch
-        per batch, so the loop's cost scales with BATCHES, not records
-        (the whole point of the ingest subsystem).  Semantics otherwise
-        mirror :meth:`run`: depth-capped pipe, readiness reaping, mega
-        grouping on backlog, deadline behavior delegated to the workers
-        (they own the micro-batchers now)."""
+        on this thread is ONE shm-slot-view → dispatch-arena memcpy per
+        batch (``poll_batches_into`` staging; the queue slot is
+        released the moment the bytes land in the arena, before the
+        batch is even dispatched) and the async dispatch, so the loop's
+        cost scales with BATCHES, not records.  Groups dispatch as
+        contiguous arena slices — no ``np.stack``, no consume copy.
+        Semantics otherwise mirror :meth:`run`: depth-capped pipe,
+        readiness reaping, ladder grouping on backlog
+        (:meth:`_drain_pending`'s policy), deadline behavior delegated
+        to the workers (they own the micro-batchers now).  A source
+        without the staging API (a stub fleet) falls back to the
+        copying ``poll_batches`` protocol with arena staging at
+        dispatch time."""
         t_start = time.perf_counter()
         src = self.source
         if not self._t0_auto and hasattr(src, "set_t0"):
@@ -1004,53 +1159,131 @@ class Engine:
                 return True
             return False
 
+        if self._arena is not None and hasattr(src, "poll_batches_into"):
+            self._sealed_loop_arena(src, bounded)
+        else:
+            self._sealed_loop_copy(src, bounded)
+        for raw, t_seal in self._pending:
+            self._dispatch(raw, t_seal)
+        self._pending.clear()
+        self._reap(0)
+        return self._build_report(time.perf_counter() - t_start)
+
+    def _adopt_fleet_t0(self, src) -> None:
+        """The fleet's epoch handshake picked t0; adopt it for the
+        device clock and the sink's ns translation."""
+        self.batcher.t0_ns = src.t0_ns
+        if hasattr(self.sink, "t0_ns"):
+            self.sink.t0_ns = src.t0_ns
+        self._t0_auto = False
+
+    def _sealed_idle(self, src) -> bool:
+        """Shared empty-poll tail of the sealed loops: True = source
+        exhausted, stop serving."""
+        if src.exhausted():
+            return True
+        if self._busy_depth() == 0:
+            time.sleep(min(self.cfg.batch.deadline_us / 4, 200) / 1e6)
+        elif self._sink_active:
+            time.sleep(20e-6)  # yield the GIL to the sink thread
+        return False
+
+    def _sealed_loop_arena(self, src, bounded) -> None:
+        """The zero-copy sealed loop (single-copy staging tentpole).
+
+        One arena SLOT is live at a time: ``poll_batches_into`` stages
+        sealed payloads into its rows at ``fill`` (releasing the shm
+        slots immediately), the ladder dispatches contiguous
+        ``rows[done:done+g]`` slices, and a fresh slot is claimed only
+        after a USED slot fully dispatches — never on an empty poll, so
+        the arena's reuse-safety rule (a slot recycles only after its
+        batches are sunk; engine/arena.py) holds by construction."""
+        top = self._mega_sizes[0] if self._mega_sizes else 0
+        rows: np.ndarray | None = None
+        fill = done = 0
+        metas: list[tuple[float, int]] = []  # (t_enqueue, n_records)/row
+        while not bounded():
+            if rows is None or (fill and fill == done):
+                rows = self._arena.rows(self._arena.claim())
+                fill = done = 0
+                metas = []
+            want = len(rows) - fill
+            if top:
+                want = min(want, max(top - (fill - done), 0))
+            batches = (src.poll_batches_into(
+                rows[fill:], want,
+                pop_timer=self.metrics.pop,
+                stage_timer=self.metrics.stage) if want > 0 else [])
+            if self._t0_auto and batches and src.t0_ns:
+                self._adopt_fleet_t0(src)
+            for sb in batches:
+                # workers sealed these; mirror into the engine-side
+                # counters the report and bounds are built on
+                self.batcher.batches_emitted += 1
+                self.batcher.records_emitted += sb.n_records
+                self._staged_batches += 1
+                self._staged_bytes += int(sb.raw.nbytes)
+                metas.append((sb.t_enqueue, sb.n_records))
+                fill += 1
+            # ``want == 0`` (slot rows exhausted under a pending carry)
+            # must flush, not poll: treat it as a short poll.
+            short = len(batches) < want or want == 0
+
+            def flush(g: int) -> None:
+                nonlocal done
+                if g > 1:
+                    t_e = min(m[0] for m in metas[done:done + g])
+                    n = sum(m[1] for m in metas[done:done + g])
+                    self._dispatch_group(rows[done:done + g], t_e, n)
+                else:
+                    self._dispatch(rows[done], metas[done][0])
+                done += g
+                self._reap(self.readback_depth)
+
+            while top and fill - done >= top:
+                flush(top)
+            # no ladder staged → singles dispatch as they arrive;
+            # with a ladder, the remainder flushes only on a short
+            # poll (a full poll means a backlog is still building)
+            if short or not top:
+                while fill - done:
+                    flush(self._rung_for(fill - done))
+            self._reap_ready()
+            if not batches and self._sealed_idle(src):
+                break
+        # bounded exit with staged-but-undispatched rows: flush singly
+        # (their records are already counted in records_emitted, and a
+        # wedged slot would also poison the next claim's safety rule)
+        while fill - done:
+            self._dispatch(rows[done], metas[done][0])
+            done += 1
+
+    def _sealed_loop_copy(self, src, bounded) -> None:
+        """Legacy copying protocol (sources without
+        ``poll_batches_into``): dequeue private copies, group through
+        the inline pending ladder (arena staging happens at dispatch
+        time in :meth:`_dispatch_mega`)."""
         while not bounded():
             with self.metrics.fill.time():
                 want = (max(self.mega_n - len(self._pending), 1)
                         if self.mega_n > 0 else 4)
                 batches = src.poll_batches(want)
                 if self._t0_auto and batches and src.t0_ns:
-                    # the fleet's epoch handshake picked t0; adopt it for
-                    # the device clock and the sink's ns translation
-                    self.batcher.t0_ns = src.t0_ns
-                    if hasattr(self.sink, "t0_ns"):
-                        self.sink.t0_ns = src.t0_ns
-                    self._t0_auto = False
+                    self._adopt_fleet_t0(src)
                 for sb in batches:
-                    # workers sealed these; mirror into the engine-side
-                    # counters the report and bounds are built on
                     self.batcher.batches_emitted += 1
                     self.batcher.records_emitted += sb.n_records
             if self.mega_n > 0:
                 for sb in batches:
                     self._pending.append((sb.raw, sb.t_enqueue))
-                while len(self._pending) >= self.mega_n:
-                    self._dispatch_mega(self._pending[: self.mega_n])
-                    del self._pending[: self.mega_n]
-                    self._reap(self.readback_depth)
-                if self._pending and len(batches) < want:
-                    for raw, t_seal in self._pending:
-                        self._dispatch(raw, t_seal)
-                        self._reap(self.readback_depth)
-                    self._pending.clear()
+                self._drain_pending(short=len(batches) < want)
             else:
                 for sb in batches:
                     self._dispatch(sb.raw, sb.t_enqueue)
                     self._reap(self.readback_depth)
             self._reap_ready()
-            if not batches:
-                if src.exhausted():
-                    break
-                if self._busy_depth() == 0:
-                    time.sleep(
-                        min(self.cfg.batch.deadline_us / 4, 200) / 1e6)
-                elif self._sink_active:
-                    time.sleep(20e-6)  # yield the GIL to the sink thread
-        for raw, t_seal in self._pending:
-            self._dispatch(raw, t_seal)
-        self._pending.clear()
-        self._reap(0)
-        return self._build_report(time.perf_counter() - t_start)
+            if not batches and self._sealed_idle(src):
+                break
 
     def _build_report(self, wall: float) -> EngineReport:
         # "now" on the device clock (t0-anchored stream seconds, not wall
@@ -1075,6 +1308,32 @@ class Engine:
                 if self.sink_thread else None),
         }
 
+        # Dispatch-pipeline accounting.  host_copies_per_batch counts
+        # ENGINE-side host memcpys per dispatched batch: arena staging
+        # is the zero-copy pipeline's one copy (sealed path == 1.0);
+        # the subsequent device_put of the page-aligned slice is the
+        # host↔device boundary itself, not a host copy.  Inline singles
+        # dispatch the batcher's own buffer (no staging), so a pure
+        # inline single-dispatch run reads 0.0.
+        dispatch = {
+            "mode": ("adaptive" if self.mega_auto
+                     else "fixed" if self.mega_n else "single"),
+            "mega_n": self.mega_n,
+            "group_sizes": list(self._mega_sizes),
+            "group_hist": {str(k): v for k, v in
+                           sorted(self._group_hist.items())},
+            "dispatches": self._dispatch_calls,
+            "dispatch_hz": round(
+                self._dispatch_calls / max(wall, 1e-9), 1),
+            "staged_batches": self._staged_batches,
+            "staged_bytes": self._staged_bytes,
+            "host_copies_per_batch": round(
+                self._staged_batches / max(self._dispatched_chunks, 1),
+                3),
+            "arena": (self._arena.info()
+                      if self._arena is not None else None),
+        }
+
         # explicit D2H for the report counters (transfer-guard contract)
         st = schema.GlobalStats(*jax.device_get(tuple(self.stats)))
         return EngineReport(
@@ -1092,4 +1351,5 @@ class Engine:
                     if self.sealed and hasattr(self.source, "ingest_stats")
                     else None),
             readback=readback,
+            dispatch=dispatch,
         )

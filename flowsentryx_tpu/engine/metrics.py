@@ -91,10 +91,22 @@ class WorkerIngestMetrics:
 
 
 class PipelineMetrics:
-    """The engine's stage set."""
+    """The engine's stage set.
+
+    ``fill`` covers the inline loop's source poll + batcher pack; the
+    sealed-batch loop splits its half of that work into ``pop`` (queue
+    peek + header decode + seq/metrics bookkeeping) and ``stage`` (the
+    ONE shm-slot-view → dispatch-arena memcpy of the zero-copy
+    pipeline) so the dispatch-thread budget is attributable per
+    sub-stage — a regression that re-grows a second copy shows up as a
+    ``stage`` p50 jump, not as undifferentiated ``fill`` noise.  The
+    inline loop also records ``stage`` when it packs a mega group into
+    the arena."""
 
     def __init__(self) -> None:
         self.fill = StageTimer("fill")          # source poll + batcher copy
+        self.pop = StageTimer("pop")            # sealed-queue peek/bookkeeping
+        self.stage = StageTimer("stage")        # slot view -> arena memcpy
         self.dispatch = StageTimer("dispatch")  # step call (async enqueue)
         self.readback = StageTimer("readback")  # D2H verdict fetch
         self.e2e = StageTimer("e2e")            # first record in -> sink
@@ -102,5 +114,6 @@ class PipelineMetrics:
     def to_dict(self) -> dict:
         return {
             t.name: t.percentiles_ms()
-            for t in (self.fill, self.dispatch, self.readback, self.e2e)
+            for t in (self.fill, self.pop, self.stage, self.dispatch,
+                      self.readback, self.e2e)
         }

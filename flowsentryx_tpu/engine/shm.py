@@ -237,6 +237,8 @@ class SealedBatchQueue:
                 ("stop", schema.SHM_STOP_OFFSET),
                 ("wstate", schema.SHM_WSTATE_OFFSET),
                 ("emit_drop", schema.SHM_EMIT_DROP_OFFSET),
+                ("spin_us", schema.SHM_SPIN_US_OFFSET),
+                ("idle_us", schema.SHM_IDLE_US_OFFSET),
             )
         }
 
@@ -341,6 +343,38 @@ class SealedBatchQueue:
         payload = cell[schema.BATCHQ_SLOT_HDR_WORDS:].copy()
         self._tail[0] = t + 1  # release after the copy
         return hdr, payload
+
+    def peek_batches(
+        self, max_batches: int
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Zero-copy dequeue half: ``(header[8] u32 copy, payload u32
+        VIEW)`` of up to ``max_batches`` oldest sealed slots, WITHOUT
+        releasing them.  SPSC makes the views safe exactly as in
+        :meth:`ShmRing.peek` — the worker cannot reuse a slot until
+        :meth:`release` moves the tail — so a consumer that stages the
+        payload somewhere anyway (the engine's dispatch arena) skips the
+        :meth:`consume_batch` copy entirely.  Views die at ``release``;
+        copy anything that must outlive it.  The 32-byte header is
+        copied (it is decoded into Python ints immediately either way).
+        Slots come back oldest-first; ``release(n)`` frees the first
+        ``n`` of them — partial release keeps the rest peekable."""
+        t = int(self._tail[0])
+        h = int(self._head[0])
+        n = min(h - t, max_batches)
+        out: list[tuple[np.ndarray, np.ndarray]] = []
+        for j in range(n):
+            cell = self._cells[(t + j) & (self.slots - 1)]
+            out.append((cell[: schema.BATCHQ_SLOT_HDR_WORDS].copy(),
+                        cell[schema.BATCHQ_SLOT_HDR_WORDS:]))
+        return out
+
+    def release(self, n: int) -> None:
+        """Hand ``n`` peeked slots back to the worker.  Every payload
+        view of a released slot is DEAD the moment this returns — the
+        worker may overwrite the bytes concurrently (the
+        mutate-after-release tests pin that staged arena copies are
+        immune to exactly this)."""
+        self._tail[0] = int(self._tail[0]) + n
 
     def readable(self) -> int:
         return int(self._head[0]) - int(self._tail[0])

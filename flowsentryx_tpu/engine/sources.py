@@ -55,7 +55,16 @@ class SealedBatchSource(Protocol):
 
     def poll_batches(self, max_batches: int) -> list:
         """Up to ``max_batches`` sealed batches (``ingest.SealedBatch``);
-        empty while none are ready."""
+        empty while none are ready.
+
+        Implementations MAY additionally provide ``poll_batches_into(
+        dst, max_batches, pop_timer=None, stage_timer=None)``, the
+        zero-copy staging dequeue: stage payloads straight into the
+        caller's ``[k, B+1, words]`` row array (the engine's dispatch
+        arena) with ONE memcpy per batch and release the transport
+        slots immediately.  The engine prefers it when present
+        (``Engine._sealed_loop_arena``) and falls back to this copying
+        protocol otherwise."""
         ...
 
     @property

@@ -106,15 +106,40 @@ class ShardedIngest:
         heartbeat_timeout_s: float = 2.0,
         t0_grace_s: float = 0.5,
         precompact: bool | None = None,
+        spin_us: int | None = None,
+        idle_us: int = 200,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
+        if spin_us is None:
+            # AUTO (the Engine sink_thread=None idiom): a spinning
+            # worker needs a core to burn — with fewer cores than
+            # workers + engine + one spare, the spin just steals cycles
+            # from the XLA step it is trying to feed (measured on the
+            # 2-vCPU CI container: sealed drain ~15 % slower).
+            import os
+
+            try:
+                n_cpus = len(os.sched_getaffinity(0))
+            except AttributeError:  # non-Linux
+                n_cpus = os.cpu_count() or 1
+            spin_us = 150 if n_cpus >= n_workers + 2 else 0
+        if spin_us < 0 or idle_us < 0:
+            raise ValueError("spin_us/idle_us must be >= 0")
         if platform.system() != "Linux":
             # seal/e2e accounting assumes perf_counter == CLOCK_MONOTONIC
             raise RuntimeError("ShardedIngest requires Linux")
         self.ring_base = str(ring_base)
         self.n_workers = n_workers
         self.queue_slots = queue_slots
+        #: Worker idle policy (ingest/worker.py ``_Backoff``): written
+        #: into each queue's ctl block at :meth:`start`, BEFORE the
+        #: worker spawns — one writer per field, and tests pin exact
+        #: values here.  The 150 µs spin default covers the common
+        #: inter-burst gap at Mpps rates without a wakeup; idle shards
+        #: still park at the daemon-matched 200 µs sleep.
+        self.spin_us = int(spin_us)
+        self.idle_us = int(idle_us)
         self.timeout_s = timeout_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.t0_grace_s = t0_grace_s
@@ -192,9 +217,13 @@ class ShardedIngest:
         self._seqs = SeqTracker(self.n_workers)
         for k in range(self.n_workers):
             qpath = f"{self.ring_paths[k]}.batchq"
-            self._queues.append(
-                SealedBatchQueue.create(qpath, self.queue_slots, payload_words)
-            )
+            q = SealedBatchQueue.create(qpath, self.queue_slots,
+                                        payload_words)
+            # idle-backoff params ride the ctl block, set before the
+            # worker process exists (read-only to it thereafter)
+            q.ctl_set("spin_us", self.spin_us)
+            q.ctl_set("idle_us", self.idle_us)
+            self._queues.append(q)
             spec = {
                 "shard": k,
                 "ring_path": self.ring_paths[k],
@@ -325,9 +354,28 @@ class ShardedIngest:
 
     # -- the sealed-batch source protocol -----------------------------------
 
+    def _note_batch(self, wid: int, hdr: np.ndarray) -> tuple:
+        """Header decode + per-worker bookkeeping shared by both
+        dequeue paths: ``(seq, n_records, t_seal, fill_s)``."""
+        seq = int(hdr[0]) | (int(hdr[1]) << 32)
+        n = int(hdr[2])
+        seal_ns = int(hdr[4]) | (int(hdr[5]) << 32)
+        fill_s = int(hdr[6]) * 1e-6
+        t_seal = seal_ns * 1e-9
+        self._seqs.note(wid, seq)
+        self._batches[wid] += 1
+        self._records[wid] += n
+        m = self._metrics[wid]
+        m.fill.add(fill_s)
+        m.queue.add(max(0.0, time.perf_counter() - t_seal))
+        return seq, n, t_seal, fill_s
+
     def poll_batches(self, max_batches: int) -> list[SealedBatch]:
         """Up to ``max_batches`` sealed batches, round-robin across the
-        worker queues (fairness: a hot shard must not starve the rest)."""
+        worker queues (fairness: a hot shard must not starve the rest).
+        Copying dequeue (``consume_batch``); the engine's hot path is
+        :meth:`poll_batches_into`, which stages straight into its
+        dispatch arena instead."""
         if not self._started:
             raise RuntimeError("ShardedIngest.start() was never called")
         self._check_health()
@@ -344,17 +392,7 @@ class ShardedIngest:
             else:
                 empty_streak = 0
                 hdr, payload = got
-                seq = int(hdr[0]) | (int(hdr[1]) << 32)
-                n = int(hdr[2])
-                seal_ns = int(hdr[4]) | (int(hdr[5]) << 32)
-                fill_s = int(hdr[6]) * 1e-6
-                t_seal = seal_ns * 1e-9
-                self._seqs.note(wid, seq)
-                self._batches[wid] += 1
-                self._records[wid] += n
-                m = self._metrics[wid]
-                m.fill.add(fill_s)
-                m.queue.add(max(0.0, time.perf_counter() - t_seal))
+                seq, n, t_seal, fill_s = self._note_batch(wid, hdr)
                 out.append(SealedBatch(
                     raw=payload.reshape(self._payload_shape),
                     n_records=n,
@@ -365,6 +403,75 @@ class ShardedIngest:
                 ))
             wid = (wid + 1) % n_q
         self._rr = wid
+        return out
+
+    def poll_batches_into(
+        self,
+        dst: np.ndarray,
+        max_batches: int,
+        pop_timer=None,
+        stage_timer=None,
+    ) -> list[SealedBatch]:
+        """Zero-copy-staging twin of :meth:`poll_batches`: peek the
+        oldest sealed slot per queue (round-robin), memcpy the payload
+        VIEW straight into the next row of ``dst`` — the dispatch
+        pipeline's ONE host copy — and release the slot immediately,
+        so the worker gets its queue slot back before the batch is
+        even dispatched (backpressure relief the consume-after-copy
+        path could not give).  ``dst`` is a ``[k, max_batch+1, words]``
+        u32 row array (an engine dispatch-arena slice); each returned
+        :class:`SealedBatch`'s ``raw`` is the dst row it was staged
+        into, NOT shm memory — a producer overwrite of the released
+        slot can never reach it (test-pinned).
+
+        ``pop_timer``/``stage_timer`` are optional
+        :class:`~flowsentryx_tpu.engine.metrics.StageTimer` hooks:
+        per-batch staging memcpy time goes to ``stage``, everything
+        else in a non-empty call (peek, header decode, seq/metric
+        bookkeeping) to ``pop``.
+        """
+        if not self._started:
+            raise RuntimeError("ShardedIngest.start() was never called")
+        self._check_health()
+        if not self._ensure_t0():
+            return []
+        t_call = time.perf_counter()
+        stage_s = 0.0
+        out: list[SealedBatch] = []
+        room = min(max_batches, len(dst))
+        n_q = self.n_workers
+        empty_streak = 0
+        wid = self._rr
+        while len(out) < room and empty_streak < n_q:
+            q = self._queues[wid]
+            peeked = q.peek_batches(1)
+            if not peeked:
+                empty_streak += 1
+            else:
+                empty_streak = 0
+                hdr, payload = peeked[0]
+                row = dst[len(out)]
+                t0c = time.perf_counter()
+                row.reshape(-1)[:] = payload     # THE one host copy
+                stage_s += time.perf_counter() - t0c
+                q.release(1)                     # slot back to the worker
+                seq, n, t_seal, fill_s = self._note_batch(wid, hdr)
+                out.append(SealedBatch(
+                    raw=row,
+                    n_records=n,
+                    t_enqueue=t_seal - fill_s,
+                    t_seal=t_seal,
+                    worker=wid,
+                    seq=seq,
+                ))
+            wid = (wid + 1) % n_q
+        self._rr = wid
+        if out:
+            if stage_timer is not None:
+                stage_timer.add(stage_s / len(out))
+            if pop_timer is not None:
+                pop_timer.add(
+                    max(0.0, time.perf_counter() - t_call - stage_s))
         return out
 
     def exhausted(self) -> bool:

@@ -35,8 +35,49 @@ import numpy as np
 #: the handshake resolves in well under a second of traffic).
 PENDING_CAP = 1 << 16
 
-#: Idle sleep between empty polls (matches the daemon's 200 µs).
+#: Idle sleep between empty polls (matches the daemon's 200 µs).  Also
+#: the spin-exhausted sleep of the drain loop's bounded backoff when
+#: the queue creator left the ctl-block ``idle_us`` field at 0.
 IDLE_SLEEP_S = 200e-6
+
+
+class _Backoff:
+    """Bounded spin-then-sleep idle policy for the drain loop.
+
+    A worker that sleeps the moment its ring shard reads empty adds a
+    whole scheduler wakeup (≥ the 200 µs sleep, often a multi-ms
+    quantum on a loaded host) to the NEXT record's path — at Mpps
+    rates the ring is "empty" between every burst, so that latency tax
+    lands constantly.  Instead the worker keeps polling (spinning) for
+    a bounded ``spin_us`` after the last productive poll, and only
+    then falls back to sleeping ``idle_us`` per miss, so a genuinely
+    idle shard stops burning its core.  Both parameters come from the
+    queue's ctl block (``schema.SHM_SPIN_US_OFFSET`` /
+    ``SHM_IDLE_US_OFFSET``), written by the queue creator before the
+    worker spawns — tests pin them through
+    ``ShardedIngest(spin_us=..., idle_us=...)``.  ``spin_us=0``
+    reproduces the pre-backoff sleep-immediately behavior."""
+
+    def __init__(self, spin_us: int, idle_us: int):
+        self.spin_s = spin_us / 1e6
+        self.idle_s = idle_us / 1e6
+        self._idle_since: float | None = None
+
+    def reset(self) -> None:
+        """A productive poll: re-arm the spin budget."""
+        self._idle_since = None
+
+    def idle(self) -> bool:
+        """An empty poll: spin (return False, poll again immediately)
+        while the budget lasts, then sleep.  Returns True iff it
+        slept (observable for tests)."""
+        now = time.perf_counter()
+        if self._idle_since is None:
+            self._idle_since = now
+        if now - self._idle_since < self.spin_s:
+            return False
+        time.sleep(self.idle_s)
+        return True
 
 #: Bounded wait on a full queue once stop was requested — the consumer
 #: may already be gone and shutdown must not hang.  A give-up is NOT
@@ -135,6 +176,13 @@ def worker_main(spec: dict) -> None:
         emitter = None
         pending: list[np.ndarray] = []
         pending_n = 0
+        # Idle policy off the ctl block (0 = worker default: no spin,
+        # the daemon-matched 200 µs sleep — a bare queue created by a
+        # test keeps the pre-backoff behavior unless it pins values).
+        backoff = _Backoff(
+            int(q.ctl_get("spin_us")),
+            int(q.ctl_get("idle_us")) or int(IDLE_SLEEP_S * 1e6),
+        )
         q.ctl_set("wstate", schema.WSTATE_RUNNING)
 
         def add(batcher, records):
@@ -225,7 +273,13 @@ def worker_main(spec: dict) -> None:
                 q.ctl_set("wstate", schema.WSTATE_DONE)
                 return
             if not n_polled and not sealed:
-                time.sleep(IDLE_SLEEP_S)
+                # Empty ring: bounded spin before sleeping (the sleep
+                # was the dominant empty-ring wakeup latency at high
+                # rates — a burst landing just after the sleep started
+                # waited the whole 200 µs plus reschedule).
+                backoff.idle()
+            else:
+                backoff.reset()
     except Exception:
         try:
             q.ctl_set("wstate", schema.WSTATE_FAILED)

@@ -652,6 +652,25 @@ def make_jitted_compact_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def pow2_group_sizes(mega_n: int) -> tuple[int, ...]:
+    """The adaptive-coalescing ladder: every power-of-two group size
+    in ``[2, mega_n]``, LARGEST first (the dispatch loop picks the
+    first size the backlog fills, so order encodes preference).
+
+    Power-of-two rungs keep the staged-variant count logarithmic in
+    ``mega_n`` (each size is its own compiled scan artifact, audited
+    and cached like any other variant) while guaranteeing any backlog
+    ``b`` dispatches in at most ``popcount(b)`` groups + singles —
+    the fixed-``mega_n`` policy's worst case was ``b`` singles the
+    moment ``b < mega_n``."""
+    sizes: list[int] = []
+    g = 2
+    while g <= mega_n:
+        sizes.append(g)
+        g *= 2
+    return tuple(reversed(sizes))
+
+
 def make_jitted_compact_megastep(
     cfg: FsxConfig,
     classify_batch,
@@ -683,6 +702,27 @@ def make_jitted_compact_megastep(
         donate = donation_supported()
     base = make_compact_step(cfg, classify_batch, **quant)
     return wrap_megastep(base, n_chunks, (0, 1) if donate else ())
+
+
+def make_compact_megastep_family(
+    cfg: FsxConfig,
+    classify_batch,
+    sizes: tuple[int, ...],
+    donate: bool | None = None,
+    **quant,
+) -> dict:
+    """One jitted megastep per group size, sharing ONE traced base step
+    (``{n: mega_n}``, keys sorted descending).  The adaptive dispatch
+    ladder (:func:`pow2_group_sizes`) compiles each rung once at boot;
+    sharing the base step keeps the N traces from re-staging the whole
+    fused pipeline per size."""
+    if donate is None:
+        donate = donation_supported()
+    base = make_compact_step(cfg, classify_batch, **quant)
+    return {
+        n: wrap_megastep(base, n, (0, 1) if donate else ())
+        for n in sorted(sizes, reverse=True)
+    }
 
 
 def wrap_megastep(base, n_chunks: int, donate_argnums: tuple):

@@ -2,6 +2,7 @@ from flowsentryx_tpu.parallel import mesh, step  # noqa: F401
 from flowsentryx_tpu.parallel.mesh import make_mesh  # noqa: F401
 from flowsentryx_tpu.parallel.step import (  # noqa: F401
     make_sharded_compact_megastep,
+    make_sharded_compact_megastep_family,
     make_sharded_compact_step,
     make_sharded_raw_step,
     make_sharded_step,

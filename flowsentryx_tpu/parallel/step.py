@@ -369,3 +369,28 @@ def make_sharded_compact_megastep(
     base = make_sharded_compact_step(cfg, classify_batch, mesh,
                                      donate=False, **quant)
     return fused.wrap_megastep(base, n_chunks, (0,) if donate else ())
+
+
+def make_sharded_compact_megastep_family(
+    cfg: FsxConfig,
+    classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    sizes: tuple[int, ...],
+    donate: bool | None = None,
+    **quant,
+) -> dict:
+    """One jitted sharded megastep per group size over ONE shard-mapped
+    base step — the multi-device twin of
+    :func:`~flowsentryx_tpu.ops.fused.make_compact_megastep_family`.
+    The adaptive engine dispatches the largest rung its backlog fills;
+    every rung carries the full owner-routed collective pipeline per
+    chunk, so per-rung parity with sequential sharded dispatches holds
+    exactly as for the single fixed size."""
+    if donate is None:
+        donate = fused.donation_supported()
+    base = make_sharded_compact_step(cfg, classify_batch, mesh,
+                                     donate=False, **quant)
+    return {
+        n: fused.wrap_megastep(base, n, (0,) if donate else ())
+        for n in sorted(sizes, reverse=True)
+    }

@@ -61,4 +61,12 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
     --out artifacts/AUDIT_r08.json || exit 1
 
+echo "== dispatch smoke: single-copy staging + adaptive coalescing =="
+# Bounded CPU smoke of the zero-copy dispatch pipeline: proves
+# host copies/batch == 1.0 (shm slot view -> arena -> device) and that
+# adaptive grouping fires, re-writing the "smoke" section of
+# artifacts/DISPATCH_r09.json (the paced PR-4 comparison evidence in
+# the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/dispatch_smoke.py || exit 1
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -97,6 +97,38 @@ class TestAcceptance:
         assert runner.boot_audit(CFG, wire=schema.WIRE_RAW48, mesh=None,
                                  mega_n=0) is None  # cache hit
 
+    def test_mega_sizes_stage_one_report_per_rung(self):
+        """Adaptive-coalescing ladder: every power-of-two group size is
+        its own compiled scan artifact and gets its own audited report,
+        each holding the merged-wire D2H pin."""
+        rep = runner.run_audit(CFG, mega_n=4, mega_sizes=(2, 4),
+                               variants=("megastep",))
+        assert rep.ok, [str(f) for v in rep.variants for f in v.findings]
+        assert [v.name for v in rep.variants] == ["megastep@4",
+                                                  "megastep@2"]
+        want = (2 * CFG.batch.verdict_k + 4) * 4
+        for v in rep.variants:
+            assert v.steady_state_d2h_bytes == want, v.name
+        assert rep.config["mega_sizes"] == [4, 2]
+
+    def test_boot_cache_keys_on_group_size_set(self):
+        """An engine re-booting with a DIFFERENT ladder serves
+        different compiled artifacts: the boot cache must miss (and
+        re-prove) on a changed group-size set, and hit on the same."""
+        runner._BOOT_CACHE.clear()
+        rep = runner.boot_audit(CFG, wire=schema.WIRE_COMPACT16,
+                                mesh=None, mega_n=2, mega_sizes=(2,))
+        assert rep is not None and rep.ok
+        assert runner.boot_audit(CFG, wire=schema.WIRE_COMPACT16,
+                                 mesh=None, mega_n=2,
+                                 mega_sizes=(2,)) is None  # cache hit
+        rep2 = runner.boot_audit(CFG, wire=schema.WIRE_COMPACT16,
+                                 mesh=None, mega_n=4,
+                                 mega_sizes=(2, 4))
+        assert rep2 is not None and rep2.ok  # different set: re-proved
+        assert [v.name for v in rep2.variants] == [
+            "compact", "megastep@4", "megastep@2"]
+
     def test_report_json_shape(self, report):
         d = report.to_json()
         assert d["ok"] is True

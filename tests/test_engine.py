@@ -403,8 +403,10 @@ class TestEngineLoop:
         assert rep.records == 512 * 40
         assert rep.stats["dropped"] > 0
         assert rep.blocked_sources > 0
-        # every stage reported timings
-        assert set(rep.stages_ms) == {"fill", "dispatch", "readback", "e2e"}
+        # every stage reported timings (pop/stage are the sealed-loop
+        # sub-stages: present in the report, empty on the inline path)
+        assert set(rep.stages_ms) == {"fill", "pop", "stage", "dispatch",
+                                      "readback", "e2e"}
         assert rep.stages_ms["e2e"]["n"] == 40
 
     def test_benign_traffic_mostly_passes(self):
@@ -458,6 +460,66 @@ class TestEngineLoop:
         for a, b in zip(jax.tree_util.tree_leaves(eng1.table),
                         jax.tree_util.tree_leaves(eng4.table)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the dispatch block accounts for every batch: groups staged
+        # through the arena (1 host copy each), singles direct
+        d = rep4.dispatch
+        assert d["mode"] == "fixed" and d["group_sizes"] == [4]
+        assert sum(int(g) * n for g, n in d["group_hist"].items()) == 32
+        assert d["staged_batches"] == 4 * d["group_hist"]["4"]
+
+    def test_adaptive_mega_matches_single_and_fixed(self):
+        """Engine(mega_n="auto"): the power-of-two coalescing ladder is
+        a pure dispatch-granularity change — byte-identical stats,
+        blacklist (keys AND untils) and final table vs singles-only and
+        fixed --mega on the same stream, while actually coalescing
+        through MORE than one rung, with the whole loop clean under
+        ``jax.transfer_guard("disallow")`` (the arena device_put is an
+        explicit transfer)."""
+        import jax
+
+        recs = TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=32, attack_fraction=0.8, seed=11)
+        ).next_records(256 * 28)  # 28 = 3 full 8-groups + 4: two rungs
+
+        def run(mega_n):
+            cfg = small_cfg(batch=256, pps_threshold=200.0,
+                            bps_threshold=1e9)
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         readback_depth=4, mega_n=mega_n,
+                         sink_thread=False)
+            with jax.transfer_guard("disallow"):
+                rep = eng.run()
+            return rep, sink, eng
+
+        rep1, sink1, eng1 = run(0)
+        rep4, sink4, _ = run(4)
+        repa, sinka, enga = run("auto")
+        assert repa.records == rep4.records == rep1.records
+        assert repa.stats == rep4.stats == rep1.stats
+        assert sinka.blocked == sink4.blocked == sink1.blocked
+        for a, b in zip(jax.tree_util.tree_leaves(eng1.table),
+                        jax.tree_util.tree_leaves(enga.table)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        d = repa.dispatch
+        assert d["mode"] == "adaptive"
+        assert d["group_sizes"] == [8, 4, 2]
+        hist = {int(g): n for g, n in d["group_hist"].items()}
+        assert sum(g * n for g, n in hist.items()) == repa.batches == 28
+        assert len([g for g in hist if g > 1]) >= 2  # ≥ two rungs fired
+        assert d["host_copies_per_batch"] <= 1.0
+        assert (repa.stages_ms["dispatch"]["n"]
+                < rep1.stages_ms["dispatch"]["n"])
+
+    def test_mega_auto_requires_pow2_cap(self):
+        cfg = small_cfg(batch=128)
+        with pytest.raises(ValueError, match="cap"):
+            Engine(cfg, TrafficSource(TrafficSpec(), total=128),
+                   NullSink(), mega_n=1, mega_auto=True)
+        with pytest.raises(ValueError, match="auto"):
+            Engine(cfg, TrafficSource(TrafficSpec(), total=128),
+                   NullSink(), mega_n="four")
 
     def test_mega_requires_compact_wire(self):
         cfg = small_cfg(batch=256)
@@ -523,6 +585,44 @@ class TestEngineLoop:
         assert (rep4.stages_ms["dispatch"]["n"]
                 < rep1.stages_ms["dispatch"]["n"])
 
+    def test_meshed_adaptive_mega_matches_meshed_single(self):
+        """Engine(mesh=8, mega_n="auto"): every rung of the sharded
+        ladder (lax.scan of shard-mapped steps per power-of-two size)
+        must reproduce the per-batch meshed engine exactly, under the
+        transfer guard — the sharded half of the adaptive-coalescing
+        parity gate."""
+        import jax
+
+        from flowsentryx_tpu.parallel import make_mesh
+
+        recs = TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=32, attack_fraction=0.8, seed=13)
+        ).next_records(512 * 12)  # 8 + 4: two rungs
+
+        def run(mega_n):
+            cfg = small_cfg(batch=512, cap=1 << 12, pps_threshold=200.0,
+                            bps_threshold=1e9)
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         readback_depth=8, mesh=make_mesh(8),
+                         mega_n=mega_n, sink_thread=False)
+            with jax.transfer_guard("disallow"):
+                rep = eng.run()
+            return rep, sink
+
+        rep1, sink1 = run(0)
+        repa, sinka = run("auto")
+        assert repa.stats == rep1.stats
+        assert sinka.blocked == sink1.blocked
+        assert repa.batches == rep1.batches == 12
+        hist = {int(g): n for g, n in
+                repa.dispatch["group_hist"].items()}
+        assert sum(g * n for g, n in hist.items()) == 12
+        assert any(g > 1 for g in hist)
+        assert (repa.stages_ms["dispatch"]["n"]
+                < rep1.stages_ms["dispatch"]["n"])
+
     def test_meshed_engine_single_device_mesh_falls_back(self):
         from flowsentryx_tpu.parallel import make_mesh
 
@@ -545,7 +645,7 @@ class TestEngineLoop:
         assert rep.batches == 2  # 256 + padded 44
 
     @staticmethod
-    def _run_sharded(recs, n_workers, base, **eng_kw):
+    def _run_sharded(recs, n_workers, base, queue_slots=16, **eng_kw):
         """Serve ``recs`` through a real ShardedIngest fleet over
         Python-created ring shards; returns (report, sink)."""
         import time as _time
@@ -560,7 +660,7 @@ class TestEngineLoop:
                 1 << 12, schema.FLOW_RECORD_DTYPE)
             part = recs[shard == k]
             assert ring.produce(part) == len(part)
-        src = ShardedIngest(base, n_workers, queue_slots=16,
+        src = ShardedIngest(base, n_workers, queue_slots=queue_slots,
                             precompact=False, t0_grace_s=0.2)
         sink = CollectSink()
         eng = Engine(small_cfg(batch=256, cap=1 << 14,
@@ -611,6 +711,41 @@ class TestEngineLoop:
         assert rep1.stats == rep0.stats
         assert rep1.ingest["n_workers"] == 1
         assert rep1.ingest["workers"]["0"]["seq_gaps"] == 0
+
+    def test_sealed_slot_reuse_under_live_overwrite_bit_identical(
+            self, tmp_path):
+        """Mutate-after-release at serving scale: a 2-slot queue with
+        16 batches forces every shm slot to be RE-USED by the live
+        worker many times while earlier batches are still dispatched-
+        but-unsunk — the engine's zero-copy loop released each slot the
+        moment it staged the view into the arena, so the worker's
+        overwrites race real in-flight dispatches.  The run must stay
+        bit-identical to the inline path (no torn batch can reach the
+        device), every batch must have gone through the arena exactly
+        once, and the sealed sub-stage timers must have fired."""
+        import platform
+
+        if platform.system() != "Linux":
+            pytest.skip("shm ingest requires Linux")
+        recs = self._flood_records(256 * 16)
+        sink0 = CollectSink()
+        rep0 = Engine(small_cfg(batch=256, cap=1 << 14,
+                                pps_threshold=200.0, bps_threshold=1e9),
+                      ArraySource(recs.copy()), sink0,
+                      readback_depth=4, wire=schema.WIRE_RAW48,
+                      sink_thread=False).run()
+        rep1, sink1 = self._run_sharded(
+            recs, 1, str(tmp_path / "fring"), queue_slots=2,
+            wire=schema.WIRE_RAW48, sink_thread=False)
+        assert rep1.records == rep0.records == len(recs)
+        assert rep1.batches == rep0.batches
+        assert sink1.blocked == sink0.blocked
+        assert rep1.stats == rep0.stats
+        d = rep1.dispatch
+        assert d["host_copies_per_batch"] == 1.0
+        assert d["staged_batches"] == rep1.batches
+        assert rep1.stages_ms["pop"].get("n", 0) > 0
+        assert rep1.stages_ms["stage"].get("n", 0) > 0
 
     def test_sharded_ingest_two_workers_equivalent(self, tmp_path):
         """N=2 regroups records into per-shard batches, and the table
@@ -843,6 +978,66 @@ class TestServeCheckpointEvery:
         assert cli.main(["serve", "--scenario", "syn_benign_mix",
                          "--rate", "1e6", "--packets", "2048",
                          "--restore", str(path)]) == 0
+
+
+class TestServeMegaAuto:
+    """``fsx serve --mega auto`` — the adaptive-coalescing operator
+    surface."""
+
+    @staticmethod
+    def _small_cfg_file(tmp_path, model="logreg_int8"):
+        import dataclasses
+
+        cfg = FsxConfig()
+        cfg = dataclasses.replace(
+            cfg,
+            batch=dataclasses.replace(cfg.batch, max_batch=256),
+            table=dataclasses.replace(cfg.table, capacity=1 << 12),
+            model=dataclasses.replace(cfg.model, name=model),
+        )
+        p = tmp_path / "cfg.json"
+        p.write_text(cfg.to_json())
+        return str(p)
+
+    def test_serve_mega_auto_adaptive_dispatch(self, tmp_path, capsys):
+        import json as js
+
+        from flowsentryx_tpu import cli
+
+        assert cli.main(["serve", "--scenario", "syn_benign_mix",
+                         "--config", self._small_cfg_file(tmp_path),
+                         "--rate", "1e6", "--packets", "4096",
+                         "--mega", "auto", "--no-sink-thread"]) == 0
+        rep = js.loads(capsys.readouterr().out)
+        assert rep["records"] == 4096
+        d = rep["dispatch"]
+        assert d["mode"] == "adaptive"
+        assert d["group_sizes"] == [8, 4, 2]
+        # warm() compile-triggered every rung without polluting the hist
+        assert sum(int(g) * n for g, n in d["group_hist"].items()) \
+            == rep["batches"]
+
+    def test_serve_mega_auto_refused_without_compact16(self, tmp_path,
+                                                      capsys):
+        """'auto' needs the compact16 wire exactly like a fixed
+        ``--mega N``: an observer-less model (mlp serves raw48) must be
+        refused BEFORE the engine boots, not with a post-compile
+        traceback."""
+        from flowsentryx_tpu import cli
+
+        assert cli.main(["serve", "--scenario", "syn_benign_mix",
+                         "--config",
+                         self._small_cfg_file(tmp_path, model="mlp"),
+                         "--packets", "512", "--mega", "auto"]) == 1
+        assert "compact16" in capsys.readouterr().err
+
+    def test_serve_mega_rejects_non_int_non_auto(self, capsys):
+        from flowsentryx_tpu import cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["serve", "--scenario", "syn_benign_mix",
+                      "--packets", "256", "--mega", "four"])
+        assert "auto" in capsys.readouterr().err
 
 
 class TestPallasModelFamily:

@@ -130,6 +130,170 @@ class TestSealedBatchQueue:
         assert int(hdr[0]) == 3  # consecutive across the drop: no gap
 
 
+class TestSealedBatchQueueViews:
+    """peek_batches()/release() — the zero-copy dequeue half of the
+    single-copy dispatch pipeline."""
+
+    def test_peek_views_match_pop_copies_across_wraparound(self, tmp_path):
+        """Fill far past the 4-slot ring boundary; every peeked view
+        must decode byte-identically (header AND payload) to the
+        consume_batch copy of the same slot."""
+        payload_words = 3 * 4
+        q = SealedBatchQueue.create(tmp_path / "q", 4, payload_words)
+        consumer = SealedBatchQueue(tmp_path / "q", payload_words)
+        sent = 0
+        seen = 0
+        while sent < 23 or consumer.readable():
+            if sent < 23:
+                payload = np.arange(
+                    payload_words, dtype=np.uint32) + 1000 * sent
+                if q.produce_batch(payload, seq=sent + 1, n_records=sent,
+                                   wire_id=schema.WIRE_ID_RAW48,
+                                   seal_ns=10**9 + sent,
+                                   fill_dur_us=sent * 7):
+                    sent += 1
+            for hdr_v, view in consumer.peek_batches(2):
+                staged = view.copy()  # the arena-style stage-then-release
+                hdr_c, payload_c = consumer.consume_batch()
+                np.testing.assert_array_equal(hdr_v, hdr_c)
+                np.testing.assert_array_equal(staged, payload_c)
+                assert int(hdr_c[0]) == seen + 1  # oldest-first order
+                seen += 1
+        assert seen == 23
+
+    def test_partial_release_keeps_remainder_peekable(self, tmp_path):
+        q = SealedBatchQueue.create(tmp_path / "q", 4, 8)
+        consumer = SealedBatchQueue(tmp_path / "q", 8)
+        for seq in (1, 2, 3):
+            assert q.produce_batch(np.full(8, seq, np.uint32), seq=seq,
+                                   n_records=1, wire_id=0, seal_ns=1,
+                                   fill_dur_us=0)
+        assert len(consumer.peek_batches(8)) == 3
+        consumer.release(2)
+        left = consumer.peek_batches(8)
+        assert len(left) == 1 and int(left[0][1][0]) == 3
+        assert consumer.readable() == 1
+
+    def test_mutate_after_release_never_reaches_staged_copy(self, tmp_path):
+        """The slot-release safety rule: stage BEFORE release, and a
+        producer overwrite of the released slot never reaches the
+        staged bytes — while the released VIEW (deliberately) does see
+        the overwrite, which is exactly why the engine stages first."""
+        q = SealedBatchQueue.create(tmp_path / "q", 2, 8)
+        consumer = SealedBatchQueue(tmp_path / "q", 8)
+
+        def push(tag, seq):
+            return q.produce_batch(np.full(8, tag, np.uint32), seq=seq,
+                                   n_records=1, wire_id=0, seal_ns=1,
+                                   fill_dur_us=0)
+
+        assert push(0xAAAA, 1) and push(0xBBBB, 2)
+        assert not push(0xCCCC, 3)          # full: backpressure holds
+        peeked = consumer.peek_batches(2)
+        assert len(peeked) == 2
+        view_a = peeked[0][1]
+        arena_row = np.empty_like(view_a)
+        arena_row[:] = view_a               # the ONE staging copy
+        consumer.release(1)                 # slot A back to the producer
+        assert push(0xCCCC, 3)              # overwrites A's slot bytes
+        np.testing.assert_array_equal(
+            arena_row, np.full(8, 0xAAAA, np.uint32))
+        # slot B untouched, C now peekable behind it
+        (_, view_b), (_, view_c) = consumer.peek_batches(2)
+        assert int(view_b[0]) == 0xBBBB and int(view_c[0]) == 0xCCCC
+        # the released slot's view is DEAD: it shows the new producer
+        # bytes, not the batch it used to name
+        assert int(view_a[0]) == 0xCCCC
+
+
+class TestWorkerBackoff:
+    """The drain loop's bounded spin-then-sleep idle policy."""
+
+    def test_spin_budget_then_sleep(self):
+        from flowsentryx_tpu.ingest.worker import _Backoff
+
+        b = _Backoff(spin_us=200_000, idle_us=100)
+        t0 = time.perf_counter()
+        assert b.idle() is False        # inside the budget: no sleep
+        assert time.perf_counter() - t0 < 0.1
+        assert _Backoff(spin_us=0, idle_us=100).idle() is True  # legacy
+        b3 = _Backoff(spin_us=500, idle_us=100)
+        b3.idle()
+        time.sleep(0.002)               # budget expires
+        assert b3.idle() is True
+        b3.reset()                      # a productive poll re-arms
+        assert b3.idle() is False
+
+    def test_params_ride_the_ctl_block(self, tmp_path):
+        """ShardedIngest(spin_us=, idle_us=) must land in every queue's
+        ctl block BEFORE the workers spawn, where worker_main reads
+        them (and where a test can pin them)."""
+        base = str(tmp_path / "fring")
+        _make_shard_rings(base, 2)
+        ing = ShardedIngest(base, 2, precompact=False, t0_grace_s=0.2,
+                            spin_us=77, idle_us=333)
+        ing.start(BatchConfig(max_batch=64, deadline_us=10_000),
+                  schema.WIRE_RAW48, None)
+        try:
+            for q in ing._queues:
+                assert q.ctl_get("spin_us") == 77
+                assert q.ctl_get("idle_us") == 333
+        finally:
+            ing.close()
+
+    def test_negative_params_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="spin_us"):
+            ShardedIngest(str(tmp_path / "r"), 1, precompact=False,
+                          spin_us=-1)
+
+
+class TestPollBatchesInto:
+    """The staging dequeue the engine's zero-copy loop drives."""
+
+    def test_drains_losslessly_into_rotating_rows(self, tmp_path):
+        """poll_batches_into over a real fleet: staged rows carry the
+        same records the copying protocol would, with slots released
+        eagerly (queue drains even though the caller never consumed)."""
+        base = str(tmp_path / "fring")
+        rings = _make_shard_rings(base, 2)
+        rec = make_records(256 * 4 + 19, n_ips=64)
+        parts = _route(rec, 2)
+        for ring, part in zip(rings, parts):
+            assert ring.produce(part) == len(part)
+        ing = _start_fleet(base, 2)
+        try:
+            deadline = time.monotonic() + 20
+            while ing.t0_ns is None:
+                ing.poll_batches(0)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            ing.request_stop()
+            words = schema.RECORD_WORDS
+            dst = np.zeros((4, 257, words), np.uint32)
+            total = 0
+            got_rows = 0
+            deadline = time.monotonic() + 30
+            while not ing.exhausted():
+                metas = ing.poll_batches_into(dst, 4)
+                for sb in metas:
+                    assert sb.raw.base is not None  # a dst view, not shm
+                    assert sb.raw.shape == (257, words)
+                    # meta row mirrors the header count
+                    assert int(sb.raw[256, 0]) == sb.n_records
+                    total += sb.n_records
+                    got_rows += 1
+                if not metas:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.005)
+            total += sum(sb.n_records
+                         for sb in ing.poll_batches_into(dst, 4))
+        finally:
+            ing.close()
+        assert total == len(rec)
+        stats = ing.ingest_stats()
+        assert all(w["seq_gaps"] == 0 for w in stats["workers"].values())
+
+
 class TestSeqTracker:
     def test_in_order(self):
         t = SeqTracker(2)
