@@ -252,6 +252,10 @@ fsx_stats read_stats(int stats_fd) {
             total.dropped_rate += s.dropped_rate;
             total.dropped_ml += s.dropped_ml;
             total.dropped_rule += s.dropped_rule;
+            // kernel-distilled classifier bands (ml=True images; zero
+            // on non-ml images or while no model blob is pushed)
+            total.ml_pass += s.ml_pass;
+            total.ml_escalated += s.ml_escalated;
         }
     }
     return total;
@@ -383,10 +387,14 @@ int run_bpf(const Options &o) {
                          "fsxd: forwarded=%" PRIu64 " verdicts=%" PRIu64
                          " skipped=%" PRIu64
                          " allowed=%" PRIu64 " drop_bl=%" PRIu64
-                         " drop_rate=%" PRIu64 "\n",
+                         " drop_rate=%" PRIu64
+                         " drop_ml=%" PRIu64 " ml_pass=%" PRIu64
+                         " ml_esc=%" PRIu64 "\n",
                          forwarded, verdicts, rb.skipped, (uint64_t)s.allowed,
                          (uint64_t)s.dropped_blacklist,
-                         (uint64_t)s.dropped_rate);
+                         (uint64_t)s.dropped_rate,
+                         (uint64_t)s.dropped_ml, (uint64_t)s.ml_pass,
+                         (uint64_t)s.ml_escalated);
             // A record-size mismatch drops EVERY drained record: the
             // deployment looks alive (kernel counters move) while the
             // ML plane starves.  The first interval that drains
@@ -424,11 +432,13 @@ int run_bpf(const Options &o) {
                 ", \"allowed\": %" PRIu64 ", \"dropped_blacklist\": %" PRIu64
                 ", \"dropped_rate\": %" PRIu64 ", \"dropped_ml\": %" PRIu64
                 ", \"dropped_rule\": %" PRIu64
+                ", \"ml_pass\": %" PRIu64 ", \"ml_escalated\": %" PRIu64
                 "}\n",
                 forwarded, verdicts, dropped_ring_full, rb.skipped,
                 (uint64_t)s.allowed,
                 (uint64_t)s.dropped_blacklist, (uint64_t)s.dropped_rate,
-                (uint64_t)s.dropped_ml, (uint64_t)s.dropped_rule);
+                (uint64_t)s.dropped_ml, (uint64_t)s.dropped_rule,
+                (uint64_t)s.ml_pass, (uint64_t)s.ml_escalated);
     if (link_fd >= 0)
         ::close(link_fd);
     return 0;

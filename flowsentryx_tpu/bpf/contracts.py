@@ -40,9 +40,13 @@ from flowsentryx_tpu.core import schema
 #: reports the header as missing otherwise).
 REPO_ROOT = Path(__file__).resolve().parents[2]
 HEADER_PATH = REPO_ROOT / "kern" / "fsx_schema.h"
+#: Sealed image per (compact, ml) build variant.  ``check_images`` also
+#: accepts plain-bool keys (compact only, ml=False) for back-compat.
 IMAGE_PATHS = {
-    False: REPO_ROOT / "kern" / "build" / "fsx_prog.img",
-    True: REPO_ROOT / "kern" / "build" / "fsx_prog_compact.img",
+    (False, False): REPO_ROOT / "kern" / "build" / "fsx_prog.img",
+    (True, False): REPO_ROOT / "kern" / "build" / "fsx_prog_compact.img",
+    (False, True): REPO_ROOT / "kern" / "build" / "fsx_prog_ml.img",
+    (True, True): REPO_ROOT / "kern" / "build" / "fsx_prog_ml_compact.img",
 }
 
 _C_SIZES = {"__u64": 8, "__u32": 4, "__u16": 2, "__u8": 1, "float": 4}
@@ -195,7 +199,17 @@ _PROGS_OFFSETS: dict[str, tuple[str, str | None]] = {
     "ST_DROPPED_RATE": ("fsx_stats", "dropped_rate"),
     "ST_DROPPED_ML": ("fsx_stats", "dropped_ml"),
     "ST_DROPPED_RULE": ("fsx_stats", "dropped_rule"),
+    "ST_ML_PASS": ("fsx_stats", "ml_pass"),
+    "ST_ML_ESCALATED": ("fsx_stats", "ml_escalated"),
     "ST_SIZE": ("fsx_stats", None),
+    "MLM_VALID": ("fsx_ml_model", "valid"),
+    "MLM_FLAGS": ("fsx_ml_model", "_reserved"),
+    "MLM_ACC_DROP": ("fsx_ml_model", "acc_drop"),
+    "MLM_ACC_PASS": ("fsx_ml_model", "acc_pass"),
+    "MLM_W": ("fsx_ml_model", "w"),
+    "MLM_QBASE": ("fsx_ml_model", "qbase"),
+    "MLM_BOUNDS": ("fsx_ml_model", "bounds_m1"),
+    "MLM_SIZE": ("fsx_ml_model", None),
 }
 
 #: map name -> (key struct-or-size, value struct-or-size).  A string
@@ -209,6 +223,7 @@ _MAP_CONTRACTS: dict[str, tuple[object, object]] = {
     "stats_map": (4, "fsx_stats"),
     "feature_ring": (0, 0),
     "rule_map": (4, 8),
+    "ml_model_map": (4, "fsx_ml_model"),
 }
 
 
@@ -226,7 +241,14 @@ def check_progs_offsets() -> list[str]:
             fails.append(f"progs.{const}: constant missing")
             continue
         lay = layouts[sname]
-        want = lay.size if field is None else lay.offset_of(field)
+        try:
+            want = lay.size if field is None else lay.offset_of(field)
+        except KeyError:
+            # a schema field removed without retiring the assembler
+            # constant: that IS the drift, not an internal error
+            fails.append(f"progs.{const}: schema struct {sname} has no "
+                         f"field {field!r} anymore")
+            continue
         if have != want:
             what = f"sizeof({sname})" if field is None \
                 else f"offsetof({sname}, {field})"
@@ -283,9 +305,12 @@ def check_header_defines(header_path: Path = HEADER_PATH) -> list[str]:
         "FSX_MAX_RULES": schema.MAX_RULES,
         "FSX_RULE_DROP": schema.RULE_DROP,
         "FSX_SHM_MAGIC": schema.SHM_MAGIC,
+        "FSX_ML_BOUNDS_PER_FEATURE": schema.ML_BOUNDS_PER_FEATURE,
         **{f"FSX_FLAG_{n}": getattr(schema, f"FLAG_{n}")
            for n in ("IPV6", "TCP_SYN", "TCP", "UDP", "ICMP")},
         **{f"FSX_VERDICT_{v.name}": v.value for v in schema.Verdict},
+        **{f"FSX_ML_BAND_{n}": getattr(schema, f"ML_BAND_{n}")
+           for n in ("PASS", "ESCALATE", "DROP", "DISABLED")},
     }
     fails = []
     for name, val in want.items():
@@ -297,22 +322,25 @@ def check_header_defines(header_path: Path = HEADER_PATH) -> list[str]:
     return fails
 
 
-def check_images(image_paths: dict[bool, Path] | None = None) -> list[str]:
+def check_images(image_paths: dict | None = None) -> list[str]:
     """The sealed FSXPROG images under kern/build/ vs a fresh emit from
     the current assembler + map specs — the artifact the daemon actually
-    loads is the one that goes stale silently."""
+    loads is the one that goes stale silently.  Keys are ``(compact,
+    ml)`` variant tuples; a bare bool means ``(compact, ml=False)``."""
     from flowsentryx_tpu.bpf import image, verifier
 
     fails = []
-    for compact, path in (image_paths or IMAGE_PATHS).items():
-        tag = "compact" if compact else "raw48"
+    for key, path in (image_paths or IMAGE_PATHS).items():
+        compact, ml = key if isinstance(key, tuple) else (key, False)
+        tag = ("ml_" if ml else "") + ("compact" if compact else "raw48")
+        flags = ("--compact " if compact else "") + ("--ml " if ml else "")
         if not path.exists():
             fails.append(f"{path}: missing ({tag} image; regenerate "
-                         "with python -m flowsentryx_tpu.bpf.image"
-                         + (" --compact" if compact else "") + ")")
+                         "with python -m flowsentryx_tpu.bpf.image "
+                         + flags.strip() + ")")
             continue
         try:
-            want = image.emit(compact=compact)
+            want = image.emit(compact=compact, ml=ml)
         except verifier.StaticVerifierError as e:
             # emit() verifies before sealing; a generation bug must
             # surface as a contract failure, not crash the report
@@ -325,8 +353,7 @@ def check_images(image_paths: dict[bool, Path] | None = None) -> list[str]:
             fails.append(
                 f"{path}: stale {tag} image — progs.py/map specs "
                 "changed since it was sealed; regenerate with "
-                "python -m flowsentryx_tpu.bpf.image "
-                + ("--compact " if compact else "") + str(path))
+                "python -m flowsentryx_tpu.bpf.image " + flags + str(path))
     return fails
 
 

@@ -45,11 +45,13 @@ class ImageMap:
 
 def emit(prog: Program | None = None,
          sizes: progs.MapSizes = progs.MapSizes(),
-         compact: bool = False) -> bytes:
+         compact: bool = False, ml: bool = False) -> bytes:
     """Serialize the fsx program (or a custom one) to an image blob.
     ``compact`` assembles the 16 B kernel-quantized emit variant
     (progs.build(compact=True)); the daemon must then be started with
-    --compact so ring record sizes agree.
+    --compact so ring record sizes agree.  ``ml`` embeds the in-kernel
+    classifier stage + ml_model_map (docs/DISTILL.md); the stage is
+    inert until ``fsx distill --pin`` pushes a model blob.
 
     The program is statically verified before the image is sealed
     (``bpf/verifier.py``; one cached pass per distinct program per
@@ -58,7 +60,7 @@ def emit(prog: Program | None = None,
     rejection cannot be reproduced.  ``FSX_SKIP_STATIC_VERIFY=1``
     skips the pass.
     """
-    prog = prog or progs.build(compact=compact)
+    prog = prog or progs.build(compact=compact, ml=ml)
     if os.environ.get("FSX_SKIP_STATIC_VERIFY") != "1":
         from flowsentryx_tpu.bpf import verifier
 
@@ -141,6 +143,7 @@ def main(argv: list[str]) -> int:
     out = None
     kw = {}
     compact = False
+    ml = False
     for a in argv[1:]:
         if a.startswith("--track-ips="):
             kw["max_track_ips"] = int(a.split("=")[1])
@@ -148,6 +151,8 @@ def main(argv: list[str]) -> int:
             kw["ring_bytes"] = int(a.split("=")[1])
         elif a == "--compact":
             compact = True
+        elif a == "--ml":
+            ml = True
         elif a.startswith("--"):
             print(f"unknown flag: {a}", file=sys.stderr)
             return 2
@@ -157,7 +162,7 @@ def main(argv: list[str]) -> int:
         else:
             out = a
     out = out or "kern/build/fsx_prog.img"
-    blob = emit(sizes=progs.MapSizes(**kw), compact=compact)
+    blob = emit(sizes=progs.MapSizes(**kw), compact=compact, ml=ml)
     pathlib.Path(out).parent.mkdir(parents=True, exist_ok=True)
     pathlib.Path(out).write_bytes(blob)
     print(f"wrote {out}: {len(blob)} bytes")

@@ -37,15 +37,15 @@ from flowsentryx_tpu.bpf import loader
 from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.bpf.asm import Asm, Program
 from flowsentryx_tpu.bpf.isa import (
-    BPF_ADD, BPF_AND, BPF_B, BPF_DIV, BPF_DW, BPF_H, BPF_JEQ, BPF_JGE,
-    BPF_JGT, BPF_JLE, BPF_JLT, BPF_JNE, BPF_LSH, BPF_MOD, BPF_MUL, BPF_OR,
-    BPF_RSH, BPF_SUB, BPF_W, BPF_XOR,
+    BPF_ADD, BPF_AND, BPF_ARSH, BPF_B, BPF_DIV, BPF_DW, BPF_H, BPF_JEQ,
+    BPF_JGE, BPF_JGT, BPF_JLE, BPF_JLT, BPF_JNE, BPF_LSH, BPF_MOD, BPF_MUL,
+    BPF_OR, BPF_RSH, BPF_SUB, BPF_W, BPF_XOR,
     FN_ktime_get_ns, FN_map_delete_elem, FN_map_lookup_elem,
     FN_map_update_elem, FN_ringbuf_reserve, FN_ringbuf_submit,
     R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10,
     XDP_DROP, XDP_MD_DATA, XDP_MD_DATA_END, XDP_PASS,
     alu64, alu64_imm, atomic_add64, call, endian_be, exit_,
-    ld_imm64, ldx, mov64, mov64_imm, mov32_imm, neg64, st_imm, stx,
+    ld_imm64, ldx, mov32, mov64, mov64_imm, mov32_imm, neg64, st_imm, stx,
 )
 
 # ---- struct offsets (must match kern/fsx_schema.h; asserted by
@@ -104,7 +104,20 @@ ST_DROPPED_BLACKLIST = 8
 ST_DROPPED_RATE = 16
 ST_DROPPED_ML = 24
 ST_DROPPED_RULE = 32
-ST_SIZE = 40
+ST_ML_PASS = 40
+ST_ML_ESCALATED = 48
+ST_SIZE = 56
+
+# struct fsx_ml_model (the kernel-distilled classifier's hot-swap map
+# value; layout owned by core.schema.ML_MODEL_*, diffed by fsx check)
+MLM_VALID = 0
+MLM_FLAGS = 4
+MLM_ACC_DROP = 8
+MLM_ACC_PASS = 16
+MLM_W = 24
+MLM_QBASE = 56
+MLM_BOUNDS = 88
+MLM_SIZE = 8248
 
 # flags (core.schema.FLAG_*)
 FLAG_IPV6, FLAG_TCP_SYN, FLAG_TCP, FLAG_UDP, FLAG_ICMP = 1, 2, 4, 8, 16
@@ -138,6 +151,9 @@ S_CW2 = -256        # u32: compact record word2 (feat 4-7, minifloat)
 S_CW3 = -260        # u32: compact record word3 (len8|flags|ts16)
 S_SADDR6 = -288     # 16B: full IPv6 source (exact-blacklist key)
 #                     [-288, -272); only initialized/read on v6 paths
+S_MLBLK = -296      # u64 slot: cfg->block_ns snapshot (ml=True builds
+#                     only; cfg in r6 is dead by the time the ML drop
+#                     band needs a blacklist TTL)
 
 COMPACT_REC_SIZE = 16  # struct fsx_compact_record
 
@@ -165,6 +181,11 @@ MAP_SPECS = {
     # stateless firewall rules (kern/fsx_kern.c rule_map): key packs
     # (proto << 16) | dport host-order, 0 = wildcard; value = action
     "rule_map": (loader.MAP_TYPE_HASH, 4, 8, "rules"),
+    # kernel-distilled int8 classifier (fsx distill): weights, exact
+    # quantization boundaries and band thresholds, hot-swapped live.
+    # Only referenced by the ml=True program variants, so non-ml images
+    # never carry it (map_names follows the relocation table).
+    "ml_model_map": (loader.MAP_TYPE_ARRAY, 4, MLM_SIZE, "one"),
 }
 
 
@@ -296,7 +317,110 @@ def _emit_minifloat_inline(a: Asm) -> None:
     a += mov64(R0, R4)
 
 
-def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot path, kept whole
+def _emit_ml_score_fn(a: Asm) -> None:
+    """BPF-to-BPF function: r0 = band(features), branch-free scoring.
+
+    Args: r1-r4 carry the 8 u32 features packed two per register
+    (``feat[2p] | feat[2p+1] << 32`` in ``r1+p``) — local calls may pass
+    scalars only, and five arg registers cannot carry eight features
+    unpacked.  Returns ``schema.ML_BAND_*`` in r0.
+
+    The scorer is the distilled int8 logreg lane (models/logreg.py
+    ``classify_batch_int8_matmul``) folded into integer-only eBPF:
+
+    * ``q_i = qbase[i] + |{r : x_i > bounds_m1[i*255 + r]}|`` — each
+      boundary is the exact u32 preimage of one quantization step of
+      the engine's f32 input observer (distill/plan.py bisects the real
+      device chain), so the rank IS the observer, bit for bit.  The
+      rank loop is fully unrolled and BRANCH-FREE (``(b - x) >> 63``
+      sign extraction): 255 boundaries x 8 features of straight-line
+      ALU cost exactly one verifier state, where a compare/jump tree
+      would multiply path counts past any budget — the same shape
+      argument as the inline minifloat quantizer above.
+    * ``s = sum w[i] * q_i`` in two's-complement u64 (weights are s32
+      widened from int8; sign-extended with LSH/ARSH).
+    * band = ``1 + (s >=s acc_drop) - (s <=s acc_pass)`` — branch-free
+      signed compares (both differences are < 2^32 in magnitude, so the
+      sign bit is exact).  The thresholds pre-fold the input zero-point
+      and the whole requant->sigmoid->quant tail (monotone in s, so the
+      distiller inverts it exactly on the host).
+
+    Everything model-dependent lives in ``ml_model_map`` — pushing a
+    new blob hot-swaps the model with no program reload.  An all-zero
+    value (``valid == 0``: no model pushed yet) returns BAND_DISABLED
+    and the caller behaves exactly like the pre-ML program.
+
+    Emulation contract: distill/emulate.py executes THIS instruction
+    stream (lock-step over vector lanes); data-dependent branches would
+    break lane coherence, which is the second reason the body is
+    branch-free up to the uniform valid/NULL checks.
+    """
+    a.label("fn_ml_score")
+    # park the packed args: the map lookup clobbers r1-r5
+    a += stx(BPF_DW, R10, -8, R1)
+    a += stx(BPF_DW, R10, -16, R2)
+    a += stx(BPF_DW, R10, -24, R3)
+    a += stx(BPF_DW, R10, -32, R4)
+    a += st_imm(BPF_W, R10, -40, 0)  # key = 0
+    a.ld_map(R1, "ml_model_map")
+    a += mov64(R2, R10)
+    a += alu64_imm(BPF_ADD, R2, -40)
+    a += call(FN_map_lookup_elem)
+    a.jmp_imm(BPF_JEQ, R0, 0, "ml_fn_off")  # verifier NULL check
+    a += mov64(R7, R0)  # r7 = model (callee-owned; frames save r6-r9)
+    a += ldx(BPF_W, R1, R7, MLM_VALID)
+    a.jmp_imm(BPF_JEQ, R1, 0, "ml_fn_off")  # no model pushed: stage off
+    a += mov64_imm(R6, 0)  # r6 = s = sum w[i] * q_i
+    for i in range(schema.NUM_FEATURES):
+        # x_i from the packed arg pair
+        a += ldx(BPF_DW, R2, R10, -8 - 8 * (i // 2))
+        if i % 2:
+            a += alu64_imm(BPF_RSH, R2, 32)
+        else:
+            a += mov32(R2, R2)  # zero-extend the low word
+        # rank: q = qbase[i] + sum over boundaries of (x > b_m1)
+        a += ldx(BPF_W, R3, R7, MLM_QBASE + 4 * i)
+        for r in range(schema.ML_BOUNDS_PER_FEATURE):
+            off = MLM_BOUNDS + 4 * (schema.ML_BOUNDS_PER_FEATURE * i + r)
+            a += ldx(BPF_W, R4, R7, off)
+            a += alu64(BPF_SUB, R4, R2)   # b_m1 - x: wraps iff x > b_m1
+            a += alu64_imm(BPF_RSH, R4, 63)
+            a += alu64(BPF_ADD, R3, R4)
+        # s += w[i] * q   (w sign-extended s32)
+        a += ldx(BPF_W, R4, R7, MLM_W + 4 * i)
+        a += alu64_imm(BPF_LSH, R4, 32)
+        a += alu64_imm(BPF_ARSH, R4, 32)
+        a += alu64(BPF_MUL, R4, R3)
+        a += alu64(BPF_ADD, R6, R4)
+    # band = ESCALATE + (s >=s acc_drop) - (s <=s acc_pass), branch-free
+    a += ldx(BPF_DW, R1, R7, MLM_ACC_DROP)
+    a += mov64(R2, R6)
+    a += alu64(BPF_SUB, R2, R1)
+    a += alu64_imm(BPF_RSH, R2, 63)
+    a += alu64_imm(BPF_XOR, R2, 1)   # (s - acc_drop) >=s 0
+    a += ldx(BPF_DW, R1, R7, MLM_ACC_PASS)
+    a += alu64(BPF_SUB, R1, R6)
+    a += alu64_imm(BPF_RSH, R1, 63)
+    a += alu64_imm(BPF_XOR, R1, 1)   # (acc_pass - s) >=s 0
+    a += mov64_imm(R0, schema.ML_BAND_ESCALATE)
+    a += alu64(BPF_ADD, R0, R2)
+    a += alu64(BPF_SUB, R0, R1)
+    a += exit_()
+    a.label("ml_fn_off")
+    a += mov64_imm(R0, schema.ML_BAND_DISABLED)
+    a += exit_()
+
+
+def build_ml_scorer() -> Program:
+    """The fn_ml_score instruction stream as a standalone Program — the
+    exact bytes the XDP variants embed (tests assert this), consumed by
+    the distill emulator (entry contract: r1-r4 = packed features)."""
+    a = Asm("fsx_ml_scorer")
+    _emit_ml_score_fn(a)
+    return a.assemble()
+
+
+def build(compact: bool = False, ml: bool = False) -> Program:  # noqa: C901 — one linear hot path, kept whole
     """Assemble the full fsx fast path (see module docstring)."""
     a = Asm("fsx")
 
@@ -323,6 +447,12 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     # fail open until a config is pushed (valid flag, fsx_kern.c:206-214)
     a += ldx(BPF_W, R1, R6, CFG_VALID)
     a.jmp_imm(BPF_JEQ, R1, 0, "pass_quiet")
+    if ml:
+        # Snapshot the blacklist TTL while cfg is live: r6 is reused for
+        # the flow-stats pointer past the limiter, and the ML drop band
+        # (which fires after feature derivation) blacklists with it.
+        a += ldx(BPF_DW, R1, R6, CFG_BLOCK_NS)
+        a += stx(BPF_DW, R10, S_MLBLK, R1)
 
     # ---- parse (kern/parsing.h:225-266) ------------------------------
     a += ldx(BPF_DW, R1, R10, S_CTX)
@@ -943,6 +1073,36 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += alu64_imm(BPF_OR, R3, FLAG_ICMP)
     a.label("fl_done")
 
+    if ml:
+        # ---- in-kernel ML stage (two-tier escalation protocol; the
+        # fsx distill tentpole).  Runs on exactly the records the
+        # pre-ML program would have emitted — features are fresh here —
+        # and splits them into three bands:
+        #   DROP      confident attack: blacklist (exact v6 / folded
+        #             v4, TTL = cfg->block_ns) + dropped_ml++ + XDP_DROP
+        #   PASS      confident benign: ml_pass++, ringbuf emit
+        #             SUPPRESSED (the line-rate win: the TPU tier never
+        #             sees traffic the kernel is sure about), XDP_PASS
+        #   ESCALATE  uncertain: ml_escalated++, record emitted
+        #             unchanged — the TPU tier decides
+        #   DISABLED  no model in ml_model_map: plain emit, no counters
+        #             (bit-identical behavior to the ml=False program)
+        a += stx(BPF_DW, R10, S_VAL64, R3)  # park flags across the call
+        for p, reg in enumerate((R1, R2, R3, R4)):
+            a += ldx(BPF_W, reg, R10, S_FEAT + 8 * p + 4)
+            a += alu64_imm(BPF_LSH, reg, 32)
+            a += ldx(BPF_W, R5, R10, S_FEAT + 8 * p)
+            a += alu64(BPF_OR, reg, R5)
+        a.call_local("fn_ml_score")
+        a.jmp_imm(BPF_JEQ, R0, schema.ML_BAND_DROP, "ml_drop")
+        a.jmp_imm(BPF_JEQ, R0, schema.ML_BAND_PASS, "ml_passq")
+        a.jmp_imm(BPF_JNE, R0, schema.ML_BAND_ESCALATE, "ml_emit")
+        a += ldx(BPF_DW, R1, R8, ST_ML_ESCALATED)
+        a += alu64_imm(BPF_ADD, R1, 1)
+        a += stx(BPF_DW, R8, ST_ML_ESCALATED, R1)
+        a.label("ml_emit")
+        a += ldx(BPF_DW, R3, R10, S_VAL64)  # un-park flags for the emit
+
     if not compact:
         # ---- 48 B ringbuf emit (fsx_kern.c:146-184) ------------------
         a += stx(BPF_DW, R10, S_VAL64, R3)  # park flags across reserve
@@ -1032,21 +1192,63 @@ def build(compact: bool = False) -> Program:  # noqa: C901 — one linear hot pa
     a += mov64_imm(R0, XDP_DROP)
     a += exit_()
 
-    a.label("drop_counted")  # blacklist / rate-limit drop
+    a.label("drop_counted")  # blacklist / rate-limit / ML-band drop
     a += mov64_imm(R0, XDP_DROP)
     a += exit_()
 
-    # ---- subfunction ------------------------------------------------
+    if ml:
+        # ---- ML band exits (see the fl_done stage above) -------------
+        a.label("ml_passq")  # confident benign: pass, emit suppressed
+        a += ldx(BPF_DW, R1, R8, ST_ML_PASS)
+        a += alu64_imm(BPF_ADD, R1, 1)
+        a += stx(BPF_DW, R8, ST_ML_PASS, R1)
+        a.ja("allowed")
+        # confident attack: blacklist so the NEXT packets of this source
+        # drop at the line-rate gate (classification runs only at emit
+        # cadence; the blacklist is what makes the drop line-rate), then
+        # count + drop this one.  v6 sources insert into the EXACT map —
+        # the full source is still on the stack — mirroring "over".
+        a.label("ml_drop")
+        a += ldx(BPF_DW, R1, R10, S_MLBLK)
+        a += alu64(BPF_ADD, R1, R7)  # until = now + block_ns
+        a += stx(BPF_DW, R10, S_VAL64, R1)
+        a += ldx(BPF_DW, R1, R10, S_IS6)
+        a.jmp_imm(BPF_JEQ, R1, 0, "mld_v4")
+        a.ld_map(R1, "blacklist_v6")
+        a += mov64(R2, R10)
+        a += alu64_imm(BPF_ADD, R2, S_SADDR6)
+        a += mov64(R3, R10)
+        a += alu64_imm(BPF_ADD, R3, S_VAL64)
+        a += mov64_imm(R4, 0)  # BPF_ANY
+        a += call(FN_map_update_elem)
+        a.ja("mld_count")
+        a.label("mld_v4")
+        a.ld_map(R1, "blacklist_map")
+        a += mov64(R2, R10)
+        a += alu64_imm(BPF_ADD, R2, S_KEY)
+        a += mov64(R3, R10)
+        a += alu64_imm(BPF_ADD, R3, S_VAL64)
+        a += mov64_imm(R4, 0)  # BPF_ANY
+        a += call(FN_map_update_elem)
+        a.label("mld_count")
+        a += ldx(BPF_DW, R1, R8, ST_DROPPED_ML)
+        a += alu64_imm(BPF_ADD, R1, 1)
+        a += stx(BPF_DW, R8, ST_DROPPED_ML, R1)
+        a.ja("drop_counted")
+
+    # ---- subfunctions -----------------------------------------------
     _emit_isqrt_fn(a)
+    if ml:
+        _emit_ml_score_fn(a)
 
     return a.assemble()
 
 
 def load(sizes: MapSizes = MapSizes(), compact: bool = False,
-         ) -> tuple[int, dict[str, loader.Map]]:
+         ml: bool = False) -> tuple[int, dict[str, loader.Map]]:
     """Create maps, load the program through the verifier; returns
     (prog_fd, maps).  Caller owns the fds."""
     maps = create_maps(sizes)
-    prog = build(compact=compact)
+    prog = build(compact=compact, ml=ml)
     fd = loader.prog_load(prog, map_fds={k: m.fd for k, m in maps.items()})
     return fd, maps

@@ -190,6 +190,10 @@ def _cmd_check(args: argparse.Namespace) -> int:
     jobs: list[tuple[str, object]] = [
         ("fsx[raw48]", lambda: progs.build()),
         ("fsx[compact16]", lambda: progs.build(compact=True)),
+        # the kernel-tier classifier variants (fsx distill): same fast
+        # path + fn_ml_score and the ml_model_map band dispatch
+        ("fsx[ml_raw48]", lambda: progs.build(ml=True)),
+        ("fsx[ml_compact16]", lambda: progs.build(compact=True, ml=True)),
     ]
     for path in args.image or ():
         def _from_image(p: str = path):
@@ -317,6 +321,225 @@ def _cmd_audit(args: argparse.Namespace) -> int:
                     print(f"  {f}", file=sys.stderr)
         print(f"fsx audit: {'PASS' if rep.ok else 'FAIL'}")
     return 0 if rep.ok else 1
+
+
+def _cmd_distill(args: argparse.Namespace) -> int:
+    """Compile a trained int8 artifact into the kernel tier.
+
+    The fourth static-toolchain verb (check / audit / distill / serve):
+    inverts the artifact's float observer + score tail into exact
+    integer tables (``flowsentryx_tpu/distill/``), packs them into the
+    hot-swappable ``ml_model_map`` blob the ``--ml`` XDP images band
+    packets with, and — with ``--emulate`` — proves JAX↔BPF verdict
+    parity by running the REAL emitted bytecode over a vector corpus.
+    See docs/DISTILL.md for the fixed-point scheme and the two-tier
+    escalation protocol.
+    """
+    import time as _time
+
+    import numpy as np
+
+    try:
+        t_lo_s, _, t_hi_s = args.thresholds.partition(",")
+        t_lo, t_hi = float(t_lo_s), float(t_hi_s)
+    except ValueError:
+        print(f"fsx distill: --thresholds wants LO,HI in [0,1], got "
+              f"{args.thresholds!r}", file=sys.stderr)
+        return 1
+    _honor_jax_platform()
+    from flowsentryx_tpu.distill import plan as dplan
+    from flowsentryx_tpu.models.registry import (
+        load_artifact,
+        require_distillable,
+    )
+
+    # distillability gate BEFORE any artifact parsing surprises
+    try:
+        params = load_artifact(args.model, args.artifact)
+        require_distillable(args.model, params)
+    except (ValueError, KeyError, OSError) as e:
+        print(f"fsx distill: {e}", file=sys.stderr)
+        return 1
+    t0 = _time.perf_counter()
+    try:
+        plan = dplan.compile_plan(params, t_lo=t_lo, t_hi=t_hi)
+    except dplan.DistillError as e:
+        print(f"fsx distill: {e}", file=sys.stderr)
+        return 1
+    out: dict = {
+        "ok": True,
+        "artifact": args.artifact,
+        "model": args.model,
+        "compile_s": round(_time.perf_counter() - t0, 3),
+        "plan": plan.to_json(),
+    }
+    blob = dplan.pack_blob(plan)
+    if args.out:
+        out["plan_file"] = dplan.save_plan(plan, args.out)
+    if args.blob:
+        Path(args.blob).write_bytes(blob)
+        out["blob_file"] = args.blob
+
+    if args.check:
+        # every program that could carry this blob must pass the static
+        # verifier, and the offsets the scorer bakes must match schema
+        from flowsentryx_tpu.bpf import contracts, progs, verifier
+
+        checks: dict = {}
+        for compact in (False, True):
+            tag = "ml_" + ("compact16" if compact else "raw48")
+            try:
+                rep = verifier.check_program_cached(
+                    progs.build(compact=compact, ml=True))
+                checks[tag] = {"ok": True, **rep.to_json()}
+            except verifier.StaticVerifierError as e:
+                checks[tag] = {"ok": False, "error": str(e)}
+                out["ok"] = False
+        for name, fails in (
+                ("progs_offsets", contracts.check_progs_offsets()),
+                ("map_specs", contracts.check_map_specs())):
+            checks[name] = {"ok": not fails, "failures": fails}
+            out["ok"] = out["ok"] and not fails
+        rt = dplan.unpack_blob(blob)
+        probe = np.arange(64, dtype=np.uint32).reshape(8, 8) * 0x01010101
+        checks["blob_roundtrip"] = {
+            "ok": bool((rt.bands(probe) == plan.bands(probe)).all())}
+        out["ok"] = out["ok"] and checks["blob_roundtrip"]["ok"]
+        out["check"] = checks
+
+    if args.emulate:
+        out["emulate"] = _distill_emulate(params, plan, blob,
+                                          n=args.emulate_n)
+        out["ok"] = out["ok"] and out["emulate"]["ok"]
+
+    if args.report:
+        Path(args.report).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.report).write_text(json.dumps(out, indent=2) + "\n")
+    if args.pin:
+        if not out["ok"]:
+            # --check/--emulate are deployment gates when combined with
+            # --pin: never hot-swap a model that just failed them
+            print("fsx distill: refusing --pin: checks failed (see "
+                  "report); the live model is unchanged", file=sys.stderr)
+            if args.json:
+                print(json.dumps(out, indent=2))
+            return 1
+        try:
+            from flowsentryx_tpu.bpf import loader
+            from flowsentryx_tpu.core import schema
+
+            fd = loader.obj_get(f"{args.pin}/ml_model_map")
+            m = loader.Map(fd, loader.MAP_TYPE_ARRAY, 4,
+                           schema.ML_MODEL_SIZE, 1, "ml_model_map")
+            try:
+                m.update(b"\x00" * 4, blob)
+            finally:
+                m.close()
+            out["pushed"] = args.pin
+        except OSError as e:
+            print(f"fsx distill: cannot push the model blob under "
+                  f"{args.pin}: {e} (is an --ml image attached with "
+                  "maps pinned there?)", file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        p = out["plan"]
+        print(f"fsx distill: {args.artifact} [{args.model}] -> "
+              f"{p['n_bounds'][0]} boundaries/feature, bands "
+              f"s<={p['acc_pass']} pass | s>={p['acc_drop']} drop "
+              f"(scores {args.thresholds})")
+        for key in ("plan_file", "blob_file", "pushed"):
+            if key in out:
+                print(f"fsx distill: {key.replace('_', ' ')}: {out[key]}")
+        if "check" in out:
+            for tag, c in out["check"].items():
+                print(f"fsx distill: check {tag}: "
+                      f"{'OK' if c['ok'] else 'FAILED'}")
+                for f in c.get("failures", []) or (
+                        [c["error"]] if c.get("error") else []):
+                    print(f"  {f}", file=sys.stderr)
+        if "emulate" in out:
+            e = out["emulate"]
+            print(f"fsx distill: emulate: {e['vectors']} vectors, "
+                  f"jax/emulator band mismatches: {e['jax_mismatches']} "
+                  f"(sim twin: {e['sim_mismatches']}), split "
+                  f"pass={e['split']['pass']} "
+                  f"escalate={e['split']['escalate']} "
+                  f"drop={e['split']['drop']} "
+                  f"(escalation ratio {e['escalation_ratio']})")
+        print(f"fsx distill: {'PASS' if out['ok'] else 'FAIL'}")
+    return 0 if out["ok"] else 1
+
+
+def _distill_emulate(params, plan, blob: bytes, n: int = 10000) -> dict:
+    """JAX↔BPF parity run: the served int8 lane vs the REAL emitted
+    bytecode (distill/emulate.py) vs the numpy sim twin, over a corpus
+    of CICIDS-shaped vectors + uniform u32 noise + saturation and
+    boundary edges.  The acceptance contract is zero band mismatches."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flowsentryx_tpu.distill.emulate import emulate_scorer
+    from flowsentryx_tpu.models import logreg
+
+    rng = np.random.default_rng(7)
+    corpora = []
+    # CICIDS-calibrated flow statistics (what production features look
+    # like), clipped into the u32 wire domain
+    from flowsentryx_tpu.train import fixture
+
+    X, _ = fixture.cicids_fixture(n=max(n // 2, 256), seed=3)
+    corpora.append(np.clip(X, 0, (1 << 32) - 1).astype(np.uint32))
+    corpora.append(rng.integers(0, 1 << 32, size=(max(n // 4, 256), 8),
+                                dtype=np.uint64).astype(np.uint32))
+    # saturation + zero-point edges, and every quantization boundary ±1
+    edges = np.array([0, 1, 8, 255, (1 << 16) - 1, (1 << 24) - 1,
+                      1 << 24, (1 << 24) + 1, 1 << 31, (1 << 32) - 1],
+                     np.uint32)
+    corpora.append(np.tile(edges[:, None], (1, 8)))
+    b = plan.bounds_m1[0]
+    real = b[b != 0xFFFFFFFF].astype(np.uint64)
+    near = np.unique(np.concatenate([real, real + 1, real + 2]))
+    near = near[near <= (1 << 32) - 1].astype(np.uint32)
+    if len(near):
+        corpora.append(
+            near[rng.integers(0, len(near), size=(max(n // 4, 256), 8))])
+    feats = np.concatenate(corpora)[:max(n, 512)]
+
+    x = jnp.asarray(feats).astype(jnp.float32)
+    # jit, because the ENGINE serves this lane jitted: an eager call
+    # can differ by 1 ULP at round-half boundaries (fused XLA codegen
+    # vs per-op dispatch), and the distilled boundaries match the
+    # compiled graph — the one production scores with
+    scores = np.asarray(jax.jit(logreg.classify_batch_int8_matmul)(
+        params, x))
+    jax_bands = np.where(
+        scores > plan.t_hi, 2, np.where(scores < plan.t_lo, 0, 1)
+    ).astype(np.uint8)
+    t0 = _time.perf_counter()
+    em_bands = emulate_scorer(blob, feats)
+    em_s = _time.perf_counter() - t0
+    sim_bands = plan.bands(feats)
+    split = {name: int((em_bands == code).sum())
+             for name, code in (("pass", 0), ("escalate", 1), ("drop", 2))}
+    return {
+        "ok": bool((em_bands == jax_bands).all()
+                   and (sim_bands == em_bands).all()),
+        "vectors": int(len(feats)),
+        "jax_mismatches": int((em_bands != jax_bands).sum()),
+        "sim_mismatches": int((sim_bands != em_bands).sum()),
+        "split": split,
+        "escalation_ratio": round(split["escalate"] / len(feats), 6),
+        "emulator_wall_s": round(em_s, 3),
+        "emulator_vectors_per_s": round(len(feats) / max(em_s, 1e-9)),
+        "thresholds": {"t_lo": plan.t_lo, "t_hi": plan.t_hi,
+                       "acc_pass": plan.acc_pass,
+                       "acc_drop": plan.acc_drop},
+    }
 
 
 def _cmd_block(args: argparse.Namespace) -> int:
@@ -477,6 +700,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("fsx serve: --verdict-k must be >= 0 (0 disables the "
               "compact verdict wire)", file=sys.stderr)
         return 1
+    if args.sim_kernel_tier and args.ingest_workers:
+        print("fsx serve: --sim-kernel-tier needs the inline record "
+              "path; sealed-batch ingest bypasses the record stream "
+              "(deploy the real tier via fsx distill --pin instead)",
+              file=sys.stderr)
+        return 1
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
@@ -579,10 +808,38 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 "(e.g. --artifact artifacts/logreg_int8.npz) or drop "
                 "--mega", file=sys.stderr)
             return 1
+    kernel_tier = None
+    if args.sim_kernel_tier:
+        from flowsentryx_tpu.distill import SimKernelTier
+        from flowsentryx_tpu.distill.plan import load_plan
+
+        if getattr(source, "precompact", False):
+            # Engine would refuse this too, but with a raw traceback;
+            # mirror the --ingest-workers refusal (records off a
+            # compact-emit ring are kernel-quantized — unscoreable)
+            print("fsx serve: --sim-kernel-tier cannot rescore a "
+                  "compact-emit feature ring (records arrive kernel-"
+                  "quantized); serve a 48 B ring or deploy the real "
+                  "tier via fsx distill --pin", file=sys.stderr)
+            return 1
+        import zipfile
+
+        try:
+            kernel_tier = SimKernelTier(load_plan(args.sim_kernel_tier),
+                                        block_s=cfg.model.ml_block_s)
+        # ValueError covers DistillError (its base) AND np.load's
+        # complaints about corrupt/pickled npz payloads; BadZipFile is
+        # what a non-zip file raises
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+            print(f"fsx serve: cannot load the distill plan "
+                  f"{args.sim_kernel_tier!r}: {e} (generate one with "
+                  "fsx distill ARTIFACT --out PLAN.npz)", file=sys.stderr)
+            return 1
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
                  mega_n=args.mega or 0,
                  sink_thread=False if args.no_sink_thread else None,
-                 audit=True if args.audit else None)
+                 audit=True if args.audit else None,
+                 kernel_tier=kernel_tier)
     if args.restore:
         eng.restore(args.restore)
     if args.mega:
@@ -1170,6 +1427,52 @@ def build_parser() -> argparse.ArgumentParser:
     # construction never imports the bpf loader (lazy-import rule).
     DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
 
+    di = sub.add_parser(
+        "distill",
+        help="compile a trained int8 artifact into the kernel XDP tier "
+             "(two-tier escalation; docs/DISTILL.md)")
+    di.add_argument("artifact",
+                    help="trained model artifact (.npz), e.g. "
+                         "artifacts/logreg_int8.npz")
+    di.add_argument("--model", default="logreg_int8",
+                    help="model family the artifact was trained as "
+                         "(must be distillable; default logreg_int8)")
+    di.add_argument("--thresholds", default="0.1,0.9", metavar="LO,HI",
+                    help="escalation band edges in probability space: "
+                         "score<LO passes in-kernel (emit suppressed), "
+                         "score>HI drops in-kernel (blacklist), the "
+                         "band between escalates to the TPU tier "
+                         "(default 0.1,0.9)")
+    di.add_argument("--out", metavar="PLAN.npz",
+                    help="write the compiled plan here (consumed by "
+                         "fsx serve --sim-kernel-tier and --pin runs)")
+    di.add_argument("--blob", metavar="PATH",
+                    help="write the raw ml_model_map value bytes "
+                         "(struct fsx_ml_model) here")
+    di.add_argument("--check", action="store_true",
+                    help="statically verify both --ml program variants "
+                         "(bpf/verifier.py) + the scorer's schema "
+                         "contracts + a blob pack/unpack roundtrip")
+    di.add_argument("--emulate", action="store_true",
+                    help="prove JAX<->BPF verdict parity: execute the "
+                         "emitted scorer bytecode (SIMD emulator) over "
+                         "CICIDS-shaped + saturation-edge vectors and "
+                         "require bit-exact band agreement with the "
+                         "served int8 lane")
+    di.add_argument("--emulate-n", type=int, default=10000,
+                    help="parity corpus size (default 10000)")
+    di.add_argument("--report", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/DISTILL_*.json evidence file)")
+    di.add_argument("--pin",
+                    help="push the blob into the ml_model_map pinned "
+                         "under this bpffs dir (LIVE hot-swap: the "
+                         "attached --ml program bands with the new "
+                         "model on the next packet)")
+    di.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    di.set_defaults(fn=_cmd_distill)
+
     blk = sub.add_parser("block", help="manually blacklist a source IP")
     blk.add_argument("ip", help="IPv4 or IPv6 address")
     blk.add_argument("--ttl", type=float, default=10.0,
@@ -1252,6 +1555,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "buffer, falling back to the full [B] fetch only "
                         "on overflow; 0 = disable compaction (full fetch "
                         "every batch)")
+    s.add_argument("--sim-kernel-tier", metavar="PLAN",
+                   help="simulate the distilled kernel tier in front of "
+                        "the engine with this fsx-distill plan (.npz): "
+                        "confident-attack records drop (plus a "
+                        "simulated blacklist TTL), confident-benign "
+                        "records are suppressed, only the uncertain "
+                        "band reaches the TPU step; per-band counters "
+                        "land in the report's escalation block. Record "
+                        "path only (no --ingest-workers / compact-emit "
+                        "ring); rootless stand-in for fsx distill --pin")
     s.add_argument("--audit", action="store_true",
                    help="statically audit the serving step's graph "
                         "contracts (dtypes/donation/transfer/retrace/"

@@ -166,7 +166,61 @@ KERNEL_STATS_FIELDS: tuple[tuple[str, str], ...] = (
     ("dropped_rate", "u64"),
     ("dropped_ml", "u64"),
     ("dropped_rule", "u64"),
+    # Two-tier escalation bands (kernel-distilled classifier,
+    # flowsentryx_tpu/distill/): confident-benign records whose ringbuf
+    # emit was suppressed, and uncertain records escalated to the TPU
+    # tier.  Confident-attack drops land in ``dropped_ml`` above —
+    # the field existed for exactly this purpose since the seed.
+    ("ml_pass", "u64"),
+    ("ml_escalated", "u64"),
 )
+
+
+# ---------------------------------------------------------------------------
+# Kernel-distilled classifier (the two-tier escalation protocol)
+# ---------------------------------------------------------------------------
+#
+# ``struct fsx_ml_model`` is the hot-swappable map value the distiller
+# (flowsentryx_tpu/distill/) compiles a LogRegParams artifact into.  The
+# XDP-side scorer (bpf/progs.py fn_ml_score) is integer-only and
+# MODEL-SHAPE-FIXED: pushing a new blob into ``ml_model_map`` swaps
+# weights/boundaries/thresholds live, with no program reload.
+#
+#   valid       nonzero once a model has been pushed; the ARRAY map's
+#               zero fill means "no model" and the stage becomes a
+#               no-op (every record escalates, exactly the pre-ML path)
+#   _reserved   alignment/future flags
+#   acc_drop    s64: drop band — s >= acc_drop (s = sum w[i]*q[i])
+#   acc_pass    s64: silent-pass band — s <= acc_pass
+#   w           s32[8] (int8 weights widened; two's complement in u32)
+#   qbase       u32[8]: q_i(0), the quantized value of a zero feature
+#   bounds_m1   u32[8*255]: per (feature, rank) quantization boundary
+#               minus one, sorted ascending per feature, padded with
+#               0xFFFFFFFF.  The kernel's rank loop computes
+#               q_i(x) = qbase[i] + popcount over (x > bounds_m1) —
+#               BIT-EXACT with the engine's f32 input observer because
+#               the distiller derives each boundary from the exact
+#               device-side quantization chain by bisection.
+#
+# The acc thresholds fold the input zero-point in: the JAX lane's
+# accumulator is sum (q-zp)*w = s - zp*sum(w), so the distiller shifts
+# the thresholds by zp*sum(w) and the kernel never multiplies by zp.
+
+ML_BOUNDS_PER_FEATURE = 255  # one boundary per reachable quant step
+ML_MODEL_VALID_OFFSET = 0
+ML_MODEL_FLAGS_OFFSET = 4
+ML_MODEL_ACC_DROP_OFFSET = 8
+ML_MODEL_ACC_PASS_OFFSET = 16
+ML_MODEL_W_OFFSET = 24
+ML_MODEL_QBASE_OFFSET = 56
+ML_MODEL_BOUNDS_OFFSET = 88
+ML_MODEL_SIZE = ML_MODEL_BOUNDS_OFFSET + 4 * 8 * ML_BOUNDS_PER_FEATURE  # 8248
+
+#: fn_ml_score return codes (the band split; FSX_ML_BAND_* in C).
+ML_BAND_PASS = 0       # confident benign: XDP_PASS, emit suppressed
+ML_BAND_ESCALATE = 1   # uncertain: emit the record, TPU tier decides
+ML_BAND_DROP = 2       # confident attack: blacklist + XDP_DROP
+ML_BAND_DISABLED = 3   # no model pushed: behave exactly pre-ML
 
 # ---------------------------------------------------------------------------
 # Machine-readable struct layouts (the cross-layer contract surface)
@@ -247,10 +301,22 @@ def struct_layouts() -> dict[str, StructLayout]:
             FieldLayout("tail", SHM_TAIL_OFFSET, 8),
             FieldLayout("_tail_pad", SHM_TAIL_OFFSET + 8, 8, 7),
         ))
+    ml_model = StructLayout(
+        "fsx_ml_model", ML_MODEL_SIZE, (
+            FieldLayout("valid", ML_MODEL_VALID_OFFSET, 4),
+            FieldLayout("_reserved", ML_MODEL_FLAGS_OFFSET, 4),
+            FieldLayout("acc_drop", ML_MODEL_ACC_DROP_OFFSET, 8),
+            FieldLayout("acc_pass", ML_MODEL_ACC_PASS_OFFSET, 8),
+            FieldLayout("w", ML_MODEL_W_OFFSET, 4, NUM_FEATURES),
+            FieldLayout("qbase", ML_MODEL_QBASE_OFFSET, 4, NUM_FEATURES),
+            FieldLayout("bounds_m1", ML_MODEL_BOUNDS_OFFSET, 4,
+                        NUM_FEATURES * ML_BOUNDS_PER_FEATURE),
+        ))
     return {
         "fsx_config": _layout_from_fields(
             "fsx_config",
             tuple((n, t) for n, t, _ in FsxConfig.KERNEL_CONFIG_FIELDS)),
+        "fsx_ml_model": ml_model,
         "fsx_ip_state": _layout_from_fields("fsx_ip_state",
                                             IP_STATE_FIELDS),
         "fsx_flow_stats": _layout_from_fields("fsx_flow_stats",

@@ -1,0 +1,35 @@
+"""Model distillation: compile the int8 classifier into the XDP tier.
+
+The FENXI/Taurus in-network-inference split built on this repo's own
+verified toolchain (ROADMAP "kernel-tier model distillation"): a trained
+:class:`~flowsentryx_tpu.models.logreg.LogRegParams` artifact is
+compiled into a :class:`~flowsentryx_tpu.distill.plan.DistillPlan` —
+exact integer quantization boundaries, signed weights, and two
+accumulator-space band thresholds — packed into the hot-swappable
+``ml_model_map`` value that the eBPF scorer (``bpf/progs.py``
+``fn_ml_score``, ``build(ml=True)``) bands packets with:
+
+* score ≥ the confident-attack threshold → blacklist + ``XDP_DROP``
+  in-kernel, at line rate;
+* score ≤ the confident-benign threshold → ``XDP_PASS`` with the
+  ringbuf emit suppressed (the TPU tier never sees the record);
+* the uncertain band escalates unchanged to the TPU engine.
+
+Bit-exactness is the package's contract, proven three ways against the
+served JAX int8 lane (``classify_batch_int8_matmul``): the plan
+compiler derives every boundary from the *device* quantization chain by
+bisection (:mod:`.plan`), a SIMD concrete interpreter executes the
+*actual emitted instruction stream* (:mod:`.emulate`), and a pure-numpy
+twin powers the root-free escalation simulator (:mod:`.sim`).
+Surfaced as the ``fsx distill`` CLI verb and ``fsx serve
+--sim-kernel-tier``; see docs/DISTILL.md.
+"""
+
+from flowsentryx_tpu.distill.plan import (  # noqa: F401
+    DistillPlan,
+    compile_plan,
+    load_plan,
+    pack_blob,
+    save_plan,
+)
+from flowsentryx_tpu.distill.sim import SimKernelTier  # noqa: F401

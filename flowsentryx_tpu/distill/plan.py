@@ -1,0 +1,358 @@
+"""Compile a LogRegParams artifact into the kernel-tier scoring plan.
+
+The eBPF scorer (``bpf/progs.py`` ``fn_ml_score``) is integer-only: it
+ranks each u32 feature against a sorted boundary table, takes a signed
+weighted sum, and compares it to two signed thresholds.  Everything
+float — the input observer, the requant → sigmoid → quant score tail,
+the operator's probability-space band thresholds — is inverted here, on
+the host, ONCE per artifact, into exact integer tables:
+
+* **Boundaries.**  The engine quantizes a feature as
+  ``q = clip(round(t(f32(x)) / in_scale) + in_zp, 0, 255)`` where ``t``
+  is identity or log1p (``models/logreg._quantize_u8``; per-tensor, so
+  all 8 features share one observer).  That chain is monotone
+  non-decreasing in the u32 ``x``, so each quant step ``q`` has an
+  exact u32 preimage boundary ``b_q = min{x : q(x) >= q}``.  We find
+  every ``b_q`` by bisection AGAINST THE REAL DEVICE CHAIN (a jitted
+  twin of the serving code), so the integer rank
+  ``qbase + |{q : x >= b_q}|`` reproduces the f32 observer bit for bit
+  — including u32→f32 conversion rounding and any log1p ULP quirks of
+  the serving backend, which are *absorbed into the table* rather than
+  re-approximated in the kernel.
+* **Thresholds.**  The score is a monotone function of the integer
+  accumulator (``models/logreg.score_from_acc``); the accumulator range
+  is small (|acc| ≤ 255·128·8), so we evaluate the exact score of EVERY
+  reachable accumulator value, verify monotonicity outright, and read
+  the two band edges off the sweep.  The input zero-point folds into
+  the thresholds (``sum w·(q - zp) = sum w·q - zp·sum w``), so the
+  kernel compares the raw weighted rank sum directly.
+
+``validate`` replays a large u32 sample (plus every boundary ±1 and the
+saturation corners) through both the table rank and the device chain —
+a failed plan never leaves this module.  The plan packs into the
+``ml_model_map`` value (``schema.ML_MODEL_*``) for live hot-swap.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+
+U32_MAX = (1 << 32) - 1
+#: bounds_m1 padding: compares as "never below x" for every u32 x.
+_PAD = U32_MAX
+
+
+@dataclass(frozen=True)
+class DistillPlan:
+    """The integer scoring tables one artifact compiles to."""
+
+    w: np.ndarray           # [8] int32 (int8-valued weights, widened)
+    qbase: np.ndarray       # [8] uint32: q_i(0)
+    bounds_m1: np.ndarray   # [8, 255] uint32: sorted (b_q - 1), PAD-filled
+    acc_drop: int           # s >= acc_drop -> DROP   (s = sum w*q, zp folded)
+    acc_pass: int           # s <= acc_pass -> PASS
+    t_lo: float             # operator band thresholds, probability space
+    t_hi: float
+    in_zp: int
+    w_sum: int              # sum of weights (the folded-zp bookkeeping)
+    meta: dict = field(default_factory=dict)
+
+    # -- the pure-integer scorer (numpy twin of fn_ml_score) ------------
+
+    def ranks(self, feat: np.ndarray) -> np.ndarray:
+        """``[N, 8]`` u32 features → ``[N, 8]`` int64 quant values.
+
+        Pure u32-vs-u32 compares — no float touches this path, which is
+        why it agrees with the eBPF scorer by construction."""
+        feat = np.asarray(feat)
+        if feat.dtype != np.uint32:
+            feat = feat.astype(np.uint32)
+        q = np.empty(feat.shape, np.int64)
+        for i in range(schema.NUM_FEATURES):
+            # count of boundaries strictly below x == count of (x > b_m1)
+            q[..., i] = self.qbase[i] + np.searchsorted(
+                self.bounds_m1[i], feat[..., i], side="left")
+        return q
+
+    def acc(self, feat: np.ndarray) -> np.ndarray:
+        """``[N, 8]`` u32 features → ``[N]`` int64 raw weighted rank sum
+        (the quantity the kernel thresholds)."""
+        return (self.ranks(feat) * self.w.astype(np.int64)).sum(axis=-1)
+
+    def bands(self, feat: np.ndarray) -> np.ndarray:
+        """``[N, 8]`` u32 features → ``[N]`` uint8 ``schema.ML_BAND_*``."""
+        s = self.acc(feat)
+        band = (np.full(s.shape, schema.ML_BAND_ESCALATE, np.int64)
+                + (s >= self.acc_drop) - (s <= self.acc_pass))
+        return band.astype(np.uint8)
+
+    def to_json(self) -> dict:
+        return {
+            "w": self.w.tolist(),
+            "qbase": self.qbase.tolist(),
+            "n_bounds": [int((self.bounds_m1[i] != _PAD).sum())
+                         for i in range(schema.NUM_FEATURES)],
+            "acc_drop": self.acc_drop,
+            "acc_pass": self.acc_pass,
+            "thresholds": {"t_lo": self.t_lo, "t_hi": self.t_hi},
+            "in_zp": self.in_zp,
+            "w_sum": self.w_sum,
+            "blob_bytes": schema.ML_MODEL_SIZE,
+            "meta": self.meta,
+        }
+
+
+class DistillError(ValueError):
+    """A plan that failed compilation or self-validation."""
+
+
+def _bisect_bounds(qchain, targets: np.ndarray) -> np.ndarray:
+    """min u32 x with ``qchain(x) >= t`` per target (int64; targets
+    with no preimage — above the chain's max — come back as 2^32)."""
+    nt = len(targets)
+    lo = np.full(nt, -1, np.int64)          # q(lo) < t (q(-1) := -inf)
+    hi = np.full(nt, U32_MAX, np.int64)     # candidate answer
+    q_top = np.asarray(qchain(np.full(nt, U32_MAX, np.uint32)), np.int64)
+    reachable = q_top >= targets
+    for _ in range(33):  # ceil(log2(2^32)) + slack; fixed-trip for jit reuse
+        span = hi - lo > 1
+        if not span.any():
+            break
+        mid = np.where(span, (lo + hi) // 2, hi)
+        qm = np.asarray(qchain(mid.astype(np.uint32)), np.int64)
+        ge = qm >= targets
+        hi = np.where(span & ge, mid, hi)
+        lo = np.where(span & ~ge, mid, lo)
+    return np.where(reachable, hi, np.int64(1) << 32)
+
+
+def compile_plan(
+    params,
+    t_lo: float = 0.1,
+    t_hi: float = 0.9,
+    validate: bool = True,
+    sample: int = 65536,
+    seed: int = 0,
+) -> DistillPlan:
+    """Compile ``params`` (a LogRegParams pytree) into a
+    :class:`DistillPlan` with bands ``(t_lo, t_hi)``; see module
+    docstring for the method.  Raises :class:`DistillError` on invalid
+    thresholds, a non-monotone score chain, or a failed validation
+    replay."""
+    # jax only here: load_plan/bands/SimKernelTier stay numpy-pure so
+    # the sim tier and ingest-side consumers never pay the jax import
+    import jax
+    import jax.numpy as jnp
+
+    from flowsentryx_tpu.models.logreg import (
+        _maybe_log1p,
+        _quantize_u8,
+        score_from_acc,
+    )
+
+    if not 0.0 <= t_lo < t_hi <= 1.0:
+        raise DistillError(
+            f"band thresholds need 0 <= t_lo < t_hi <= 1, got "
+            f"({t_lo}, {t_hi})")
+    w = np.asarray(params.w_int8, np.int32).astype(np.int32)
+    if w.shape != (schema.NUM_FEATURES,):
+        raise DistillError(f"expected [{schema.NUM_FEATURES}] weights, "
+                           f"got shape {w.shape}")
+    in_zp = int(np.asarray(params.in_zp))
+    w_sum = int(w.sum())
+
+    # -- the exact device-side quantization chain (u32 -> quant value).
+    # Identical code to the serving decode+observer: u32 -> f32 cast,
+    # feature transform, per-tensor affine quantize — all ON DEVICE, so
+    # backend-specific rounding is captured, not modeled.  params MUST
+    # be a traced ARGUMENT, exactly as the engine passes them into its
+    # jitted step: closing over them bakes in_scale into the graph as a
+    # constant, and XLA:CPU then strength-reduces x / const into
+    # x * (1/const) — off by one ULP at round-half boundaries versus
+    # the true division the served graph performs (observed: golden
+    # x=162992120 quantizes 173 closed-over vs 172 served).
+    @jax.jit
+    def _qchain(p, x_u32):
+        x = jnp.asarray(x_u32).astype(jnp.float32)
+        x = _maybe_log1p(p, x)
+        return _quantize_u8(x, p.in_scale, p.in_zp)
+
+    def qchain(x_u32):
+        return _qchain(params, x_u32)
+
+    qbase_scalar = int(np.asarray(qchain(np.zeros(1, np.uint32)))[0])
+    # per-tensor observer: one boundary table, tiled per feature (the
+    # map layout stays per-feature for a future per-channel observer)
+    targets = np.arange(qbase_scalar + 1, 256, dtype=np.int64)
+    b = _bisect_bounds(qchain, targets) if len(targets) else \
+        np.empty(0, np.int64)
+    n_real = int((b <= U32_MAX).sum())
+    bounds_row = np.full(schema.ML_BOUNDS_PER_FEATURE, _PAD, np.uint32)
+    if n_real:
+        # q(0) = qbase < target  =>  every reachable boundary is >= 1,
+        # so (b - 1) stays in u32 and the kernel's unsigned
+        # (b_m1 - x) sign trick is exact
+        bounds_row[:n_real] = (b[:n_real] - 1).astype(np.uint32)
+    bounds_m1 = np.tile(bounds_row, (schema.NUM_FEATURES, 1))
+    qbase = np.full(schema.NUM_FEATURES, qbase_scalar, np.uint32)
+
+    # -- exact band thresholds: sweep the ENTIRE reachable accumulator
+    # range through the served score tail and read the edges off it.
+    contrib = (np.arange(256)[None, :] - in_zp) * w[:, None]  # [8, 256]
+    amin = int(contrib.min(axis=1).sum())
+    amax = int(contrib.max(axis=1).sum())
+    accs = np.arange(amin, amax + 1, dtype=np.int32)
+    g = np.asarray(jax.jit(score_from_acc)(params, accs), np.float64)
+    if not (np.diff(g) >= 0).all():
+        i = int(np.argmin(np.diff(g)))
+        raise DistillError(
+            f"score_from_acc is not monotone at acc={amin + i} "
+            f"({g[i]} -> {g[i + 1]}); the threshold inversion is unsound "
+            "for this artifact")
+    above = np.nonzero(g > t_hi)[0]
+    below = np.nonzero(g < t_lo)[0]
+    acc_drop_jax = amin + int(above[0]) if len(above) else amax + 1
+    acc_pass_jax = amin + int(below[-1]) if len(below) else amin - 1
+    # fold the zero-point: kernel sums raw w*q, JAX sums w*(q - zp)
+    acc_drop = acc_drop_jax + in_zp * w_sum
+    acc_pass = acc_pass_jax + in_zp * w_sum
+    if acc_drop <= acc_pass:
+        raise DistillError(
+            f"degenerate bands: acc_drop ({acc_drop}) <= acc_pass "
+            f"({acc_pass}) — every packet would be both confident-attack "
+            "and confident-benign; widen (t_lo, t_hi)")
+
+    plan = DistillPlan(
+        w=w, qbase=qbase, bounds_m1=bounds_m1,
+        acc_drop=acc_drop, acc_pass=acc_pass,
+        t_lo=float(t_lo), t_hi=float(t_hi),
+        in_zp=in_zp, w_sum=w_sum,
+        meta={
+            "log1p": bool(int(np.asarray(getattr(params, "log1p", 0)))),
+            "in_scale": float(np.asarray(params.in_scale)),
+            "n_bounds": n_real,
+            "qbase": qbase_scalar,
+            "score_min": float(g[0]), "score_max": float(g[-1]),
+            "acc_range": [amin, amax],
+            "backend": jax.default_backend(),
+        },
+    )
+
+    if validate:
+        # boundary-local exactness + a broad replay: table rank must
+        # reproduce the device chain at every boundary neighborhood,
+        # the saturation corners, and a large uniform u32 sample
+        edges = np.unique(np.concatenate([
+            b[:n_real], b[:n_real] - 1, b[:n_real] + 1,
+            np.array([0, 1, 7, 8, 9, 255, 1 << 16, (1 << 24) - 1,
+                      1 << 24, (1 << 24) + 1, 1 << 31, U32_MAX,
+                      U32_MAX - 1], np.int64),
+        ]))
+        edges = edges[(edges >= 0) & (edges <= U32_MAX)].astype(np.uint32)
+        rng = np.random.default_rng(seed)
+        xs = np.concatenate([
+            edges, rng.integers(0, 1 << 32, size=sample, dtype=np.uint64
+                                ).astype(np.uint32)])
+        want = np.asarray(qchain(xs), np.int64)
+        got = qbase_scalar + np.searchsorted(bounds_row, xs, side="left")
+        bad = np.nonzero(want != got)[0]
+        if len(bad):
+            x = int(xs[bad[0]])
+            raise DistillError(
+                f"boundary table diverges from the device observer at "
+                f"x={x}: table rank {int(got[bad[0]])} != device "
+                f"q {int(want[bad[0]])} ({len(bad)}/{len(xs)} points)")
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Packing: the ml_model_map value (hot-swap payload) and the .npz plan
+# ---------------------------------------------------------------------------
+
+
+def pack_blob(plan: DistillPlan) -> bytes:
+    """Serialize into the ``struct fsx_ml_model`` map value
+    (``schema.ML_MODEL_*`` layout; diffed by ``fsx check``)."""
+    out = struct.pack("<II", 1, 0)  # valid, _reserved
+    out += struct.pack("<qq", plan.acc_drop, plan.acc_pass)
+    out += plan.w.astype("<i4").tobytes()
+    out += plan.qbase.astype("<u4").tobytes()
+    out += np.ascontiguousarray(plan.bounds_m1, "<u4").tobytes()
+    if len(out) != schema.ML_MODEL_SIZE:
+        raise DistillError(
+            f"packed blob is {len(out)} B, schema says "
+            f"{schema.ML_MODEL_SIZE} B — schema drift (run fsx check)")
+    return out
+
+
+def unpack_blob(blob: bytes) -> DistillPlan:
+    """Inverse of :func:`pack_blob` (thresholds in probability space
+    are not carried on the wire; they come back as NaN markers)."""
+    if len(blob) != schema.ML_MODEL_SIZE:
+        raise DistillError(f"blob is {len(blob)} B, want "
+                           f"{schema.ML_MODEL_SIZE}")
+    valid, _ = struct.unpack_from("<II", blob, 0)
+    if not valid:
+        raise DistillError("blob has valid=0 (no model)")
+    acc_drop, acc_pass = struct.unpack_from(
+        "<qq", blob, schema.ML_MODEL_ACC_DROP_OFFSET)
+    nf = schema.NUM_FEATURES
+    w = np.frombuffer(blob, "<i4", nf, schema.ML_MODEL_W_OFFSET)
+    qbase = np.frombuffer(blob, "<u4", nf, schema.ML_MODEL_QBASE_OFFSET)
+    bounds = np.frombuffer(
+        blob, "<u4", nf * schema.ML_BOUNDS_PER_FEATURE,
+        schema.ML_MODEL_BOUNDS_OFFSET,
+    ).reshape(nf, schema.ML_BOUNDS_PER_FEATURE)
+    return DistillPlan(
+        w=w.astype(np.int32), qbase=qbase.copy(), bounds_m1=bounds.copy(),
+        acc_drop=int(acc_drop), acc_pass=int(acc_pass),
+        t_lo=float("nan"), t_hi=float("nan"),
+        in_zp=0, w_sum=int(w.sum()), meta={"from": "blob"},
+    )
+
+
+PLAN_SCHEMA_VERSION = 1
+
+
+def save_plan(plan: DistillPlan, path: str) -> str:
+    """Persist as .npz (the ``fsx distill --out`` artifact; consumed by
+    ``fsx serve --sim-kernel-tier`` and ``fsx distill --pin``)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(
+        path,
+        w=plan.w, qbase=plan.qbase, bounds_m1=plan.bounds_m1,
+        acc_drop=np.int64(plan.acc_drop), acc_pass=np.int64(plan.acc_pass),
+        t_lo=np.float64(plan.t_lo), t_hi=np.float64(plan.t_hi),
+        in_zp=np.int64(plan.in_zp), w_sum=np.int64(plan.w_sum),
+        meta=json.dumps(plan.meta),
+        plan_schema_version=PLAN_SCHEMA_VERSION,
+    )
+    return path
+
+
+def load_plan(path: str) -> DistillPlan:
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path) as z:
+        version = int(z["plan_schema_version"]) \
+            if "plan_schema_version" in z else 0
+        if version != PLAN_SCHEMA_VERSION:
+            raise DistillError(
+                f"plan schema version {version} != {PLAN_SCHEMA_VERSION} "
+                f"(re-run fsx distill to regenerate {path})")
+        return DistillPlan(
+            w=z["w"].astype(np.int32),
+            qbase=z["qbase"].astype(np.uint32),
+            bounds_m1=z["bounds_m1"].astype(np.uint32),
+            acc_drop=int(z["acc_drop"]), acc_pass=int(z["acc_pass"]),
+            t_lo=float(z["t_lo"]), t_hi=float(z["t_hi"]),
+            in_zp=int(z["in_zp"]), w_sum=int(z["w_sum"]),
+            meta=json.loads(str(z["meta"])),
+        )

@@ -100,6 +100,16 @@ class EngineReport(NamedTuple):
     #: path — one shm-slot-view → arena memcpy, then the device_put
     #: boundary), plus the arena geometry.  None before the first run.
     dispatch: dict | None = None
+    #: Two-tier escalation accounting (kernel-distilled classifier,
+    #: flowsentryx_tpu/distill/): band thresholds plus per-band record
+    #: counts — kernel drops / suppressed passes / escalations — and
+    #: the derived escalation ratio and kernel-drop Hz.  Filled from a
+    #: simulated tier (``Engine(kernel_tier=SimKernelTier(...))`` /
+    #: ``fsx serve --sim-kernel-tier``; rootless CI path); a real
+    #: deployment reads the same split off the kernel stats map
+    #: (``fsx status --pin``: dropped_ml / ml_pass / ml_escalated).
+    #: None when no kernel tier fronts the engine.
+    escalation: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -147,10 +157,29 @@ class Engine:
         mega_auto: bool = False,
         sink_thread: bool | None = None,
         audit: bool | None = None,
+        kernel_tier: Any | None = None,
     ):
         self.cfg = cfg
         self.source = source
         self.sink = sink
+        #: Simulated kernel tier (distill.SimKernelTier protocol:
+        #: ``filter(records) -> records`` + ``report() -> dict``): band-
+        #: splits drained records BEFORE the batcher, exactly where the
+        #: real XDP stage splits them before the ringbuf.  Record-path
+        #: only — sealed-ingest workers and precompact rings deliver
+        #: records the tier cannot rescore (quantized / already sealed).
+        self.kernel_tier = kernel_tier
+        if kernel_tier is not None:
+            if getattr(source, "provides_sealed", False):
+                raise ValueError(
+                    "kernel_tier needs the inline record path; sealed-"
+                    "batch ingest bypasses the record stream (run the "
+                    "real kernel tier via fsx distill --pin instead)")
+            if getattr(source, "precompact", False):
+                raise ValueError(
+                    "kernel_tier cannot rescore a compact-emit ring: "
+                    "records arrive kernel-quantized; the distilled "
+                    "bands are defined on raw u32 features")
         #: Compact-verdict-wire slots (cfg.batch.verdict_k; 0 = the
         #: legacy full [B] fetch per batch).
         self.verdict_k = cfg.batch.verdict_k
@@ -1044,6 +1073,13 @@ class Engine:
                     if hasattr(self.sink, "t0_ns"):
                         self.sink.t0_ns = t0  # sinks translate s -> abs ns
                     self._t0_auto = False
+                # the (simulated) kernel tier splits records exactly
+                # where XDP would: after the drain, before the batcher.
+                # n_polled drives the idle backoff below — a hot source
+                # whose records all drop in-kernel is not an idle link.
+                n_polled = len(records)
+                if self.kernel_tier is not None and n_polled:
+                    records = self.kernel_tier.filter(records)
                 if not len(records):
                     sealed = []
                     if self.precompact:
@@ -1075,9 +1111,12 @@ class Engine:
                 # the largest staged rung they still fill (adaptive),
                 # then singly — so grouping only ever ADDS latency to
                 # batches that were queueing behind a backlog anyway.
+                # Shortness is judged PRE-filter (n_polled): a flood
+                # the kernel tier mostly drops still means a deep
+                # source backlog, exactly when coalescing pays most.
                 for raw in sealed:
                     self._pending.append((raw, self.batcher.pop_seal_time()))
-                self._drain_pending(short=len(records) < requested)
+                self._drain_pending(short=n_polled < requested)
             else:
                 for raw in sealed:
                     self._dispatch(raw, self.batcher.pop_seal_time())
@@ -1091,7 +1130,7 @@ class Engine:
                 if self.batcher.fill:
                     self._dispatch(self.batcher.take(), self.batcher.pop_seal_time())
                 break
-            if not sealed and not len(records):
+            if not sealed and not n_polled:
                 if self._busy_depth() == 0:
                     # Idle link: back off instead of spinning poll() at
                     # 100% CPU (the daemon sleeps 200 µs in its
@@ -1334,6 +1373,14 @@ class Engine:
                       if self._arena is not None else None),
         }
 
+        escalation = None
+        if self.kernel_tier is not None:
+            escalation = self.kernel_tier.report()
+            escalation["kernel_drop_hz"] = round(
+                (escalation.get("kernel_drops", 0)
+                 + escalation.get("blacklist_hits", 0)) / max(wall, 1e-9),
+                1)
+
         # explicit D2H for the report counters (transfer-guard contract)
         st = schema.GlobalStats(*jax.device_get(tuple(self.stats)))
         return EngineReport(
@@ -1352,4 +1399,5 @@ class Engine:
                     else None),
             readback=readback,
             dispatch=dispatch,
+            escalation=escalation,
         )

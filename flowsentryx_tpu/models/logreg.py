@@ -125,6 +125,27 @@ def _quantize_u8(x: jnp.ndarray, scale: jnp.ndarray, zp: jnp.ndarray) -> jnp.nda
     return jnp.clip(q, 0, 255).astype(jnp.int32)
 
 
+def score_from_acc(params: LogRegParams, acc: jnp.ndarray) -> jnp.ndarray:
+    """int32 linear accumulator ``sum((q_x - in_zp) * w_int8)`` →
+    quantized probability — the requant → sigmoid → output-quant tail
+    shared by every int8 lane (steps 2b-5 of :func:`classify`).
+
+    Monotone non-decreasing in ``acc`` (scale products are positive,
+    sigmoid and both quantizers are monotone), which is what lets the
+    kernel distiller (:mod:`flowsentryx_tpu.distill`) invert it into two
+    integer accumulator thresholds and band packets in eBPF without ever
+    computing a sigmoid in the kernel.  Keeping it factored here is the
+    distiller's exactness contract: the threshold sweep calls THIS
+    function, so kernel bands cannot drift from served scores.
+    """
+    y = acc.astype(jnp.float32) * (params.in_scale * params.w_scale) + params.bias
+    q_y = _quantize_u8(y, params.out_scale, params.out_zp)
+    y_dq = (q_y - params.out_zp).astype(jnp.float32) * params.out_scale
+    p = jax.nn.sigmoid(y_dq)
+    # torch quantized sigmoid output: scale 1/256, zero_point 0
+    return jnp.clip(jnp.round(p * 256.0), 0, 255) * (1.0 / 256.0)
+
+
 def classify(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
     """Score one 8-feature vector through the exact int8 pipeline.
 
@@ -146,13 +167,7 @@ def classify(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
     acc = jnp.sum(
         (q_x - params.in_zp) * params.w_int8.astype(jnp.int32), dtype=jnp.int32
     )
-    y = acc.astype(jnp.float32) * (params.in_scale * params.w_scale) + params.bias
-    q_y = _quantize_u8(y, params.out_scale, params.out_zp)
-    y_dq = (q_y - params.out_zp).astype(jnp.float32) * params.out_scale
-    p = jax.nn.sigmoid(y_dq)
-    # torch quantized sigmoid output: scale 1/256, zero_point 0
-    q_p = jnp.clip(jnp.round(p * 256.0), 0, 255)
-    return q_p * (1.0 / 256.0)
+    return score_from_acc(params, acc)
 
 
 def classify_float(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
@@ -196,13 +211,7 @@ def classify_batch_int8_matmul(params: LogRegParams, x: jnp.ndarray) -> jnp.ndar
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )[:, 0] + (128 - params.in_zp) * w_sum
-    y = acc.astype(jnp.float32) * (params.in_scale * params.w_scale) + params.bias
-    q_y = jax.vmap(_quantize_u8, in_axes=(0, None, None))(
-        y, params.out_scale, params.out_zp
-    )
-    y_dq = (q_y - params.out_zp).astype(jnp.float32) * params.out_scale
-    p = jax.nn.sigmoid(y_dq)
-    return jnp.clip(jnp.round(p * 256.0), 0, 255) * (1.0 / 256.0)
+    return score_from_acc(params, acc)
 
 
 # ---------------------------------------------------------------------------

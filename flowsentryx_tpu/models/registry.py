@@ -21,6 +21,14 @@ class ModelSpec:
     name: str
     init: Callable[..., Any]                    # (key?, **kw) -> params
     classify_batch: Callable[[Any, jnp.ndarray], jnp.ndarray]  # (params, [B,8]) -> [B]
+    #: ``fsx distill`` can compile this family's artifacts into the
+    #: kernel tier: the served lane must be the int8 logreg pipeline
+    #: (monotone accumulator → score tail) whose bands the distiller
+    #: inverts exactly.  Families serving any other function (MLP
+    #: hidden layers, multiclass heads, the float lane) stay False —
+    #: a distilled band there would silently diverge from served
+    #: verdicts.
+    distillable: bool = False
 
 
 _REGISTRY: dict[str, ModelSpec] = {}
@@ -44,6 +52,38 @@ def get_model(name: str) -> ModelSpec:
 
 def registered_models() -> list[str]:
     return sorted(_REGISTRY)
+
+
+def distillable_models() -> list[str]:
+    return sorted(n for n, s in _REGISTRY.items() if s.distillable)
+
+
+def require_distillable(name: str, params: Any) -> None:
+    """Refuse a (model, artifact) pair the kernel distiller cannot
+    compile, BEFORE any emission work — the ``fsx distill`` pre-gate.
+
+    Two layers: the model family must serve the int8 logreg lane
+    (``ModelSpec.distillable``), and the artifact must actually carry
+    that family's quantization observers (an artifact from another
+    family loaded under a logreg name would otherwise die deep in the
+    boundary search with an attribute error).
+    """
+    spec = get_model(name)
+    if not spec.distillable:
+        raise ValueError(
+            f"model {name!r} is not distillable: fsx distill compiles "
+            "the int8 logistic-regression lane (quantize -> int8 dot -> "
+            "requant -> sigmoid) into eBPF, and this family serves a "
+            "different function. Supported families: "
+            f"{distillable_models()}")
+    missing = [f for f in ("w_int8", "bias", "w_scale", "in_scale",
+                           "in_zp", "out_scale", "out_zp")
+               if not hasattr(params, f)]
+    if missing:
+        raise ValueError(
+            f"artifact is not a {name!r} params pytree: missing "
+            f"quantization fields {missing} (is this an artifact from "
+            "another model family?)")
 
 
 def load_artifact(name: str, path: str):
@@ -86,6 +126,7 @@ register_model(
         # the dot_general form: one int8 matmul on the MXU instead of a
         # vmapped per-row reduction (bit-identical; see test_models)
         classify_batch=_logreg.classify_batch_int8_matmul,
+        distillable=True,
     )
 )
 register_model(
@@ -111,6 +152,9 @@ register_model(
         name="logreg_int8_pallas",
         init=lambda key=None, **kw: _logreg.golden_params(),
         classify_batch=_pallas_score,
+        # bit-identical to logreg_int8 (test-pinned), so the same
+        # distilled bands serve both
+        distillable=True,
     )
 )
 from flowsentryx_tpu.models import multiclass as _multiclass  # noqa: E402

@@ -23,6 +23,14 @@
  * SURVEY.md §5.3).  The TPU plane adds ML verdicts by writing into
  * blacklist_map through the daemon.
  *
+ * In-kernel ML (the reference's fsx_kern_ml.c ambition) ships in the
+ * ASSEMBLER twin only: bpf/progs.py build(ml=True) adds fn_ml_score —
+ * a distilled int8 classifier (struct fsx_ml_model in fsx_schema.h,
+ * hot-swapped via ml_model_map by `fsx distill --pin`) banding each
+ * would-be-emitted record into drop/pass/escalate (docs/DISTILL.md).
+ * This C twin stays the pre-ML reference implementation; its behavior
+ * is identical to an --ml image with no model pushed (valid == 0).
+ *
  * Verifier discipline (fsx_kern_ml.c:1-17 constraints): every map
  * lookup NULL-checked, no unbounded loops, no floats (token bucket
  * uses milli-tokens), stack < 512 B.

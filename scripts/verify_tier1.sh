@@ -61,6 +61,15 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
     --out artifacts/AUDIT_r08.json || exit 1
 
+echo "== fsx distill: kernel-tier compile + static check + JAX<->BPF parity =="
+# Compiles the shipped artifact into the kernel tier, statically
+# verifies both --ml program variants, and proves bit-exact band
+# parity by EXECUTING the emitted scorer bytecode over a 10k-vector
+# corpus (docs/DISTILL.md); rewrites artifacts/DISTILL_r10.json.
+env JAX_PLATFORMS=cpu python -m flowsentryx_tpu.cli distill \
+    artifacts/logreg_int8.npz --check --emulate \
+    --report artifacts/DISTILL_r10.json || exit 1
+
 echo "== dispatch smoke: single-copy staging + adaptive coalescing =="
 # Bounded CPU smoke of the zero-copy dispatch pipeline: proves
 # host copies/batch == 1.0 (shm slot view -> arena -> device) and that

