@@ -42,8 +42,15 @@ CARRY_NAMES = ["table.key", "table.state"] + [
 #: scan-over-shard_map graph a mesh+mega engine actually serves — its
 #: contracts are NOT implied by sharded and megastep separately (the
 #: scan could drop the table donation or add a collective of its own).
+#: "device_loop"/"sharded_device_loop" are the drain-ring deep scans
+#: (fused/device_loop.py) a ``--device-loop N`` engine serves — again
+#: their own compiled artifacts: the nested scan carries table/stats
+#: across a whole ring round and its wire output is ``[R, 2K+4]``
+#: (one merged wire per slot), both of which must be proved on THAT
+#: graph, not inferred from the megastep's.
 ALL_VARIANTS = ("raw", "compact", "sharded", "megastep",
-                "sharded_megastep")
+                "sharded_megastep", "device_loop",
+                "sharded_device_loop")
 
 
 @dataclasses.dataclass
@@ -128,6 +135,7 @@ def _audit_one(
     donate_leaves: int,
     quantized: bool,
     n_param_leaves: int,
+    ring_depth: int = 0,
 ) -> VariantReport:
     """Stage one variant and run every contract on it."""
     findings: list[Finding] = []
@@ -160,8 +168,22 @@ def _audit_one(
                         "dtype": str(np.dtype(leaf.dtype)),
                         "bytes": int(nbytes)})
         if n.endswith(".wire"):
-            wire_words = int(np.prod(leaf.shape, dtype=np.int64))
-            wire_bytes = int(nbytes)
+            shape = tuple(int(s) for s in leaf.shape)
+            if ring_depth:
+                # the ring's wire output is [R, 2K+4]: ONE merged wire
+                # PER SLOT — reported and pinned per slot (the round's
+                # total D2H is ring * that, fetched as one buffer)
+                if len(shape) != 2 or shape[0] != ring_depth:
+                    findings.append(Finding(
+                        contract="transfer", where=n,
+                        reason=(f"device-loop wire has shape {shape}, "
+                                f"expected [{ring_depth}, 2K+4] — one "
+                                "merged verdict wire per ring slot")))
+                wire_words = shape[-1]
+                wire_bytes = wire_words * 4
+            else:
+                wire_words = int(np.prod(leaf.shape, dtype=np.int64))
+                wire_bytes = int(nbytes)
             if np.dtype(leaf.dtype) != np.uint32:
                 findings.append(Finding(
                     contract="transfer", where=n,
@@ -250,6 +272,7 @@ def run_audit(
     variants: tuple[str, ...] | None = None,
     donate: bool | None = None,
     mega_sizes: tuple[int, ...] | None = None,
+    device_loop: int = 0,
 ) -> AuditReport:
     """Stage and audit the requested step variants under ``cfg``.
 
@@ -269,6 +292,14 @@ def run_audit(
     more than one size the per-size reports are named
     ``megastep@<n>``; ``None`` keeps the single-``mega_n`` staging and
     plain names.
+
+    ``device_loop >= 1`` additionally stages the drain-ring deep scan
+    (``device_loop@<ring>x<chunks>``, chunks = the ladder's top rung):
+    the 528 B-PER-SLOT wire pin on the ``[ring, 2K+4]`` output, the
+    donation aliasing proof for the carried ring state (table/stats
+    threading the nested scan), the no-hidden-callback sweep, and the
+    retrace sentinel, each on the graph a ``--device-loop`` engine
+    actually serves.
     """
     notes: list[str] = []
     if donate is None:
@@ -284,11 +315,13 @@ def run_audit(
     shardable = mesh is not None and int(mesh.devices.size) > 1
     sizes = _normalize_mega_sizes(mega_sizes, mega_n)
     mega_ok = bool(sizes)
+    ring_ok = device_loop >= 1 and mega_ok
     if variants is None:
         variants = tuple(
             v for v in ALL_VARIANTS
             if (shardable or not v.startswith("sharded"))
-            and (mega_ok or "megastep" not in v))
+            and (mega_ok or "megastep" not in v)
+            and (ring_ok or "device_loop" not in v))
         if not shardable:
             notes.append("sharded variants skipped: need a >1-device "
                          "mesh (run under "
@@ -296,14 +329,20 @@ def run_audit(
                          "count=N or on a real slice)")
         if not mega_ok:
             notes.append("megastep variants skipped: mega_n < 1")
+        if device_loop >= 1 and not mega_ok:
+            notes.append("device_loop variants skipped: the ring needs "
+                         "mega group sizes (mega_n >= 1)")
     else:
         bad = [v for v in variants
                if ("megastep" in v and not mega_ok)
+               or ("device_loop" in v and not ring_ok)
                or (v.startswith("sharded") and not shardable)]
         if bad:
             raise ValueError(
                 f"variant(s) {bad} need "
-                + ("mega_n >= 1" if "megastep" in bad[0]
+                + ("device_loop >= 1 and mega_n >= 1"
+                   if "device_loop" in bad[0]
+                   else "mega_n >= 1" if "megastep" in bad[0]
                    else "a >1-device mesh"))
 
     def table_args(sharded: bool):
@@ -380,6 +419,38 @@ def run_audit(
                     quantized=cfg.model.quantized,
                     n_param_leaves=n_param_leaves))
             continue
+        elif name in ("device_loop", "sharded_device_loop"):
+            # the drain-ring deep scan: ring slots of top-rung groups,
+            # staged with the exact shapes a --device-loop engine
+            # uploads (R separate [chunks, B+1, words] slot arguments)
+            from flowsentryx_tpu.fused import device_loop as dl
+
+            is_sh = name == "sharded_device_loop"
+            chunks = max(sizes)
+            if is_sh:
+                jitted = dl.make_sharded_compact_device_loop(
+                    cfg, spec.classify_batch, mesh, device_loop,
+                    chunks, donate=donate, **quant)
+            else:
+                jitted = dl.make_compact_device_loop(
+                    cfg, spec.classify_batch, device_loop, chunks,
+                    donate=donate, **quant)
+
+            def mk(is_sh=is_sh, chunks=chunks):
+                slots = tuple(
+                    np.zeros((chunks, cfg.batch.max_batch + 1,
+                              schema.COMPACT_RECORD_WORDS), np.uint32)
+                    for _ in range(device_loop))
+                return (*table_args(is_sh), params, *slots)
+            reports.append(_audit_one(
+                f"{name}@{device_loop}x{chunks}", jitted, mk,
+                verdict_k=cfg.batch.verdict_k, expect_sharded=is_sh,
+                donate_leaves=((2 if is_sh else len(CARRY_NAMES))
+                               if donate else 0),
+                quantized=cfg.model.quantized,
+                n_param_leaves=n_param_leaves,
+                ring_depth=device_loop))
+            continue
         else:
             raise ValueError(f"unknown audit variant {name!r}")
         reports.append(_audit_one(
@@ -400,6 +471,7 @@ def run_audit(
             else 1,
             "mega_n": mega_n,
             "mega_sizes": list(sizes),
+            "device_loop": device_loop,
             "donate": bool(donate),
         },
         backend=jax.default_backend(),
@@ -424,6 +496,7 @@ def boot_audit(
     mega_n: int,
     params: Any | None = None,
     mega_sizes: tuple[int, ...] | None = None,
+    device_loop: int = 0,
 ) -> AuditReport | None:
     """Audit exactly the variants a booting engine is about to serve
     and refuse the boot (raise :class:`AuditError`) on any violated
@@ -432,7 +505,10 @@ def boot_audit(
     ``mega_sizes`` is the adaptive engine's group-size ladder: every
     size stages (and is cached) as its own variant, and the cache key
     includes the SET — an engine re-booting with a different ladder is
-    serving different compiled artifacts and must re-prove them."""
+    serving different compiled artifacts and must re-prove them.
+    ``device_loop`` is the drain-ring depth, in the cache key for the
+    same reason: a different ring depth is a different deep-scan
+    artifact."""
     shardable = mesh is not None and int(mesh.devices.size) > 1
     variants: list[str] = []
     if shardable:
@@ -446,11 +522,15 @@ def boot_audit(
         # auditing sharded + single-device megastep separately would
         # leave the variant that actually serves unproved
         variants.append("sharded_megastep" if shardable else "megastep")
+    device_loop = int(device_loop)
+    if device_loop >= 1 and sizes:
+        variants.append("sharded_device_loop" if shardable
+                        else "device_loop")
     # The cache key must cover everything that changes the STAGED
-    # graph: config, wire, mesh, the group-size set — and the params
-    # leaves' shapes/dtypes (a later engine serving a different
-    # artifact, e.g. an f64-poisoned .npz, is a different graph and
-    # must re-audit).
+    # graph: config, wire, mesh, the group-size set, the ring depth —
+    # and the params leaves' shapes/dtypes (a later engine serving a
+    # different artifact, e.g. an f64-poisoned .npz, is a different
+    # graph and must re-audit).
     if params is None:
         params_sig = ("default", cfg.model.name)
     else:
@@ -459,12 +539,12 @@ def boot_audit(
             (str(np.dtype(getattr(l, "dtype", type(l)))),
              tuple(getattr(l, "shape", ()))) for l in leaves)
     key = (cfg.to_json(), wire, shardable and int(mesh.devices.size),
-           sizes, tuple(variants), params_sig)
+           sizes, device_loop, tuple(variants), params_sig)
     if _BOOT_CACHE.get(key):
         return None
     rep = run_audit(cfg, params=params, mesh=mesh,
                     mega_n=mega_n or 2, variants=tuple(variants),
-                    mega_sizes=sizes or None)
+                    mega_sizes=sizes or None, device_loop=device_loop)
     rep.raise_if_failed()
     _BOOT_CACHE[key] = True
     return rep

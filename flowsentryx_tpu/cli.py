@@ -265,6 +265,16 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     _honor_jax_platform()
     from flowsentryx_tpu.audit import run_audit, runner
 
+    # Flag validation BEFORE any JAX/mesh boot (the fsx serve
+    # fail-fast ordering): a usage error must not cost the user the
+    # multi-second backend init.
+    if args.device_loop < 0:
+        print("fsx audit: --device-loop must be >= 0", file=sys.stderr)
+        return 1
+    if args.device_loop and not args.mega:
+        print("fsx audit: --device-loop needs --mega N|auto (the ring "
+              "scans top-rung mega groups)", file=sys.stderr)
+        return 1
     cfg = _load_cfg(args)
     if args.verdict_k is not None:
         if args.verdict_k < 1:
@@ -300,9 +310,11 @@ def _cmd_audit(args: argparse.Namespace) -> int:
         from flowsentryx_tpu.ops.fused import pow2_group_sizes
 
         rep = run_audit(cfg, mesh=mesh, mega_n=MEGA_AUTO_MAX,
-                        mega_sizes=pow2_group_sizes(MEGA_AUTO_MAX))
+                        mega_sizes=pow2_group_sizes(MEGA_AUTO_MAX),
+                        device_loop=args.device_loop)
     else:
-        rep = run_audit(cfg, mesh=mesh, mega_n=args.mega)
+        rep = run_audit(cfg, mesh=mesh, mega_n=args.mega,
+                        device_loop=args.device_loop)
     if args.out:
         runner.write_artifact(rep, args.out)
     if args.json:
@@ -706,6 +718,29 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "(deploy the real tier via fsx distill --pin instead)",
               file=sys.stderr)
         return 1
+    # Device-loop refusals BEFORE the multi-second JAX boot.  The ring
+    # rides the mega ladder (each slot carries one top-rung group) and
+    # reads verdicts back exclusively through the per-slot compact
+    # wires — both are structural, not preferences, so a combination
+    # that breaks them (or the arena slot-safety accounting built on
+    # them) is refused here with its actual problem named.
+    if args.device_loop < 0:
+        print("fsx serve: --device-loop must be >= 0 (0 = per-group "
+              "dispatch, the parity baseline)", file=sys.stderr)
+        return 1
+    if args.device_loop and not args.mega:
+        print("fsx serve: --device-loop requires --mega N|auto: each "
+              "ring slot carries one top-rung coalescing group (the "
+              "deep scan is a ring of megasteps)", file=sys.stderr)
+        return 1
+    if args.device_loop and args.verdict_k == 0:
+        print("fsx serve: --device-loop is incompatible with "
+              "--verdict-k 0: the ring's only steady-state readback is "
+              "the per-slot compact verdict wire, and without it every "
+              "round would fetch full [ring*mega, B] block arrays — "
+              "the exact transfer the ring exists to amortize",
+              file=sys.stderr)
+        return 1
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
@@ -837,13 +872,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             return 1
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
                  mega_n=args.mega or 0,
+                 device_loop=args.device_loop,
                  sink_thread=False if args.no_sink_thread else None,
                  audit=True if args.audit else None,
                  kernel_tier=kernel_tier)
     if args.restore:
         eng.restore(args.restore)
     if args.mega:
-        # pay both compiles at boot, not on the first traffic backlog
+        # pay every staged compile (each ladder rung, and the deep-scan
+        # ring graph) at boot, not on the first traffic backlog
         eng.warm()
     import contextlib
 
@@ -1412,6 +1449,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "or 'auto' to audit every rung of the "
                          "adaptive power-of-two ladder (one staged "
                          "artifact per group size)")
+    au.add_argument("--device-loop", type=int, default=0, metavar="N",
+                    help="also stage + audit the drain-ring deep scan "
+                         "at ring depth N (the graph fsx serve "
+                         "--device-loop N serves: [N, 2K+4] per-slot "
+                         "wire pin, ring-carry donation proof, no "
+                         "hidden callbacks); needs --mega")
     au.add_argument("--quick", action="store_true",
                     help="small table/batch shapes (CI gate); the "
                          "contracts are shape-generic, only the "
@@ -1540,6 +1583,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "power-of-two group size up to 8 and dispatch "
                         "the largest the instantaneous backlog fills, "
                         "so partial backlogs amortize too")
+    s.add_argument("--device-loop", type=int, default=0, metavar="N",
+                   help="device-resident drain ring of depth N: a deep-"
+                        "scan dispatch consumes N staged ring slots "
+                        "(one top-rung --mega group each) per host "
+                        "round-trip, carrying table/stats on-device "
+                        "across the whole round while the NEXT round's "
+                        "slots upload (double-buffered H2D) and the "
+                        "pipeline worker harvests per-slot verdict "
+                        "wires; requires --mega; 0 = per-group "
+                        "dispatch, the parity baseline")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
     s.add_argument("--checkpoint-every", type=float, default=0,
                    help="ALSO checkpoint every S seconds while serving "

@@ -65,11 +65,49 @@ class DispatchArena:
         self._mm = mmap.mmap(-1, nbytes)
         self.buf = np.frombuffer(self._mm, np.uint32).reshape(
             slots, group_max, max_batch + 1, words)
+        # Pre-fault every page NOW: anonymous mmap pages materialize on
+        # first write, and a ring-sized arena left lazy pays its page
+        # faults inside the first serving rounds' staging memcpys — a
+        # boot cost billed to the hot path (measured as a consistently
+        # slow first drain window on the ring arena).
+        self.buf[...] = 0
         self._cur = -1
 
     @property
     def nbytes(self) -> int:
         return self.buf.nbytes
+
+    @staticmethod
+    def ring_safe_slots(readback_depth: int, ring: int) -> int:
+        """Slot count that keeps the reuse-safety rule when a
+        device-loop ring holds up to ``ring`` uploaded slices in
+        flight — the generalization of the single-buffer
+        ``readback_depth + 2`` rule (which is the ``ring = 1`` case).
+
+        The proof mirrors the module docstring's, with one new term.
+        A slot is recycled only at :meth:`claim` time, and the engine
+        claims only after ``_reap`` has bounded dispatched-but-unsunk
+        batches by ``readback_depth``.  At that instant the slots that
+        must stay immutable are:
+
+        * **sunk-pending slots** — every unsunk batch pins at most one
+          slot (a single in its own slot is the worst case; a C-chunk
+          group shares one slot, a ring round pins ``ring`` slots for
+          ``ring * chunks`` batches — 1/chunks per batch), so at most
+          ``readback_depth`` slots;
+        * **uploaded-but-unlaunched slots** — ring mode ``device_put``s
+          each slot slice the moment it fills (the double-buffered H2D
+          half) and launches only when ``ring`` are ready, so up to
+          ``ring - 1`` uploaded slices plus the slot being filled: on
+          CPU the transfer may ALIAS the arena rows, so these pin too;
+        * the claim itself: ``+1``.
+
+        Hence ``slots = readback_depth + ring + 1``; ``ring = 1``
+        (one in-flight device buffer) recovers ``readback_depth + 2``.
+        """
+        if ring < 1:
+            raise ValueError(f"ring must be >= 1, got {ring}")
+        return max(readback_depth, 1) + ring + 1
 
     def claim(self) -> int:
         """Next slot index, recycling the oldest.  Callers claim only

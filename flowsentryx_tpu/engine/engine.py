@@ -29,6 +29,16 @@ XLA's own pool (the ``donate=None`` auto-detect idiom).
 The blacklist tolerates the remaining small writeback delay by design —
 the kernel limiter stands alone during the gap (fail-open, SURVEY.md
 §5.3).
+
+**Device-loop mode** (``device_loop=N`` / ``fsx serve --device-loop``)
+replaces the sink thread with the device-PIPELINE worker: the second
+thread both LAUNCHES the deep-scan rounds (fused/device_loop.py — on
+XLA:CPU the step's scatter custom-calls execute synchronously, so the
+launch call blocks for the whole round's compute; putting it on the
+worker is what lets staging overlap compute at all) and harvests their
+per-slot verdict wires.  The dispatch thread's steady state becomes
+poll → stage → upload → submit, with the upload↔compute overlap
+measured in ``EngineReport.dispatch["device_loop"]["h2d"]``.
 """
 
 from __future__ import annotations
@@ -128,6 +138,14 @@ class Engine:
     oldest verdicts are fetched and sunk (``None`` = the config's
     ``BatchConfig.readback_depth``).
 
+    ``device_loop`` (0 = off) is the drain-ring depth: N staged ring
+    slots — one top-rung ``mega_n`` group each — consumed by ONE
+    deep-scan dispatch per host round-trip, with the next round's
+    slots uploading while the current one computes (module docstring;
+    requires mega grouping and ``verdict_k >= 1``; ``readback_depth``
+    must cover one round — the config default is auto-raised, an
+    explicit smaller value refused).
+
     ``audit`` (``None`` = on when ``FSX_AUDIT=1``) statically audits
     the serving step's graph contracts at boot — dtypes, donation
     aliasing, transfer budget, retrace stability, collectives
@@ -155,6 +173,7 @@ class Engine:
         wire: str | None = None,
         mega_n: int | str = 0,
         mega_auto: bool = False,
+        device_loop: int = 0,
         sink_thread: bool | None = None,
         audit: bool | None = None,
         kernel_tier: Any | None = None,
@@ -302,6 +321,10 @@ class Engine:
         self.stats = self._put(schema.make_stats())
         # None = the config's pipe depth (BatchConfig.readback_depth,
         # validated >= 1 at construction); an explicit int overrides.
+        # The explicitness is remembered: a device-loop engine may
+        # auto-raise a config-default depth to cover one ring round but
+        # must REFUSE an explicit depth that can't.
+        self._depth_explicit = readback_depth is not None
         if readback_depth is None:
             readback_depth = cfg.batch.readback_depth
         self.readback_depth = readback_depth
@@ -362,6 +385,58 @@ class Engine:
                     **quant,
                 )
             self.megastep = self.megasteps[max(self.megasteps)]
+        # -- device-resident drain ring (fused/device_loop.py) ----------
+        # ``device_loop=R`` makes the steady-state loop pull-based from
+        # the device: R staged ring slots (one top-rung group each) go
+        # to the device as ONE deep-scan dispatch carrying table/stats
+        # across all R*C batches, while the NEXT round's slots upload
+        # during the current round's compute (double-buffered H2D).
+        # 0 = today's per-group dispatch (the fallback and the parity
+        # baseline — short backlogs always drain through it).
+        self.ring = int(device_loop)
+        if self.ring < 0:
+            raise ValueError(
+                f"device_loop must be >= 0, got {device_loop}")
+        self._ring_chunks = 0
+        self.ring_step = None
+        if self.ring:
+            if not self.megasteps:
+                raise ValueError(
+                    "device_loop requires mega grouping (mega_n >= 2 or "
+                    "'auto'): each ring slot carries one top-rung group")
+            if self.verdict_k < 1:
+                raise ValueError(
+                    "device_loop requires the compact verdict wire "
+                    "(verdict_k >= 1): the ring's steady-state readback "
+                    "is one [ring, 2K+4] buffer per round")
+            self._ring_chunks = max(self.megasteps)
+            round_b = self.ring * self._ring_chunks
+            if self._depth_explicit and readback_depth < round_b:
+                raise ValueError(
+                    f"device_loop={self.ring} with readback_depth="
+                    f"{readback_depth} < {round_b} (one ring round of "
+                    f"{self.ring}x{self._ring_chunks} batches): the pipe "
+                    "could never keep a round in flight while the next "
+                    "stages, so every H2D upload would serialize behind "
+                    "the drain — raise readback_depth to >= "
+                    f"{round_b} or shrink the ring")
+            if not self._depth_explicit:
+                # a config-default depth grows to cover one full round,
+                # or the ring would be refused for every default
+                # config; an EXPLICIT depth below the round is refused
+                # above instead of silently inflated.
+                readback_depth = max(readback_depth, round_b)
+                self.readback_depth = readback_depth
+            from flowsentryx_tpu.fused import device_loop as dl
+
+            if self.mesh is not None:
+                self.ring_step = dl.make_sharded_compact_device_loop(
+                    cfg, spec.classify_batch, self.mesh, self.ring,
+                    self._ring_chunks, donate=donate, **quant)
+            else:
+                self.ring_step = dl.make_compact_device_loop(
+                    cfg, spec.classify_batch, self.ring,
+                    self._ring_chunks, donate=donate, **quant)
         # Static graph audit at boot (class docstring): prove the
         # serving variant's dtype/donation/transfer/retrace/collective
         # contracts on the staged jaxpr + executable BEFORE the first
@@ -381,6 +456,7 @@ class Engine:
             boot_audit(cfg, wire=self.wire, mesh=self.mesh,
                        mega_n=self.mega_n if self._mega_sizes else 0,
                        mega_sizes=self._mega_sizes or None,
+                       device_loop=self.ring,
                        params=self.params)
         #: Sealed-but-undispatched (raw, t_seal) group candidates.
         self._pending: list[tuple[np.ndarray, float]] = []
@@ -408,8 +484,15 @@ class Engine:
                  else schema.RECORD_WORDS)
         if self.sealed or self.megasteps:
             group_max = max(self.megasteps) if self.megasteps else 1
+            # Slot count: the plain readback_depth+2 rule assumes ONE
+            # in-flight device buffer; a device-loop ring holds up to
+            # ``ring`` uploaded slices in flight per unsunk round, so
+            # the bound is recomputed (ring_safe_slots docstring has
+            # the proof — the non-ring rule is its ring=chunks=1 case).
+            slots = DispatchArena.ring_safe_slots(
+                readback_depth, self.ring or 1)
             self._arena = DispatchArena(
-                slots=readback_depth + 2,
+                slots=slots,
                 # sealed singles still batch their queue drains: give
                 # the slot a few rows even when no megastep is staged
                 group_max=max(group_max, 4) if self.sealed else group_max,
@@ -424,12 +507,25 @@ class Engine:
         self._dispatched_chunks = 0
         self._staged_batches = 0
         self._staged_bytes = 0
+        # device-loop accounting (EngineReport.dispatch["device_loop"])
+        self._ring_rounds = 0
+        self._ring_partial_slots = 0
+        self._h2d_put_s = 0.0
+        self._h2d_overlap_s = 0.0
+        self._h2d_puts = 0
+        self._h2d_puts_overlapped = 0
+        #: How many sealed-but-undispatched batches the loops
+        #: accumulate before the coalescing policy must fire: one ring
+        #: round in device-loop mode, one top-rung group otherwise.
+        self._pending_cap = ((self.ring * self._ring_chunks)
+                             if self.ring else self.mega_n)
         # A wire buffer may be reused only after its batch is off the
         # in-flight queue (or, for a pending group member, dispatched):
-        # keep more buffers than in-flight batches + the pending group.
+        # keep more buffers than in-flight batches + the pending group
+        # (a whole ring round in device-loop mode).
         self.batcher = MicroBatcher(
             cfg.batch, t0_ns=t0_ns or 0,
-            n_buffers=readback_depth + 2 + self.mega_n,
+            n_buffers=readback_depth + 2 + self._pending_cap,
             wire=wire, quant=quant,
         )
         # t0 anchors the device clock (f32 seconds).  None = auto: take
@@ -476,13 +572,23 @@ class Engine:
         # on.  A sink-thread exception lands in _sink_exc and fails the
         # next dispatch-thread _reap loudly.
         self._sink_cv = threading.Condition()
-        self._sinkq: deque[_InFlight] = deque()
+        self._sinkq: deque = deque()
         self._sink_pending = 0
         self._sink_stop = False
         self._sink_exc: BaseException | None = None
         self._sink_active = False
         self._sink_thread_obj: threading.Thread | None = None
         self._sink_busy_s = 0.0
+        # Device-loop mode replaces the post-launch sink thread with
+        # the device-PIPELINE worker: the queue carries pre-launch
+        # submissions (the jit call itself runs on the worker), so the
+        # dispatch thread's steady state is pure stage→upload→submit.
+        # On backends whose step graphs execute synchronously at
+        # dispatch (XLA:CPU runs the step's scatter custom-calls
+        # inline), this is what makes "upload slot i+1 while round i
+        # computes" REAL rather than aspirational — the launch blocks
+        # the worker, not the stager.
+        self._pipe_active = False
         # readback accounting (EngineReport.readback)
         self._d2h_bytes = 0
         self._sink_compact = 0
@@ -499,8 +605,11 @@ class Engine:
         return (jax.device_put(a, self._in_sharding)
                 if self._in_sharding is not None else jax.device_put(a))
 
-    def _dispatch(self, raw: np.ndarray, t_enqueue: float) -> None:
-        n_records = int(raw[self.cfg.batch.max_batch, 0])
+    def _launch_single(self, raw: Any, t_enqueue: float,
+                       n_records: int) -> _InFlight:
+        """The step call + accounting of a single-batch dispatch (runs
+        on the dispatch thread directly, or on the device-pipeline
+        worker in device-loop mode)."""
         with self.metrics.dispatch.time():
             self.table, self.stats, out = self.step(
                 self.table, self.stats, self.params, self._put(raw)
@@ -508,7 +617,33 @@ class Engine:
         self._dispatch_calls += 1
         self._dispatched_chunks += 1
         self._group_hist[1] = self._group_hist.get(1, 0) + 1
-        self._inflight.append(_InFlight(out, t_enqueue, n_records))
+        return _InFlight(out, t_enqueue, n_records)
+
+    def _dispatch(self, raw: np.ndarray, t_enqueue: float) -> None:
+        n_records = int(raw[self.cfg.batch.max_batch, 0])
+        if self._pipe_active:
+            self._submit("single", raw, t_enqueue, n_records, 1)
+            return
+        self._inflight.append(self._launch_single(raw, t_enqueue,
+                                                  n_records))
+
+    def _launch_group(self, raws: Any, t_enqueue: float, n_records: int,
+                      on_device: bool = False) -> _InFlight:
+        """The megastep call + accounting of a group dispatch.
+        ``on_device=True`` skips the H2D put — the buffer is an
+        already-uploaded ring slot."""
+        g = int(raws.shape[0])
+        with self.metrics.dispatch.time():
+            self.table, self.stats, out = self.megasteps[g](
+                self.table, self.stats, self.params,
+                raws if on_device else self._put(raws)
+            )
+        self._dispatch_calls += 1
+        self._dispatched_chunks += g
+        self._group_hist[g] = self._group_hist.get(g, 0) + 1
+        if on_device:
+            self._ring_partial_slots += 1
+        return _InFlight(out, t_enqueue, n_records, n_chunks=g)
 
     def _dispatch_group(self, raws: np.ndarray, t_enqueue: float,
                         n_records: int) -> None:
@@ -520,16 +655,12 @@ class Engine:
         :meth:`_sink_group` ravels, so verdict extraction is unchanged.
         e2e is anchored at the OLDEST member's first-record arrival (the
         honest group latency: earlier members waited for the group)."""
-        g = int(raws.shape[0])
-        with self.metrics.dispatch.time():
-            self.table, self.stats, out = self.megasteps[g](
-                self.table, self.stats, self.params, self._put(raws)
-            )
-        self._dispatch_calls += 1
-        self._dispatched_chunks += g
-        self._group_hist[g] = self._group_hist.get(g, 0) + 1
-        self._inflight.append(
-            _InFlight(out, t_enqueue, n_records, n_chunks=g))
+        if self._pipe_active:
+            self._submit("group", raws, t_enqueue, n_records,
+                         int(raws.shape[0]))
+            return
+        self._inflight.append(self._launch_group(raws, t_enqueue,
+                                                 n_records))
 
     def _dispatch_mega(self, group: list[tuple[np.ndarray, float]]) -> None:
         """Group dispatch of INLINE-path pending buffers: stage the
@@ -546,6 +677,95 @@ class Engine:
         self._staged_bytes += int(rows[0].nbytes) * g
         n_records = int(sum(int(raw[b, 0]) for raw, _ in group))
         self._dispatch_group(rows[:g], min(t for _, t in group), n_records)
+
+    # -- device-loop (drain ring) dispatch ----------------------------------
+
+    def _upload_slot(self, rows: np.ndarray, t_enqueue: float,
+                     n_records: int) -> tuple:
+        """EXPLICIT H2D of one staged ring slice — issued the moment
+        the slot fills, so the transfer overlaps whatever round is
+        still computing (the double-buffered half of the ring).  The
+        overlap accounting feeds
+        ``EngineReport.dispatch["device_loop"]["h2d"]``: an upload
+        issued while dispatched-but-unsunk work exists counts as
+        overlapped — that is the "device never waits on the host"
+        claim, measured rather than asserted."""
+        busy = self._busy_depth() > 0
+        t0 = time.perf_counter()
+        buf = self._put(rows)
+        dt = time.perf_counter() - t0
+        self._h2d_put_s += dt
+        self._h2d_puts += 1
+        if busy:
+            self._h2d_overlap_s += dt
+            self._h2d_puts_overlapped += 1
+        return buf, t_enqueue, n_records
+
+    def _launch_ring(self, devs: list, t_enqueue: float,
+                     n_records: int) -> _InFlight:
+        """The deep-scan call + accounting of a full ring round."""
+        g = self.ring * self._ring_chunks
+        with self.metrics.dispatch.time():
+            self.table, self.stats, out = self.ring_step(
+                self.table, self.stats, self.params, *devs
+            )
+        self._dispatch_calls += 1
+        self._dispatched_chunks += g
+        self._group_hist[g] = self._group_hist.get(g, 0) + 1
+        self._ring_rounds += 1
+        return _InFlight(out, t_enqueue, n_records, n_chunks=g)
+
+    def _dispatch_ring(self, uploaded: list[tuple]) -> None:
+        """ONE deep-scan dispatch over a full ring round (R uploaded
+        slot buffers; fused/device_loop.py): one in-flight entry of
+        ``ring * chunks`` batches whose RingOutput carries one merged
+        verdict wire PER SLOT — the sink harvests the round as a
+        single ``[R, 2K+4]`` fetch."""
+        devs = [u[0] for u in uploaded]
+        t_enqueue = min(u[1] for u in uploaded)
+        n_records = sum(u[2] for u in uploaded)
+        if self._pipe_active:
+            self._submit("ring", devs, t_enqueue, n_records,
+                         self.ring * self._ring_chunks)
+            return
+        self._inflight.append(self._launch_ring(devs, t_enqueue,
+                                                n_records))
+
+    def _dispatch_group_dev(self, dev: Any, t_enqueue: float,
+                            n_records: int) -> None:
+        """Megastep dispatch of an ALREADY-UPLOADED ring slot (a short
+        backlog left the round partial: the uploaded slices flush
+        through the ordinary top-rung megastep, byte-identical by
+        construction — the ring's slot body IS that megastep)."""
+        if self._pipe_active:
+            self._submit("group_dev", dev, t_enqueue, n_records,
+                         self._ring_chunks)
+            return
+        self._inflight.append(self._launch_group(dev, t_enqueue,
+                                                 n_records,
+                                                 on_device=True))
+
+    def _ring_from_pending(self) -> None:
+        """Stage one full ring round out of the inline pending list:
+        R arena slots of C wire buffers each, uploaded slot-by-slot
+        (each ``device_put`` overlapping in-flight compute), then one
+        deep-scan dispatch."""
+        b = self.cfg.batch.max_batch
+        c = self._ring_chunks
+        uploaded: list[tuple] = []
+        for _ in range(self.ring):
+            rows = self._arena.rows(self._arena.claim())
+            group = self._pending[:c]
+            del self._pending[:c]
+            with self.metrics.stage.time():
+                for i, (raw, _) in enumerate(group):
+                    rows[i][...] = raw
+            self._staged_batches += c
+            self._staged_bytes += int(rows[0].nbytes) * c
+            uploaded.append(self._upload_slot(
+                rows[:c], min(t for _, t in group),
+                int(sum(int(raw[b, 0]) for raw, _ in group))))
+        self._dispatch_ring(uploaded)
 
     def _rung_for(self, backlog: int) -> int:
         """THE coalescing policy, shared by the inline and sealed
@@ -564,7 +784,22 @@ class Engine:
         ``mega_n`` the ladder is one rung, which reduces to the
         original all-or-nothing policy; adaptive mode
         (``mega_n="auto"``) is where partial backlogs stop paying the
-        full per-dispatch tax batch by batch."""
+        full per-dispatch tax batch by batch.
+
+        Device-loop mode adds one rung ABOVE the ladder: a backlog
+        holding a whole ring round (``ring * top_rung``) goes as one
+        deep-scan dispatch; anything less falls through to the ladder
+        exactly as before — the ring only ever engages on backlogs
+        that were queueing anyway, so light-load latency is untouched
+        and ``device_loop=0`` remains the byte-identical baseline."""
+        if self.ring:
+            while len(self._pending) >= self._pending_cap:
+                self._ring_from_pending()
+                self._reap(self.readback_depth)
+            if not short:
+                # a full poll means the backlog is still building
+                # toward the next round — hold the remainder
+                return
         top = self._mega_sizes[0]
         while len(self._pending) >= top:
             self._dispatch_mega(self._pending[:top])
@@ -678,14 +913,24 @@ class Engine:
     # -- the sink thread ----------------------------------------------------
 
     def _start_sink_thread(self) -> None:
-        if not self.sink_thread or self._sink_active:
+        if self._sink_active:
+            return
+        if self.ring:
+            # device-loop mode: the pipeline worker (launch + sink)
+            # runs regardless of the sink_thread flag — it IS the
+            # mechanism that overlaps host staging with device compute
+            target, name = self._ring_worker, "fsx-devpipe"
+        elif self.sink_thread:
+            target, name = self._sink_worker, "fsx-sink"
+        else:
             return
         self._sink_stop = False
         self._sink_exc = None
         self._sink_busy_s = 0.0
         self._sink_thread_obj = threading.Thread(
-            target=self._sink_worker, name="fsx-sink", daemon=True)
+            target=target, name=name, daemon=True)
         self._sink_active = True
+        self._pipe_active = bool(self.ring)
         self._sink_thread_obj.start()
 
     def _stop_sink_thread(self) -> None:
@@ -701,6 +946,7 @@ class Engine:
         self._sink_thread_obj.join()
         self._sink_thread_obj = None
         self._sink_active = False
+        self._pipe_active = False
 
     def _sink_worker(self) -> None:
         """Sink-thread main: pop the oldest entry (blocking on its
@@ -736,6 +982,69 @@ class Engine:
                 if exc is not None:
                     return
         except BaseException as e:  # noqa: BLE001 — surfaced by _check_sink
+            with self._sink_cv:
+                self._sink_exc = e
+                self._sink_cv.notify_all()
+
+    def _submit(self, kind: str, payload: Any, t_enqueue: float,
+                n_records: int, n_chunks: int) -> None:
+        """Hand one pre-launch work item to the device-pipeline worker
+        (device-loop mode).  ``_sink_pending`` rises at SUBMIT time, so
+        the ``readback_depth`` backpressure bound covers queued-but-
+        unlaunched work too — the wire/arena reuse-safety arguments
+        both lean on that."""
+        with self._sink_cv:
+            self._sinkq.append((kind, payload, t_enqueue, n_records,
+                                n_chunks))
+            self._sink_pending += n_chunks
+            self._sink_cv.notify_all()
+
+    def _ring_worker(self) -> None:
+        """Device-pipeline worker main (device-loop mode): pop the
+        oldest submission, LAUNCH it (the jit call — which on backends
+        whose step graphs execute synchronously, like XLA:CPU with its
+        inline scatter custom-calls, blocks for the whole round's
+        compute), then sink its output immediately.  FIFO by a single
+        worker: the carry chain (table/stats donation) stays
+        sequential, and ``on_reap`` still sees records in exact
+        arrival order.  Meanwhile the dispatch thread keeps polling,
+        staging and ``device_put``-ing the NEXT round's slots — the
+        double-buffered H2D overlap the report measures."""
+        try:
+            while True:
+                with self._sink_cv:
+                    while not self._sinkq and not self._sink_stop:
+                        self._sink_cv.wait(0.1)
+                    if not self._sinkq:
+                        return  # stop requested and queue drained
+                    kind, payload, t_e, n_rec, n_chunks = \
+                        self._sinkq.popleft()
+                t0 = time.perf_counter()
+                exc: BaseException | None = None
+                try:
+                    if kind == "ring":
+                        entry = self._launch_ring(payload, t_e, n_rec)
+                    elif kind == "group_dev":
+                        entry = self._launch_group(payload, t_e, n_rec,
+                                                   on_device=True)
+                    elif kind == "group":
+                        entry = self._launch_group(payload, t_e, n_rec)
+                    else:
+                        entry = self._launch_single(payload, t_e, n_rec)
+                    self._sink_group([entry])
+                except BaseException as e:  # noqa: BLE001
+                    exc = e
+                # exception recorded ATOMICALLY with the pending
+                # decrement (the _sink_worker discipline)
+                with self._sink_cv:
+                    self._sink_busy_s += time.perf_counter() - t0
+                    self._sink_pending -= n_chunks
+                    if exc is not None:
+                        self._sink_exc = exc
+                    self._sink_cv.notify_all()
+                if exc is not None:
+                    return
+        except BaseException as e:  # noqa: BLE001 — _check_sink surfaces
             with self._sink_cv:
                 self._sink_exc = e
                 self._sink_cv.notify_all()
@@ -808,9 +1117,21 @@ class Engine:
         self._apply_updates(extract_updates(keys, untils), now, group)
 
     def _sink_group_wire(self, group: list[_InFlight]) -> None:
-        """The compact-wire sink (see :meth:`_sink_group`)."""
+        """The compact-wire sink (see :meth:`_sink_group`).
+
+        An entry's wire is either one ``[2K+4]`` buffer (single / mega
+        dispatch) or a ``[R, 2K+4]`` stack of per-slot wires (a
+        device-loop round, harvested at ring granularity: still ONE
+        D2H fetch for the whole round).  A round with ANY overflowed
+        slot wire falls back to the full block-array fetch for the
+        whole entry — the arrays cover every slot in chunk order, so
+        last-wins decode stays exact and no block is lost."""
         with self.metrics.readback.time():
-            if len(group) <= 2:
+            if len(group) <= 2 or any(g.out.wire.ndim == 2
+                                      for g in group):
+                # per-entry fetch: ring wires are already deep-
+                # amortized, and mixed [2K+4]/[R, 2K+4] shapes cannot
+                # stack anyway
                 wires = [jax.device_get(g.out.wire) for g in group]
             else:
                 wires = jax.device_get(
@@ -819,12 +1140,25 @@ class Engine:
             parts_u: list[np.ndarray] = []
             now = 0.0
             for g, w in zip(group, wires):
-                vw = decode_verdict_wire(w)
+                rows = w.reshape(-1, w.shape[-1])
                 self._d2h_bytes += w.nbytes
-                if vw.overflow:
-                    # K_MAX-overflow fallback: this batch condemned more
-                    # flows than the wire holds — pay the full fetch
-                    # once rather than lose a single block.
+                overflow = False
+                entry_k: list[np.ndarray] = []
+                entry_u: list[np.ndarray] = []
+                for row in rows:
+                    vw = decode_verdict_wire(row)
+                    overflow |= vw.overflow
+                    entry_k.append(vw.key)
+                    entry_u.append(vw.until_s)
+                    self._route_drop += vw.route_drop
+                    now = max(now, vw.now)
+                if overflow:
+                    # K_MAX-overflow fallback: a batch (or a ring
+                    # slot's merged window) condemned more flows than
+                    # its wire holds — pay the full fetch once rather
+                    # than lose a single block.  The wire slots of the
+                    # WHOLE entry are discarded: the full arrays carry
+                    # every block in the same chunk order.
                     fk = jax.device_get(g.out.block_key).reshape(-1)
                     fu = jax.device_get(g.out.block_until).reshape(-1)
                     self._d2h_bytes += fk.nbytes + fu.nbytes
@@ -832,11 +1166,9 @@ class Engine:
                     parts_k.append(fk)
                     parts_u.append(fu)
                 else:
-                    self._sink_compact += 1
-                    parts_k.append(vw.key)
-                    parts_u.append(vw.until_s)
-                self._route_drop += vw.route_drop
-                now = max(now, vw.now)
+                    self._sink_compact += len(rows)
+                    parts_k.extend(entry_k)
+                    parts_u.extend(entry_u)
             keys = (np.concatenate(parts_k) if len(parts_k) > 1
                     else parts_k[0])
             untils = (np.concatenate(parts_u) if len(parts_u) > 1
@@ -881,6 +1213,15 @@ class Engine:
         for g in self._mega_sizes:
             self._dispatch_mega([(warm, time.perf_counter())] * g)
             self._reap(0)
+        if self.ring:
+            # the deep-scan ring graph is its own compiled artifact:
+            # one all-masked round pays its XLA compile at boot
+            zero_slot = np.zeros(
+                (self._ring_chunks,) + warm.shape, np.uint32)
+            self._dispatch_ring([
+                self._upload_slot(zero_slot, time.perf_counter(), 0)
+                for _ in range(self.ring)])
+            self._reap(0)
         # warm dispatches are compile triggers, not traffic — keep them
         # out of the dispatch-block accounting
         self._reset_dispatch_counters()
@@ -891,6 +1232,12 @@ class Engine:
         self._dispatched_chunks = 0
         self._staged_batches = 0
         self._staged_bytes = 0
+        self._ring_rounds = 0
+        self._ring_partial_slots = 0
+        self._h2d_put_s = 0.0
+        self._h2d_overlap_s = 0.0
+        self._h2d_puts = 0
+        self._h2d_puts_overlapped = 0
 
     # -- stream rebinding ---------------------------------------------------
 
@@ -935,7 +1282,7 @@ class Engine:
         self.batcher = MicroBatcher(
             self.cfg.batch,
             t0_ns=keep_t0,
-            n_buffers=self.readback_depth + 2 + self.mega_n,
+            n_buffers=self.readback_depth + 2 + self._pending_cap,
             wire=self.wire,
             quant=quant,
         )
@@ -1055,10 +1402,11 @@ class Engine:
 
         while not bounded():
             with self.metrics.fill.time():
-                # Mega mode polls up to the remaining GROUP capacity so
-                # a deep source backlog can seal several batches in one
+                # Mega mode polls up to the remaining GROUP capacity
+                # (one whole ring round in device-loop mode) so a deep
+                # source backlog can seal several batches in one
                 # drain; otherwise exactly one batch's worth.
-                group_room = max(self.mega_n - len(self._pending), 1)
+                group_room = max(self._pending_cap - len(self._pending), 1)
                 requested = group_room * cfg_b.max_batch - self.batcher.fill
                 records = self.source.poll(requested)
                 if self._t0_auto and len(records):
@@ -1199,7 +1547,10 @@ class Engine:
             return False
 
         if self._arena is not None and hasattr(src, "poll_batches_into"):
-            self._sealed_loop_arena(src, bounded)
+            if self.ring:
+                self._sealed_loop_ring(src, bounded)
+            else:
+                self._sealed_loop_arena(src, bounded)
         else:
             self._sealed_loop_copy(src, bounded)
         for raw, t_seal in self._pending:
@@ -1297,6 +1648,92 @@ class Engine:
             self._dispatch(rows[done], metas[done][0])
             done += 1
 
+    def _sealed_loop_ring(self, src, bounded) -> None:
+        """The device-loop sealed loop: the zero-copy staging protocol
+        of :meth:`_sealed_loop_arena` feeding the drain ring.
+
+        One arena slot at a time fills to exactly ``chunks`` batches
+        (the staging memcpy is still the pipeline's ONE host copy);
+        the moment a slot fills it is ``device_put`` — while the
+        previous round still computes, which is the double-buffered
+        H2D — and when ``ring`` slots are uploaded they launch as ONE
+        deep-scan dispatch carrying table/stats across the whole round.
+        A short poll degrades gracefully: uploaded slots flush through
+        the ordinary top-rung megastep (byte-identical — the ring's
+        slot body IS that megastep) and the partial slot drains through
+        the coalescing ladder, so the ring only ever engages on
+        backlogs that were queueing anyway.  The claim discipline is
+        unchanged (a fresh slot only after the current one is staged
+        away, never on an empty poll); the ring-aware slot bound
+        (``DispatchArena.ring_safe_slots``) covers the up-to-``ring``
+        in-flight uploads this loop adds."""
+        c = self._ring_chunks
+        uploaded: list[tuple] = []   # (dev_buf, t_enqueue, n_records)
+        rows: np.ndarray | None = None
+        fill = 0
+        metas: list[tuple[float, int]] = []  # (t_enqueue, n_records)/row
+        while not bounded():
+            if rows is None:
+                rows = self._arena.rows(self._arena.claim())
+                fill = 0
+                metas = []
+            want = c - fill
+            batches = src.poll_batches_into(
+                rows[fill:c], want,
+                pop_timer=self.metrics.pop,
+                stage_timer=self.metrics.stage) if want > 0 else []
+            if self._t0_auto and batches and src.t0_ns:
+                self._adopt_fleet_t0(src)
+            for sb in batches:
+                self.batcher.batches_emitted += 1
+                self.batcher.records_emitted += sb.n_records
+                self._staged_batches += 1
+                self._staged_bytes += int(sb.raw.nbytes)
+                metas.append((sb.t_enqueue, sb.n_records))
+                fill += 1
+            short = len(batches) < want
+            if fill == c:
+                # slot full: upload NOW (overlapping in-flight compute)
+                uploaded.append(self._upload_slot(
+                    rows[:c], min(m[0] for m in metas),
+                    sum(m[1] for m in metas)))
+                rows = None
+                if len(uploaded) == self.ring:
+                    self._dispatch_ring(uploaded)
+                    uploaded = []
+                    self._reap(self.readback_depth)
+            elif short:
+                # partial round: flush uploaded slots as megasteps
+                # (arrival order before the younger partial slot)...
+                for dev, t_e, n in uploaded:
+                    self._dispatch_group_dev(dev, t_e, n)
+                    self._reap(self.readback_depth)
+                uploaded = []
+                # ...then the partial slot through the ladder
+                if fill:
+                    done = 0
+                    while fill - done:
+                        g = self._rung_for(fill - done)
+                        if g > 1:
+                            self._dispatch_group(
+                                rows[done:done + g],
+                                min(m[0] for m in metas[done:done + g]),
+                                sum(m[1] for m in metas[done:done + g]))
+                        else:
+                            self._dispatch(rows[done], metas[done][0])
+                        done += g
+                        self._reap(self.readback_depth)
+                    rows = None
+            self._reap_ready()
+            if not batches and self._sealed_idle(src):
+                break
+        # bounded exit: drain uploaded slots, then any staged rows
+        for dev, t_e, n in uploaded:
+            self._dispatch_group_dev(dev, t_e, n)
+        if rows is not None and fill:
+            for i in range(fill):
+                self._dispatch(rows[i], metas[i][0])
+
     def _sealed_loop_copy(self, src, bounded) -> None:
         """Legacy copying protocol (sources without
         ``poll_batches_into``): dequeue private copies, group through
@@ -1304,7 +1741,7 @@ class Engine:
         time in :meth:`_dispatch_mega`)."""
         while not bounded():
             with self.metrics.fill.time():
-                want = (max(self.mega_n - len(self._pending), 1)
+                want = (max(self._pending_cap - len(self._pending), 1)
                         if self.mega_n > 0 else 4)
                 batches = src.poll_batches(want)
                 if self._t0_auto and batches and src.t0_ns:
@@ -1354,10 +1791,40 @@ class Engine:
         # host↔device boundary itself, not a host copy.  Inline singles
         # dispatch the batcher's own buffer (no staging), so a pure
         # inline single-dispatch run reads 0.0.
+        # Device-loop accounting: rounds, the per-round shape, ring
+        # occupancy (how much of the staged flow went through full
+        # rounds vs partial-backlog slot flushes) and the measured H2D
+        # overlap — the "device never waits on the host" claim as a
+        # number, re-proved per run by scripts/device_loop_smoke.py.
+        device_loop = None
+        if self.ring:
+            full = self._ring_rounds * self.ring
+            staged_slots = full + self._ring_partial_slots
+            device_loop = {
+                "ring": self.ring,
+                "chunks_per_slot": self._ring_chunks,
+                "batches_per_round": self.ring * self._ring_chunks,
+                "rounds": self._ring_rounds,
+                "steps_per_round": self.ring,   # megasteps / round trip
+                "partial_slot_flushes": self._ring_partial_slots,
+                "ring_occupancy": round(full / staged_slots, 4)
+                if staged_slots else 0.0,
+                "h2d": {
+                    "puts": self._h2d_puts,
+                    "puts_overlapped": self._h2d_puts_overlapped,
+                    "put_s": round(self._h2d_put_s, 6),
+                    "overlap_s": round(self._h2d_overlap_s, 6),
+                    "overlap_fraction": round(
+                        self._h2d_overlap_s / self._h2d_put_s, 4)
+                    if self._h2d_put_s else 0.0,
+                },
+            }
         dispatch = {
-            "mode": ("adaptive" if self.mega_auto
+            "mode": ("device_loop" if self.ring
+                     else "adaptive" if self.mega_auto
                      else "fixed" if self.mega_n else "single"),
             "mega_n": self.mega_n,
+            "device_loop": device_loop,
             "group_sizes": list(self._mega_sizes),
             "group_hist": {str(k): v for k, v in
                            sorted(self._group_hist.items())},

@@ -1,0 +1,16 @@
+"""Device-resident fused serving graphs.
+
+``flowsentryx_tpu.ops.fused`` owns the per-batch step and the megastep
+(one ``lax.scan`` group per dispatch); this package owns the graphs
+that keep the DEVICE busy across multiple host round-trips — starting
+with the persistent drain ring (:mod:`.device_loop`), the deep-scan
+that consumes a whole staging ring of arena slices per dispatch.
+"""
+
+from flowsentryx_tpu.fused.device_loop import (  # noqa: F401
+    RingOutput,
+    make_compact_device_loop,
+    make_sharded_compact_device_loop,
+    ring_round_batches,
+    wrap_device_loop,
+)

@@ -1,0 +1,186 @@
+"""Bounded CPU device-loop smoke — the drain-ring CI gate.
+
+Serves a prefilled shm ring shard through a REAL one-worker
+``ShardedIngest`` fleet into a device-loop engine
+(``mega_n="auto", device_loop=2``) and checks the ring invariants on
+the report's ``dispatch`` block:
+
+* ``host_copies_per_batch == 1.0`` — the ring changes dispatch
+  granularity, not the zero-copy staging contract: every batch still
+  crosses the host exactly once (shm slot view → page-aligned arena;
+  the per-slot ``device_put`` is the H2D boundary);
+* **H2D overlap > 0** — at least one slot upload was issued while a
+  dispatched round was still in flight (the double-buffered half: the
+  dispatch thread stages round k+1 while the pipeline worker runs
+  round k), measured, not asserted from the design;
+* full deep-scan rounds actually fired (``rounds >= 2``, the
+  ``ring*chunks`` histogram entry accounts for them) and the group
+  histogram covers every served batch;
+* verdict parity: the device-loop run blocks the same sources with the
+  same stats as the inline singles run on the same records.
+
+Results merge into ``artifacts/DEVLOOP_r11.json`` under ``"smoke"``
+(the ``"paced"`` PR-6-comparison drain evidence in the same artifact is
+preserved), so the invariants are re-proved by every
+``scripts/verify_tier1.sh`` run, not benched once and trusted forever.
+
+Usage: JAX_PLATFORMS=cpu python scripts/device_loop_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_BATCHES = 48
+BATCH = 256
+RING = 2
+
+
+def _records(n: int):
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficGen, TrafficSpec
+
+    return TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=8, n_benign_ips=24, attack_fraction=0.8, seed=29,
+    )).next_records(n)
+
+
+def _cfg():
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=BATCH),
+        table=dataclasses.replace(cfg.table, capacity=1 << 14),
+        limiter=dataclasses.replace(
+            cfg.limiter, pps_threshold=200.0, bps_threshold=1e9),
+    )
+
+
+def main() -> int:
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+    from flowsentryx_tpu.engine.shm import ShmRing
+    from flowsentryx_tpu.ingest import ShardedIngest
+
+    t_start = time.perf_counter()
+    recs = _records(BATCH * N_BATCHES)
+
+    # inline singles reference (same records, same config)
+    sink0 = CollectSink()
+    rep0 = Engine(_cfg(), ArraySource(recs.copy()), sink0,
+                  readback_depth=4, sink_thread=False).run()
+
+    # sealed device-loop run over a real worker fleet; warm() BEFORE
+    # the workers start filling their bounded queues (a cold deep-scan
+    # compile stalls the drain long enough for emit-timeout drops)
+    tmpdir = tempfile.mkdtemp(prefix="fsx_dlsmoke_")
+    base = os.path.join(tmpdir, "fring")
+    ring = ShmRing.create(schema.shard_ring_path(base, 0, 1), 1 << 14,
+                          schema.FLOW_RECORD_DTYPE)
+    assert ring.produce(recs) == len(recs)
+    src = ShardedIngest(base, 1, queue_slots=16, precompact=False,
+                        t0_grace_s=0.2)
+    sink1 = CollectSink()
+    eng = Engine(_cfg(), src, sink1, sink_thread=False,
+                 mega_n="auto", device_loop=RING)
+    eng.warm()
+    try:
+        deadline = time.monotonic() + 60
+        while src.t0_ns is None:
+            src.poll_batches(0)
+            if time.monotonic() > deadline:
+                raise TimeoutError("ingest t0 handshake did not resolve")
+            time.sleep(0.01)
+        src.request_stop()
+        rep1 = eng.run()
+    finally:
+        src.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    d = rep1.dispatch
+    dl = d["device_loop"]
+    failures: list[str] = []
+    if d["mode"] != "device_loop" or dl is None:
+        failures.append(f"dispatch mode {d['mode']} != device_loop")
+        dl = dl or {"rounds": 0, "h2d": {}}
+    if d["host_copies_per_batch"] != 1.0:
+        failures.append(
+            f"host_copies_per_batch {d['host_copies_per_batch']} != 1.0 "
+            "(the ring must not re-grow a staging copy)")
+    if d["staged_batches"] != rep1.batches:
+        failures.append(
+            f"staged {d['staged_batches']} != served {rep1.batches} "
+            "batches (a batch bypassed the arena)")
+    hist_chunks = sum(int(g) * n for g, n in d["group_hist"].items())
+    if hist_chunks != rep1.batches:
+        failures.append(
+            f"group histogram covers {hist_chunks} != {rep1.batches}")
+    if dl["rounds"] < 2:
+        failures.append(
+            f"only {dl['rounds']} deep-scan rounds fired under a deep "
+            "prefilled backlog (expected >= 2)")
+    if not dl["h2d"].get("puts_overlapped", 0):
+        failures.append(
+            "H2D overlap == 0: no slot upload was issued while a round "
+            "was in flight — the double-buffer half of the ring is not "
+            "engaging")
+    if rep1.records != rep0.records or rep1.stats != rep0.stats:
+        failures.append("device-loop stats != inline singles stats")
+    if sink1.blocked != sink0.blocked:
+        failures.append("device-loop blacklist != inline singles")
+
+    smoke = {
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "records": rep1.records,
+        "batches": rep1.batches,
+        "dispatch": d,
+        "stages_ms": {k: rep1.stages_ms[k]
+                      for k in ("pop", "stage", "dispatch")},
+        "invariants": {
+            "copies_per_batch": d["host_copies_per_batch"],
+            "h2d_overlap_fraction": dl["h2d"].get("overlap_fraction"),
+            "h2d_puts_overlapped": dl["h2d"].get("puts_overlapped"),
+            "rounds": dl["rounds"],
+            "batches_per_round": dl.get("batches_per_round"),
+            "ring_occupancy": dl.get("ring_occupancy"),
+        },
+        "ok": not failures,
+        "failures": failures,
+    }
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "DEVLOOP_r11.json")
+    try:
+        artifact = json.loads(open(out_path).read())
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["smoke"] = smoke
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"device-loop smoke: wrote {out_path}")
+    print(f"device-loop smoke: rounds={dl['rounds']} "
+          f"copies/batch={d['host_copies_per_batch']} "
+          f"h2d_overlap={dl['h2d'].get('overlap_fraction')} "
+          f"groups={d['group_hist']}")
+    for msg in failures:
+        print(f"device-loop smoke: FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,11 +18,19 @@ Stages, in order; the gate fails if any stage fails:
    shadows a name a module-level import bound (the drift PR 3 had to
    clean out of the engine's sink paths by hand).  ``# noqa`` exempts
    a line.
-4. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+4. **device-loop purity** — an AST pass over
+   ``flowsentryx_tpu/fused/`` (the traced-region package: everything
+   in it runs inside ``jit``) that bans host round-trips —
+   ``device_get`` and the callback primitives (``pure_callback``,
+   ``io_callback``, ``debug_callback``, ``jax.debug.print``) — at
+   review speed.  ``fsx audit`` proves the same property statically on
+   the staged graph; this stage catches it before anything compiles.
+   ``# noqa`` exempts a line.
+5. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-3 there.
-5. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-4 there.
+6. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -188,6 +196,70 @@ def stage_local_imports() -> list[str]:
     return fails
 
 
+#: Names that are host round-trips when they appear in traced-region
+#: code (each is an unbounded mid-graph host sync; the serving step's
+#: only host contact is the post-step wire fetch).
+TRACED_REGION_BANNED = frozenset({
+    "device_get", "pure_callback", "io_callback", "debug_callback",
+    "host_callback", "block_until_ready",
+})
+
+#: The traced-region package: every module here builds code that runs
+#: INSIDE jit (fused/device_loop.py's deep scan above all).
+TRACED_REGION_TREE = "flowsentryx_tpu/fused"
+
+
+def _traced_purity_findings(path: Path) -> list[str]:
+    """Host-round-trip findings for one traced-region module."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+            # jax.debug.print / jax.debug.callback: the banned part is
+            # the .debug chain, whatever the leaf method
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "debug":
+                name = f"debug.{node.attr}"
+            elif isinstance(v, ast.Name) and v.id == "debug":
+                name = f"debug.{node.attr}"
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name is None:
+            continue
+        banned = (name in TRACED_REGION_BANNED
+                  or name.startswith("debug."))
+        if not banned:
+            continue
+        line = (lines[node.lineno - 1]
+                if node.lineno <= len(lines) else "")
+        if "noqa" in line:
+            continue
+        try:
+            rel = path.relative_to(REPO)
+        except ValueError:
+            rel = path
+        out.append(
+            f"{rel}:{node.lineno}: host round-trip {name!r} in "
+            "traced-region code — the device loop's graph must stay "
+            "free of device_get/callbacks (fsx audit proves it on the "
+            "staged jaxpr; fix it here first)")
+    return out
+
+
+def stage_device_loop_purity() -> list[str]:
+    fails = []
+    for path in sorted((REPO / TRACED_REGION_TREE).rglob("*.py")):
+        fails.extend(_traced_purity_findings(path))
+    return fails
+
+
 def _run_tool(cmd: list[str]) -> list[str]:
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
     if r.returncode == 0:
@@ -218,6 +290,7 @@ def main(argv: list[str] | None = None) -> int:
         "syntax": stage_syntax(),
         "unused_imports": stage_unused_imports(),
         "local_imports": stage_local_imports(),
+        "device_loop_purity": stage_device_loop_purity(),
         "ruff": stage_ruff(),
         "mypy": stage_mypy(),
     }

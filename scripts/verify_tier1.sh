@@ -57,9 +57,12 @@ echo "== lint gate (scripts/lint.py) =="
 python scripts/lint.py || exit 1
 
 echo "== fsx audit: static step-graph contracts (docs/AUDIT.md) =="
+# --device-loop 2 also stages the drain-ring deep scans (single-device
+# and sharded) so the 528 B-per-slot wire pin and the ring-carry
+# donation proof are re-proved on every run.
 env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
-    --out artifacts/AUDIT_r08.json || exit 1
+    --device-loop 2 --out artifacts/AUDIT_r08.json || exit 1
 
 echo "== fsx distill: kernel-tier compile + static check + JAX<->BPF parity =="
 # Compiles the shipped artifact into the kernel tier, statically
@@ -77,5 +80,13 @@ echo "== dispatch smoke: single-copy staging + adaptive coalescing =="
 # artifacts/DISPATCH_r09.json (the paced PR-4 comparison evidence in
 # the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/dispatch_smoke.py || exit 1
+
+echo "== device-loop smoke: drain ring + double-buffered H2D =="
+# Bounded CPU smoke of the device-resident drain ring: re-proves that
+# full deep-scan rounds fire, copies/batch stays 1.0, and H2D overlap
+# (uploads issued while a round is in flight) is > 0, re-writing the
+# "smoke" section of artifacts/DEVLOOP_r11.json (the paced PR-6
+# comparison evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/device_loop_smoke.py || exit 1
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

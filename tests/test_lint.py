@@ -88,3 +88,60 @@ class TestLocalImportStage:
 
     def test_repo_is_clean(self):
         assert lint.stage_local_imports() == []
+
+
+def _purity_findings(tmp_path, src):
+    p = tmp_path / "device_loop.py"
+    p.write_text(src)
+    old = lint.REPO
+    lint.REPO = tmp_path
+    try:
+        return lint._traced_purity_findings(p)
+    finally:
+        lint.REPO = old
+
+
+class TestDeviceLoopPurityStage:
+    """The traced-region gate: no device_get/callback may appear in
+    fused/ (everything there runs inside jit — fsx audit proves it on
+    the staged graph, this stage catches it at review speed)."""
+
+    def test_device_get_flagged(self, tmp_path):
+        out = _purity_findings(tmp_path, (
+            "import jax\n\n"
+            "def loop(x):\n"
+            "    return jax.device_get(x)\n"))
+        assert len(out) == 1
+        assert "device_get" in out[0] and "device_loop.py:4" in out[0]
+
+    def test_callbacks_flagged(self, tmp_path):
+        for snippet, name in (
+                ("jax.pure_callback(f, x, x)", "pure_callback"),
+                ("io_callback(f, x, x)", "io_callback"),
+                ("jax.debug.print('{}', x)", "debug.print"),
+                ("jax.experimental.io_callback(f, x, x)",
+                 "io_callback")):
+            out = _purity_findings(tmp_path, (
+                "import jax\n\n"
+                "def loop(f, x):\n"
+                f"    return {snippet}\n"))
+            assert out, snippet
+            assert name in out[0]
+
+    def test_noqa_exempts(self, tmp_path):
+        out = _purity_findings(tmp_path, (
+            "import jax\n\n"
+            "def loop(x):\n"
+            "    return jax.device_get(x)  # noqa: doc example\n"))
+        assert out == []
+
+    def test_clean_traced_code_passes(self, tmp_path):
+        out = _purity_findings(tmp_path, (
+            "import jax\nimport jax.numpy as jnp\n\n"
+            "def loop(base, slots):\n"
+            "    ring = jnp.stack(slots)\n"
+            "    return jax.lax.scan(base, None, ring)\n"))
+        assert out == []
+
+    def test_repo_traced_region_is_clean(self):
+        assert lint.stage_device_loop_purity() == []
