@@ -466,6 +466,13 @@ def run_audit(
             "max_batch": cfg.batch.max_batch,
             "verdict_k": cfg.batch.verdict_k,
             "capacity": cfg.table.capacity,
+            # the eviction epoch changes every staged graph (the
+            # in-step rolling sweep window), so the artifact records
+            # which family this report proved; the boot cache keys on
+            # cfg.to_json(), so eviction-enabled engines re-audit
+            # automatically
+            "evict_ttl_s": cfg.table.evict_ttl_s,
+            "evict_every": cfg.table.evict_every,
             "model": cfg.model.name,
             "mesh_devices": int(mesh.devices.size) if mesh is not None
             else 1,

@@ -283,6 +283,23 @@ def _cmd_audit(args: argparse.Namespace) -> int:
             return 1
         cfg = _dc.replace(cfg, batch=_dc.replace(
             cfg.batch, verdict_k=args.verdict_k))
+    if args.evict_ttl < 0:
+        print("fsx audit: --evict-ttl must be >= 0", file=sys.stderr)
+        return 1
+    if args.evict_every < 1:
+        print("fsx audit: --evict-every must be >= 1", file=sys.stderr)
+        return 1
+    if args.evict_ttl:
+        # stage the EVICTION-EPOCH variants: the in-step aging sweep
+        # changes every staged graph (a rolling gather + victim-only-
+        # scatter window at step start), so its donation/transfer/
+        # collective contracts must be proved on the graphs an
+        # eviction-enabled engine actually serves — and the boot cache
+        # keys on the config, so these stage (and cache) as their own
+        # artifacts
+        cfg = _dc.replace(cfg, table=_dc.replace(
+            cfg.table, evict_ttl_s=args.evict_ttl,
+            evict_every=args.evict_every))
     if args.quick:
         # small shapes, same contracts: every check here is
         # shape-generic except the byte budgets, which scale with the
@@ -741,16 +758,98 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "the exact transfer the ring exists to amortize",
               file=sys.stderr)
         return 1
+    if args.artifact_reload and not args.artifact:
+        print("fsx serve: --artifact-reload requires --artifact PATH "
+              "(it hot-swaps that file when its mtime changes)",
+              file=sys.stderr)
+        return 1
+    # Table-geometry validation, still BEFORE the JAX boot: config
+    # parsing and the geometry validators (engine/table.py) are
+    # jax-free, so a bad --table-capacity or an unrestorable
+    # checkpoint refuses in milliseconds with its actual problem
+    # named, not after a multi-second backend init (or worse, after
+    # silently corrupting the slot layout).
+    import dataclasses as _dck
+
+    cfg = _load_cfg(args)
+    if args.verdict_k is not None:
+        cfg = _dck.replace(cfg, batch=_dck.replace(
+            cfg.batch, verdict_k=args.verdict_k))
+    if args.table_capacity is not None:
+        from flowsentryx_tpu.engine.table import validate_capacity
+
+        problems = validate_capacity(args.table_capacity,
+                                     cfg.batch.max_batch,
+                                     max(args.mesh, 1))
+        if problems:
+            for p in problems:
+                print(f"fsx serve: --table-capacity: {p}",
+                      file=sys.stderr)
+            return 1
+        cfg = _dck.replace(cfg, table=_dck.replace(
+            cfg.table, capacity=args.table_capacity))
+    ck_hdr = None
+    if args.restore:
+        import zipfile as _zf
+
+        from flowsentryx_tpu.engine.checkpoint import peek_header
+
+        try:
+            ck_hdr = peek_header(args.restore)
+        except (OSError, ValueError, KeyError, _zf.BadZipFile) as e:
+            print(f"fsx serve: cannot read checkpoint "
+                  f"{args.restore!r}: {e}", file=sys.stderr)
+            return 1
+        if cfg.table.salt and cfg.table.salt != ck_hdr["hash_salt"]:
+            # an EXPLICITLY configured salt that disagrees with the
+            # checkpoint's is refused, not silently overridden:
+            # proceeding under either value breaks one side's slot
+            # layout (the config owner asked for one hash universe,
+            # the checkpoint was built in another)
+            print(
+                f"fsx serve: config salt {cfg.table.salt:#x} != "
+                f"checkpoint salt {ck_hdr['hash_salt']:#x} — refusing "
+                "to restore (the table's slot layout is bound to the "
+                "salt it was built under). Drop the config salt to "
+                "adopt the checkpoint's, or retire the checkpoint.",
+                file=sys.stderr)
+            return 1
+        if args.table_capacity is None and not getattr(args, "config",
+                                                       None):
+            # no capacity was asked for: adopt the checkpoint's so a
+            # plain `fsx serve --restore` resumes bit-identically
+            # instead of resharding into the config default — but the
+            # adopted geometry passes the SAME validation an explicit
+            # --table-capacity would (a checkpoint from a smaller-batch
+            # era must refuse loudly, not degrade via arbitration drops)
+            from flowsentryx_tpu.engine.table import validate_capacity
+
+            problems = validate_capacity(ck_hdr["capacity"],
+                                         cfg.batch.max_batch,
+                                         max(args.mesh, 1))
+            if problems:
+                for p in problems:
+                    print(f"fsx serve: checkpoint capacity: {p}",
+                          file=sys.stderr)
+                print("fsx serve: pass --table-capacity to reshard "
+                      "the restore into a serving-valid geometry",
+                      file=sys.stderr)
+                return 1
+            cfg = _dck.replace(cfg, table=_dck.replace(
+                cfg.table, capacity=ck_hdr["capacity"]))
+        if (ck_hdr["capacity"] != cfg.table.capacity
+                or ck_hdr["n_shards"] != max(args.mesh, 1)):
+            print(
+                f"fsx serve: checkpoint geometry "
+                f"{ck_hdr['capacity']} rows x {ck_hdr['n_shards']} "
+                f"shard(s) != boot geometry {cfg.table.capacity} rows "
+                f"x {max(args.mesh, 1)} shard(s): occupied rows will "
+                "be resharded at restore (engine/table.py)",
+                file=sys.stderr)
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
     _honor_jax_platform()
-    cfg = _load_cfg(args)
-    if args.verdict_k is not None:
-        import dataclasses as _dck
-
-        cfg = _dck.replace(cfg, batch=_dck.replace(
-            cfg.batch, verdict_k=args.verdict_k))
     if args.feature_ring:
         from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
 
@@ -786,22 +885,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         )
         sink = NullSink()
     # Boot-time hash salt (TableConfig.salt docstring): a restore must
-    # hash with the salt the checkpoint's slot layout was built under;
-    # otherwise an unspecified salt (0 = auto) draws a fresh random one
-    # so slot/owner collisions can't be precomputed by an attacker.
+    # hash with the salt the checkpoint's slot layout was built under
+    # (an EXPLICIT conflicting config salt was already refused
+    # pre-boot); otherwise an unspecified salt (0 = auto) draws a
+    # fresh random one so slot/owner collisions can't be precomputed
+    # by an attacker.
     import dataclasses as _dc
 
     if args.restore:
-        from flowsentryx_tpu.engine.checkpoint import peek_salt
-
-        ck_salt = peek_salt(args.restore)
-        if cfg.table.salt and cfg.table.salt != ck_salt:
-            print(
-                f"fsx serve: config salt {cfg.table.salt:#x} overridden "
-                f"by checkpoint salt {ck_salt:#x} (the table's slot "
-                "layout is bound to the salt it was built under)",
-                file=sys.stderr,
-            )
+        ck_salt = ck_hdr["hash_salt"]
         if ck_salt == 0:
             print(
                 "fsx serve: WARNING restoring a pre-salt checkpoint - "
@@ -878,6 +970,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  kernel_tier=kernel_tier)
     if args.restore:
         eng.restore(args.restore)
+    if args.artifact_reload:
+        # live model hot-swap: re-stat the artifact and swap it in
+        # mid-serve on mtime change (Engine.watch_artifact; the
+        # distill --pin push, brought to the TPU tier)
+        eng.watch_artifact(args.artifact)
     if args.mega:
         # pay every staged compile (each ladder rung, and the deep-scan
         # ring graph) at boot, not on the first traffic backlog
@@ -1455,6 +1552,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "--device-loop N serves: [N, 2K+4] per-slot "
                          "wire pin, ring-carry donation proof, no "
                          "hidden callbacks); needs --mega")
+    au.add_argument("--evict-ttl", type=float, default=0.0,
+                    metavar="S",
+                    help="also prove the eviction-epoch step variants: "
+                         "stage every graph with the in-step aging "
+                         "sweep enabled at this idle TTL (0 = the "
+                         "sweepless graphs, the default)")
+    au.add_argument("--evict-every", type=int, default=64, metavar="N",
+                    help="sweep epoch period in batches for "
+                         "--evict-ttl (default 64)")
     au.add_argument("--quick", action="store_true",
                     help="small table/batch shapes (CI gate); the "
                          "contracts are shape-generic, only the "
@@ -1593,6 +1699,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "pipeline worker harvests per-slot verdict "
                         "wires; requires --mega; 0 = per-group "
                         "dispatch, the parity baseline")
+    s.add_argument("--table-capacity", type=int, default=None,
+                   metavar="N",
+                   help="flow-table rows (overrides config "
+                        "table.capacity; default 2^20): power of two, "
+                        ">= max_batch, divisible by --mesh — validated "
+                        "with clear refusals BEFORE the JAX boot. "
+                        "Production scale is 2^22 (4M) and up; rows "
+                        "shard by IP hash across --mesh devices")
+    s.add_argument("--artifact-reload", action="store_true",
+                   help="watch --artifact's mtime and hot-swap the "
+                        "model live when the file changes — no drain, "
+                        "no recompile, in-flight rounds finish on the "
+                        "old model (requires the same artifact "
+                        "family/shape; a bad push is announced and "
+                        "serving continues on the incumbent)")
     s.add_argument("--checkpoint", help="save table+stats here on exit")
     s.add_argument("--checkpoint-every", type=float, default=0,
                    help="ALSO checkpoint every S seconds while serving "

@@ -185,6 +185,26 @@ class TableConfig:
     #: the packed kernel-config blob for config-file deployments that
     #: fix the salt explicitly (see ``KERNEL_CONFIG_FIELDS``).
     salt: int = 0
+    #: In-step aging: slots idle longer than ``evict_ttl_s`` (device-
+    #: clock seconds since last_seen, still-valid blacklist entries
+    #: exempt) are freed IN-GRAPH by a rolling sweep — each batch the
+    #: step opens by sweeping one ``capacity/evict_every``-row window,
+    #: the window base advancing with the batch counter, so every row
+    #: is re-examined once per ``evict_every`` batches
+    #: (``ops/fused.evict_idle_epoch``; shard-local on a mesh, no new
+    #: collectives or D2H, constant per-batch cost).  0 disables the
+    #: sweep entirely: the staged step graphs are then unchanged from
+    #: the pre-eviction era (stale-slot reclamation on insert still
+    #: works as before), which is what keeps parity baselines
+    #: byte-identical.  Distinct from ``stale_s`` (reclaim-on-insert
+    #: eligibility): reclamation frees a slot only when a new flow
+    #: happens to probe it; eviction bounds table occupancy under
+    #: churn whether or not the slot is re-probed.
+    evict_ttl_s: float = 0.0
+    #: Batches per full sweep cycle: each batch sweeps
+    #: ``ceil(capacity / evict_every)`` rows, and a row idle past the
+    #: ttl is freed within one cycle of crossing it.
+    evict_every: int = 64
 
     def __post_init__(self) -> None:
         if self.capacity & (self.capacity - 1) or self.capacity <= 0:
@@ -197,6 +217,10 @@ class TableConfig:
             raise ValueError("probes must be >= 1")
         if not 0 <= self.salt < 1 << 32:
             raise ValueError("salt must fit in u32")
+        if self.evict_ttl_s < 0:
+            raise ValueError("evict_ttl_s must be >= 0 (0 disables)")
+        if self.evict_every < 1:
+            raise ValueError("evict_every must be >= 1")
 
 
 @dataclass(frozen=True)

@@ -653,6 +653,12 @@ class GlobalStats(NamedTuple):
     dropped_rate: jnp.ndarray       # [2] uint32
     dropped_ml: jnp.ndarray         # [2] uint32
     batches: jnp.ndarray            # [2] uint32
+    #: Idle flows freed by the in-step aging epoch
+    #: (:func:`flowsentryx_tpu.ops.fused.evict_idle_epoch`;
+    #: ``TableConfig.evict_ttl_s``).  Stays zero — a pure donated
+    #: passthrough — when eviction is disabled, so pre-eviction graphs
+    #: and parity baselines are unchanged.
+    evicted: jnp.ndarray            # [2] uint32
 
     @property
     def dropped(self) -> int:
@@ -690,7 +696,8 @@ def make_stats() -> GlobalStats:
     # Distinct arrays per field — see make_table's donation note.
     import jax.numpy as jnp
 
-    return GlobalStats(*(jnp.zeros((2,), jnp.uint32) for _ in range(5)))
+    return GlobalStats(*(jnp.zeros((2,), jnp.uint32)
+                         for _ in GlobalStats._fields))
 
 
 class FeatureBatch(NamedTuple):

@@ -8,13 +8,33 @@ trips through one ``.npz``, so a restarted engine resumes with every
 tracked flow, window counter, and blacklist expiry intact — the
 user-plane analog of map pinning.
 
-(Plain npz rather than orbax: the state is a flat dict of 11 arrays,
+Production-scale upgrades (PR 8):
+
+* **Atomic writes** — the snapshot lands in a same-directory temp file
+  and ``os.replace``\\s into place, so a crash mid-snapshot can never
+  truncate the live checkpoint (the periodic ``--checkpoint-every``
+  loop overwrites the same path forever; a torn write there would
+  destroy the only copy).
+* **Geometry header** — ``hash_salt`` (as before) plus ``n_shards``
+  and ``capacity``: a table's global row indices are meaningful ONLY
+  under the geometry that wrote them (owner = top hash bits, slot =
+  probed low bits), so the header is what lets a restore detect a
+  mesh/capacity change and RESHARD
+  (:func:`flowsentryx_tpu.engine.table.reshard_rows`) instead of
+  silently mislocating every key.  Arrays stay the flat per-column
+  global layout (shard-major when sharded — exactly what
+  ``device_get`` of a row-sharded array yields), so every pre-header
+  snapshot still loads (``n_shards`` defaults to 1).
+
+(Plain npz rather than orbax: the state is a flat dict of arrays,
 ~40 MB at 1M rows; zero-dependency and byte-inspectable wins here.)
 """
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
+from typing import NamedTuple
 
 import jax
 import numpy as np
@@ -24,18 +44,34 @@ from flowsentryx_tpu.core import schema
 CHECKPOINT_SCHEMA_VERSION = 1
 
 
+class Checkpoint(NamedTuple):
+    """A loaded snapshot, HOST-side (numpy): the caller owns placement
+    (direct when the geometry matches, through
+    :func:`~flowsentryx_tpu.engine.table.reshard_rows` when not)."""
+
+    table: schema.IpTableState   # numpy leaves, global shard-major rows
+    stats: schema.GlobalStats    # numpy [2] u32 pairs
+    t0_ns: int
+    hash_salt: int
+    n_shards: int                # geometry the rows were laid out under
+    capacity: int
+    missing_columns: tuple       # table columns the snapshot predates
+    missing_stats: tuple         # stats counters the snapshot predates
+
+
 def save_state(
     path: str | Path,
     table: schema.IpTableState,
     stats: schema.GlobalStats,
     t0_ns: int,
     hash_salt: int = 0,
+    n_shards: int = 1,
 ) -> Path:
-    """Snapshot serving state.  Arrays are fetched from device (the one
-    deliberate D2H of the engine's lifetime).  ``hash_salt`` is the
-    salt the table's slot layout was built under — a restore into an
-    engine hashing with a different salt would mislocate every key, so
-    it travels with the state."""
+    """Snapshot serving state ATOMICALLY (module docstring).  Arrays
+    are fetched from device (the one deliberate D2H of the engine's
+    lifetime); ``hash_salt``/``n_shards`` record the geometry the slot
+    layout was built under, so a restore can detect and reshard a
+    geometry change instead of mislocating keys."""
     path = Path(path)
     # np.savez silently appends .npz to a suffix-less path; normalize so
     # the returned path is the file actually written (same contract as
@@ -46,45 +82,76 @@ def save_state(
     # column-per-key format predates the matrix layout, keeps old
     # snapshots loadable, and lets future columns default cleanly.
     state = np.asarray(table.state)
+    key = np.asarray(table.key)  # fetched ONCE (shared with the header)
     cols = {f"table_{name}": state[:, i]
             for i, name in enumerate(schema.TABLE_COLUMN_NAMES)}
-    np.savez_compressed(
-        path,
-        table_key=np.asarray(table.key),
-        **cols,
-        **{f"stats_{k}": np.asarray(v) for k, v in stats._asdict().items()},
-        t0_ns=np.uint64(t0_ns),
-        hash_salt=np.uint64(hash_salt),
-        schema_version=CHECKPOINT_SCHEMA_VERSION,
-    )
+    # same-directory temp + os.replace: rename is atomic on POSIX, so
+    # the live checkpoint is either the old complete snapshot or the
+    # new complete snapshot — never a torn write
+    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+    try:
+        np.savez_compressed(
+            tmp,
+            table_key=key,
+            **cols,
+            **{f"stats_{k}": np.asarray(v)
+               for k, v in stats._asdict().items()},
+            t0_ns=np.uint64(t0_ns),
+            hash_salt=np.uint64(hash_salt),
+            n_shards=np.uint64(n_shards),
+            capacity=np.uint64(key.shape[0]),
+            schema_version=CHECKPOINT_SCHEMA_VERSION,
+        )
+        # np.savez appends .npz to the temp stem too
+        tmp_written = (tmp if tmp.suffix == ".npz"
+                       else tmp.with_suffix(tmp.suffix + ".npz"))
+        os.replace(tmp_written, path)
+    except BaseException:
+        for t in (tmp, tmp.with_suffix(tmp.suffix + ".npz")):
+            try:
+                os.unlink(t)
+            except OSError:
+                pass
+        raise
     return path
+
+
+def peek_header(path: str | Path) -> dict:
+    """The geometry header WITHOUT loading the arrays — salt, shard
+    count, capacity, schema version — so servers and the CLI can
+    validate (or plan a reshard) before the multi-second JAX boot.
+    Pre-header snapshots read as salt 0 / 1 shard; capacity falls back
+    to the key column's length."""
+    with np.load(Path(path)) as z:
+        cap = (int(z["capacity"]) if "capacity" in z
+               else int(z["table_key"].shape[0]))
+        return {
+            "schema_version": int(z["schema_version"]),
+            "hash_salt": int(z["hash_salt"]) if "hash_salt" in z else 0,
+            "n_shards": int(z["n_shards"]) if "n_shards" in z else 1,
+            "capacity": cap,
+        }
 
 
 def peek_salt(path: str | Path) -> int:
     """The hash salt a checkpoint's table was built under, WITHOUT
     loading the arrays — so a server can adopt it before compiling its
     step (pre-salt checkpoints read as 0, the unsalted hash)."""
-    with np.load(Path(path)) as z:
-        return int(z["hash_salt"]) if "hash_salt" in z else 0
+    return peek_header(path)["hash_salt"]
 
 
-def load_state(
-    path: str | Path,
-) -> tuple[schema.IpTableState, schema.GlobalStats, int, int, tuple]:
-    """Restore serving state to device.
-    Returns (table, stats, t0_ns, hash_salt, missing_columns) —
-    ``missing_columns`` names table columns the snapshot predates (they
-    load zero-filled; the caller decides whether zero is the right
-    default, e.g. Engine.restore refills byte-bucket credit)."""
+def load_checkpoint(path: str | Path) -> Checkpoint:
+    """Load a snapshot to HOST arrays (placement is the caller's job —
+    see :class:`Checkpoint`).  Columns or stats counters added after
+    the snapshot was written load zero-filled and are named in the
+    ``missing_*`` fields so the caller can apply the right default
+    (e.g. ``Engine.restore`` refills byte-bucket credit)."""
     with np.load(Path(path)) as z:
         version = int(z["schema_version"])
         if version != CHECKPOINT_SCHEMA_VERSION:
             raise ValueError(
                 f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
             )
-        # Columns added after a checkpoint was written load as their
-        # empty-table default (e.g. tok_bytes on pre-byte-bucket
-        # snapshots: zero byte credit, refilled on first sight).
         cap = int(z["table_key"].shape[0])
         state = np.zeros((cap, schema.NUM_TABLE_COLS), np.float32)
         missing = []
@@ -93,12 +160,38 @@ def load_state(
                 state[:, i] = z[f"table_{name}"]
             else:
                 missing.append(name)
-        table = schema.IpTableState(
-            key=jax.device_put(z["table_key"]),
-            state=jax.device_put(state),
+        missing_stats = []
+        stats_vals = {}
+        for k in schema.GlobalStats._fields:
+            if f"stats_{k}" in z:
+                stats_vals[k] = np.asarray(z[f"stats_{k}"])
+            else:
+                # a counter added after the snapshot (e.g. ``evicted``
+                # on pre-eviction-era snapshots): zero is the correct
+                # resume value for a monotone counter
+                stats_vals[k] = np.zeros((2,), np.uint32)
+                missing_stats.append(k)
+        return Checkpoint(
+            table=schema.IpTableState(
+                key=np.asarray(z["table_key"]), state=state),
+            stats=schema.GlobalStats(**stats_vals),
+            t0_ns=int(z["t0_ns"]),
+            hash_salt=int(z["hash_salt"]) if "hash_salt" in z else 0,
+            n_shards=int(z["n_shards"]) if "n_shards" in z else 1,
+            capacity=cap,
+            missing_columns=tuple(missing),
+            missing_stats=tuple(missing_stats),
         )
-        stats = schema.GlobalStats(
-            **{k: jax.device_put(z[f"stats_{k}"]) for k in schema.GlobalStats._fields}
-        )
-        salt = int(z["hash_salt"]) if "hash_salt" in z else 0
-        return table, stats, int(z["t0_ns"]), salt, tuple(missing)
+
+
+def load_state(
+    path: str | Path,
+) -> tuple[schema.IpTableState, schema.GlobalStats, int, int, tuple]:
+    """Compatibility shim over :func:`load_checkpoint`: the historical
+    5-tuple, with table/stats already on the default device."""
+    ck = load_checkpoint(path)
+    table = schema.IpTableState(key=jax.device_put(ck.table.key),
+                                state=jax.device_put(ck.table.state))
+    stats = schema.GlobalStats(
+        *(jax.device_put(v) for v in ck.stats))
+    return table, stats, ck.t0_ns, ck.hash_salt, ck.missing_columns

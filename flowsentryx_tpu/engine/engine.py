@@ -231,8 +231,11 @@ class Engine:
         # docstring): replicated over the mesh when sharded, default
         # device otherwise.  None = plain device_put.
         if self.mesh is not None:
-            self._in_sharding = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec())
+            from flowsentryx_tpu.parallel import layout as par_layout
+
+            # derived from the declarative partition rules — the same
+            # table the shard_map specs and checkpoint restore use
+            self._in_sharding = par_layout.replicated(self.mesh)
         else:
             self._in_sharding = None
         # Params go to the device ONCE at boot.  A numpy artifact
@@ -594,6 +597,11 @@ class Engine:
         self._sink_compact = 0
         self._sink_fallback = 0
         self._sunk_batches = 0
+        # live artifact hot-swap (watch_artifact / hot_swap)
+        self._watch_path: str | None = None
+        self._watch_mtime = 0
+        self._watch_next = 0.0
+        self._hot_swaps = 0
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -895,6 +903,10 @@ class Engine:
         sink itself has a fixed host cost, so reaps COALESCE — a sink
         happens only when one is due (minimum gap) or the pipe is
         stacking up, and consecutive ready batches go as one group."""
+        # every serving loop passes through here each iteration — the
+        # one place the artifact watcher's throttled mtime check covers
+        # inline, sealed, and ring loops alike
+        self._maybe_reload_artifact()
         if self._sink_active:
             self._handoff()
             self._check_sink()
@@ -1309,55 +1321,208 @@ class Engine:
 
     # -- checkpoint/resume (SURVEY.md §5.4: the map-pinning analog) ---------
 
+    def _n_shards(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None else 1
+
     def checkpoint(self, path) -> str:
         """Snapshot table+stats+clock so a restarted engine resumes with
-        every tracked flow and blacklist expiry intact."""
+        every tracked flow and blacklist expiry intact.  The write is
+        atomic and the header records the table GEOMETRY (salt, shard
+        count, capacity) so a restore under a different mesh reshards
+        instead of mislocating keys (engine/checkpoint.py docstring)."""
         from flowsentryx_tpu.engine import checkpoint as ckpt
 
         return str(ckpt.save_state(path, self.table, self.stats,
                                    self.batcher.t0_ns,
-                                   hash_salt=self.cfg.table.salt))
+                                   hash_salt=self.cfg.table.salt,
+                                   n_shards=self._n_shards()))
 
-    def restore(self, path) -> None:
+    def restore(self, path) -> dict:
+        """Resume from a snapshot.  Same geometry → bit-identical
+        placement; a different mesh size or capacity re-places every
+        occupied row for THIS engine's geometry
+        (:func:`flowsentryx_tpu.engine.table.reshard_rows` — announced,
+        with unplaceable rows counted, never silent).  A salt mismatch
+        is refused outright: proceeding under either salt would break
+        one side's slot layout.  Returns a summary dict
+        (``resharded``/``dropped_rows``/``from``/``to``)."""
         from flowsentryx_tpu.engine import checkpoint as ckpt
+        from flowsentryx_tpu.engine import table as tbl
 
-        table, stats, t0_ns, salt, missing = ckpt.load_state(path)
-        if "tok_bytes" in missing and self.cfg.limiter.bucket_burst_bytes > 0:
-            # Pre-byte-bucket snapshot under a byte-limited config:
-            # zero credit would spuriously rate-block every restored
-            # flow's first batch (refill is elapsed-based, not full).
-            # Occupied slots start with the full burst, matching the
-            # is_new semantics their flows got on first sight.
-            table = table.with_columns(tok_bytes=jnp.where(
-                table.key != 0,
-                jnp.float32(self.cfg.limiter.bucket_burst_bytes), 0.0))
-        if table.capacity != self.cfg.table.capacity:
-            raise ValueError(
-                f"checkpoint capacity {table.capacity} != configured "
-                f"{self.cfg.table.capacity}"
-            )
-        if salt != self.cfg.table.salt:
+        ck = ckpt.load_checkpoint(path)
+        if ck.hash_salt != self.cfg.table.salt:
             # A different salt relocates every slot: lookups would miss
             # all persisted flows and silently rebuild the table from
             # scratch while the stale rows rot.  Refuse; the caller
             # adopts the checkpoint's salt (checkpoint.peek_salt) before
             # building the engine, as `fsx serve --restore` does.
             raise ValueError(
-                f"checkpoint hash salt {salt} != configured "
+                f"checkpoint hash salt {ck.hash_salt} != configured "
                 f"{self.cfg.table.salt}; rebuild the engine with "
                 "TableConfig(salt=<checkpoint salt>)"
             )
+        key = np.asarray(ck.table.key)
+        state = np.asarray(ck.table.state)
+        if ("tok_bytes" in ck.missing_columns
+                and self.cfg.limiter.bucket_burst_bytes > 0):
+            # Pre-byte-bucket snapshot under a byte-limited config:
+            # zero credit would spuriously rate-block every restored
+            # flow's first batch (refill is elapsed-based, not full).
+            # Occupied slots start with the full burst, matching the
+            # is_new semantics their flows got on first sight.
+            state = state.copy()
+            state[:, int(schema.TableCol.TOK_BYTES)] = np.where(
+                key != 0,
+                np.float32(self.cfg.limiter.bucket_burst_bytes),
+                np.float32(0.0))
+        n_shards = self._n_shards()
+        info = {
+            "resharded": False, "dropped_rows": 0,
+            "from": {"capacity": ck.capacity, "n_shards": ck.n_shards},
+            "to": {"capacity": self.cfg.table.capacity,
+                   "n_shards": n_shards},
+        }
+        if (ck.capacity != self.cfg.table.capacity
+                or ck.n_shards != n_shards):
+            plan = tbl.TablePlan(capacity=self.cfg.table.capacity,
+                                 n_shards=n_shards,
+                                 salt=self.cfg.table.salt,
+                                 probes=self.cfg.table.probes)
+            key, state, dropped = tbl.reshard_rows(key, state, plan)
+            info["resharded"] = True
+            info["dropped_rows"] = dropped
+            import sys
+
+            print(
+                f"fsx engine: resharding checkpoint "
+                f"{ck.capacity} rows x {ck.n_shards} shard(s) -> "
+                f"{plan.capacity} rows x {plan.n_shards} shard(s)"
+                + (f"; {dropped} row(s) dropped (probe sequences "
+                   "exhausted - table too full for the new geometry)"
+                   if dropped else ""),
+                file=sys.stderr,
+            )
+        table = schema.IpTableState(key=key, state=state)
         if self.mesh is not None:
             from flowsentryx_tpu import parallel as par
 
             table = par.shard_table(table, self.mesh)
+        else:
+            table = schema.IpTableState(key=jax.device_put(key),
+                                        state=jax.device_put(state))
         # restored stats re-enter through _put for the same replication
         # reason as the boot-time make_stats()
+        stats = schema.GlobalStats(*(np.asarray(v) for v in ck.stats))
         self.table, self.stats = table, self._put(stats)
-        self.batcher.t0_ns = t0_ns
+        self.batcher.t0_ns = ck.t0_ns
         self._t0_auto = False
         if hasattr(self.sink, "t0_ns"):
-            self.sink.t0_ns = t0_ns
+            self.sink.t0_ns = ck.t0_ns
+        return info
+
+    # -- live model hot-swap ------------------------------------------------
+
+    def hot_swap(self, params) -> None:
+        """Replace the served artifact WITHOUT draining the pipeline or
+        recompiling (the TPU-tier analog of ``fsx distill --pin``'s
+        live map push).  The jitted step takes params as an ARGUMENT,
+        so the swap is one atomic reference assignment: dispatches
+        launched after it score with the new artifact, in-flight
+        rounds finish with the old one — no serving gap, no verdict
+        lost.  Safe from any thread (``on_reap`` hooks, the artifact
+        watcher, an operator REPL): launch sites read ``self.params``
+        exactly once per dispatch.
+
+        Refused (ValueError) when the swap would invalidate compiled
+        state rather than just re-parameterize it: a different leaf
+        structure/shape/dtype would silently retrace mid-serve, and a
+        compact16 ``model``-mode wire quantizes with the BOOT
+        artifact's observer constants (baked into the traced decode
+        and the sealed-ingest workers), so a new artifact must carry
+        the same ``in_scale``/``in_zp``/``log1p`` — or be served over
+        raw48."""
+        old_leaves = jax.tree_util.tree_leaves(self.params)
+        new_leaves = jax.tree_util.tree_leaves(params)
+        if (jax.tree_util.tree_structure(self.params)
+                != jax.tree_util.tree_structure(params)):
+            raise ValueError(
+                "hot_swap: artifact tree structure differs from the "
+                "served model (different family?); boot a fresh engine")
+        for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+            sa, sb = np.shape(a), np.shape(b)
+            da = np.dtype(getattr(a, "dtype", type(a)))
+            db = np.dtype(getattr(b, "dtype", type(b)))
+            if sa != sb or da != db:
+                raise ValueError(
+                    f"hot_swap: params leaf {i} is {db}{list(sb)}, "
+                    f"served model has {da}{list(sa)} — a shape/dtype "
+                    "change would retrace the step mid-serve")
+        q = self.batcher.quant or None
+        if q and q.get("feat_mode") == "model":
+            nq = schema.model_quant_args(params)
+            drift = {k: (q.get(k), nq[k])
+                     for k in ("in_scale", "in_zp", "log1p")
+                     if nq[k] != q.get(k)}
+            if drift:
+                raise ValueError(
+                    "hot_swap: the compact16 wire quantizes with the "
+                    "boot artifact's input observer, but the new "
+                    f"artifact's differs: {drift}; serve raw48 or "
+                    "reboot with the new artifact")
+        self.params = jax.tree.map(self._put, params)
+        self._hot_swaps += 1
+
+    def watch_artifact(self, path: str) -> None:
+        """Live artifact reload (``fsx serve --artifact-reload``): the
+        serving loops re-stat ``path`` at most twice a second and
+        :meth:`hot_swap` when its mtime changes.  A failed reload
+        (half-written file, wrong family) is announced on stderr and
+        serving continues on the incumbent model — fail-open, the data
+        plane never dies for a bad artifact push."""
+        import os
+
+        self._watch_path = str(path)
+        try:
+            self._watch_mtime = os.stat(self._watch_path).st_mtime_ns
+        except OSError:
+            self._watch_mtime = 0
+        self._watch_next = 0.0
+
+    def _maybe_reload_artifact(self) -> None:
+        if self._watch_path is None:
+            return
+        t = time.monotonic()
+        if t < self._watch_next:
+            return
+        self._watch_next = t + 0.5
+        import os
+
+        try:
+            m = os.stat(self._watch_path).st_mtime_ns
+        except OSError:
+            return  # mid-replace or gone; try again next tick
+        if m == self._watch_mtime:
+            return
+        self._watch_mtime = m
+        import sys
+        import zipfile
+
+        try:
+            from flowsentryx_tpu.models.registry import load_artifact
+
+            self.hot_swap(load_artifact(self.cfg.model.name,
+                                        self._watch_path))
+            print(f"fsx engine: hot-swapped artifact "
+                  f"{self._watch_path} (swap #{self._hot_swaps})",
+                  file=sys.stderr)
+        # BadZipFile: a non-atomic deploy caught mid-write hands
+        # np.load a partial zip — the headline case the fail-open
+        # contract exists for (a later poll picks up the finished file)
+        except (ValueError, KeyError, OSError,
+                zipfile.BadZipFile) as e:
+            print("fsx engine: artifact reload failed (serving "
+                  f"continues on the incumbent model): {e}",
+                  file=sys.stderr)
 
     # -- main loop ----------------------------------------------------------
 

@@ -1,0 +1,197 @@
+"""Flow-table lifecycle: geometry planning, host hash twins, reshard.
+
+The device-resident flow table's GEOMETRY — capacity, shard count,
+salt, probe depth — fixes where every key's row lives: the top
+``log2(n_shards)`` bits of the salted hash pick the owner shard, the
+low bits drive the double-hashed probe inside the owner's rows
+(``ops/hashtable.probe_slots``; disjoint bits, so ownership never
+migrates).  This module owns everything about that geometry that runs
+on the HOST:
+
+* :class:`TablePlan` — the geometry as one value, derived from config
+  + mesh, carried in checkpoints, compared at restore;
+* :func:`validate_capacity` — the pre-boot refusal list ``fsx serve
+  --table-capacity`` prints (power-of-two, batch floor, shard
+  divisibility) instead of a post-compile traceback;
+* numpy twins of the device hash (:func:`hash_u32_np`,
+  :func:`owner_of`) — bit-identical to ``ops/hashtable.hash_u32``,
+  used by the reshard below and by the table-scale smoke to PROVE
+  shard-local residency (every key in shard i must satisfy
+  ``owner_of(key) == i``);
+* :func:`reshard_rows` — restore-with-reshard: re-place every occupied
+  row of a checkpoint under a DIFFERENT geometry (mesh grew/shrank,
+  capacity grew) by re-running the insert probe host-side, vectorized
+  over all rows.  A checkpoint's global slot indices are meaningless
+  under any other geometry — restoring them verbatim would mislocate
+  every key and silently rot the table, which is exactly the failure
+  the engine refuses/reshards at restore time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import TableConfig
+
+#: Second-hash tweak constant — MUST mirror ``ops/hashtable.probe_slots``
+#: (the probe-step hash is ``hash_u32(key ^ GOLDEN, salt) | 1``).
+_GOLDEN = np.uint32(0x9E3779B9)
+
+
+def hash_u32_np(k: np.ndarray, salt: int = 0) -> np.ndarray:
+    """Numpy twin of :func:`flowsentryx_tpu.ops.hashtable.hash_u32`
+    (murmur3 finalizer, salt xor-mixed ahead) — bit-identical, pinned
+    by tests/test_table.py."""
+    k = np.asarray(k, np.uint32) ^ np.uint32(salt)
+    with np.errstate(over="ignore"):
+        k = k ^ (k >> np.uint32(16))
+        k = k * np.uint32(0x85EBCA6B)
+        k = k ^ (k >> np.uint32(13))
+        k = k * np.uint32(0xC2B2AE35)
+        k = k ^ (k >> np.uint32(16))
+    return k
+
+
+def owner_of(keys: np.ndarray, salt: int, n_shards: int) -> np.ndarray:
+    """Owner-shard index of each key — the host twin of the sharded
+    step's routing (``parallel/step.py``: top hash bits)."""
+    if n_shards <= 1:
+        return np.zeros(np.asarray(keys).shape, np.int64)
+    k_bits = int(n_shards).bit_length() - 1
+    return (hash_u32_np(keys, salt) >> np.uint32(32 - k_bits)).astype(
+        np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class TablePlan:
+    """The table geometry as one comparable value."""
+
+    capacity: int
+    n_shards: int = 1
+    salt: int = 0
+    probes: int = 8
+
+    def __post_init__(self) -> None:
+        problems = validate_capacity(self.capacity, n_shards=self.n_shards)
+        if problems:
+            raise ValueError("; ".join(problems))
+
+    @property
+    def local_capacity(self) -> int:
+        return self.capacity // self.n_shards
+
+    @property
+    def k_bits(self) -> int:
+        return int(self.n_shards).bit_length() - 1
+
+    @classmethod
+    def of(cls, tcfg: TableConfig, n_shards: int = 1) -> "TablePlan":
+        return cls(capacity=tcfg.capacity, n_shards=n_shards,
+                   salt=tcfg.salt, probes=tcfg.probes)
+
+
+def validate_capacity(
+    capacity: int, max_batch: int = 0, n_shards: int = 1
+) -> list[str]:
+    """Every reason this capacity cannot serve, each as one clear
+    sentence (the ``fsx serve --table-capacity`` pre-boot refusals;
+    empty list = valid)."""
+    problems: list[str] = []
+    if capacity <= 0 or capacity & (capacity - 1):
+        problems.append(
+            f"table capacity {capacity} is not a power of two (slot "
+            "probing masks with capacity-1)")
+        return problems  # the rest assumes pow2
+    if capacity > 1 << 29:
+        problems.append(
+            f"table capacity {capacity} exceeds 2^29 (the packed "
+            "arbitration sort key must fit int32)")
+    if max_batch and capacity < max_batch:
+        problems.append(
+            f"table capacity {capacity} is smaller than max_batch "
+            f"{max_batch}: one batch of distinct flows could not even "
+            "be tracked")
+    if n_shards > 1:
+        if n_shards & (n_shards - 1):
+            problems.append(
+                f"shard count {n_shards} is not a power of two "
+                "(ownership uses top hash bits)")
+        elif capacity < n_shards:
+            problems.append(
+                f"table capacity {capacity} cannot split over "
+                f"{n_shards} shards")
+    return problems
+
+
+def _global_candidates(keys: np.ndarray, plan: TablePlan) -> np.ndarray:
+    """``[R, probes]`` GLOBAL row candidates of each key under
+    ``plan`` — the host twin of the device probe sequence
+    (``(h1 + p*step) & (local_capacity - 1)`` inside the owner's
+    rows)."""
+    h1 = hash_u32_np(keys, plan.salt)
+    step = hash_u32_np(np.asarray(keys, np.uint32) ^ _GOLDEN,
+                       plan.salt) | np.uint32(1)
+    mask = np.uint32(plan.local_capacity - 1)
+    offs = np.arange(plan.probes, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        local = (h1[:, None] + offs[None, :] * step[:, None]) & mask
+    base = owner_of(keys, plan.salt, plan.n_shards) * plan.local_capacity
+    return base[:, None] + local.astype(np.int64)
+
+
+def reshard_rows(
+    key: np.ndarray,
+    state: np.ndarray,
+    plan: TablePlan,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Re-place every occupied row under a new geometry.
+
+    ``key``/``state`` are the HOST global arrays of a loaded checkpoint
+    (shard-major under whatever geometry wrote them — their positions
+    are discarded; only occupancy matters).  Each occupied key re-runs
+    the insert probe under ``plan`` and lands in its earliest free
+    candidate row, so a subsequent lookup finds it at match priority
+    exactly as if the flow had inserted live.  Returns
+    ``(key, state, dropped)`` where ``dropped`` counts rows whose whole
+    probe sequence was taken by other restored keys — possible only
+    near capacity (a restore into a SMALLER table); fail-open, counted,
+    never silent.
+
+    Vectorized: ``probes`` passes over all rows (argsort per pass), so
+    a 4M-row reshard is numpy-speed, not a Python loop.
+    """
+    key = np.asarray(key, np.uint32)
+    state = np.asarray(state, np.float32)
+    occ = np.flatnonzero(key != 0)
+    new_key = np.zeros((plan.capacity,), np.uint32)
+    new_state = np.zeros((plan.capacity, schema.NUM_TABLE_COLS),
+                         np.float32)
+    if not len(occ):
+        return new_key, new_state, 0
+    k_occ = key[occ]
+    st_occ = state[occ]
+    cand = _global_candidates(k_occ, plan)          # [R, P]
+    placed = np.zeros(len(occ), bool)
+    taken = np.zeros(plan.capacity, bool)
+    for p in range(plan.probes):
+        idx = np.flatnonzero(~placed)
+        if not len(idx):
+            break
+        c = cand[idx, p]
+        free = ~taken[c]
+        idx, c = idx[free], c[free]
+        # one winner per contested slot: stable sort by slot keeps the
+        # first (lowest original row) — deterministic across runs
+        order = np.argsort(c, kind="stable")
+        c_s, idx_s = c[order], idx[order]
+        head = np.ones(len(c_s), bool)
+        head[1:] = c_s[1:] != c_s[:-1]
+        slots_w, rows_w = c_s[head], idx_s[head]
+        new_key[slots_w] = k_occ[rows_w]
+        new_state[slots_w] = st_occ[rows_w]
+        taken[slots_w] = True
+        placed[rows_w] = True
+    return new_key, new_state, int(np.sum(~placed))

@@ -332,6 +332,11 @@ def update_stats_from_counts(
         dropped_ml=u64_add(stats.dropped_ml, counts[3]),
         batches=u64_add(stats.batches,
                         (counts.sum() > 0).astype(jnp.uint32)),
+        # eviction is accounted at the sweep site (evict_idle_epoch's
+        # callers), not from the verdict counts; a pure passthrough here
+        # keeps disabled-eviction graphs — and their donation aliasing —
+        # identical to the pre-eviction era
+        evicted=stats.evicted,
     )
 
 
@@ -341,6 +346,103 @@ def update_stats(
     """Per-packet counters (successor of the reference's racy
     allowed/dropped bumps, ``fsx_kern.c:210,332,342``)."""
     return update_stats_from_counts(stats, count_verdicts(verdict, valid))
+
+
+# -- in-step aging: the rolling idle-flow eviction sweep --------------------
+#
+# The reference gets flow-table aging for free from BPF LRU maps; the
+# dense device table only ever RECLAIMED stale slots when a new flow
+# happened to probe them, so under sustained flow churn occupancy grew
+# monotonically toward capacity and every probe sequence degraded with
+# it.  The eviction sweep bounds occupancy in-graph: each batch, the
+# step OPENS by sweeping one ``ceil(capacity / evict_every)``-row
+# WINDOW — the window base advancing with the batch counter, so every
+# row is re-examined once per ``evict_every`` batches (one full aging
+# cycle) — freeing slots idle longer than ``evict_ttl_s`` (still-valid
+# blacklist entries exempt: a blocked source must keep dropping until
+# its TTL expires, exactly like the kernel map entry).
+#
+# Why a rolling window and not an every-N-batches whole-table pass
+# under ``lax.cond``: XLA:CPU materializes a conditional's operands and
+# results as fresh buffers, so a cond carrying a [4M, 12] table COPIES
+# ~400 MB per batch whether or not the sweep branch fires — measured
+# 60x off the no-eviction drain rate.  The window form costs
+# ``capacity/evict_every`` rows of gather+scatter per batch, adds no
+# whole-table latency spike on epoch batches, and keeps the exact same
+# guarantee: a row idle past the ttl is freed within one cycle of
+# crossing it.
+#
+# The window is read with a GATHER and written with a victim-only
+# SCATTER — not ``dynamic_slice``/``dynamic_update_slice``: a
+# dynamic-OFFSET slice touching the donated table defeats XLA:CPU's
+# in-place buffer reuse for the whole donated chain, and the step
+# falls off the in-place cliff (measured ~250 ms/step at 4M rows —
+# the full-table-copy signature — regardless of window size, even at
+# a 1-row window).  Scatters on the donated buffers are the hot
+# path's own proven-in-place mechanism; with drop-mode parking for
+# the non-victim lanes the write volume is the evicted rows only.
+#
+# Everything stays inside the staged graph: no new D2H (the verdict
+# wire is unchanged), no new collectives (each shard sweeps its own
+# rows; the count rides the existing stats psum).  Sweeping at step
+# START (before slot probing) means freed slots are claimable by the
+# same batch's inserts, and the sweep depends only on (incoming table,
+# incoming batch count, batch clock) — which is what makes the
+# reference-sweep parity test exact.
+
+
+def evict_window(capacity: int, evict_every: int) -> int:
+    """Rows swept per batch: one full pass every ``evict_every``
+    batches.  When the division is ragged the last window re-sweeps a
+    few tail rows (the base is clamped to keep the window in bounds) —
+    idempotent, so merely redundant.  Sizing rule: the sweep costs
+    ~0.2 µs/row single-device and ~1 µs/row under shard_map on CPU, so
+    size by CYCLE TIME, not window size — pick ``evict_every`` so one
+    full pass (``evict_every`` batches) takes about ``ttl/4`` at your
+    batch rate; the window lands in the tens-to-hundreds of rows and
+    the per-batch overhead vanishes.  At the 10 Mpps design rate a 4M
+    table with ``evict_every=32768`` cycles in ~7 s with a 128-row
+    window (the TABLESCALE_r12 bench setting)."""
+    return -(-capacity // evict_every)
+
+
+def evict_idle_epoch(
+    tcfg,
+    table: IpTableState,
+    stats: GlobalStats,
+    now: jnp.ndarray,
+) -> tuple[IpTableState, jnp.ndarray]:
+    """One rolling-sweep step (module comment above).
+
+    Returns ``(table, [] uint32 evicted-count-this-window)``.  Callers
+    gate on ``tcfg.evict_ttl_s > 0`` STATICALLY — a disabled config
+    must stage the pre-eviction graph, not a sweep that never frees.
+
+    Warm/empty batches carry ``now == 0``, making the sweep a no-op by
+    construction (``0 - last_seen`` can never exceed a positive ttl),
+    so ``warm()``'s state-preservation contract holds without a
+    valid-count input here."""
+    C = TableCol
+    cap = table.key.shape[0]
+    chunk = evict_window(cap, tcfg.evict_every)
+    off = ((stats.batches[0] % np.uint32(tcfg.evict_every))
+           * np.uint32(chunk)).astype(jnp.int32)
+    # clamp so a ragged last window re-sweeps tail rows instead of
+    # parking out of bounds (which would leave them unswept forever)
+    off = jnp.minimum(off, np.int32(cap - chunk))
+    idx = off + jnp.arange(chunk, dtype=jnp.int32)
+    keys = table.key[idx]
+    rows = table.state[idx]
+    idle = now - rows[:, C.LAST_SEEN] > tcfg.evict_ttl_s
+    live_block = rows[:, C.BLOCKED_UNTIL] > now
+    victim = (keys != hashtable.EMPTY_KEY) & idle & ~live_block
+    # victim-only scatter: non-victim lanes park at row `cap` and drop
+    vidx = jnp.where(victim, idx, jnp.int32(cap))
+    return IpTableState(
+        key=table.key.at[vidx].set(jnp.uint32(hashtable.EMPTY_KEY),
+                                   mode="drop"),
+        state=table.state.at[vidx].set(0.0, mode="drop"),
+    ), jnp.sum(victim).astype(jnp.uint32)
 
 
 # -- compact verdict wire ---------------------------------------------------
@@ -479,6 +581,13 @@ def make_step(
         # owner side); parity is pinned by tests/test_fused.py.
         b = batch.key.shape[0]
         now = jnp.max(jnp.where(batch.valid, batch.ts, 0.0))
+        # In-step aging epoch (evict_idle_epoch): sweep BEFORE probing
+        # so freed slots are claimable by this very batch's inserts.
+        # Statically absent when disabled — the pre-eviction graph.
+        n_evicted = None
+        if cfg.table.evict_ttl_s > 0:
+            table, n_evicted = evict_idle_epoch(cfg.table, table, stats,
+                                                now)
         score = classify_batch(params, batch.feat)  # [B] f32, MXU path
         mal = (score > cfg.model.threshold) & batch.valid
 
@@ -551,6 +660,11 @@ def make_step(
         verdict = resolve_record_verdicts(dec.flow_verdict, fa.inv, mal,
                                           batch.valid)
         new_stats = update_stats(stats, verdict, batch.valid)
+        if n_evicted is not None:
+            from flowsentryx_tpu.core.schema import u64_add
+
+            new_stats = new_stats._replace(
+                evicted=u64_add(new_stats.evicted, n_evicted))
 
         block_key = jnp.where(dec.newly_blocked, fa.rep_key, agg.INVALID_KEY)
         block_until = jnp.where(dec.newly_blocked, dec.new_blocked_until, 0.0)

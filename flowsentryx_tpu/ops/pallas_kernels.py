@@ -242,9 +242,16 @@ def table_summary(
         now_dev = jax.device_put(np.float32(now), dst)
     else:
         now_dev = jax.device_put(np.float32(now))
+    # Pallas only on a REAL TPU: interpret-mode emulation walks the
+    # grid step by step, which at production capacities turns a
+    # per-report scan into tens of seconds (measured ~100 s at 4M rows
+    # on CPU — it silently dominated every engine run's report).  The
+    # XLA twin is the same answer at memory-bandwidth speed everywhere
+    # else.
     counts, newest = _table_summary(
         table.key, table.state, now_dev,
-        float(stale_s), use_pallas=not table.capacity % _CHUNK,
+        float(stale_s),
+        use_pallas=(not table.capacity % _CHUNK and not _interpret()),
     )
     counts = jax.device_get(counts)
     return {

@@ -1,4 +1,4 @@
-from flowsentryx_tpu.parallel import mesh, step  # noqa: F401
+from flowsentryx_tpu.parallel import layout, mesh, step  # noqa: F401
 from flowsentryx_tpu.parallel.mesh import make_mesh  # noqa: F401
 from flowsentryx_tpu.parallel.step import (  # noqa: F401
     make_sharded_compact_megastep,

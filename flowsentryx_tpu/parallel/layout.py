@@ -1,0 +1,87 @@
+"""Declarative placement: THE partition-rule table for device state.
+
+Before this module, the row-sharded-table / replicated-everything
+layout was re-stated independently at every seam — ``shard_table``'s
+``device_put``, the shard_map in/out specs, the engine's explicit H2D
+sharding, the checkpoint restore path — and nothing but review kept
+them in agreement.  Here the layout is DECLARED once as partition
+rules (regex on the leaf's path name → ``PartitionSpec``, the
+match-rules idiom of the big-model sharding utilities) and every
+consumer derives its placement from the same table:
+
+* :func:`table_specs` / :func:`stats_specs` — the shard_map in/out
+  specs of the sharded step (:mod:`flowsentryx_tpu.parallel.step`);
+* :func:`shard_table` — device placement of a fresh or restored table
+  (``parallel.step`` re-exports it for compatibility);
+* :func:`replicated` — the engine's wire-buffer/params/stats sharding
+  (:class:`~flowsentryx_tpu.engine.engine.Engine` boot placement).
+
+Why the table rows shard and nothing else does: the ingest IP-hash
+seam routes a flow's records to its owner by the TOP bits of the same
+salted hash whose LOW bits pick the slot inside the owner's shard
+(``ops/hashtable.hash_u32``; disjoint bits, so ownership never
+migrates) — lookups are shard-local BY CONSTRUCTION, and the only
+cross-device traffic is the step's two ``all_to_all`` flow routings
+plus scalar reductions (the audited collective census).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flowsentryx_tpu.core.schema import GlobalStats, IpTableState
+
+#: The partition rules, first match wins.  Each entry is
+#: ``(leaf-path regex, spec builder taking the mesh's table axis)``.
+#: Leaf paths are dotted names rooted at the step's argument names
+#: (``table.key``, ``stats.allowed``, ``params``, ``raw``...).
+PARTITION_RULES: tuple[tuple[str, Callable[[str], P]], ...] = (
+    # per-IP state rows: sharded over the hash axis (module docstring)
+    (r"^table\.", lambda axis: P(axis)),
+    # global counters, classifier params, and wire batches: replicated
+    # (each device slices its own batch span ON DEVICE inside the
+    # shard-mapped step; nothing per-record is ever resharded)
+    (r"^(stats|params|raw|wire|slot)", lambda _axis: P()),
+)
+
+
+def spec_for(name: str, axis: str = "ip") -> P:
+    """The :class:`PartitionSpec` of one leaf path under the rules."""
+    for pat, build in PARTITION_RULES:
+        if re.search(pat, name) is not None:
+            return build(axis)
+    raise KeyError(f"no partition rule matches leaf {name!r}")
+
+
+def sharding_for(mesh: Mesh, name: str) -> NamedSharding:
+    """``NamedSharding`` of one leaf path on ``mesh``."""
+    return NamedSharding(mesh, spec_for(name, mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The replicated placement (stats/params/wire buffers)."""
+    return NamedSharding(mesh, P())
+
+
+def table_specs(axis: str = "ip") -> IpTableState:
+    """shard_map specs for the table pytree, derived from the rules."""
+    return IpTableState(*(spec_for(f"table.{f}", axis)
+                          for f in IpTableState._fields))
+
+
+def stats_specs() -> GlobalStats:
+    """shard_map specs for the stats pytree, derived from the rules."""
+    return GlobalStats(*(spec_for(f"stats.{f}")
+                         for f in GlobalStats._fields))
+
+
+def shard_table(table: IpTableState, mesh: Mesh) -> IpTableState:
+    """Place a state table under the rules (row-sharded over the
+    mesh's table axis) — THE placement everything restores through."""
+    return IpTableState(*(
+        jax.device_put(leaf, sharding_for(mesh, f"table.{f}"))
+        for f, leaf in zip(IpTableState._fields, table)))

@@ -44,20 +44,18 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from flowsentryx_tpu.core.config import FsxConfig
-from flowsentryx_tpu.parallel import mesh as mesh_lib
+from flowsentryx_tpu.parallel import layout, mesh as mesh_lib
 from flowsentryx_tpu.core.schema import (
-    GlobalStats, IpTableState, Verdict, make_table,
+    IpTableState, Verdict, make_table,
 )
 from flowsentryx_tpu.ops import agg, fused, hashtable
 
-
-def shard_table(table: IpTableState, mesh: Mesh) -> IpTableState:
-    """Place a state table row-sharded over the mesh's first axis."""
-    spec = NamedSharding(mesh, P(mesh.axis_names[0]))
-    return jax.tree.map(lambda a: jax.device_put(a, spec), table)
+#: Re-export (the historical home): placement now derives from the
+#: declarative partition rules in :mod:`flowsentryx_tpu.parallel.layout`.
+shard_table = layout.shard_table
 
 
 def make_sharded_table(cfg: FsxConfig, mesh: Mesh) -> IpTableState:
@@ -121,6 +119,16 @@ def make_sharded_step(
         ml_l = (jnp.zeros((local_b,), jnp.float32)
                 .at[fa.inv].add(mal_l.astype(jnp.float32)))
         now = jax.lax.pmax(jnp.max(jnp.where(valid_l, ts_l, 0.0)), axis)
+
+        # In-step aging epoch, the shard-local way: each device sweeps
+        # its OWN table rows (an elementwise pass — nothing crosses the
+        # mesh), gated by the replicated batch counter so every shard
+        # fires the same epochs; the per-shard count rides the existing
+        # stats psum below.  Statically absent when disabled.
+        n_evict_l = None
+        if cfg.table.evict_ttl_s > 0:
+            table_shard, n_evict_l = fused.evict_idle_epoch(
+                cfg.table, table_shard, stats, now)
 
         # --- route local flow partials to their owner ----------------------
         h1 = hashtable.hash_u32(fa.rep_key, cfg.table.salt)
@@ -209,14 +217,21 @@ def make_sharded_step(
             jnp.where(valid_l, overflow[fa.inv].astype(jnp.uint32),
                       jnp.uint32(0))
         )
-        counts = jax.lax.psum(
-            jnp.concatenate([
-                fused.count_verdicts(verdict_l, valid_l),
-                route_drop_l[None].astype(jnp.uint32),
-            ]),
-            axis,
-        )
+        count_parts = [
+            fused.count_verdicts(verdict_l, valid_l),
+            route_drop_l[None].astype(jnp.uint32),
+        ]
+        if n_evict_l is not None:
+            # the eviction count joins the ONE existing scalar psum —
+            # the audited collective census does not grow
+            count_parts.append(n_evict_l[None])
+        counts = jax.lax.psum(jnp.concatenate(count_parts), axis)
         new_stats = fused.update_stats_from_counts(stats, counts[:4])
+        if n_evict_l is not None:
+            from flowsentryx_tpu.core.schema import u64_add
+
+            new_stats = new_stats._replace(
+                evicted=u64_add(new_stats.evicted, counts[5]))
 
         blk_key = jnp.where(dec.newly_blocked, m_key,
                             agg.INVALID_KEY)                      # owner-side
@@ -262,8 +277,11 @@ def make_sharded_step(
         )
         return new_shard, new_stats, out
 
-    table_specs = IpTableState(*([P(axis)] * len(IpTableState._fields)))
-    stats_specs = GlobalStats(*([P()] * len(GlobalStats._fields)))
+    # in/out placement comes from the declarative rule table
+    # (parallel/layout.py) — the one layout declaration the engine's
+    # H2D path and the checkpoint restore path also derive from
+    table_specs = layout.table_specs(axis)
+    stats_specs = layout.stats_specs()
     out_specs = fused.StepOutput(
         verdict=P(axis), score=P(axis) if emit_score else None,
         block_key=P(axis), block_until=P(axis),

@@ -1,0 +1,196 @@
+"""Bounded CPU table-scale smoke — the production-flow-table CI gate.
+
+Serves sustained flow CHURN (a fresh keyset every batch, the workload
+whose occupancy only eviction can bound) through a mesh-sharded
+eviction-epoch engine and re-proves, on every ``verify_tier1.sh`` run:
+
+* **eviction fires** — ``stats.evicted > 0`` and the sweep actually
+  freed rows (a no-eviction control run over the same records tracks
+  strictly more);
+* **occupancy stays bounded** — final ``table.tracked`` is held near
+  the live (ttl-recent) flow count, not the cumulative distinct-flow
+  count the control run reaches;
+* **shard-local residency** — every occupied key in shard *i*
+  satisfies ``owner_of(key) == i`` (the host hash twin,
+  ``engine/table.py``), which is the "lookups stay shard-local"
+  invariant measured rather than asserted from the design;
+* **restore-with-reshard** — the run's checkpoint round-trips
+  mesh=4 → mesh=8 with every key and its row intact, owner-correct
+  under the new geometry, and zero dropped rows.
+
+Results merge into ``artifacts/TABLESCALE_r12.json`` under ``"smoke"``
+(the ``"paced"`` 4M-row drain/ladder evidence in the same artifact is
+preserved).
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+           python scripts/table_scale_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla:
+    os.environ["XLA_FLAGS"] = (
+        xla + " --xla_force_host_platform_device_count=8").strip()
+
+BATCH = 256
+PHASES = 24
+CAP = 1 << 14
+TTL_S = 2.0
+EVERY = 4
+SALT = 0xC0FFEE
+
+
+def _cfg(ttl: float):
+    from flowsentryx_tpu.core.config import (
+        BatchConfig, FsxConfig, LimiterConfig, TableConfig,
+    )
+
+    return FsxConfig(
+        table=TableConfig(capacity=CAP, stale_s=1e6, salt=SALT,
+                          evict_ttl_s=ttl, evict_every=EVERY),
+        batch=BatchConfig(max_batch=BATCH),
+        limiter=LimiterConfig(pps_threshold=1e9, bps_threshold=1e18),
+    )
+
+
+def _churn():
+    import numpy as np
+
+    from flowsentryx_tpu.core import schema
+
+    bufs = []
+    for i in range(PHASES):
+        buf = np.zeros(BATCH, schema.FLOW_RECORD_DTYPE)
+        buf["saddr"] = 20_000 * (i + 1) + np.arange(BATCH)
+        buf["pkt_len"] = 100
+        buf["ts_ns"] = int(i * 1e9) + np.arange(BATCH) * 1000
+        buf["feat"][:, 0] = 80.0
+        bufs.append(buf)
+    return np.concatenate(bufs)
+
+
+def main() -> int:
+    import numpy as np
+
+    from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+    from flowsentryx_tpu.engine import table as tbl
+    from flowsentryx_tpu.parallel import make_mesh
+
+    t_start = time.perf_counter()
+    recs = _churn()
+    failures: list[str] = []
+
+    # no-eviction control (single-device is fine — occupancy is
+    # layout-independent up to arbitration losses)
+    ctl = Engine(_cfg(0.0), ArraySource(recs.copy()), CollectSink(),
+                 sink_thread=False)
+    rep_ctl = ctl.run()
+
+    # the eviction-epoch mesh engine
+    mesh4 = make_mesh(4)
+    eng = Engine(_cfg(TTL_S), ArraySource(recs.copy()), CollectSink(),
+                 sink_thread=False, mesh=mesh4)
+    rep = eng.run()
+
+    evicted = rep.stats["evicted"]
+    tracked = rep.table["tracked"]
+    tracked_ctl = rep_ctl.table["tracked"]
+    if evicted <= 0:
+        failures.append("eviction never fired under 24 phases of churn")
+    # live flows = the phases younger than ttl (+ the sweep period's
+    # slack); 2x that is a generous bound, and far under the control's
+    # cumulative occupancy
+    live_bound = (int(TTL_S) + 1 + EVERY) * BATCH
+    if tracked > live_bound:
+        failures.append(
+            f"occupancy {tracked} exceeds the live-flow bound "
+            f"{live_bound} — eviction is not bounding churn")
+    if tracked >= tracked_ctl:
+        failures.append(
+            f"evicting engine tracks {tracked} >= control "
+            f"{tracked_ctl} — the sweep freed nothing")
+
+    # shard-local residency, measured: every occupied key in shard i
+    # hashes to owner i
+    key = np.asarray(eng.table.key)
+    local = CAP // 4
+    occ = np.flatnonzero(key != 0)
+    owners = tbl.owner_of(key[occ], SALT, 4)
+    misplaced = int(np.sum(owners != occ // local))
+    if misplaced:
+        failures.append(
+            f"{misplaced} occupied key(s) resident outside their "
+            "owner shard — lookups are not shard-local")
+
+    # restore-with-reshard: mesh=4 checkpoint → mesh=8 engine
+    tmp = tempfile.mkdtemp(prefix="fsx_tblsmoke_")
+    try:
+        path = eng.checkpoint(os.path.join(tmp, "m4.npz"))
+        e8 = Engine(_cfg(TTL_S), ArraySource(recs[:BATCH].copy()),
+                    CollectSink(), sink_thread=False, mesh=make_mesh(8))
+        info = e8.restore(path)
+        k8 = np.asarray(e8.table.key)
+        occ8 = np.flatnonzero(k8 != 0)
+        if not info["resharded"] or info["dropped_rows"]:
+            failures.append(f"mesh 4->8 reshard: {info}")
+        if set(k8[occ8]) != set(key[occ]):
+            failures.append("mesh 4->8 reshard lost/invented keys")
+        own8 = tbl.owner_of(k8[occ8], SALT, 8)
+        if int(np.sum(own8 != occ8 // (CAP // 8))):
+            failures.append("resharded keys not owner-correct at mesh=8")
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    smoke = {
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "capacity": CAP,
+        "mesh": 4,
+        "phases": PHASES,
+        "evict_ttl_s": TTL_S,
+        "evict_every": EVERY,
+        "invariants": {
+            "evicted": evicted,
+            "tracked": tracked,
+            "tracked_no_evict_control": tracked_ctl,
+            "live_flow_bound": live_bound,
+            "misplaced_keys": misplaced,
+            "reshard_4_to_8": info,
+        },
+        "ok": not failures,
+        "failures": failures,
+    }
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "TABLESCALE_r12.json")
+    try:
+        artifact = json.loads(open(out_path).read())
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["smoke"] = smoke
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"table-scale smoke: wrote {out_path}")
+    print(f"table-scale smoke: evicted={evicted} tracked={tracked} "
+          f"(control {tracked_ctl}, bound {live_bound}) "
+          f"misplaced={misplaced}")
+    for msg in failures:
+        print(f"table-scale smoke: FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

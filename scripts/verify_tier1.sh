@@ -64,6 +64,27 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
     --device-loop 2 --out artifacts/AUDIT_r08.json || exit 1
 
+echo "== fsx audit: eviction-epoch step variants (quick shapes) =="
+# The in-step aging sweep changes every staged graph (a rolling
+# gather + victim-only-scatter window at step start), so the
+# eviction-enabled family is audited as its own artifact set: donation
+# through the sweep, the 528 B wire pin, and the unchanged collective
+# census (the eviction count rides the existing stats psum) are
+# re-proved each run.
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
+    --device-loop 2 --evict-ttl 30 --quick \
+    --out artifacts/AUDIT_evict_r12.json || exit 1
+
+echo "== table-scale smoke: eviction + occupancy bound + shard-local rows =="
+# Bounded CPU smoke of the production flow table: re-proves that the
+# eviction epoch fires under churn, occupancy stays bounded at the
+# live-flow count, every occupied key is resident on its owner shard,
+# and a mesh=4 checkpoint reshards losslessly into mesh=8 — rewriting
+# the "smoke" section of artifacts/TABLESCALE_r12.json (the paced
+# 4M-row drain/ladder evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/table_scale_smoke.py || exit 1
+
 echo "== fsx distill: kernel-tier compile + static check + JAX<->BPF parity =="
 # Compiles the shipped artifact into the kernel tier, statically
 # verifies both --ml program variants, and proves bit-exact band

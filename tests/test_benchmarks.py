@@ -60,9 +60,12 @@ class TestCheckpoint:
         # and global counters carried over (resumed, not reset)
         assert rep2.stats["dropped"] >= rep1.stats["dropped"]
 
-    def test_capacity_mismatch_rejected(self, tmp_path):
+    def test_capacity_change_reshards(self, tmp_path):
+        """A restore into a different capacity re-places every occupied
+        row for the new geometry (PR 8 restore-with-reshard) — the old
+        refusal would have forced a state-losing cold boot just to grow
+        the table."""
         import dataclasses
-        import pytest
 
         cfg = FsxConfig(table=TableConfig(capacity=1 << 12),
                         batch=BatchConfig(max_batch=256))
@@ -71,8 +74,11 @@ class TestCheckpoint:
         path = e1.checkpoint(tmp_path / "s.npz")
         cfg2 = dataclasses.replace(cfg, table=TableConfig(capacity=1 << 13))
         e2 = Engine(cfg2, TrafficSource(TrafficSpec(seed=1), total=256), CollectSink())
-        with pytest.raises(ValueError):
-            e2.restore(path)
+        info = e2.restore(path)
+        assert info["resharded"] and info["dropped_rows"] == 0
+        k1 = np.asarray(e1.table.key)
+        k2 = np.asarray(e2.table.key)
+        assert set(k2[k2 != 0]) == set(k1[k1 != 0])
 
     def test_pre_byte_bucket_checkpoint_refills_credit(self, tmp_path):
         """A snapshot that predates the byte bucket (no tok_bytes

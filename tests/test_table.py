@@ -1,0 +1,664 @@
+"""Production-scale flow table (PR 8): host hash twins + capacity
+validation, the in-step eviction epoch (byte-parity vs a reference
+sweep, single-device AND mesh, under the transfer guard), sharded
+checkpoint round-trips with restore-with-reshard, and live model
+hot-swap."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core.config import (
+    BatchConfig, FsxConfig, LimiterConfig, TableConfig,
+)
+from flowsentryx_tpu.core.schema import (
+    IpTableState, TableCol, make_stats, make_table, stat_value,
+)
+from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+from flowsentryx_tpu.engine import table as tbl
+from flowsentryx_tpu.models import get_model
+from flowsentryx_tpu.ops import fused, hashtable
+from flowsentryx_tpu.parallel import make_mesh
+
+CAP = 1 << 12
+BATCH = 256
+
+
+def evict_cfg(ttl=2.0, every=1, cap=CAP, batch=BATCH, **lim) -> FsxConfig:
+    return FsxConfig(
+        table=TableConfig(capacity=cap, stale_s=1e6, evict_ttl_s=ttl,
+                          evict_every=every),
+        batch=BatchConfig(max_batch=batch),
+        limiter=LimiterConfig(**lim) if lim else LimiterConfig(
+            pps_threshold=1e9, bps_threshold=1e18),
+    )
+
+
+def mkbuf(keys, t_s, pkt_len=100):
+    """One FLOW_RECORD_DTYPE buffer: each key once, at ``t_s`` seconds
+    (spread by 1 µs so timestamps are distinct)."""
+    n = len(keys)
+    buf = np.zeros(n, schema.FLOW_RECORD_DTYPE)
+    buf["saddr"] = np.asarray(keys, np.uint32)
+    buf["pkt_len"] = pkt_len
+    buf["ts_ns"] = int(t_s * 1e9) + np.arange(n) * 1000
+    buf["feat"][:, 0] = 80.0
+    return buf
+
+
+class TestHostHashTwins:
+    def test_hash_np_matches_device(self, rng):
+        keys = rng.integers(1, 2**32 - 2, 4096, dtype=np.uint32)
+        for salt in (0, 0xDEADBEEF, 0x1):
+            dev = np.asarray(hashtable.hash_u32(jnp.asarray(keys), salt))
+            np.testing.assert_array_equal(dev,
+                                          tbl.hash_u32_np(keys, salt))
+
+    def test_owner_matches_top_hash_bits(self, rng):
+        keys = rng.integers(1, 2**32 - 2, 1024, dtype=np.uint32)
+        h = tbl.hash_u32_np(keys, 7)
+        np.testing.assert_array_equal(tbl.owner_of(keys, 7, 8), h >> 29)
+        assert (tbl.owner_of(keys, 7, 1) == 0).all()
+
+
+class TestValidateCapacity:
+    def test_valid_is_silent(self):
+        assert tbl.validate_capacity(1 << 20, 2048, 8) == []
+
+    def test_each_refusal_names_its_problem(self):
+        assert "power of two" in tbl.validate_capacity(3000)[0]
+        assert "2^29" in tbl.validate_capacity(1 << 30)[0]
+        assert "max_batch" in tbl.validate_capacity(1 << 10, 2048)[0]
+        assert "shards" in tbl.validate_capacity(4, n_shards=8)[0]
+
+    def test_plan_rejects_bad_geometry(self):
+        with pytest.raises(ValueError):
+            tbl.TablePlan(capacity=3000)
+
+
+class TestReshard:
+    def test_every_key_relocates_with_state(self, rng):
+        key = np.zeros(CAP, np.uint32)
+        state = np.zeros((CAP, schema.NUM_TABLE_COLS), np.float32)
+        ks = rng.choice(np.arange(1, 10**7, dtype=np.uint32), 2000,
+                        replace=False)
+        pos = rng.choice(CAP, 2000, replace=False)
+        key[pos] = ks
+        state[pos, 0] = ks.astype(np.float32)
+        plan = tbl.TablePlan(capacity=CAP, n_shards=8, salt=0x55)
+        k2, s2, dropped = tbl.reshard_rows(key, state, plan)
+        occ = np.flatnonzero(k2 != 0)
+        assert len(occ) + dropped == 2000 and dropped == 0
+        # owner-correct rows: shard index == top hash bits
+        np.testing.assert_array_equal(
+            occ // plan.local_capacity, tbl.owner_of(k2[occ], 0x55, 8))
+        # state rode along, and every key sits on one of its own probe
+        # candidates (a live lookup finds it at match priority)
+        np.testing.assert_array_equal(s2[occ, 0],
+                                      k2[occ].astype(np.float32))
+        cand = tbl._global_candidates(k2[occ], plan)
+        assert (cand == occ[:, None]).any(axis=1).all()
+
+    def test_overfull_target_drops_counted(self, rng):
+        key = np.zeros(1024, np.uint32)
+        key[:] = np.arange(1, 1025, dtype=np.uint32)
+        state = np.ones((1024, schema.NUM_TABLE_COLS), np.float32)
+        plan = tbl.TablePlan(capacity=256, n_shards=1, probes=8)
+        k2, _, dropped = tbl.reshard_rows(key, state, plan)
+        assert dropped > 0
+        assert int(np.sum(k2 != 0)) + dropped == 1024
+
+
+class TestEvictionStep:
+    """The in-step aging epoch ≡ (reference numpy sweep ∘ sweepless
+    step), byte-for-byte — the eviction-epoch parity the ISSUE pins."""
+
+    def _steps(self, ttl, every):
+        cfg_e = evict_cfg(ttl=ttl, every=every)
+        cfg_0 = dataclasses.replace(cfg_e, table=dataclasses.replace(
+            cfg_e.table, evict_ttl_s=0.0))
+        spec = get_model(cfg_e.model.name)
+        step_e = fused.make_jitted_raw_step(cfg_e, spec.classify_batch,
+                                            donate=False)
+        step_0 = fused.make_jitted_raw_step(cfg_0, spec.classify_batch,
+                                            donate=False)
+        return cfg_e, step_e, step_0, spec.init()
+
+    @staticmethod
+    def _ref_sweep(table, now, ttl):
+        k = np.asarray(table.key)
+        st = np.asarray(table.state)
+        idle = (np.float32(now) - st[:, int(TableCol.LAST_SEEN)]
+                ) > np.float32(ttl)
+        keep_block = st[:, int(TableCol.BLOCKED_UNTIL)] > np.float32(now)
+        victim = (k != 0) & idle & ~keep_block
+        return IpTableState(
+            key=jnp.asarray(np.where(victim, 0, k)),
+            state=jnp.asarray(np.where(victim[:, None], 0.0, st)),
+        ), int(victim.sum())
+
+    def test_epoch_step_equals_reference_sweep(self):
+        ttl = 2.5
+        cfg_e, step_e, step_0, params = self._steps(ttl, every=1)
+        t_e, s_e = make_table(CAP), make_stats()
+        t_r, s_r = make_table(CAP), make_stats()
+        total_ref = 0
+        # rotating keysets, 1 s apart: by t=3 s the t=0 flows are idle
+        # past the 2.5 s ttl and must sweep
+        for i in range(6):
+            keys = 1000 * (i % 3 + 1) + np.arange(64)
+            raw = schema.encode_raw(mkbuf(keys, t_s=float(i)), BATCH, 0)
+            t_e, s_e, out_e = step_e(t_e, s_e, params, raw)
+            ref, n_ref = self._ref_sweep(t_r, float(out_e.now), ttl)
+            total_ref += n_ref
+            t_r, s_r, out_r = step_0(ref, s_r, params, raw)
+            np.testing.assert_array_equal(np.asarray(t_e.key),
+                                          np.asarray(t_r.key))
+            np.testing.assert_array_equal(np.asarray(t_e.state),
+                                          np.asarray(t_r.state))
+            np.testing.assert_array_equal(np.asarray(out_e.verdict),
+                                          np.asarray(out_r.verdict))
+            for f in schema.GlobalStats._fields:
+                if f != "evicted":
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(s_e, f)),
+                        np.asarray(getattr(s_r, f)), err_msg=f)
+        assert total_ref > 0          # the scenario really evicted
+        assert stat_value(s_e.evicted) == total_ref
+
+    def test_full_cycle_sweeps_every_idle_row(self):
+        """The rolling window re-examines every row once per
+        ``evict_every`` batches: rows idle past the ttl are all freed
+        within ONE full cycle of going idle, and the counter accounts
+        for exactly them."""
+        cfg_e, step_e, _, params = self._steps(ttl=0.5, every=4)
+        t_e, s_e = make_table(CAP), make_stats()
+        # batch 0: 64 rows that will go idle
+        raw0 = schema.encode_raw(mkbuf(8000 + np.arange(64), t_s=0.0),
+                                 BATCH, 0)
+        t_e, s_e, _ = step_e(t_e, s_e, params, raw0)
+        old = set(8000 + np.arange(64))
+        n_tracked = int(np.sum(np.asarray(t_e.key) != 0))  # minus any
+        #                       batch-internal arbitration losses
+        # batches 1..4 at t=5.0..5.3: windows 1,2,3,0 — a full cycle —
+        # while the fresh keys themselves never sit idle
+        for i in range(1, 5):
+            keys = 5000 + 100 * i + np.arange(32)
+            raw = schema.encode_raw(mkbuf(keys, t_s=5.0 + 0.1 * i),
+                                    BATCH, 0)
+            t_e, s_e, _ = step_e(t_e, s_e, params, raw)
+        k = set(int(x) for x in np.asarray(t_e.key) if x)
+        assert not (k & old)                         # every idle row freed
+        assert stat_value(s_e.evicted) == n_tracked  # and only them
+
+    def test_blocked_rows_survive_until_expiry(self):
+        cfg_e = evict_cfg(ttl=1.0, every=1, pps_threshold=50.0,
+                          bps_threshold=1e18, block_s=10.0)
+        spec = get_model(cfg_e.model.name)
+        step = fused.make_jitted_raw_step(cfg_e, spec.classify_batch,
+                                          donate=False)
+        params = spec.init()
+        t, s = make_table(CAP), make_stats()
+        # one flood flow: 100 packets in one batch → rate-blocked 10 s
+        flood = np.zeros(100, schema.FLOW_RECORD_DTYPE)
+        flood["saddr"] = 0xBEEF
+        flood["pkt_len"] = 100
+        flood["ts_ns"] = np.arange(100) * 1000
+        t, s, _ = step(t, s, params,
+                       schema.encode_raw(flood, BATCH, 0))
+        assert (np.asarray(t.key) == 0xBEEF).any()
+        # 5 s later (idle > ttl but block still live): row must survive
+        t, s, _ = step(t, s, params, schema.encode_raw(
+            mkbuf([77], t_s=5.0), BATCH, 0))
+        assert (np.asarray(t.key) == 0xBEEF).any()
+        # 20 s later (block expired): the next epoch frees it
+        t, s, _ = step(t, s, params, schema.encode_raw(
+            mkbuf([78], t_s=20.0), BATCH, 0))
+        assert not (np.asarray(t.key) == 0xBEEF).any()
+
+    def test_sharded_epoch_step_equals_reference_sweep(self):
+        """The mesh half of the parity pin: the sharded eviction-epoch
+        step ≡ (reference numpy sweep over the sharded rows ∘ the
+        sweepless sharded step), byte-for-byte — the sweep is
+        shard-local and elementwise, so the same host reference applies
+        to the global row array unchanged."""
+        from flowsentryx_tpu.parallel import step as pstep
+
+        ttl = 2.5
+        mesh = make_mesh(8)
+        cfg_e = evict_cfg(ttl=ttl, every=1)
+        cfg_0 = dataclasses.replace(cfg_e, table=dataclasses.replace(
+            cfg_e.table, evict_ttl_s=0.0))
+        spec = get_model(cfg_e.model.name)
+        step_e = pstep.make_sharded_raw_step(cfg_e, spec.classify_batch,
+                                             mesh, donate=False)
+        step_0 = pstep.make_sharded_raw_step(cfg_0, spec.classify_batch,
+                                             mesh, donate=False)
+        params = spec.init()
+        t_e, s_e = pstep.make_sharded_table(cfg_e, mesh), make_stats()
+        t_r, s_r = pstep.make_sharded_table(cfg_0, mesh), make_stats()
+        total_ref = 0
+        for i in range(6):
+            keys = 1000 * (i % 3 + 1) + np.arange(64)
+            raw = schema.encode_raw(mkbuf(keys, t_s=float(i)), BATCH, 0)
+            t_e, s_e, out_e = step_e(t_e, s_e, params, raw)
+            ref, n_ref = self._ref_sweep(t_r, float(out_e.now), ttl)
+            total_ref += n_ref
+            from flowsentryx_tpu.parallel import layout
+
+            ref = layout.shard_table(ref, mesh)
+            t_r, s_r, out_r = step_0(ref, s_r, params, raw)
+            np.testing.assert_array_equal(np.asarray(t_e.key),
+                                          np.asarray(t_r.key))
+            np.testing.assert_array_equal(np.asarray(t_e.state),
+                                          np.asarray(t_r.state))
+            np.testing.assert_array_equal(np.asarray(out_e.verdict),
+                                          np.asarray(out_r.verdict))
+        assert total_ref > 0
+        assert stat_value(s_e.evicted) == total_ref
+
+    def test_warm_batch_is_a_noop(self):
+        cfg_e, step_e, _, params = self._steps(ttl=0.1, every=1)
+        t, s = make_table(CAP), make_stats()
+        raw = schema.encode_raw(mkbuf(2000 + np.arange(16), 1.0),
+                                BATCH, 0)
+        t, s, _ = step_e(t, s, params, raw)
+        k_before = np.asarray(t.key).copy()
+        # an all-masked (warm) batch carries now == 0: nothing may
+        # evict, nothing may count
+        warm = np.zeros((BATCH + 1, schema.RECORD_WORDS), np.uint32)
+        t, s, _ = step_e(t, s, params, warm)
+        np.testing.assert_array_equal(np.asarray(t.key), k_before)
+        assert stat_value(s.evicted) == 0
+
+
+def churn_records(phases=8, per_phase=BATCH, gap_s=1.0, base=10_000):
+    """Sustained flow churn: each phase is a fresh keyset, ``gap_s``
+    after the previous — the workload whose occupancy only eviction
+    can bound."""
+    bufs = [mkbuf(base * (i + 1) + np.arange(per_phase), t_s=i * gap_s)
+            for i in range(phases)]
+    return np.concatenate(bufs)
+
+
+class TestEngineEviction:
+    def test_single_vs_mesh_byte_parity_under_guard(self):
+        """Eviction-epoch engines: single-device ≡ 8-device mesh in
+        stats (evicted included), blacklist, and per-key table rows —
+        the whole loop under ``jax.transfer_guard("disallow")``."""
+        cfg = evict_cfg(ttl=2.5, every=2)
+        recs = churn_records(phases=6)
+        reps, sinks, tables = [], [], []
+        for mesh in (None, make_mesh(8)):
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         sink_thread=False, mesh=mesh)
+            with jax.transfer_guard("disallow"):
+                reps.append(eng.run())
+            sinks.append(sink)
+            tables.append(eng.table)
+        # verdict counters are layout-independent; ``evicted`` counts
+        # TABLE ROWS, which differ by a few batch-internal arbitration
+        # losses between the global and per-shard layouts — so it is
+        # compared for presence and closeness, not equality (the exact
+        # per-layout parity pin is the reference-sweep test above)
+        for f, v0 in reps[0].stats.items():
+            if f == "evicted":
+                assert v0 > 0 and reps[1].stats[f] > 0
+                assert abs(v0 - reps[1].stats[f]) <= 8
+            else:
+                assert v0 == reps[1].stats[f], f
+        assert sinks[0].blocked == sinks[1].blocked
+
+    def test_mega_auto_parity_with_eviction(self):
+        """The epoch rides the scan carry: singles ≡ ``--mega auto``
+        byte-identically with eviction active."""
+        cfg = evict_cfg(ttl=2.5, every=2)
+        recs = churn_records(phases=6)
+        stats, blocked = [], []
+        for mega in (0, "auto"):
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         sink_thread=False, mega_n=mega)
+            rep = eng.run()
+            stats.append(rep.stats)
+            blocked.append(sink.blocked)
+        assert stats[0]["evicted"] > 0
+        assert stats[0] == stats[1] and blocked[0] == blocked[1]
+
+    def test_occupancy_bounded_under_churn(self):
+        recs = churn_records(phases=8)
+        out = {}
+        for ttl in (0.0, 2.0):
+            cfg = evict_cfg(ttl=ttl, every=2)
+            eng = Engine(cfg, ArraySource(recs.copy()), CollectSink(),
+                         sink_thread=False)
+            rep = eng.run()
+            out[ttl] = rep
+        # churn fills the table (minus a few batch-internal
+        # arbitration losses — each key appears in exactly one batch)
+        assert out[0.0].table["tracked"] >= 7 * BATCH
+        # eviction bounds occupancy near the live (≤ ttl-recent) flows
+        assert out[2.0].table["tracked"] <= 4 * BATCH
+        assert out[2.0].stats["evicted"] > 0
+        # verdict counters untouched by the sweep
+        assert out[2.0].stats["allowed"] == out[0.0].stats["allowed"]
+
+
+class TestCheckpointV2:
+    def _run_engine(self, cfg, recs, mesh=None):
+        eng = Engine(cfg, ArraySource(recs), CollectSink(),
+                     sink_thread=False, mesh=mesh)
+        eng.run()
+        return eng
+
+    def test_header_and_atomic_write(self, tmp_path, monkeypatch):
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        cfg = evict_cfg(pps_threshold=50.0, bps_threshold=1e18)
+        cfg = dataclasses.replace(cfg, table=dataclasses.replace(
+            cfg.table, salt=0x77))
+        eng = self._run_engine(cfg, churn_records(phases=2))
+        path = eng.checkpoint(tmp_path / "s.npz")
+        hdr = ckpt.peek_header(path)
+        assert hdr == {"schema_version": 1, "hash_salt": 0x77,
+                       "n_shards": 1, "capacity": CAP}
+        good = open(path, "rb").read()
+
+        # a crash mid-snapshot must leave the previous snapshot intact
+        # (tmp + os.replace) and no temp litter behind
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(np, "savez_compressed", boom)
+        with pytest.raises(OSError):
+            eng.checkpoint(path)
+        monkeypatch.undo()
+        assert open(path, "rb").read() == good
+        assert [p for p in os.listdir(tmp_path) if "tmp" in p] == []
+
+    def test_mesh4_roundtrip_bit_identity_and_mesh8_reshard(
+            self, tmp_path):
+        """The satellite matrix: mesh=4 checkpoint → mesh=4 restore is
+        bit-identical; mesh=4 → mesh=8 reshards with every key and its
+        row intact, owner-correct, and the restored blacklist fires."""
+        cfg = evict_cfg(ttl=0.0, pps_threshold=50.0, bps_threshold=1e18,
+                        block_s=3600.0)
+        cfg = dataclasses.replace(cfg, table=dataclasses.replace(
+            cfg.table, salt=0xABC))
+        flood = np.zeros(BATCH * 8, schema.FLOW_RECORD_DTYPE)
+        flood["saddr"] = np.repeat(
+            np.arange(1, BATCH * 8 // 128 + 1, dtype=np.uint32) * 7919,
+            128)
+        flood["pkt_len"] = 100
+        flood["ts_ns"] = np.arange(BATCH * 8) * 1000
+        e1 = self._run_engine(cfg, flood.copy(), mesh=make_mesh(4))
+        assert len(e1._blocked) > 0
+        path = e1.checkpoint(tmp_path / "m4.npz")
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        assert ckpt.peek_header(path)["n_shards"] == 4
+
+        # mesh=4 → mesh=4: bit identity
+        e2 = Engine(cfg, ArraySource(flood.copy()), CollectSink(),
+                    sink_thread=False, mesh=make_mesh(4))
+        info = e2.restore(path)
+        assert not info["resharded"]
+        np.testing.assert_array_equal(np.asarray(e2.table.key),
+                                      np.asarray(e1.table.key))
+        np.testing.assert_array_equal(np.asarray(e2.table.state),
+                                      np.asarray(e1.table.state))
+        for a, b in zip(e2.stats, e1.stats):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # mesh=4 → mesh=8: resharded, nothing lost, owners correct
+        e3 = Engine(cfg, ArraySource(flood.copy()), CollectSink(),
+                    sink_thread=False, mesh=make_mesh(8))
+        info = e3.restore(path)
+        assert info["resharded"] and info["dropped_rows"] == 0
+        k1, s1 = np.asarray(e1.table.key), np.asarray(e1.table.state)
+        k3, s3 = np.asarray(e3.table.key), np.asarray(e3.table.state)
+        assert set(k3[k3 != 0]) == set(k1[k1 != 0])
+        ref = {int(k): s1[i].tobytes() for i, k in enumerate(k1) if k}
+        occ3 = np.flatnonzero(k3)
+        assert {int(k3[i]): s3[i].tobytes()
+                for i in occ3} == ref
+        np.testing.assert_array_equal(
+            occ3 // (CAP // 8), tbl.owner_of(k3[occ3], 0xABC, 8))
+        # condemned sources stay condemned across the mesh change
+        sink3 = CollectSink()
+        eng3 = Engine(cfg, ArraySource(flood.copy()), sink3,
+                      sink_thread=False, mesh=make_mesh(8))
+        eng3.restore(path)
+        rep3 = eng3.run()
+        assert rep3.stats["dropped_blacklist"] > 0
+
+    def test_missing_stats_counter_tolerated(self, tmp_path):
+        """A pre-eviction-era snapshot (no stats_evicted) restores with
+        the counter at zero, named in missing_stats."""
+        from flowsentryx_tpu.engine import checkpoint as ckpt
+
+        cfg = evict_cfg()
+        eng = self._run_engine(cfg, churn_records(phases=2))
+        path = eng.checkpoint(tmp_path / "old.npz")
+        with np.load(path) as z:
+            d = {k: z[k] for k in z.files if k != "stats_evicted"}
+        np.savez_compressed(path, **d)
+        ck = ckpt.load_checkpoint(path)
+        assert ck.missing_stats == ("evicted",)
+        assert (np.asarray(ck.stats.evicted) == 0).all()
+        eng2 = Engine(cfg, ArraySource(churn_records(phases=1)),
+                      CollectSink(), sink_thread=False)
+        eng2.restore(path)  # and the engine accepts it
+
+
+class TestHotSwap:
+    TRAINED = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts", "logreg_int8.npz")
+
+    @staticmethod
+    def _attack_recs(n):
+        from flowsentryx_tpu.engine.traffic import (
+            Scenario, TrafficGen, TrafficSpec,
+        )
+
+        return TrafficGen(TrafficSpec(
+            scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e6,
+            n_attack_ips=16, n_benign_ips=16, attack_fraction=0.9,
+            seed=5)).next_records(n)
+
+    def test_mid_drain_swap_with_verdict_continuity(self):
+        """Swap golden (benign predictor) → the trained detector after
+        8 reaped batches, mid-run: every record still serves, and the
+        post-swap model's ML verdicts appear — the live-reload
+        protocol, no drain, no recompile."""
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        cfg = evict_cfg(ttl=0.0, pps_threshold=1e9, bps_threshold=1e18)
+        recs = self._attack_recs(BATCH * 24)
+        trained = load_artifact("logreg_int8", self.TRAINED)
+
+        dropped_ml = {}
+        for swap in (False, True):
+            eng = Engine(cfg, ArraySource(recs.copy()), CollectSink(),
+                         sink_thread=False, wire="raw48")
+            if swap:
+                seen = [0]
+
+                def hook(n, t, eng=eng, seen=seen):
+                    seen[0] += 1
+                    if seen[0] == 8:
+                        eng.hot_swap(trained)
+
+                eng.on_reap = hook
+            rep = eng.run()
+            assert rep.records == len(recs)   # continuity: nothing lost
+            dropped_ml[swap] = rep.stats["dropped_ml"]
+            assert eng._hot_swaps == (1 if swap else 0)
+        # the swapped-in detector actually decided verdicts post-swap
+        assert dropped_ml[True] > dropped_ml[False]
+
+    def test_swap_refusals(self):
+        cfg = evict_cfg()
+        spec = get_model(cfg.model.name)
+        golden = spec.init()
+        eng = Engine(cfg, ArraySource(self._attack_recs(BATCH)),
+                     CollectSink(), sink_thread=False)  # compact16 wire
+        # shape drift → refuse
+        with pytest.raises(ValueError, match="shape/dtype"):
+            eng.hot_swap(golden._replace(
+                w_int8=np.zeros((4,), np.int8)))
+        # observer drift under the model-mode compact16 wire → refuse
+        with pytest.raises(ValueError, match="observer"):
+            eng.hot_swap(golden._replace(
+                in_scale=np.float32(np.asarray(golden.in_scale) * 2)))
+        # identical-observer swap is accepted
+        eng.hot_swap(golden)
+        assert eng._hot_swaps == 1
+
+    def test_watch_artifact_reloads_on_mtime_change(self, tmp_path):
+        """The --artifact-reload protocol: a changed artifact file is
+        hot-swapped by the serving loop itself, mid-run."""
+        from flowsentryx_tpu.models import logreg
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        cfg = evict_cfg(ttl=0.0, pps_threshold=1e9, bps_threshold=1e18)
+        spec = get_model(cfg.model.name)
+        path = str(tmp_path / "live.npz")
+        logreg.save_params(spec.init(), path)
+        trained = load_artifact("logreg_int8", self.TRAINED)
+
+        eng = Engine(cfg, ArraySource(self._attack_recs(BATCH * 24)),
+                     CollectSink(), sink_thread=False, wire="raw48")
+        eng.watch_artifact(path)
+        seen = [0]
+
+        def hook(n, t, eng=eng, seen=seen):
+            seen[0] += 1
+            if seen[0] == 6:
+                logreg.save_params(trained, path)
+                eng._watch_next = 0.0  # skip the 0.5 s throttle
+        eng.on_reap = hook
+        rep = eng.run()
+        assert eng._hot_swaps == 1
+        assert rep.stats["dropped_ml"] > 0  # the reloaded model served
+
+    def test_watch_survives_bad_artifact(self, tmp_path):
+        """A half-written/wrong-family push must not kill the data
+        plane: announced, skipped, serving continues."""
+        cfg = evict_cfg(ttl=0.0, pps_threshold=1e9, bps_threshold=1e18)
+        path = str(tmp_path / "live.npz")
+        from flowsentryx_tpu.models import logreg
+
+        logreg.save_params(get_model(cfg.model.name).init(), path)
+        eng = Engine(cfg, ArraySource(self._attack_recs(BATCH * 8)),
+                     CollectSink(), sink_thread=False, wire="raw48")
+        eng.watch_artifact(path)
+        seen = [0]
+
+        # a TRUNCATED zip is the non-atomic-deploy mid-write case
+        # (np.load raises zipfile.BadZipFile, not ValueError)
+        good = open(path, "rb").read()
+
+        def hook(n, t, eng=eng, seen=seen):
+            seen[0] += 1
+            if seen[0] == 3:
+                with open(path, "wb") as f:
+                    f.write(good[: len(good) // 2])
+                eng._watch_next = 0.0
+            elif seen[0] == 5:
+                with open(path, "wb") as f:
+                    f.write(b"not an npz")
+                eng._watch_next = 0.0
+        eng.on_reap = hook
+        rep = eng.run()
+        assert rep.records == BATCH * 8
+        assert eng._hot_swaps == 0
+
+
+class TestServeCLI:
+    def _run(self, argv, capsys):
+        from flowsentryx_tpu.cli import main
+
+        rc = main(argv)
+        return rc, capsys.readouterr()
+
+    def test_table_capacity_refusals_pre_boot(self, capsys):
+        base = ["serve", "--scenario", "benign", "--packets", "64"]
+        rc, cap = self._run(base + ["--table-capacity", "3000"], capsys)
+        assert rc == 1 and "power of two" in cap.err
+        rc, cap = self._run(base + ["--table-capacity", "1024"], capsys)
+        assert rc == 1 and "max_batch" in cap.err
+        rc, cap = self._run(
+            base + ["--table-capacity", "4096", "--mesh", "8192"],
+            capsys)
+        assert rc == 1 and "shards" in cap.err
+
+    def test_table_capacity_accepted_and_checkpointed(self, tmp_path,
+                                                      capsys):
+        from flowsentryx_tpu.engine.checkpoint import peek_header
+
+        path = str(tmp_path / "cap.npz")
+        rc, cap = self._run(
+            ["serve", "--scenario", "benign", "--packets", "512",
+             "--table-capacity", "4096", "--checkpoint", path], capsys)
+        assert rc == 0
+        assert peek_header(path)["capacity"] == 4096
+
+    def test_restore_salt_conflict_refused_pre_boot(self, tmp_path,
+                                                    capsys):
+        cfg = evict_cfg()
+        cfg = dataclasses.replace(cfg, table=dataclasses.replace(
+            cfg.table, salt=0x1111, capacity=4096))
+        eng = Engine(cfg, ArraySource(churn_records(phases=1)),
+                     CollectSink(), sink_thread=False)
+        eng.run()
+        path = str(tmp_path / "salted.npz")
+        eng.checkpoint(path)
+        cfg_file = tmp_path / "cfg.json"
+        cfg2 = dataclasses.replace(cfg, table=dataclasses.replace(
+            cfg.table, salt=0x2222))
+        cfg_file.write_text(cfg2.to_json())
+        rc, cap = self._run(
+            ["serve", "--scenario", "benign", "--packets", "64",
+             "--config", str(cfg_file), "--restore", path], capsys)
+        assert rc == 1 and "salt" in cap.err and "refusing" in cap.err
+
+    def test_artifact_reload_requires_artifact(self, capsys):
+        rc, cap = self._run(
+            ["serve", "--scenario", "benign", "--packets", "64",
+             "--artifact-reload"], capsys)
+        assert rc == 1 and "--artifact" in cap.err
+
+    def test_adopted_checkpoint_capacity_still_validates(self, tmp_path,
+                                                         capsys):
+        """A restore that ADOPTS the checkpoint's capacity (no
+        --table-capacity asked) must hold it to the same pre-boot
+        validation: a snapshot from a smaller-batch era cannot boot a
+        table smaller than one serving batch."""
+        cfg = evict_cfg(cap=1024, batch=256)  # valid at batch 256...
+        eng = Engine(cfg, ArraySource(churn_records(phases=1)),
+                     CollectSink(), sink_thread=False)
+        eng.run()
+        path = str(tmp_path / "small.npz")
+        eng.checkpoint(path)
+        # ...but the default serve config runs max_batch 2048
+        rc, cap = self._run(
+            ["serve", "--scenario", "benign", "--packets", "64",
+             "--restore", path], capsys)
+        assert rc == 1 and "max_batch" in cap.err
+        assert "--table-capacity" in cap.err  # the remedy is named
+
+    def test_unreadable_restore_refused_pre_boot(self, tmp_path,
+                                                 capsys):
+        bad = tmp_path / "junk.npz"
+        bad.write_bytes(b"garbage")
+        rc, cap = self._run(
+            ["serve", "--scenario", "benign", "--packets", "64",
+             "--restore", str(bad)], capsys)
+        assert rc == 1 and "cannot read checkpoint" in cap.err
