@@ -26,6 +26,11 @@ Contract catalog (docs/AUDIT.md has the operator view):
 * :func:`check_donation` — ``donate_argnums`` buffers actually appear
   in the executable's ``input_output_alias`` map (a dropped donation is
   a silent HBM copy of the 1M-row table per batch).
+* :func:`check_inplace` — the in-place/copy census: the donated table
+  incurs zero ``copy``/``convert`` HLO ops and never rides a
+  ``lax.cond`` or dynamic-offset ``dynamic_update_slice`` — the two
+  measured XLA:CPU cliffs (PR 8) pinned as graph facts instead of
+  bench-only findings.
 * :func:`staging_cache_check` — staging twice under identical
   host-side construction hits the jit tracing cache (weak_type /
   dtype / static-arg drift means the serving loop recompiles forever).
@@ -296,6 +301,14 @@ _SHAPE_TOKEN = re.compile(
     r"c64|c128)\[[^\]]*\]")
 
 
+def _entry_param_tokens(hlo_text: str) -> list[str]:
+    """Shape tokens of the entry parameters, in declaration order, off
+    the ``entry_computation_layout`` header ([] when absent)."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text,
+                  re.DOTALL)
+    return _SHAPE_TOKEN.findall(m.group(1)) if m else []
+
+
 def parse_alias_map(hlo_text: str) -> tuple[set[int], int]:
     """Parse the compiled module header: returns (aliased parameter
     numbers from ``input_output_alias``, total entry parameter count
@@ -317,12 +330,7 @@ def parse_alias_map(hlo_text: str) -> tuple[set[int], int]:
             k += 1
         body = hlo_text[start:k + 1]
         aliased = {int(m.group(1)) for m in _ALIAS_RE.finditer(body)}
-    n_params = 0
-    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text,
-                  re.DOTALL)
-    if m:
-        n_params = len(_SHAPE_TOKEN.findall(m.group(1)))
-    return aliased, n_params
+    return aliased, len(_entry_param_tokens(hlo_text))
 
 
 def check_donation(hlo_text: str, donated_names: list[str],
@@ -363,6 +371,164 @@ def check_donation(hlo_text: str, donated_names: list[str],
             ))
     return findings, {"aliased_params": sorted(aliased),
                       "n_params": n_params or n_inputs}
+
+
+# -- contract 6: in-place / copy census -------------------------------------
+
+#: numpy dtype name -> HLO shape-token prefix (the subset the serving
+#: plane can produce; anything else simply won't match a table leaf).
+_HLO_DTYPE = {
+    "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "int8": "s8", "int16": "s16", "int32": "s32", "int64": "s64",
+    "float16": "f16", "bfloat16": "bf16", "float32": "f32",
+    "bool": "pred",
+}
+
+
+def _is_literal(var: Any) -> bool:
+    # test the POSITIVE property (Literal carries .val) so a jax
+    # upgrade reshaping Var internals fails closed, not open
+    return hasattr(var, "val")
+
+
+def check_inplace(closed_jaxpr: Any, hlo_text: str | None,
+                  table_avals: list[Any],
+                  table_names: list[str],
+                  n_shards: int = 1) -> tuple[list[Finding], dict]:
+    """The donated table must stay on XLA's in-place path end to end.
+
+    Two measured cliffs (PR 8) defeat it, each ~2 orders of magnitude
+    at production capacity, and both are *graph facts* this contract
+    pins statically instead of leaving to the bench:
+
+    * a ``lax.cond`` carrying the table copies operands and results
+      through the ``conditional`` every batch, even when the branch
+      never fires;
+    * a dynamic-offset ``dynamic_slice``/``dynamic_update_slice``
+      touching the table defeats in-place buffer reuse for the whole
+      donated chain (a CONSTANT-offset window is fine, and so are the
+      single-index scatters XLA itself fuses into DUS — the checked
+      property is table-shaped jaxpr-level DUS with computed starts,
+      which the fast gather + victim-only-scatter form never emits).
+
+    The jaxpr half catches both at their source equation (matching
+    the global table shapes AND, given ``n_shards``, the per-shard
+    shapes staged inside ``shard_map`` bodies); the HLO half is the
+    executable-level census — zero ``copy``/``convert`` ops producing
+    a table-shaped buffer, and no ``conditional`` whose operands carry
+    one (shapes are read per-executable, so sharded variants census
+    their local shard shapes)."""
+    findings: list[Finding] = []
+    sigs: dict[tuple, str] = {}
+    for a, n in zip(table_avals, table_names):
+        shp = tuple(int(d) for d in a.shape)
+        sigs[(shp, str(a.dtype))] = n
+        # shard_map bodies stage SHARD-LOCAL avals (the layout shards
+        # table.* along the leading ip axis), so the per-shard shape
+        # must be a table signature too — otherwise the production
+        # scan-over-shard_map variants are blind to both cliffs at
+        # the jaxpr level
+        if n_shards > 1 and shp and shp[0] % n_shards == 0:
+            local = (shp[0] // n_shards,) + shp[1:]
+            sigs.setdefault((local, str(a.dtype)), n)
+
+    def sig_of(aval: Any) -> str | None:
+        if aval is None or not hasattr(aval, "dtype"):
+            return None
+        return sigs.get((tuple(int(d) for d in getattr(aval, "shape",
+                                                       ()) or ()),
+                         str(aval.dtype)))
+
+    for where, eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name == "cond":
+            carried = sorted({
+                s for v in list(eqn.invars) + list(eqn.outvars)
+                if (s := sig_of(getattr(v, "aval", None))) is not None})
+            if carried:
+                findings.append(Finding(
+                    contract="inplace", where=where, eqn=_eqn_txt(eqn),
+                    reason=(f"lax.cond carries the donated table "
+                            f"({', '.join(carried)}) — XLA:CPU copies "
+                            "conditional operands and results every "
+                            "batch even when the branch never fires "
+                            "(the PR 8 in-place cliff); hoist the "
+                            "table out of the cond or rewrite as a "
+                            "lax.select/where on the rows"),
+                ))
+        elif name in ("dynamic_slice", "dynamic_update_slice"):
+            operand = sig_of(getattr(eqn.invars[0], "aval", None))
+            idx_start = 2 if name == "dynamic_update_slice" else 1
+            dynamic = any(not _is_literal(v)
+                          for v in eqn.invars[idx_start:])
+            if operand is not None and dynamic:
+                findings.append(Finding(
+                    contract="inplace", where=where, eqn=_eqn_txt(eqn),
+                    reason=(f"dynamic-offset {name} on the donated "
+                            f"table ({operand}) — a computed start "
+                            "index defeats XLA:CPU in-place reuse for "
+                            "the whole donated chain (the PR 8 DUS "
+                            "cliff); use gather reads + victim-only "
+                            "scatter writes (the eviction sweep's "
+                            "proven form)"),
+                ))
+
+    census = {"checked": hlo_text is not None,
+              "copies": 0, "converts": 0, "conditionals": 0}
+    if hlo_text is not None:
+        # executable-local table types come off the entry layout — the
+        # leading parameters are the donated leaves, so sharded
+        # variants census their per-device shard shapes automatically;
+        # the no-header fallback covers both signature sets (a global
+        # token would never match a shard-local executable's text)
+        tokens = _entry_param_tokens(hlo_text)[:len(table_avals)] or [
+            f"{_HLO_DTYPE.get(dt, dt)}[{','.join(map(str, shp))}]"
+            for (shp, dt) in sigs]
+        toks = sorted({t.split("{")[0] for t in tokens})
+        pat = "|".join(re.escape(t) for t in toks)
+        census["table_types"] = toks
+        for op, key in (("copy", "copies"), ("convert", "converts")):
+            n = len(re.findall(
+                rf"= ({pat})\{{[^}}]*\}} {op}\(", hlo_text))
+            census[key] = n
+            if n:
+                findings.append(Finding(
+                    contract="inplace",
+                    reason=(f"{n} {op} op(s) producing a table-shaped "
+                            f"buffer ({', '.join(toks)}) in the "
+                            "compiled executable — the donated table "
+                            "must flow copy-free through every step "
+                            "variant (each one is a full-table "
+                            "materialization per batch)"),
+                ))
+        # operand lists nest parens (tuple-typed operands), so walk to
+        # the balanced close of each call — a single [^)]* scan would
+        # stop at the first inner ')' and miss a table operand sitting
+        # after an earlier tuple operand
+        pat_re = re.compile(pat)
+        n_cond = 0
+        for mc in re.finditer(r"conditional\(", hlo_text):
+            depth, k = 1, mc.end()
+            while k < len(hlo_text) and depth:
+                c = hlo_text[k]
+                if c == "(":
+                    depth += 1
+                elif c == ")":
+                    depth -= 1
+                k += 1
+            if pat_re.search(hlo_text, mc.end(), k):
+                n_cond += 1
+        census["conditionals"] = n_cond
+        if n_cond:
+            findings.append(Finding(
+                contract="inplace",
+                reason=(f"{n_cond} conditional op(s) carry a "
+                        f"table-shaped operand ({', '.join(toks)}) in "
+                        "the compiled executable — XLA:CPU copies "
+                        "conditional operands/results every batch "
+                        "(the PR 8 cond cliff)"),
+            ))
+    return findings, census
 
 
 # -- contract 4: retrace sentinel -------------------------------------------

@@ -67,6 +67,7 @@ class VariantReport:
     donation: dict                 # aliased params / required leaves
     collectives: dict              # collective primitive -> count
     dtypes: dict                   # dtype -> eqn-output count
+    inplace: dict                  # table copy/convert/conditional census
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
@@ -136,6 +137,7 @@ def _audit_one(
     quantized: bool,
     n_param_leaves: int,
     ring_depth: int = 0,
+    n_shards: int = 1,
 ) -> VariantReport:
     """Stage one variant and run every contract on it."""
     findings: list[Finding] = []
@@ -224,6 +226,7 @@ def _audit_one(
     # contract 2: donation (needs the compiled executable's alias map)
     donation: dict = {"checked": donate_leaves > 0,
                       "required": CARRY_NAMES[:donate_leaves]}
+    hlo = None
     if donate_leaves:
         hlo = lowered.compile().as_text()
         f, info = graph.check_donation(
@@ -233,12 +236,22 @@ def _audit_one(
         findings += f
         donation.update(info)
 
+    # contract 6: in-place/copy census on the donated table (the two
+    # table leaves are always the leading inputs); the jaxpr half
+    # (cond / dynamic-offset DUS) runs even when donation is off and
+    # matches shard-local avals inside shard_map bodies, the HLO half
+    # censuses the same executable the donation check read
+    f, inplace = graph.check_inplace(
+        closed, hlo, list(closed.in_avals)[:2], CARRY_NAMES[:2],
+        n_shards=n_shards)
+    findings += f
+
     n_eqns = sum(1 for _ in graph.iter_eqns(closed))
     return VariantReport(
         name=name, ok=not findings, findings=findings, outputs=outputs,
         n_eqns=n_eqns, steady_state_d2h_bytes=wire_bytes,
         wire_words=wire_words, donation=donation, collectives=coll,
-        dtypes=dtypes,
+        dtypes=dtypes, inplace=inplace,
     )
 
 
@@ -417,7 +430,8 @@ def run_audit(
                     donate_leaves=((2 if is_sh else len(CARRY_NAMES))
                                    if donate else 0),
                     quantized=cfg.model.quantized,
-                    n_param_leaves=n_param_leaves))
+                    n_param_leaves=n_param_leaves,
+                    n_shards=(int(mesh.devices.size) if is_sh else 1)))
             continue
         elif name in ("device_loop", "sharded_device_loop"):
             # the drain-ring deep scan: ring slots of top-rung groups,
@@ -449,7 +463,8 @@ def run_audit(
                                if donate else 0),
                 quantized=cfg.model.quantized,
                 n_param_leaves=n_param_leaves,
-                ring_depth=device_loop))
+                ring_depth=device_loop,
+                n_shards=(int(mesh.devices.size) if is_sh else 1)))
             continue
         else:
             raise ValueError(f"unknown audit variant {name!r}")
@@ -457,7 +472,8 @@ def run_audit(
             name, jitted, mk, verdict_k=cfg.batch.verdict_k,
             expect_sharded=sharded, donate_leaves=donate_leaves,
             quantized=cfg.model.quantized,
-            n_param_leaves=n_param_leaves))
+            n_param_leaves=n_param_leaves,
+            n_shards=(int(mesh.devices.size) if sharded else 1)))
 
     return AuditReport(
         ok=all(v.ok for v in reports),

@@ -352,6 +352,87 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     return 0 if rep.ok else 1
 
 
+def _cmd_sync(args: argparse.Namespace) -> int:
+    """Static verification of the HOST concurrency plane — the third
+    leg of the static suite (``fsx check`` proves the BPF layer,
+    ``fsx audit`` the device graphs; docs/CONCURRENCY.md).
+
+    Two halves, one diagnostic idiom:
+
+    * the thread-contract lint (sync/contracts.py): every registered
+      shared field's access discipline re-proved over the real source
+      by AST walk — plus the unregistered-shared-state, SPSC-cursor
+      and ctl-block single-writer detectors;
+    * the bounded interleaving model checker (sync/interleave.py):
+      exhaustive cooperative schedules over the REAL protocol objects
+      (SinkChannel, SealedBatchQueue, DispatchArena), including the
+      arena reuse-bound tightness proof — all interleavings pass at
+      ``ring_safe_slots`` and a concrete staged-copy-overwrite
+      schedule is printed one slot below it.
+
+    Both are jax-free; ``--quick`` runs the contract lint only (the
+    ``sync_contracts`` lint-gate stage), full mode adds the model
+    checker (a few seconds).
+    """
+    from flowsentryx_tpu.sync.contracts import run_contracts
+
+    crep = run_contracts(quick=args.quick)
+    out: dict = {"ok": crep.ok, "contracts": crep.to_json(),
+                 "interleave": None}
+    if not args.json:
+        st = crep.stats
+        print(f"fsx sync: contracts: "
+              f"{'OK' if crep.ok else 'FAILED'} "
+              f"({st['classes']} classes, {st['registered_fields']} "
+              f"fields, {st['cursor_classes']} cursor protocols, "
+              f"{st['ctl_sites']} ctl sites)")
+        for f in crep.findings:
+            print(f"  {f}", file=sys.stderr)
+
+    if not args.quick:
+        from flowsentryx_tpu.sync.interleave import run_interleave
+
+        irep = run_interleave()
+        out["interleave"] = irep.to_json()
+        out["ok"] = out["ok"] and irep.ok
+        if not args.json:
+            for c in irep.checks:
+                tag = ("counterexample found" if c.expect_violation
+                       else f"{c.interleavings} interleavings pass")
+                status = "OK" if c.ok else "FAILED"
+                print(f"fsx sync: model {c.check}: {status} ({tag}, "
+                      f"{c.steps} steps"
+                      + (", CAPPED" if c.capped else "") + ")")
+                if not c.ok:
+                    detail = (c.counterexample or
+                              "expected counterexample not found")
+                    print(f"  {detail}", file=sys.stderr)
+            b = irep.bound
+            if b["counterexample_found"] and b["safe_ok"]:
+                cx = next(c.counterexample for c in irep.checks
+                          if c.expect_violation
+                          and c.check.startswith("arena"))
+                print(f"fsx sync: arena bound TIGHT: depth+ring+1 = "
+                      f"{b['safe_slots']} slots pass all "
+                      f"{b['interleavings_at_safe']} interleavings; "
+                      f"{b['counterexample_at']} slots fail:")
+                print("  " + str(cx).replace("\n", "\n  "))
+
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(out, indent=2) + "\n")
+        if not args.json:
+            print(f"fsx sync: report -> {p}")
+    if args.json:
+        print(json.dumps(out, indent=2))
+    elif out["ok"]:
+        print("fsx sync: PASS")
+    else:
+        print("fsx sync: FAIL", file=sys.stderr)
+    return 0 if out["ok"] else 1
+
+
 def _cmd_distill(args: argparse.Namespace) -> int:
     """Compile a trained int8 artifact into the kernel tier.
 
@@ -725,6 +806,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "(the sharded drain fronts the daemon's shm rings)",
               file=sys.stderr)
         return 1
+    if args.strict_ingest and not args.ingest_workers:
+        print("fsx serve: --strict-ingest requires --ingest-workers N "
+              "(>= 1): the crash posture governs the sharded drain "
+              "fleet — there is no ingest worker to die on the inline "
+              "path", file=sys.stderr)
+        return 1
     if args.verdict_k is not None and args.verdict_k < 0:
         print("fsx serve: --verdict-k must be >= 0 (0 disables the "
               "compact verdict wire)", file=sys.stderr)
@@ -859,7 +946,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # an unsharded daemon) and hand the engine sealed batches.
             from flowsentryx_tpu.ingest import ShardedIngest
 
-            source = ShardedIngest(args.feature_ring, args.ingest_workers)
+            source = ShardedIngest(args.feature_ring, args.ingest_workers,
+                                   strict=args.strict_ingest)
         else:
             source = ShmRingSource(args.feature_ring)
         sink = (
@@ -1572,6 +1660,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "artifacts/AUDIT_*.json evidence file)")
     au.set_defaults(fn=_cmd_audit)
 
+    sy = sub.add_parser(
+        "sync",
+        help="statically verify the host concurrency plane: thread "
+             "contracts over the real source + bounded-interleaving "
+             "model checks of the real protocol objects (jax-free)")
+    sy.add_argument("--quick", action="store_true",
+                    help="thread-contract lint only (milliseconds; "
+                         "what the sync_contracts lint stage runs) — "
+                         "skip the interleaving model checker")
+    sy.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    sy.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/SYNC_*.json evidence file)")
+    sy.set_defaults(fn=_cmd_sync)
+
     # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
     # construction never imports the bpf loader (lazy-import rule).
     DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
@@ -1670,6 +1774,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "unsharded daemon; 0 = the inline single-"
                         "threaded drain, bit-identical to pre-ingest "
                         "engines)")
+    s.add_argument("--strict-ingest", action="store_true",
+                   help="surface an ingest-worker crash as the same "
+                        "loud RuntimeError the engine's sink/pipeline "
+                        "workers die with (after the corpse's queue "
+                        "drains), instead of the default per-shard "
+                        "fail-open posture")
     s.add_argument("--records",
                    help="replay a raw fsx_flow_record file (fsx pcap output)")
     s.add_argument("--scenario", default="syn_benign_mix",

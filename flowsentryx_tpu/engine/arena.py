@@ -24,7 +24,10 @@ round-robin; the safety rule mirrors ``MicroBatcher.n_buffers``:
     readback_depth + 2``, because the engine claims a fresh slot only
     after dispatching everything staged in the current one, and
     ``_reap`` keeps at most ``readback_depth`` dispatched-but-unsunk
-    batches (each occupying >= 1 slot) at any time.
+    batches (each occupying >= 1 slot) at any time.  The ring-aware
+    generalization is :meth:`DispatchArena.ring_safe_slots`; the full
+    derivation is docs/CONCURRENCY.md §arena, and ``fsx sync`` proves
+    the bound TIGHT by exhaustive interleaving of this class.
 
 This also covers the CPU backend, where ``device_put`` of an aligned
 buffer may alias rather than copy: rows stay immutable for the whole
@@ -84,26 +87,17 @@ class DispatchArena:
         flight — the generalization of the single-buffer
         ``readback_depth + 2`` rule (which is the ``ring = 1`` case).
 
-        The proof mirrors the module docstring's, with one new term.
-        A slot is recycled only at :meth:`claim` time, and the engine
-        claims only after ``_reap`` has bounded dispatched-but-unsunk
-        batches by ``readback_depth``.  At that instant the slots that
-        must stay immutable are:
-
-        * **sunk-pending slots** — every unsunk batch pins at most one
-          slot (a single in its own slot is the worst case; a C-chunk
-          group shares one slot, a ring round pins ``ring`` slots for
-          ``ring * chunks`` batches — 1/chunks per batch), so at most
-          ``readback_depth`` slots;
-        * **uploaded-but-unlaunched slots** — ring mode ``device_put``s
-          each slot slice the moment it fills (the double-buffered H2D
-          half) and launches only when ``ring`` are ready, so up to
-          ``ring - 1`` uploaded slices plus the slot being filled: on
-          CPU the transfer may ALIAS the arena rows, so these pin too;
-        * the claim itself: ``+1``.
-
-        Hence ``slots = readback_depth + ring + 1``; ``ring = 1``
-        (one in-flight device buffer) recovers ``readback_depth + 2``.
+        In one line: at any claim, at most ``readback_depth``
+        sunk-pending slots (trickle singles, one slot each, worst
+        case) plus up to ``ring`` slots of the just-submitted round
+        whose uploaded ALIASES the worker has not consumed, plus the
+        overlapped claim itself must coexist — hence
+        ``readback_depth + ring + 1``.  The full derivation lives in
+        docs/CONCURRENCY.md §arena, and the bound is not argued but
+        MACHINE-CHECKED: ``fsx sync`` (sync/interleave.py) drives this
+        class over exhaustive thread interleavings, passing every
+        schedule at this bound and printing a staged-copy-overwrite
+        counterexample one slot below it.
         """
         if ring < 1:
             raise ValueError(f"ring must be >= 1, got {ring}")

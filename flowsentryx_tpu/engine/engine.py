@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Any, NamedTuple
 
 import jax
@@ -67,6 +66,8 @@ from flowsentryx_tpu.engine.writeback import (
 )
 from flowsentryx_tpu.models import get_model
 from flowsentryx_tpu.ops import fused, pallas_kernels
+from flowsentryx_tpu.sync import tuning
+from flowsentryx_tpu.sync.channel import SinkChannel
 
 
 #: ``Engine(mega_n="auto")`` / ``fsx serve --mega auto``: the largest
@@ -566,22 +567,19 @@ class Engine:
         # mode needs this: a threaded sink's host cost doesn't block
         # dispatch, and its worker coalesces naturally when behind.
         self._last_sink_t = 0.0
-        self._min_sink_gap_s = min(0.3e-3, cfg.batch.deadline_us * 1e-6 / 2)
+        self._min_sink_gap_s = min(tuning.MIN_SINK_GAP_S,
+                                   cfg.batch.deadline_us * 1e-6 / 2)
         # -- sink-thread machinery (module docstring) -------------------
-        # The handoff deque + condition variable are the ONLY shared
-        # state between the dispatch and sink threads; _sink_pending
-        # counts dispatched-but-unsunk BATCHES (chunks, not entries — a
-        # mega entry is mega_n batches) and is what backpressure waits
-        # on.  A sink-thread exception lands in _sink_exc and fails the
-        # next dispatch-thread _reap loudly.
-        self._sink_cv = threading.Condition()
-        self._sinkq: deque = deque()
-        self._sink_pending = 0
-        self._sink_stop = False
-        self._sink_exc: BaseException | None = None
+        # The SinkChannel (sync/channel.py) is the ONLY shared state
+        # between the dispatch and sink/pipeline threads: the handoff
+        # queue, the dispatched-but-unsunk BATCH count backpressure
+        # waits on (chunks, not entries — a mega entry is mega_n
+        # batches), the stop flag, and the crash slot a worker death
+        # lands in atomically with its accounting.  _check_sink
+        # surfaces that crash loudly on the next dispatch-thread reap.
+        self._chan = SinkChannel("sink thread")
         self._sink_active = False
         self._sink_thread_obj: threading.Thread | None = None
-        self._sink_busy_s = 0.0
         # Device-loop mode replaces the post-launch sink thread with
         # the device-PIPELINE worker: the queue carries pre-launch
         # submissions (the jit call itself runs on the worker), so the
@@ -836,27 +834,20 @@ class Engine:
         """Batches dispatched but not yet sunk (staging + sink queue +
         in-sink) — the 'pipe is busy' predicate the deadline-flush and
         idle-sleep decisions key on."""
-        return sum(g.n_chunks for g in self._inflight) + self._sink_pending
+        return sum(g.n_chunks for g in self._inflight) + self._chan.pending
 
     def _check_sink(self) -> None:
-        """Propagate a sink-thread crash into the dispatch thread —
-        the engine must fail LOUDLY, not serve on with verdicts
-        silently discarded."""
-        if self._sink_exc is not None:
-            exc = self._sink_exc
-            raise RuntimeError(
-                f"engine sink thread crashed: {type(exc).__name__}: {exc}"
-            ) from exc
+        """Propagate a worker crash into the dispatch thread — the
+        engine must fail LOUDLY, not serve on with verdicts silently
+        discarded (SinkChannel.check is THE unified worker-death
+        path; strict-mode ingest death raises the same way)."""
+        self._chan.check()
 
     def _handoff(self) -> None:
         """Move staged in-flight entries to the sink thread's queue."""
         if not self._inflight:
             return
-        with self._sink_cv:
-            for g in self._inflight:
-                self._sinkq.append(g)
-                self._sink_pending += g.n_chunks
-            self._sink_cv.notify_all()
+        self._chan.submit_many(self._inflight, lambda g: g.n_chunks)
         self._inflight.clear()
 
     def _reap(self, down_to: int) -> None:
@@ -873,9 +864,7 @@ class Engine:
         here, blocking on device completion."""
         if self._sink_active:
             self._handoff()
-            with self._sink_cv:
-                while self._sink_pending > down_to and self._sink_exc is None:
-                    self._sink_cv.wait(0.05)
+            self._chan.wait_below(down_to)
             self._check_sink()
             return
         total = sum(g.n_chunks for g in self._inflight)
@@ -936,9 +925,9 @@ class Engine:
             target, name = self._sink_worker, "fsx-sink"
         else:
             return
-        self._sink_stop = False
-        self._sink_exc = None
-        self._sink_busy_s = 0.0
+        self._chan.name = ("device-pipeline worker" if self.ring
+                           else "sink thread")
+        self._chan.reset()
         self._sink_thread_obj = threading.Thread(
             target=target, name=name, daemon=True)
         self._sink_active = True
@@ -952,9 +941,7 @@ class Engine:
         caller re-checks ``_check_sink`` after."""
         if not self._sink_active:
             return
-        with self._sink_cv:
-            self._sink_stop = True
-            self._sink_cv.notify_all()
+        self._chan.request_stop()
         self._sink_thread_obj.join()
         self._sink_thread_obj = None
         self._sink_active = False
@@ -967,14 +954,10 @@ class Engine:
         by a single worker preserves record order for ``on_reap``."""
         try:
             while True:
-                with self._sink_cv:
-                    while not self._sinkq and not self._sink_stop:
-                        self._sink_cv.wait(0.1)
-                    if not self._sinkq:
-                        return  # stop requested and queue drained
-                    group = [self._sinkq.popleft()]
-                    while self._sinkq and self._out_ready(self._sinkq[0].out):
-                        group.append(self._sinkq.popleft())
+                group = self._chan.pop(
+                    coalesce=lambda e: self._out_ready(e.out))
+                if group is None:
+                    return  # stop requested and queue drained
                 t0 = time.perf_counter()
                 exc: BaseException | None = None
                 try:
@@ -982,34 +965,26 @@ class Engine:
                 except BaseException as e:  # noqa: BLE001
                     exc = e
                 # exception recorded ATOMICALLY with the pending
-                # decrement: a backpressure waiter woken by this
-                # notify must never observe (pending drained, exc
-                # unset) for a group that actually crashed.
-                with self._sink_cv:
-                    self._sink_busy_s += time.perf_counter() - t0
-                    self._sink_pending -= sum(g.n_chunks for g in group)
-                    if exc is not None:
-                        self._sink_exc = exc
-                    self._sink_cv.notify_all()
+                # decrement (SinkChannel.complete): a backpressure
+                # waiter woken by this notify must never observe
+                # (pending drained, exc unset) for a group that
+                # actually crashed.
+                self._chan.complete(sum(g.n_chunks for g in group),
+                                    time.perf_counter() - t0, exc)
                 if exc is not None:
                     return
         except BaseException as e:  # noqa: BLE001 — surfaced by _check_sink
-            with self._sink_cv:
-                self._sink_exc = e
-                self._sink_cv.notify_all()
+            self._chan.record_exc(e)
 
     def _submit(self, kind: str, payload: Any, t_enqueue: float,
                 n_records: int, n_chunks: int) -> None:
         """Hand one pre-launch work item to the device-pipeline worker
-        (device-loop mode).  ``_sink_pending`` rises at SUBMIT time, so
-        the ``readback_depth`` backpressure bound covers queued-but-
-        unlaunched work too — the wire/arena reuse-safety arguments
-        both lean on that."""
-        with self._sink_cv:
-            self._sinkq.append((kind, payload, t_enqueue, n_records,
-                                n_chunks))
-            self._sink_pending += n_chunks
-            self._sink_cv.notify_all()
+        (device-loop mode).  The channel's pending count rises at
+        SUBMIT time, so the ``readback_depth`` backpressure bound
+        covers queued-but-unlaunched work too — the wire/arena
+        reuse-safety arguments both lean on that."""
+        self._chan.submit((kind, payload, t_enqueue, n_records, n_chunks),
+                          n_chunks)
 
     def _ring_worker(self) -> None:
         """Device-pipeline worker main (device-loop mode): pop the
@@ -1024,13 +999,10 @@ class Engine:
         double-buffered H2D overlap the report measures."""
         try:
             while True:
-                with self._sink_cv:
-                    while not self._sinkq and not self._sink_stop:
-                        self._sink_cv.wait(0.1)
-                    if not self._sinkq:
-                        return  # stop requested and queue drained
-                    kind, payload, t_e, n_rec, n_chunks = \
-                        self._sinkq.popleft()
+                got = self._chan.pop()
+                if got is None:
+                    return  # stop requested and queue drained
+                kind, payload, t_e, n_rec, n_chunks = got[0]
                 t0 = time.perf_counter()
                 exc: BaseException | None = None
                 try:
@@ -1047,19 +1019,13 @@ class Engine:
                 except BaseException as e:  # noqa: BLE001
                     exc = e
                 # exception recorded ATOMICALLY with the pending
-                # decrement (the _sink_worker discipline)
-                with self._sink_cv:
-                    self._sink_busy_s += time.perf_counter() - t0
-                    self._sink_pending -= n_chunks
-                    if exc is not None:
-                        self._sink_exc = exc
-                    self._sink_cv.notify_all()
+                # decrement (the SinkChannel.complete discipline)
+                self._chan.complete(n_chunks,
+                                    time.perf_counter() - t0, exc)
                 if exc is not None:
                     return
         except BaseException as e:  # noqa: BLE001 — _check_sink surfaces
-            with self._sink_cv:
-                self._sink_exc = e
-                self._sink_cv.notify_all()
+            self._chan.record_exc(e)
 
     def _sink_group(self, group: list[_InFlight]) -> None:
         """Fetch + sink a reap group.
@@ -1646,17 +1612,17 @@ class Engine:
             if not sealed and not n_polled:
                 if self._busy_depth() == 0:
                     # Idle link: back off instead of spinning poll() at
-                    # 100% CPU (the daemon sleeps 200 µs in its
-                    # analogous case).  A fraction of the batch deadline
-                    # keeps added latency well under the flush budget.
-                    time.sleep(min(cfg_b.deadline_us / 4, 200) / 1e6)
+                    # 100% CPU (sync/tuning.py IDLE_SLEEP_S, the
+                    # daemon-matched cadence).  A fraction of the batch
+                    # deadline keeps added latency under the flush
+                    # budget.
+                    time.sleep(tuning.idle_sleep_s(cfg_b.deadline_us))
                 elif self._sink_active:
-                    # Pipe busy, nothing new to dispatch: YIELD the GIL.
-                    # A spinning dispatch loop holds the interpreter for
-                    # the full 5 ms switch interval per slice, starving
-                    # the sink thread's (pure-Python) decode/writeback —
-                    # measured stretching sub-ms sinks to 10-25 ms.
-                    time.sleep(20e-6)
+                    # Pipe busy, nothing new to dispatch: YIELD the GIL
+                    # (sync/tuning.py GIL_YIELD_S — a spinning dispatch
+                    # loop starved the sink thread's pure-Python
+                    # decode/writeback, measured 10-25 ms sinks).
+                    time.sleep(tuning.GIL_YIELD_S)
 
         # A bounded exit (max_batches/max_seconds) can in principle trip
         # with sealed group candidates still pending (span-boundary
@@ -1738,9 +1704,10 @@ class Engine:
         if src.exhausted():
             return True
         if self._busy_depth() == 0:
-            time.sleep(min(self.cfg.batch.deadline_us / 4, 200) / 1e6)
+            time.sleep(tuning.idle_sleep_s(self.cfg.batch.deadline_us))
         elif self._sink_active:
-            time.sleep(20e-6)  # yield the GIL to the sink thread
+            # yield the GIL to the sink thread (sync/tuning.py)
+            time.sleep(tuning.GIL_YIELD_S)
         return False
 
     def _sealed_loop_arena(self, src, bounded) -> None:
@@ -1945,7 +1912,7 @@ class Engine:
                 self._d2h_bytes / max(self._sunk_batches, 1), 1),
             "sink_thread": self.sink_thread,
             "sink_occupancy": (round(
-                self._sink_busy_s / max(wall, 1e-9), 4)
+                self._chan.busy_s / max(wall, 1e-9), 4)
                 if self.sink_thread else None),
         }
 

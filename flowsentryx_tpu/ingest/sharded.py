@@ -44,6 +44,8 @@ import numpy as np
 from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.engine.metrics import WorkerIngestMetrics
 from flowsentryx_tpu.engine.shm import SealedBatchQueue
+from flowsentryx_tpu.sync import tuning
+from flowsentryx_tpu.sync.channel import WorkerCrash
 
 
 class SealedBatch(NamedTuple):
@@ -108,6 +110,7 @@ class ShardedIngest:
         precompact: bool | None = None,
         spin_us: int | None = None,
         idle_us: int = 200,
+        strict: bool = False,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -116,14 +119,16 @@ class ShardedIngest:
             # worker needs a core to burn — with fewer cores than
             # workers + engine + one spare, the spin just steals cycles
             # from the XLA step it is trying to feed (measured on the
-            # 2-vCPU CI container: sealed drain ~15 % slower).
+            # 2-vCPU CI container: sealed drain ~15 % slower; the spin
+            # budget itself is sync/tuning.py SPIN_US_DEFAULT).
             import os
 
             try:
                 n_cpus = len(os.sched_getaffinity(0))
             except AttributeError:  # non-Linux
                 n_cpus = os.cpu_count() or 1
-            spin_us = 150 if n_cpus >= n_workers + 2 else 0
+            spin_us = (tuning.SPIN_US_DEFAULT
+                       if n_cpus >= n_workers + 2 else 0)
         if spin_us < 0 or idle_us < 0:
             raise ValueError("spin_us/idle_us must be >= 0")
         if platform.system() != "Linux":
@@ -143,6 +148,17 @@ class ShardedIngest:
         self.timeout_s = timeout_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.t0_grace_s = t0_grace_s
+        #: Crash posture (docs/CONCURRENCY.md §crash).  False — the
+        #: documented default — is per-shard fail-open: a dead worker's
+        #: queue drains to empty, the remaining shards keep serving,
+        #: the death is surfaced in ``ingest_stats()``.  True surfaces
+        #: the crash as the same loud dispatch-side RuntimeError the
+        #: engine's sink/pipeline workers raise (the unified
+        #: :class:`~flowsentryx_tpu.sync.channel.WorkerCrash` path) —
+        #: once the corpse's queue is drained, so no sealed batch is
+        #: lost.  ``fsx serve --strict-ingest`` wires it.
+        self.strict = bool(strict)
+        self._crash: WorkerCrash | None = None
         self.ring_paths = [
             schema.shard_ring_path(self.ring_base, k, n_workers)
             for k in range(n_workers)
@@ -316,10 +332,20 @@ class ShardedIngest:
                 continue
             state = q.ctl_get("wstate")
             if not p.is_alive() and state not in (schema.WSTATE_DONE,):
-                # fail-open: note it, keep serving the other shards (the
-                # queue keeps draining until empty — sealed batches that
-                # made it out of the worker are still good).
+                # Record the death through the unified worker-crash
+                # path; default posture stays fail-open — note it, keep
+                # serving the other shards (the queue keeps draining
+                # until empty: sealed batches that made it out of the
+                # worker are still good).  Strict mode re-raises this
+                # in _surface_crash once the corpse's queue drains.
                 self._dead.add(k)
+                if self._crash is None:
+                    self._crash = WorkerCrash(
+                        f"engine ingest worker {k} crashed: died "
+                        f"without publishing DONE (wstate={state}, "
+                        f"exitcode={p.exitcode}); its ring shard is "
+                        "unserved — the kernel limiter stands alone "
+                        "for those flows")
                 continue
             hbeat = q.ctl_get("hbeat")
             if (p.is_alive() and hbeat
@@ -327,6 +353,18 @@ class ShardedIngest:
                 self._stalled.add(k)
             else:
                 self._stalled.discard(k)
+
+    def _surface_crash(self) -> None:
+        """Strict-mode crash propagation: raise the recorded
+        :class:`WorkerCrash` on the DISPATCH side — the same loud
+        RuntimeError shape the engine's sink thread and device-pipeline
+        worker die with — but only once every dead worker's queue is
+        drained, so sealed batches that escaped the corpse still
+        serve (the drain guarantee strict mode keeps)."""
+        if not self.strict or self._crash is None:
+            return
+        if all(self._queues[k].readable() == 0 for k in self._dead):
+            raise self._crash
 
     def request_stop(self) -> None:
         """Ask every worker to drain its ring and exit (drain-on-
@@ -379,6 +417,7 @@ class ShardedIngest:
         if not self._started:
             raise RuntimeError("ShardedIngest.start() was never called")
         self._check_health()
+        self._surface_crash()
         if not self._ensure_t0():
             return []
         out: list[SealedBatch] = []
@@ -433,6 +472,7 @@ class ShardedIngest:
         if not self._started:
             raise RuntimeError("ShardedIngest.start() was never called")
         self._check_health()
+        self._surface_crash()
         if not self._ensure_t0():
             return []
         t_call = time.perf_counter()
@@ -506,6 +546,8 @@ class ShardedIngest:
         return {
             "n_workers": self.n_workers,
             "t0_ns": self._t0,
+            "strict": self.strict,
+            "crashed": self._crash is not None,
             "dead_workers": sorted(self._dead),
             "dropped_tail_batches": self._dropped_tail,
             "dropped_emit_batches": sum(

@@ -30,15 +30,18 @@ import traceback
 
 import numpy as np
 
+from flowsentryx_tpu.sync import tuning
+
 #: Records a worker will buffer while waiting for the t0 handshake
 #: before letting ring backpressure take over (64k records ≈ 3 MB raw48;
 #: the handshake resolves in well under a second of traffic).
 PENDING_CAP = 1 << 16
 
-#: Idle sleep between empty polls (matches the daemon's 200 µs).  Also
-#: the spin-exhausted sleep of the drain loop's bounded backoff when
-#: the queue creator left the ctl-block ``idle_us`` field at 0.
-IDLE_SLEEP_S = 200e-6
+#: Idle sleep between empty polls — sync/tuning.py is the documented
+#: table (daemon-matched 200 µs).  Also the spin-exhausted sleep of the
+#: drain loop's bounded backoff when the queue creator left the
+#: ctl-block ``idle_us`` field at 0.
+IDLE_SLEEP_S = tuning.IDLE_SLEEP_S
 
 
 class _Backoff:
@@ -79,12 +82,14 @@ class _Backoff:
         time.sleep(self.idle_s)
         return True
 
-#: Bounded wait on a full queue once stop was requested — the consumer
-#: may already be gone and shutdown must not hang.  A give-up is NOT
-#: silent: the batch's seq is un-burned (a gap stays a corruption
-#: signal) and the loss lands in the queue's ``emit_drop`` counter,
-#: surfaced per worker in the engine report's ``ingest`` block.
-EMIT_STOP_TIMEOUT_S = 2.0
+#: Bounded wait on a full queue once stop was requested (rationale in
+#: sync/tuning.py) — the consumer may already be gone and shutdown must
+#: not hang.  A give-up is NOT silent: the batch's seq is un-burned (a
+#: gap stays a corruption signal) and the loss lands in the queue's
+#: ``emit_drop`` counter, surfaced per worker in the engine report's
+#: ``ingest`` block.  Module-level (not read from tuning at call time)
+#: so tests can monkeypatch the shutdown bound.
+EMIT_STOP_TIMEOUT_S = tuning.EMIT_STOP_TIMEOUT_S
 
 
 def _monotonic_ns() -> int:

@@ -1,0 +1,27 @@
+"""The host-concurrency plane: runtime primitives + static analysis.
+
+Third leg of the static-analysis suite (``fsx check`` proves the BPF
+layer, ``fsx audit`` the device graphs, ``fsx sync`` the host threads
+— docs/CONCURRENCY.md is the operator view):
+
+* :mod:`flowsentryx_tpu.sync.tuning` — THE table of idle/backoff
+  timing constants the engine and ingest share, each with its measured
+  rationale.
+* :mod:`flowsentryx_tpu.sync.channel` — :class:`SinkChannel`, the
+  cv-guarded dispatch↔worker handoff protocol extracted from the
+  engine so the model checker can drive the REAL code.
+* :mod:`flowsentryx_tpu.sync.contracts` — the declarative registry of
+  shared mutable state plus the AST pass that enforces each field's
+  thread discipline (``fsx sync`` / the ``sync_contracts`` lint stage).
+* :mod:`flowsentryx_tpu.sync.interleave` — the bounded-interleaving
+  model checker: exhaustive cooperative schedules over the real
+  protocol objects, including the arena reuse-bound tightness proof.
+
+Everything here is deliberately jax-free: the ingest workers import
+:mod:`tuning` on their sub-second boot path, and the checkers must run
+in the lint gate without paying a backend init.
+"""
+
+from flowsentryx_tpu.sync.channel import SinkChannel, WorkerCrash
+
+__all__ = ["SinkChannel", "WorkerCrash"]

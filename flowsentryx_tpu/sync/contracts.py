@@ -1,0 +1,793 @@
+"""Thread-contract lint: the declarative registry of shared mutable
+state in the host pipeline plus the AST pass that enforces it.
+
+The host plane (PRs 1/3/5/7) is genuinely concurrent — dispatch
+thread, sink thread, device-pipeline worker, N drain-worker processes,
+SPSC queues with a TSO cursor protocol — and until now its disciplines
+lived only in docstrings.  This module makes them *checkable*:
+
+* :data:`REGISTRY` declares, per class, every shared mutable field and
+  the discipline that keeps it safe (owner thread, guarding cv,
+  exclusive code section, atomic-reference swap, quiescent-only
+  writes), each with the rationale docs/CONCURRENCY.md mirrors.
+* :func:`check_module` walks the real source: it attributes every read
+  and write of a registered field to the thread context(s) that can
+  execute the enclosing method — worker contexts traced from
+  ``threading.Thread(target=...)`` spawns (including the engine's
+  ``target, name = self._x, ...`` indirection), dispatch context from
+  the public API, propagated through the intra-class call graph — and
+  reports any access outside the declared discipline with file:line.
+* Unregistered shared-looking state — a field MUTATED outside
+  boot/teardown in two different thread contexts without a registry
+  entry — is itself a finding, so the registry cannot silently rot;
+  so are stale entries naming fields or methods that no longer exist,
+  and thread spawns whose target the registry never declared.
+* :data:`CURSORS` pins the SPSC shm protocol: ``_head[0] = ...`` only
+  in producer-side methods, ``_tail[0] = ...`` only in consumer-side
+  ones (the x86-TSO plain-store protocol's single-writer premise).
+* :data:`CTL_WRITERS` pins the sealed-queue control block's
+  one-writer-per-field rule across the engine/worker process boundary.
+
+Everything here is pure ``ast`` work — no jax, no imports of the
+checked modules — so it runs in the lint gate (``scripts/lint.py``
+stage ``sync_contracts``) and in ``fsx sync`` in milliseconds.
+
+Diagnostic idiom matches ``fsx check`` / ``fsx audit``: one
+:class:`SyncFinding` per violation, naming the contract, the
+``file:line``, the ``Class.method``, and the violated rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+#: Contexts a method can execute under.  "dispatch" is the engine
+#: caller's thread (the serving loop); "worker" is any in-process
+#: helper thread spawned via Thread(target=...).
+DISPATCH, WORKER = "dispatch", "worker"
+
+
+@dataclasses.dataclass
+class SyncFinding:
+    """One violated thread contract, pinned to file:line."""
+
+    contract: str    # discipline | unregistered | cursor | ctl | registry
+    path: str        # repo-relative module path
+    line: int
+    where: str       # "Class.method" (or "Class" / "module")
+    reason: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.contract}] "
+                f"{self.where}: {self.reason}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldContract:
+    """Discipline of one shared mutable field.
+
+    ``discipline``:
+
+    * ``"dispatch"`` — owner is the dispatch thread; any access from a
+      method a worker context can execute is a violation.
+    * ``"section:<name>"`` — accessed only inside the named exclusive
+      code section (``ClassPlan.sections``): a set of methods that,
+      by the runtime mode protocol, never run concurrently with each
+      other or with any other accessor (e.g. the launch section runs
+      on the dispatch thread OR the pipeline worker, never both —
+      the interleave checker exercises that exclusivity).
+    * ``"cv"`` — every access lexically under ``with self.<lock>:``.
+    * ``"cv-write"`` — writes under the lock; unlocked reads are
+      declared benign (single CPython reference/int loads).
+    * ``"atomic-ref"`` — reads anywhere; every write must be a plain
+      whole-object assignment (no ``+=``, no item/attribute store):
+      the hot-swap idiom.
+    * ``"quiescent-write"`` — writes only in quiescent methods; reads
+      anywhere (mode flags set before a worker exists).
+    * ``"documented"`` — no mechanical rule; the entry exists to
+      register the field (silencing the unregistered-shared-state
+      detector) and to carry the rationale docs/CONCURRENCY.md shows.
+
+    ``extra`` grants specific additional methods access, each such
+    grant being part of the documented discipline (e.g. a read that is
+    unreachable while the worker is active, guarded by a mode flag).
+    """
+
+    discipline: str
+    rationale: str
+    extra: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassPlan:
+    """Registry entry for one concurrent class."""
+
+    module: str                       # repo-relative path
+    cls: str
+    fields: dict                      # field -> FieldContract
+    worker_targets: tuple[str, ...] = ()   # declared Thread targets
+    sections: dict = dataclasses.field(default_factory=dict)
+    quiescent: tuple[str, ...] = ()   # boot/teardown methods: no worker
+    #                                   alive while they run (documented)
+    lock_attr: str = ""               # the cv attribute for cv disciplines
+
+
+@dataclasses.dataclass(frozen=True)
+class CursorPlan:
+    """SPSC cursor single-writer rule for one shm class."""
+
+    module: str
+    cls: str
+    producer: tuple[str, ...]   # methods allowed to store head[0]
+    consumer: tuple[str, ...]   # methods allowed to store tail[0]
+    head: str = "_head"
+    tail: str = "_tail"
+
+
+# ---------------------------------------------------------------------------
+# THE registry (docs/CONCURRENCY.md mirrors this, table for table)
+# ---------------------------------------------------------------------------
+
+_ENGINE_QUIESCENT = (
+    # Methods documented to run with NO worker thread alive (boot,
+    # teardown, between-runs plumbing — each one's docstring states it;
+    # _reap's single-thread branch is covered by the section grants).
+    "__init__", "warm", "reset_stream", "restore", "checkpoint",
+    "_build_report", "_reset_dispatch_counters",
+    "_start_sink_thread", "_stop_sink_thread", "watch_artifact",
+)
+
+_ENGINE_LAUNCH = (
+    # The launch section: mutates the device carry (table/stats) and
+    # the dispatch accounting.  Runs on the dispatch thread in
+    # sink-thread mode, on the device-pipeline worker in ring mode —
+    # never both: _pipe_active routes every _dispatch* through _submit
+    # while the worker owns launches (interleave.py exercises this).
+    "_launch_single", "_launch_group", "_launch_ring",
+)
+
+_ENGINE_SINK = (
+    # The sink section: fetch + decode + writeback accounting.  Runs
+    # on the dispatch thread in single-thread mode, on the sink thread
+    # or pipeline worker otherwise — FIFO by a single owner either
+    # way, so each field has one writer at a time.
+    "_sink_group", "_sink_group_wire", "_apply_updates",
+)
+
+_LAUNCH = FieldContract(
+    "section:launch",
+    "device carry + dispatch accounting: single launcher at a time "
+    "(dispatch thread XOR pipeline worker, routed by _pipe_active)")
+_SINK = FieldContract(
+    "section:sink",
+    "sink accounting: single sinker at a time (dispatch thread in "
+    "single-thread mode, else the sink/pipeline worker, FIFO)")
+_DISP = FieldContract(
+    "dispatch",
+    "dispatch-thread-owned staging/polling state; no worker touches it")
+
+ENGINE_PLAN = ClassPlan(
+    module="flowsentryx_tpu/engine/engine.py",
+    cls="Engine",
+    worker_targets=("_sink_worker", "_ring_worker"),
+    sections={"launch": _ENGINE_LAUNCH, "sink": _ENGINE_SINK},
+    quiescent=_ENGINE_QUIESCENT,
+    fields={
+        # -- launch section -------------------------------------------
+        "table": _LAUNCH, "stats": _LAUNCH,
+        "_dispatch_calls": _LAUNCH, "_dispatched_chunks": _LAUNCH,
+        "_group_hist": _LAUNCH, "_ring_rounds": _LAUNCH,
+        "_ring_partial_slots": _LAUNCH,
+        # -- sink section ---------------------------------------------
+        "_d2h_bytes": _SINK, "_sink_compact": _SINK,
+        "_sink_fallback": _SINK, "_route_drop": _SINK,
+        "_blocked": _SINK, "_device_now": _SINK, "_sunk_batches": _SINK,
+        "_last_sink_t": FieldContract(
+            "section:sink",
+            "ready-reap coalescing clock, written at sink time",
+            # single-thread mode only: _reap_ready returns before this
+            # read whenever _sink_active (mode-guarded access)
+            extra=("_reap_ready",)),
+        # -- dispatch-thread-owned ------------------------------------
+        "_inflight": _DISP, "_pending": _DISP, "_arena": _DISP,
+        "batcher": _DISP, "_staged_batches": _DISP,
+        "_staged_bytes": _DISP, "_h2d_put_s": _DISP,
+        "_h2d_overlap_s": _DISP, "_h2d_puts": _DISP,
+        "_h2d_puts_overlapped": _DISP, "_t0_auto": _DISP,
+        "_watch_path": _DISP, "_watch_mtime": _DISP,
+        "_watch_next": _DISP, "_hot_swaps": _DISP,
+        # -- cross-thread by protocol ---------------------------------
+        "params": FieldContract(
+            "atomic-ref",
+            "hot_swap's one-reference-assignment swap: launch sites "
+            "read self.params exactly once per dispatch, so a plain "
+            "rebind is safe from any thread; read-modify-write is not"),
+        "_sink_active": FieldContract(
+            "quiescent-write",
+            "mode flag: written only while no worker exists "
+            "(_start/_stop_sink_thread); racy reads are stable"),
+        "_pipe_active": FieldContract(
+            "quiescent-write",
+            "ring-mode routing flag, same lifecycle as _sink_active"),
+        "_chan": FieldContract(
+            "documented",
+            "the SinkChannel: its own cv discipline is enforced in "
+            "sync/channel.py's plan; engine-side use is deep calls"),
+        "metrics": FieldContract(
+            "documented",
+            "per-stage timers with per-stage owners: fill/pop/stage "
+            "on the dispatch thread, dispatch in the launch section, "
+            "readback/e2e in the sink section — one writer per timer"),
+        "sink": FieldContract(
+            "documented",
+            "t0_ns written on the dispatch thread only before the "
+            "first batch reaches the sink section (handoff through "
+            "the channel's cv is the happens-before edge); apply() "
+            "runs in the sink section"),
+        "on_reap": FieldContract(
+            "documented",
+            "bound by the caller before run() and cleared quiescent "
+            "(reset_stream); read-only during serving"),
+    },
+)
+
+CHANNEL_PLAN = ClassPlan(
+    module="flowsentryx_tpu/sync/channel.py",
+    cls="SinkChannel",
+    lock_attr="cv",
+    quiescent=("__init__",),
+    fields={
+        "_q": FieldContract(
+            "cv", "the handoff queue: every access under the cv"),
+        "_stop": FieldContract(
+            "cv", "drain-on-stop flag: every access under the cv"),
+        "_pending": FieldContract(
+            "cv-write",
+            "backpressure count: writes under the cv; the unlocked "
+            "pending-property read is a benign single int load",
+            extra=("pending",)),
+        "_exc": FieldContract(
+            "cv-write",
+            "crash slot: set under the cv ATOMICALLY with the pending "
+            "decrement; unlocked reads (crashed/check) are benign — "
+            "one None->exc transition per run",
+            extra=("crashed", "check")),
+        "busy_s": FieldContract(
+            "cv-write",
+            "occupancy total: advanced under the cv at complete(); "
+            "read unlocked only by the quiescent report"),
+    },
+)
+
+INGEST_PLAN = ClassPlan(
+    module="flowsentryx_tpu/ingest/sharded.py",
+    cls="ShardedIngest",
+    quiescent=("__init__", "start", "close"),
+    fields={
+        # No in-process threads: every method runs on the engine's
+        # dispatch thread.  The entries pin that — a future helper
+        # thread touching these would trip the checker, and the
+        # cross-PROCESS state is governed by the cursor/ctl plans.
+        "_rr": _DISP, "_queues": _DISP, "_procs": _DISP,
+        "_seqs": _DISP, "_dead": _DISP, "_stalled": _DISP,
+        "_t0": _DISP, "_t0_first_seen": _DISP, "_batches": _DISP,
+        "_records": _DISP, "_dropped_tail": _DISP, "_metrics": _DISP,
+        "_crash": _DISP,
+    },
+)
+
+REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN)
+
+CURSORS: tuple[CursorPlan, ...] = (
+    CursorPlan(module="flowsentryx_tpu/engine/shm.py", cls="ShmRing",
+               producer=("produce",), consumer=("consume", "advance")),
+    CursorPlan(module="flowsentryx_tpu/engine/shm.py",
+               cls="SealedBatchQueue",
+               producer=("produce_batch",),
+               consumer=("consume_batch", "release")),
+)
+
+#: One writer side per sealed-queue control field (engine/shm.py
+#: SealedBatchQueue docstring: "every control field has exactly one
+#: writer side" — this is that claim, checkable).
+CTL_WRITERS: dict[str, str] = {
+    "hbeat": "worker", "first_ts": "worker", "wstate": "worker",
+    "emit_drop": "worker",
+    "t0": "engine", "stop": "engine", "spin_us": "engine",
+    "idle_us": "engine",
+}
+
+#: Which side each production module writes from.  Modules not listed
+#: here must not call ctl_set at all (tests/scripts are out of scope —
+#: they are harnesses, not the data plane).
+CTL_MODULE_SIDE: dict[str, str] = {
+    "flowsentryx_tpu/ingest/worker.py": "worker",
+    "flowsentryx_tpu/ingest/sharded.py": "engine",
+}
+
+#: Production modules swept for ctl_set sites.
+_CTL_SCOPE = ("flowsentryx_tpu/ingest", "flowsentryx_tpu/engine",
+              "flowsentryx_tpu/fused", "flowsentryx_tpu/daemon")
+
+
+# ---------------------------------------------------------------------------
+# AST machinery
+# ---------------------------------------------------------------------------
+
+def _self_chain(node: ast.AST) -> tuple[str, ...] | None:
+    """``self.a.b.c`` -> ("a", "b", "c"); None when not self-rooted."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self":
+        return tuple(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class _Access:
+    field: str
+    kind: str     # read|write|augwrite|subwrite|deepwrite|deepuse
+    line: int
+    locked: bool
+
+
+class _MethodInfo:
+    def __init__(self) -> None:
+        self.accesses: list[_Access] = []
+        self.calls: set[str] = set()       # self.m() call edges
+        self.refs: set[str] = set()        # bare self.m references
+        self.spawns_thread = False
+
+
+def _scan_method(fn: ast.AST, method_names: set[str],
+                 lock_attr: str) -> _MethodInfo:
+    """One full recursive pass over a method body: field accesses with
+    lock state, intra-class call edges, bare method references, and
+    whether the method spawns a thread."""
+    info = _MethodInfo()
+    called_funcs: set[int] = set()
+
+    def write_roots(target: ast.AST, kind: str):
+        """Record write accesses for one assignment target."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                write_roots(elt, kind)
+            return
+        if isinstance(target, ast.Starred):
+            write_roots(target.value, kind)
+            return
+        if isinstance(target, ast.Subscript):
+            chain = _self_chain(target.value)
+            if chain:
+                info.accesses.append(_Access(
+                    chain[0], "subwrite" if len(chain) == 1 else
+                    "deepwrite", target.lineno, locked[-1]))
+            return
+        if isinstance(target, ast.Attribute):
+            chain = _self_chain(target)
+            if chain:
+                k = kind if len(chain) == 1 else "deepwrite"
+                info.accesses.append(_Access(
+                    chain[0], k, target.lineno, locked[-1]))
+
+    locked = [False]
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.With):
+            is_lock = lock_attr and any(
+                _self_chain(item.context_expr) == (lock_attr,)
+                for item in node.items)
+            for item in node.items:
+                visit(item.context_expr)
+            locked.append(locked[-1] or bool(is_lock))
+            for stmt in node.body:
+                visit(stmt)
+            locked.pop()
+            return
+        if isinstance(node, ast.Call):
+            called_funcs.add(id(node.func))
+            chain = (_self_chain(node.func)
+                     if isinstance(node.func, ast.Attribute) else None)
+            if chain is not None:
+                if len(chain) == 1:
+                    info.calls.add(chain[0])
+                else:
+                    info.accesses.append(_Access(
+                        chain[0], "deepuse", node.lineno, locked[-1]))
+            func_names: list[str] = []
+            n = node.func
+            while isinstance(n, ast.Attribute):
+                func_names.append(n.attr)
+                n = n.value
+            if isinstance(n, ast.Name):
+                func_names.append(n.id)
+            if "Thread" in func_names or "Process" in func_names:
+                info.spawns_thread = True
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            kind = ("augwrite" if isinstance(node, ast.AugAssign)
+                    else "write")
+            for t in targets:
+                write_roots(t, kind)
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                write_roots(t, "write")
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            chain = _self_chain(node)
+            if chain:
+                if len(chain) == 1:
+                    info.accesses.append(_Access(
+                        chain[0], "read", node.lineno, locked[-1]))
+                    if (chain[0] in method_names
+                            and id(node) not in called_funcs):
+                        info.refs.add(chain[0])
+                # deeper loads surface through the root read above
+                elif len(chain) > 1:
+                    info.accesses.append(_Access(
+                        chain[0], "read", node.lineno, locked[-1]))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    # visit children (not fn itself: its decorators/args are noise)
+    for stmt in getattr(fn, "body", []):
+        visit(stmt)
+    return info
+
+
+def _class_methods(tree: ast.Module, cls: str) -> dict[str, ast.AST]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return {n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return {}
+
+
+def _contexts(methods: dict[str, _MethodInfo],
+              worker_targets: tuple[str, ...],
+              public_seeds: list[str]) -> dict[str, set]:
+    """Propagate thread contexts through the intra-class call graph.
+    A bare reference to a non-target method counts as a call edge
+    (conservative: the callable escapes into the referencer's
+    context)."""
+    ctx: dict[str, set] = {m: set() for m in methods}
+
+    def flood(seed: str, tag: str) -> None:
+        stack = [seed]
+        while stack:
+            m = stack.pop()
+            if m not in ctx or tag in ctx[m]:
+                continue
+            ctx[m].add(tag)
+            info = methods[m]
+            for callee in info.calls | {
+                    r for r in info.refs if r not in worker_targets}:
+                if callee in ctx:
+                    stack.append(callee)
+
+    for t in worker_targets:
+        if t in ctx:
+            flood(t, WORKER)
+    for m in public_seeds:
+        flood(m, DISPATCH)
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+def _dedupe(findings: list[SyncFinding]) -> list[SyncFinding]:
+    """One access site can surface as several AST records (a chained
+    ``self.f.g(...)`` is a read + a deep use); report each violated
+    (contract, line, where, reason) once."""
+    seen: set[tuple] = set()
+    out = []
+    for f in findings:
+        key = (f.contract, f.path, f.line, f.where, f.reason)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def check_class(tree: ast.Module, path: str,
+                plan: ClassPlan) -> list[SyncFinding]:
+    """Run the registered disciplines (and the unregistered-shared-
+    state detector) over one class."""
+    out: list[SyncFinding] = []
+    fns = _class_methods(tree, plan.cls)
+    if not fns:
+        return [SyncFinding("registry", path, 1, plan.cls,
+                            f"registered class {plan.cls!r} not found "
+                            "in module — stale registry entry")]
+    method_names = set(fns)
+    scans = {m: _scan_method(fn, method_names, plan.lock_attr)
+             for m, fn in fns.items()}
+
+    # registry-rot guards: declared names must exist
+    for t in plan.worker_targets:
+        if t not in method_names:
+            out.append(SyncFinding(
+                "registry", path, 1, f"{plan.cls}.{t}",
+                "declared thread target does not exist"))
+    for sec, members in plan.sections.items():
+        for m in members:
+            if m not in method_names:
+                out.append(SyncFinding(
+                    "registry", path, 1, f"{plan.cls}.{m}",
+                    f"section {sec!r} names a missing method"))
+    for m in plan.quiescent:
+        if m not in method_names:
+            out.append(SyncFinding(
+                "registry", path, 1, f"{plan.cls}.{m}",
+                "quiescent list names a missing method"))
+    all_fields = {a.field for s in scans.values() for a in s.accesses}
+    for f in plan.fields:
+        if f not in all_fields:
+            out.append(SyncFinding(
+                "registry", path, 1, f"{plan.cls}.{f}",
+                "registered field is never accessed — stale entry"))
+
+    # undeclared thread spawns: a bare method reference inside a
+    # thread-spawning method must be a declared worker target
+    for m, s in scans.items():
+        if not s.spawns_thread:
+            continue
+        for r in s.refs:
+            if r not in plan.worker_targets:
+                out.append(SyncFinding(
+                    "registry", path, fns[m].lineno, f"{plan.cls}.{m}",
+                    f"thread spawned with undeclared target "
+                    f"self.{r} — add it to the sync registry's "
+                    "worker_targets (and give its shared state a "
+                    "discipline)"))
+
+    public = [m for m in fns if not m.startswith("_")] + ["__init__"]
+    ctx = _contexts(scans, plan.worker_targets, public)
+    quiescent = set(plan.quiescent)
+    writes = ("write", "augwrite", "subwrite", "deepwrite")
+
+    for m, s in scans.items():
+        mctx = ctx[m]
+        for a in s.accesses:
+            fc = plan.fields.get(a.field)
+            if fc is None:
+                continue
+            where = f"{plan.cls}.{m}"
+            if m in quiescent or m in fc.extra:
+                continue
+            d = fc.discipline
+            if d == "dispatch":
+                if WORKER in mctx:
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"dispatch-owned field self.{a.field} "
+                        f"accessed from a worker-reachable method "
+                        f"(contexts: {sorted(mctx)}) — {fc.rationale}"))
+            elif d.startswith("section:"):
+                sec = d.split(":", 1)[1]
+                if m not in plan.sections.get(sec, ()):
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"self.{a.field} belongs to the {sec!r} "
+                        f"section ({', '.join(plan.sections[sec])}) "
+                        f"and may not be touched elsewhere — "
+                        f"{fc.rationale}"))
+            elif d == "cv":
+                if not a.locked:
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"self.{a.field} accessed outside "
+                        f"'with self.{plan.lock_attr}:' — "
+                        f"{fc.rationale}"))
+            elif d == "cv-write":
+                if a.kind in writes and not a.locked:
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"self.{a.field} WRITTEN outside "
+                        f"'with self.{plan.lock_attr}:' — "
+                        f"{fc.rationale}"))
+            elif d == "atomic-ref":
+                if a.kind in ("augwrite", "subwrite", "deepwrite"):
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"read-modify-write of atomic-ref field "
+                        f"self.{a.field} ({a.kind}) — only a plain "
+                        f"whole-object rebind is safe: {fc.rationale}"))
+            elif d == "quiescent-write":
+                if a.kind in writes:
+                    out.append(SyncFinding(
+                        "discipline", path, a.line, where,
+                        f"self.{a.field} written outside the "
+                        f"quiescent set ({', '.join(plan.quiescent)})"
+                        f" — {fc.rationale}"))
+            # "documented": registration only
+
+    # unregistered shared-looking state: mutated (outside quiescent
+    # methods) under >= 2 thread contexts without a registry entry
+    write_ctx: dict[str, set] = {}
+    write_site: dict[str, tuple] = {}
+    for m, s in scans.items():
+        if m in quiescent:
+            continue
+        for a in s.accesses:
+            if a.kind in writes and a.field not in plan.fields:
+                write_ctx.setdefault(a.field, set()).update(ctx[m])
+                # point the finding at a worker-reachable site when
+                # one exists — that is the racy half
+                cur = write_site.get(a.field)
+                if cur is None or (WORKER in ctx[m]
+                                   and WORKER not in cur[2]):
+                    write_site[a.field] = (a.line, m, ctx[m])
+    for f, ctxs in sorted(write_ctx.items()):
+        if len(ctxs) >= 2:
+            line, m, _ = write_site[f]
+            out.append(SyncFinding(
+                "unregistered", path, line, f"{plan.cls}.{m}",
+                f"self.{f} is mutated under {sorted(ctxs)} contexts "
+                "but has no sync-registry entry — declare its "
+                "discipline in sync/contracts.py (and document it in "
+                "docs/CONCURRENCY.md) or move it off the shared path"))
+    return _dedupe(out)
+
+
+def check_cursors(tree: ast.Module, path: str,
+                  plan: CursorPlan) -> list[SyncFinding]:
+    """SPSC single-writer rule: cursor item-stores only on the
+    declared side."""
+    out: list[SyncFinding] = []
+    fns = _class_methods(tree, plan.cls)
+    if not fns:
+        return [SyncFinding("registry", path, 1, plan.cls,
+                            f"cursor-checked class {plan.cls!r} not "
+                            "found — stale registry entry")]
+    for m, fn in fns.items():
+        scan = _scan_method(fn, set(fns), "")
+        for a in scan.accesses:
+            if a.kind not in ("subwrite", "deepwrite"):
+                continue
+            if a.field == plan.head and m not in plan.producer:
+                out.append(SyncFinding(
+                    "cursor", path, a.line, f"{plan.cls}.{m}",
+                    f"head cursor stored outside the producer side "
+                    f"({', '.join(plan.producer)}) — the TSO "
+                    "plain-store protocol is single-writer per "
+                    "cursor; a consumer-side head store races the "
+                    "producer's publish"))
+            if a.field == plan.tail and m not in plan.consumer:
+                out.append(SyncFinding(
+                    "cursor", path, a.line, f"{plan.cls}.{m}",
+                    f"tail cursor stored outside the consumer side "
+                    f"({', '.join(plan.consumer)}) — releasing slots "
+                    "from the producer side would let it overwrite "
+                    "unread records"))
+    return _dedupe(out)
+
+
+def check_ctl(tree: ast.Module, path: str,
+              side: str | None) -> list[SyncFinding]:
+    """Sealed-queue control block: one writer side per field."""
+    out: list[SyncFinding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "ctl_set" and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue  # the generic ctl_set definition itself
+        field = arg.value
+        owner = CTL_WRITERS.get(field)
+        if owner is None:
+            out.append(SyncFinding(
+                "ctl", path, node.lineno, "module",
+                f"ctl_set({field!r}) writes an UNDECLARED control "
+                "field — add it to sync/contracts.py CTL_WRITERS "
+                "with its single writer side"))
+        elif side is None:
+            out.append(SyncFinding(
+                "ctl", path, node.lineno, "module",
+                f"ctl_set({field!r}) from a module with no declared "
+                "writer side — add the module to CTL_MODULE_SIDE"))
+        elif owner != side:
+            out.append(SyncFinding(
+                "ctl", path, node.lineno, "module",
+                f"ctl_set({field!r}) from the {side} side, but "
+                f"{field!r} is {owner}-written — two writers on one "
+                "plain-store TSO field is silent corruption"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SyncReport:
+    ok: bool
+    findings: list
+    stats: dict
+
+    def to_json(self) -> dict:
+        return {"ok": self.ok,
+                "stats": self.stats,
+                "findings": [f.to_json() for f in self.findings]}
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def run_contracts(root: Path | None = None,
+                  quick: bool = False) -> SyncReport:
+    """Run every registered contract over the real tree.  ``quick``
+    and full mode run the same checks (pure AST, milliseconds) — the
+    flag exists so callers mirror the ``fsx sync --quick`` surface."""
+    root = Path(root) if root is not None else _repo_root()
+    findings: list[SyncFinding] = []
+    trees: dict[str, ast.Module] = {}
+
+    def parse(rel: str) -> ast.Module | None:
+        if rel not in trees:
+            p = root / rel
+            if not p.exists():
+                findings.append(SyncFinding(
+                    "registry", rel, 1, "module",
+                    "registered module does not exist"))
+                trees[rel] = None
+            else:
+                trees[rel] = ast.parse(p.read_text(), filename=rel)
+        return trees[rel]
+
+    n_fields = 0
+    for plan in REGISTRY:
+        tree = parse(plan.module)
+        if tree is not None:
+            findings += check_class(tree, plan.module, plan)
+            n_fields += len(plan.fields)
+    for cplan in CURSORS:
+        tree = parse(cplan.module)
+        if tree is not None:
+            findings += check_cursors(tree, cplan.module, cplan)
+
+    ctl_sites = 0
+    for scope in _CTL_SCOPE:
+        base = root / scope
+        if not base.exists():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = str(p.relative_to(root))
+            tree = parse(rel)
+            if tree is None:
+                continue
+            found = check_ctl(tree, rel, CTL_MODULE_SIDE.get(rel))
+            findings += found
+            ctl_sites += sum(
+                1 for node in ast.walk(tree)
+                if isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "ctl_set")
+
+    return SyncReport(
+        ok=not findings,
+        findings=findings,
+        stats={
+            "classes": len(REGISTRY),
+            "registered_fields": n_fields,
+            "cursor_classes": len(CURSORS),
+            "ctl_fields": len(CTL_WRITERS),
+            "ctl_sites": ctl_sites,
+            "quick": bool(quick),
+        },
+    )

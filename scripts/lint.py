@@ -26,11 +26,18 @@ Stages, in order; the gate fails if any stage fails:
    review speed.  ``fsx audit`` proves the same property statically on
    the staged graph; this stage catches it before anything compiles.
    ``# noqa`` exempts a line.
-5. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+5. **sync contracts** — the thread-contract checker
+   (``flowsentryx_tpu/sync/contracts.py``) in ``--quick`` mode: every
+   registered shared field's thread discipline, the SPSC cursor
+   single-writer rule and the ctl-block writer sides re-proved over
+   the real source by AST walk.  ``fsx sync`` is the full surface
+   (it adds the bounded-interleaving model checker); this stage is
+   its review-speed gate, jax-free like the rest of the module.
+6. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-4 there.
-6. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-5 there.
+7. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -260,6 +267,24 @@ def stage_device_loop_purity() -> list[str]:
     return fails
 
 
+def stage_sync_contracts() -> list[str]:
+    """The thread-contract half of ``fsx sync`` as a lint stage (quick
+    mode: pure AST, no model checking, no jax)."""
+    try:
+        from flowsentryx_tpu.sync.contracts import run_contracts
+    except ImportError:
+        # run as a script: scripts/ is sys.path[0].  Insert the REAL
+        # repo root (from __file__, NOT the REPO global — tests point
+        # that at throwaway trees the import system must never see).
+        import sys as _sys
+
+        _sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from flowsentryx_tpu.sync.contracts import run_contracts
+
+    rep = run_contracts(root=REPO, quick=True)
+    return [str(f) for f in rep.findings]
+
+
 def _run_tool(cmd: list[str]) -> list[str]:
     r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
     if r.returncode == 0:
@@ -291,6 +316,7 @@ def main(argv: list[str] | None = None) -> int:
         "unused_imports": stage_unused_imports(),
         "local_imports": stage_local_imports(),
         "device_loop_purity": stage_device_loop_purity(),
+        "sync_contracts": stage_sync_contracts(),
         "ruff": stage_ruff(),
         "mypy": stage_mypy(),
     }

@@ -10,11 +10,16 @@
 #            torture plus ASan/UBSan builds+runs of kern/host_test,
 #            kern/prop_driver and an fsxd --sim smoke)
 # Always-on pre-stages (each failure exits early, before pytest):
-#   * scripts/lint.py — syntax, unused-import, local-import gates
+#   * scripts/lint.py — syntax, unused-import, local-import,
+#     device-loop-purity and sync_contracts gates
+#   * fsx sync        — host thread contracts + bounded-interleaving
+#     model checks (arena bound tightness re-proved per run); writes
+#     artifacts/SYNC_r13.json
 #   * fsx audit       — static dtype/donation/transfer/retrace/
-#     collective contracts over every staged step variant (8 virtual
-#     CPU devices so the sharded variant stages too); writes the
-#     machine-readable artifacts/AUDIT_r08.json byte-budget artifact
+#     collective/in-place contracts over every staged step variant (8
+#     virtual CPU devices so the sharded variant stages too); writes
+#     the machine-readable artifacts/AUDIT_r08.json byte-budget
+#     artifact
 # Exit code: pytest's (a pre-stage failure exits early).  Prints
 # DOTS_PASSED=<n> as a tamper-evident passed-test count derived from
 # the progress dots, not the summary.
@@ -55,6 +60,17 @@ fi
 
 echo "== lint gate (scripts/lint.py) =="
 python scripts/lint.py || exit 1
+
+echo "== fsx sync: host thread contracts + interleaving model checks =="
+# The host-plane leg of the static suite (docs/CONCURRENCY.md):
+# re-proves every registered thread contract over the real source,
+# runs the bounded-interleaving model checker on the real protocol
+# objects (SinkChannel crash atomicity, SealedBatchQueue wraparound),
+# and re-proves the arena reuse bound TIGHT — all interleavings pass
+# at depth+ring+1 slots, a staged-copy-overwrite counterexample is
+# emitted one below.  Jax-free; writes the machine-readable artifact.
+python -m flowsentryx_tpu.cli sync --out artifacts/SYNC_r13.json \
+    || exit 1
 
 echo "== fsx audit: static step-graph contracts (docs/AUDIT.md) =="
 # --device-loop 2 also stages the drain-ring deep scans (single-device

@@ -202,6 +202,139 @@ def _staged(fn, *example_args):
     return jax.jit(fn).trace(*example_args).jaxpr
 
 
+class TestInplaceCensus:
+    """The in-place/copy census: PR 8's measured XLA:CPU table cliffs
+    (a lax.cond-carried table; a dynamic-offset DUS) pinned as graph
+    facts, with a planted-violation negative per cliff."""
+
+    def test_every_variant_censuses_zero_copies(self, report):
+        for v in report.variants:
+            assert v.inplace["checked"], v.name
+            assert v.inplace["copies"] == 0, v.name
+            assert v.inplace["converts"] == 0, v.name
+            assert v.inplace["conditionals"] == 0, v.name
+            assert len(v.inplace["table_types"]) == 2  # key + state
+
+    def test_sharded_census_uses_local_shard_types(self, report):
+        sh = next(v for v in report.variants if v.name == "sharded")
+        single = next(v for v in report.variants if v.name == "compact")
+        # shard-local shapes are capacity/mesh — NOT the global shapes
+        assert sh.inplace["table_types"] != single.inplace["table_types"]
+
+    @staticmethod
+    def _plant(step):
+        cap = 64
+        j = jax.jit(step, donate_argnums=(0, 1))
+        key = jnp.zeros(cap, jnp.uint32)
+        st = jnp.zeros((cap, 4), jnp.float32)
+        tr = j.trace(key, st, jnp.uint32(3))
+        hlo = tr.lower().compile().as_text()
+        return graph.check_inplace(
+            tr.jaxpr, hlo, list(tr.jaxpr.in_avals)[:2],
+            ["table.key", "table.state"])
+
+    def test_planted_cond_carried_table(self):
+        cap = 64
+
+        def step(key, state, x):
+            key, state = jax.lax.cond(
+                x > jnp.uint32(0),
+                lambda k, s: (k.at[x % cap].set(x), s),
+                lambda k, s: (k, s), key, state)
+            return key, state, jnp.sum(state[:4])
+
+        finds, census = self._plant(step)
+        assert finds
+        cond = [f for f in finds if "lax.cond carries the donated "
+                "table" in f.reason]
+        assert cond and cond[0].contract == "inplace"
+        assert "table.key" in cond[0].reason
+        assert "eqns[" in cond[0].where  # names the source equation
+        # the executable-level census sees it too
+        assert census["conditionals"] >= 1
+        assert any("conditional op(s) carry a table-shaped operand"
+                   in f.reason for f in finds)
+
+    def test_planted_dynamic_offset_dus(self):
+        def step(key, state, x):
+            state = jax.lax.dynamic_update_slice(
+                state, jnp.ones((1, 4), jnp.float32),
+                (x.astype(jnp.int32), jnp.int32(0)))
+            return key, state, jnp.sum(state[:4])
+
+        finds, _ = self._plant(step)
+        dus = [f for f in finds
+               if "dynamic-offset dynamic_update_slice" in f.reason]
+        assert dus and dus[0].contract == "inplace"
+        assert "table.state" in dus[0].reason
+        assert "gather reads + victim-only scatter" in dus[0].reason
+
+    def test_planted_shard_local_dus(self):
+        # shard_map bodies stage SHARD-LOCAL avals — the census must
+        # match the per-shard table shape too, or the production
+        # scan-over-shard_map variants are blind to the DUS cliff
+        from flowsentryx_tpu.parallel import mesh as mesh_lib
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs a multi-device mesh")
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("ip",))
+
+        def body(key, state, x):
+            state = jax.lax.dynamic_update_slice(
+                state, jnp.ones((1, 4), jnp.float32),
+                (x[0].astype(jnp.int32), jnp.int32(0)))
+            return key, state, jax.lax.psum(jnp.sum(state), "ip")
+
+        sh = mesh_lib.shard_map(
+            body, mesh=mesh, in_specs=(P("ip"), P("ip"), P("ip")),
+            out_specs=(P("ip"), P("ip"), P()), check_vma=False)
+        j = jax.jit(sh, donate_argnums=(0, 1))
+        tr = j.trace(jnp.zeros(64, jnp.uint32),
+                     jnp.zeros((64, 4), jnp.float32),
+                     jnp.zeros(len(devs), jnp.uint32))
+        finds, _ = graph.check_inplace(
+            tr.jaxpr, tr.lower().compile().as_text(),
+            list(tr.jaxpr.in_avals)[:2], ["table.key", "table.state"],
+            n_shards=len(devs))
+        dus = [f for f in finds
+               if "dynamic-offset dynamic_update_slice" in f.reason]
+        assert dus and dus[0].contract == "inplace"
+        assert "table.state" in dus[0].reason
+
+    def test_planted_table_copy_in_hlo(self):
+        # positive for the executable-level copy census: returning the
+        # donated table as TWO outputs is an aliasing conflict XLA can
+        # only solve with a table-shaped materializing copy — if the
+        # census regex ever stops matching the dump format, this trips
+        def step(key, state, x):
+            return key, state, state, jnp.sum(state[:1])
+
+        j = jax.jit(step, donate_argnums=(0, 1))
+        tr = j.trace(jnp.zeros(64, jnp.uint32),
+                     jnp.zeros((64, 4), jnp.float32), jnp.uint32(3))
+        finds, census = graph.check_inplace(
+            tr.jaxpr, tr.lower().compile().as_text(),
+            list(tr.jaxpr.in_avals)[:2], ["table.key", "table.state"])
+        assert census["copies"] >= 1
+        assert any("producing a table-shaped buffer" in f.reason
+                   and f.contract == "inplace" for f in finds)
+
+    def test_constant_offset_window_is_fine(self):
+        # the legal form: a CONSTANT-offset window (and the scatters
+        # XLA fuses to DUS) must NOT trip the census
+        def step(key, state, x):
+            # python-int starts stage as Literals — the static form
+            state = jax.lax.dynamic_update_slice(
+                state, jnp.ones((1, 4), jnp.float32), (0, 0))
+            state = state.at[x % 64, 0].add(1.0)  # single-index scatter
+            return key, state, jnp.sum(state[:4])
+
+        finds, census = self._plant(step)
+        assert [f for f in finds if f.contract == "inplace"] == [], [
+            str(f) for f in finds]
+        assert census["copies"] == 0 and census["conditionals"] == 0
+
+
 class TestNegatives:
     """Planted defects, each caught with an instruction-level
     diagnostic (the `fsx check` rejection idiom on the TPU plane)."""

@@ -145,3 +145,60 @@ class TestDeviceLoopPurityStage:
 
     def test_repo_traced_region_is_clean(self):
         assert lint.stage_device_loop_purity() == []
+
+
+class TestSyncContractsStage:
+    """The thread-contract gate (fsx sync --quick as a lint stage): a
+    regression in the stage plumbing must not pass silently."""
+
+    def test_repo_is_clean(self):
+        assert lint.stage_sync_contracts() == []
+
+    def test_stage_surfaces_findings(self, tmp_path):
+        # point the stage at a tree where the registered modules are
+        # missing: every registry entry must surface as a finding —
+        # proof the stage actually runs the checker (a stage that
+        # silently returned [] on error would pass this repo forever)
+        old = lint.REPO
+        lint.REPO = tmp_path
+        try:
+            out = lint.stage_sync_contracts()
+        finally:
+            lint.REPO = old
+        assert out
+        assert any("registered module does not exist" in f for f in out)
+
+    def test_stage_catches_planted_discipline_violation(self, tmp_path):
+        # a full end-to-end plant: copy the real tree layout with ONE
+        # engine violation — a worker-reachable method writing a
+        # dispatch-owned field — and run the stage against it
+        import shutil
+
+        repo = Path(lint.REPO)
+        for rel in ("flowsentryx_tpu/engine/engine.py",
+                    "flowsentryx_tpu/engine/shm.py",
+                    "flowsentryx_tpu/sync/channel.py",
+                    "flowsentryx_tpu/ingest/sharded.py",
+                    "flowsentryx_tpu/ingest/worker.py"):
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(repo / rel, dst)
+        eng = tmp_path / "flowsentryx_tpu/engine/engine.py"
+        src = eng.read_text()
+        # plant: the sink worker touches the dispatch-owned staging
+        # counter (exactly the drift class the registry exists to stop)
+        needle = "    def _sink_worker(self) -> None:"
+        assert needle in src
+        planted = src.replace(
+            needle,
+            "    def _sink_worker(self) -> None:\n"
+            "        self._staged_batches += 1\n", 1)
+        eng.write_text(planted)
+        old = lint.REPO
+        lint.REPO = tmp_path
+        try:
+            out = lint.stage_sync_contracts()
+        finally:
+            lint.REPO = old
+        assert any("_staged_batches" in f and "worker" in f
+                   for f in out), out
