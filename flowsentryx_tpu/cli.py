@@ -19,17 +19,25 @@ import sys
 from pathlib import Path
 
 
-def _mega_arg(s: str):
-    """``--mega`` value: a fixed group size (int) or ``auto`` — the
-    adaptive power-of-two coalescing ladder (group sizes track the
-    instantaneous backlog; ``Engine(mega_n="auto")``)."""
-    if s == "auto":
-        return "auto"
-    try:
-        return int(s)
-    except ValueError:
-        raise argparse.ArgumentTypeError(
-            f"--mega takes an integer or 'auto', got {s!r}")
+def _int_or_auto(flag: str):
+    """argparse type for flags taking an int or the string ``auto``."""
+    def parse(s: str):
+        if s == "auto":
+            return "auto"
+        try:
+            return int(s)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{flag} takes an integer or 'auto', got {s!r}")
+    return parse
+
+
+#: ``--mega``: a fixed group size or the adaptive power-of-two
+#: coalescing ladder (``Engine(mega_n="auto")``).
+_mega_arg = _int_or_auto("--mega")
+#: ``--device-loop``: an explicit ring depth or a depth picked from a
+#: short boot-time calibration drain (``engine.calibrate_ring_depth``).
+_device_loop_arg = _int_or_auto("--device-loop")
 
 
 def _cmd_codegen(args: argparse.Namespace) -> int:
@@ -827,10 +835,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # reads verdicts back exclusively through the per-slot compact
     # wires — both are structural, not preferences, so a combination
     # that breaks them (or the arena slot-safety accounting built on
-    # them) is refused here with its actual problem named.
-    if args.device_loop < 0:
+    # them) is refused here with its actual problem named.  ``auto``
+    # (the boot-time ring-depth calibration) obeys the SAME rules as
+    # an explicit depth — a calibration that could only refuse after
+    # its multi-compile drain would be the exact hostility this block
+    # exists to prevent.
+    if args.device_loop != "auto" and args.device_loop < 0:
         print("fsx serve: --device-loop must be >= 0 (0 = per-group "
-              "dispatch, the parity baseline)", file=sys.stderr)
+              "dispatch, the parity baseline) or 'auto'",
+              file=sys.stderr)
         return 1
     if args.device_loop and not args.mega:
         print("fsx serve: --device-loop requires --mega N|auto: each "
@@ -850,6 +863,72 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "(it hot-swaps that file when its mtime changes)",
               file=sys.stderr)
         return 1
+    # Cluster-member refusals (docs/CLUSTER.md), still jax-free.  A
+    # rank is one engine of an `fsx cluster` fleet: it owns ring
+    # shards [R*W, (R+1)*W) of the N*W-shard fan-out end-to-end and
+    # shares ONLY the gossip plane, so every structural requirement is
+    # checkable (and refused, naming its problem) before any backend
+    # boots.
+    cluster_rank = cluster_n = None
+    gossip = None
+    t0_ns = None
+    if args.cluster_rank is not None:
+        r_s, sep, n_s = args.cluster_rank.partition("/")
+        try:
+            cluster_rank, cluster_n = int(r_s), int(n_s)
+        except ValueError:
+            sep = ""
+        if not sep:
+            print(f"fsx serve: --cluster-rank wants R/N (e.g. 0/2), "
+                  f"got {args.cluster_rank!r}", file=sys.stderr)
+            return 1
+        if cluster_n < 2:
+            print(f"fsx serve: --cluster-rank {args.cluster_rank}: a "
+                  f"{cluster_n}-engine cluster is just fsx serve — "
+                  "drop the flag, or run >= 2 engines",
+                  file=sys.stderr)
+            return 1
+        if not 0 <= cluster_rank < cluster_n:
+            print(f"fsx serve: --cluster-rank {args.cluster_rank}: "
+                  f"rank must be in [0, {cluster_n})", file=sys.stderr)
+            return 1
+        if not args.ingest_workers:
+            print("fsx serve: --cluster-rank requires --ingest-workers "
+                  "W >= 1: rank R of N owns ring shards [R*W, (R+1)*W) "
+                  "of the daemon's N*W-shard IP-hash fan-out (pair "
+                  "with fsxd --shards N*W)", file=sys.stderr)
+            return 1
+        if not args.cluster_dir:
+            print("fsx serve: --cluster-rank requires --cluster-dir "
+                  "DIR: the gossip mailboxes and status blocks live "
+                  "there (fsx cluster creates them)", file=sys.stderr)
+            return 1
+        from flowsentryx_tpu.cluster import GossipPlane
+        from flowsentryx_tpu.engine.shm import RingNotReady
+
+        try:
+            gossip = GossipPlane(args.cluster_dir, cluster_rank,
+                                 cluster_n)
+        except ValueError as e:
+            # plane exists but disagrees with the flags (e.g. the
+            # stamped fleet size != N): the plane's own message names
+            # the problem better than "not initialized" would
+            print(f"fsx serve: {e}", file=sys.stderr)
+            return 1
+        except (OSError, RingNotReady) as e:
+            print(f"fsx serve: cluster dir {args.cluster_dir!r} is not "
+                  f"an initialized gossip plane: {e} (fsx cluster "
+                  "creates the mailboxes and status blocks before any "
+                  "engine boots)", file=sys.stderr)
+            return 1
+        t0_ns = gossip.status.ctl_get("c_t0")
+        if not t0_ns:
+            print("fsx serve: cluster epoch not published (status "
+                  "c_t0 == 0): every engine's device clock — and "
+                  "every gossiped blacklist `until` — must share one "
+                  "t0; boot the fleet through fsx cluster, which "
+                  "stamps it", file=sys.stderr)
+            return 1
     # Table-geometry validation, still BEFORE the JAX boot: config
     # parsing and the geometry validators (engine/table.py) are
     # jax-free, so a bad --table-capacity or an unrestorable
@@ -944,10 +1023,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # Sharded parallel ingest (flowsentryx_tpu/ingest/): N drain
             # workers front N ring shards (fsxd --shards N; N=1 fronts
             # an unsharded daemon) and hand the engine sealed batches.
+            # A cluster rank fronts only ITS contiguous span of the
+            # N*W-shard fan-out (parallel/layout.py ClusterLayout).
             from flowsentryx_tpu.ingest import ShardedIngest
 
+            span = {}
+            if cluster_rank is not None:
+                span = dict(
+                    shard_offset=cluster_rank * args.ingest_workers,
+                    total_shards=cluster_n * args.ingest_workers)
             source = ShardedIngest(args.feature_ring, args.ingest_workers,
-                                   strict=args.strict_ingest)
+                                   strict=args.strict_ingest, **span)
         else:
             source = ShmRingSource(args.feature_ring)
         sink = (
@@ -1050,12 +1136,35 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   f"{args.sim_kernel_tier!r}: {e} (generate one with "
                   "fsx distill ARTIFACT --out PLAN.npz)", file=sys.stderr)
             return 1
+    device_loop = args.device_loop
+    if device_loop == "auto":
+        # ring-depth autotuning: a short synthetic calibration drain
+        # per candidate depth, judged on the measured H2D overlap
+        # (engine.calibrate_ring_depth / fused.choose_ring_depth).
+        # One XLA compile per candidate — a boot cost, announced, paid
+        # once for a long-lived server exactly like warm().
+        from flowsentryx_tpu.engine.engine import calibrate_ring_depth
+
+        print("fsx serve: --device-loop auto: calibrating ring depth "
+              "(one short drain + XLA compile per candidate)...",
+              file=sys.stderr)
+        device_loop, detail = calibrate_ring_depth(
+            cfg, params=params, mesh=mesh, mega_n=args.mega)
+        print(f"fsx serve: --device-loop auto -> ring depth "
+              f"{device_loop} ({detail['reason']}; measured: "
+              + ", ".join(
+                  f"ring {m['ring']}: overlap "
+                  f"{m['overlap_fraction']}" for m in
+                  detail["candidates"]) + ")",
+              file=sys.stderr)
     eng = Engine(cfg, source, sink, params=params, mesh=mesh,
                  mega_n=args.mega or 0,
-                 device_loop=args.device_loop,
+                 device_loop=device_loop,
+                 t0_ns=t0_ns,
                  sink_thread=False if args.no_sink_thread else None,
                  audit=True if args.audit else None,
-                 kernel_tier=kernel_tier)
+                 kernel_tier=kernel_tier,
+                 gossip=gossip)
     if args.restore:
         eng.restore(args.restore)
     if args.artifact_reload:
@@ -1067,6 +1176,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # pay every staged compile (each ladder rung, and the deep-scan
         # ring graph) at boot, not on the first traffic backlog
         eng.warm()
+    if gossip is not None:
+        from flowsentryx_tpu.core import schema as _schema
+
+        gossip.set_state(_schema.CSTATE_SERVING)
     import contextlib
 
     if args.profile:
@@ -1120,6 +1233,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.checkpoint and not args.checkpoint_every:
         # the chunked loop's last iteration already saved this state
         eng.checkpoint(args.checkpoint)
+    if gossip is not None:
+        from flowsentryx_tpu.core import schema as _schema
+
+        gossip.set_state(_schema.CSTATE_DONE)
     if hasattr(source, "close"):
         source.close()  # stop + join the ingest worker fleet
         if rep.ingest is not None and hasattr(source, "ingest_stats"):
@@ -1130,6 +1247,184 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             rep = rep._replace(ingest=source.ingest_stats())
     print(json.dumps(rep._asdict(), indent=2))
     return 0
+
+
+def _cmd_cluster(args: argparse.Namespace) -> int:
+    """Coordinator-less multi-engine scale-out (docs/CLUSTER.md).
+
+    N full engine processes, each owning ring shards
+    ``[r*W, (r+1)*W)`` of the daemon's ``N*W``-shard IP-hash fan-out
+    end-to-end (``fsxd --shards N*W``) — its own drain workers,
+    dispatch arena, device loop and flow-table partition — sharing
+    ONLY the verdict-gossip blacklist plane.  The supervisor here is
+    pure control plane: it creates the shm plane, stamps the shared
+    t0 epoch, spawns the engines, and restarts any that die from
+    their last checkpoint (crash-fail-open: the survivors keep
+    serving, and the dead engine's blocks are already replicated).
+    """
+    # Pre-boot refusals, all jax-free, each naming its actual problem
+    # (the fsx serve fail-fast ordering).
+    if args.engines < 2:
+        print(f"fsx cluster: --engines must be >= 2 (got "
+              f"{args.engines}): a 1-engine cluster is fsx serve",
+              file=sys.stderr)
+        return 1
+    if args.shards < args.engines:
+        print(f"fsx cluster: --shards {args.shards} cannot feed "
+              f"--engines {args.engines}: every engine needs at "
+              "least one ring shard to drain (pair with fsxd "
+              "--shards N*W)", file=sys.stderr)
+        return 1
+    if args.shards % args.engines:
+        print(f"fsx cluster: --shards {args.shards} is not a multiple "
+              f"of --engines {args.engines}: each engine owns an "
+              "equal contiguous span of the ring-shard fan-out "
+              "(rank r drains shards [r*W, (r+1)*W), W = shards/"
+              "engines)", file=sys.stderr)
+        return 1
+    w = args.shards // args.engines
+    if args.checkpoint:
+        # validate by FORMATTING, not substring: '{rank:02d}' is a
+        # fine placeholder, '{host}' is a KeyError waiting to fire
+        # after the jax boot, and a rank-invariant template means N
+        # engines overwriting one file
+        try:
+            distinct = (args.checkpoint.format(rank=0)
+                        != args.checkpoint.format(rank=1))
+        except (KeyError, IndexError, ValueError) as e:
+            print(f"fsx cluster: --checkpoint {args.checkpoint!r} "
+                  f"does not format with rank= alone ({e!r}): the "
+                  "template may use only a {rank} placeholder",
+                  file=sys.stderr)
+            return 1
+        if not distinct:
+            print(f"fsx cluster: --checkpoint {args.checkpoint!r} has "
+                  "no {rank} placeholder: "
+                  + str(args.engines) + " engines "
+                  "checkpointing the same path would overwrite each "
+                  "other's flow memory (and a restart would restore "
+                  "the wrong shard's table)", file=sys.stderr)
+            return 1
+    if args.checkpoint_every < 0:
+        print("fsx cluster: --checkpoint-every must be >= 0 "
+              "(0 disables)", file=sys.stderr)
+        return 1
+    if args.checkpoint_every and not args.checkpoint:
+        print("fsx cluster: --checkpoint-every requires --checkpoint "
+              "TEMPLATE (with a {rank} placeholder)", file=sys.stderr)
+        return 1
+    if args.device_loop < 0:
+        print("fsx cluster: --device-loop must be >= 0",
+              file=sys.stderr)
+        return 1
+    if args.device_loop and not args.mega:
+        print("fsx cluster: --device-loop requires --mega N|auto "
+              "(each ring slot carries one top-rung coalescing "
+              "group)", file=sys.stderr)
+        return 1
+    if args.verdict_k is not None and args.verdict_k < 0:
+        print("fsx cluster: --verdict-k must be >= 0", file=sys.stderr)
+        return 1
+    if args.device_loop and args.verdict_k == 0:
+        print("fsx cluster: --device-loop is incompatible with "
+              "--verdict-k 0 (the ring's steady-state readback is the "
+              "per-slot compact wire)", file=sys.stderr)
+        return 1
+    if not args.feature_ring:
+        print("fsx cluster: --feature-ring BASE is required: engines "
+              f"front the daemon's ring shards (pair with fsxd "
+              f"--shards {args.shards})", file=sys.stderr)
+        return 1
+
+    import dataclasses as _dc
+
+    cfg = _load_cfg(args)
+    if args.verdict_k is not None:
+        cfg = _dc.replace(cfg, batch=_dc.replace(
+            cfg.batch, verdict_k=args.verdict_k))
+    if args.table_capacity is not None:
+        from flowsentryx_tpu.engine.table import validate_capacity
+
+        problems = validate_capacity(args.table_capacity,
+                                     cfg.batch.max_batch)
+        if problems:
+            for p in problems:
+                print(f"fsx cluster: --table-capacity: {p}",
+                      file=sys.stderr)
+            return 1
+        cfg = _dc.replace(cfg, table=_dc.replace(
+            cfg.table, capacity=args.table_capacity))
+    if cfg.table.salt == 0:
+        # one shared random salt: every engine's table (and every
+        # checkpoint) lives in the same hash universe, so operators
+        # can reason about the fleet as one table split N ways
+        import secrets
+
+        cfg = _dc.replace(cfg, table=_dc.replace(
+            cfg.table, salt=secrets.randbits(32) | 1))
+    if args.mega:
+        # mirror the serve-side compact16 probe: refuse a model the
+        # engines would refuse, once, here — not N times in N children
+        _honor_jax_platform()
+        from flowsentryx_tpu.models import get_model
+
+        if args.artifact:
+            from flowsentryx_tpu.models.registry import load_artifact
+
+            probe = load_artifact(cfg.model.name, args.artifact)
+        else:
+            probe = get_model(cfg.model.name).init()
+        if not hasattr(probe, "in_scale"):
+            print("fsx cluster: --mega requires the compact16 wire, "
+                  "but the selected model exposes no input observer; "
+                  "pass an observer-carrying artifact (e.g. "
+                  "--artifact artifacts/logreg_int8.npz) or drop "
+                  "--mega", file=sys.stderr)
+            return 1
+
+    from flowsentryx_tpu.cluster.runner import pin_core_for
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+    cluster_dir = args.cluster_dir or f"{args.feature_ring}.cluster"
+    specs = []
+    for r in range(args.engines):
+        specs.append({
+            # the per-core deployment shape (runner.pin_core_for):
+            # rank r owns core r when the fleet fits the host, with
+            # the XLA pool sized to match
+            "pin_core": pin_core_for(r, args.engines, args.pin_cores),
+            "cfg_json": cfg.to_json(),
+            "ring_base": args.feature_ring,
+            "workers": w,
+            "total_shards": args.shards,
+            "verdict_ring": (f"{args.verdict_ring}.r{r}"
+                             if args.verdict_ring else None),
+            "mega": args.mega or 0,
+            "device_loop": args.device_loop,
+            "artifact": args.artifact,
+            "checkpoint": (args.checkpoint.format(rank=r)
+                           if args.checkpoint else None),
+            "checkpoint_every": args.checkpoint_every,
+        })
+    sup = ClusterSupervisor(cluster_dir, specs,
+                            max_restarts=args.max_restarts)
+    try:
+        sup.boot()
+    except RuntimeError as e:
+        # e.g. a live fleet already owns this plane (booting over it
+        # would truncate mmaps under its serving engines)
+        print(f"fsx cluster: {e}", file=sys.stderr)
+        return 1
+    print(f"fsx cluster: {args.engines} engines x {w} worker(s), "
+          f"shards 0..{args.shards - 1}, gossip plane {cluster_dir}",
+          file=sys.stderr)
+    try:
+        agg = sup.run(max_seconds=args.seconds or None)
+    except KeyboardInterrupt:
+        sup.close()
+        agg = sup.aggregate()
+    print(json.dumps(agg, indent=2))
+    return 0 if not agg["failed_ranks"] else 1
 
 
 def _cmd_status(args: argparse.Namespace) -> int:
@@ -1562,6 +1857,22 @@ def _cmd_bench(args: argparse.Namespace) -> int:
         print(json.dumps(benchmarks.run_scaling()), flush=True)
         return 0
 
+    if args.cluster:
+        # the paced scale-out comparison (docs/CLUSTER.md §evidence):
+        # persistent warmed engines, ABAB-interleaved sealed drains vs
+        # a pre-cluster worktree, writing the "paced" half of
+        # artifacts/CLUSTER_r14.json
+        script = Path(__file__).resolve().parents[1] \
+            / "scripts" / "cluster_bench.py"
+        if not script.exists():
+            print("fsx bench --cluster requires a source checkout "
+                  f"(cluster_bench.py not found at {script})",
+                  file=sys.stderr)
+            return 1
+        cmd = [_sys.executable, str(script),
+               "--baseline-repo", args.baseline_repo]
+        return subprocess.run(cmd, cwd=script.parents[1]).returncode
+
     bench = Path(__file__).resolve().parents[1] / "bench.py"
     if not bench.exists():
         print("fsx bench requires a source checkout (bench.py not found "
@@ -1799,7 +2110,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "power-of-two group size up to 8 and dispatch "
                         "the largest the instantaneous backlog fills, "
                         "so partial backlogs amortize too")
-    s.add_argument("--device-loop", type=int, default=0, metavar="N",
+    s.add_argument("--device-loop", type=_device_loop_arg, default=0,
+                   metavar="N",
                    help="device-resident drain ring of depth N: a deep-"
                         "scan dispatch consumes N staged ring slots "
                         "(one top-rung --mega group each) per host "
@@ -1808,7 +2120,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "slots upload (double-buffered H2D) and the "
                         "pipeline worker harvests per-slot verdict "
                         "wires; requires --mega; 0 = per-group "
-                        "dispatch, the parity baseline")
+                        "dispatch, the parity baseline. 'auto' picks "
+                        "the depth from a short boot-time calibration "
+                        "drain's measured H2D overlap (one XLA compile "
+                        "per candidate, announced)")
+    s.add_argument("--cluster-rank", metavar="R/N", default=None,
+                   help="serve as engine R of an N-engine cluster "
+                        "(docs/CLUSTER.md): own ring shards "
+                        "[R*W, (R+1)*W) of the daemon's N*W-shard "
+                        "fan-out end-to-end (W = --ingest-workers) "
+                        "and gossip verdicts with the peers; requires "
+                        "--ingest-workers and --cluster-dir (fsx "
+                        "cluster is the supervised form)")
+    s.add_argument("--cluster-dir", default=None,
+                   help="cluster gossip/status plane directory "
+                        "(created by fsx cluster before any engine "
+                        "boots)")
     s.add_argument("--table-capacity", type=int, default=None,
                    metavar="N",
                    help="flow-table rows (overrides config "
@@ -1863,6 +2190,65 @@ def build_parser() -> argparse.ArgumentParser:
                         "hosts with >=3 cores, single-thread below that "
                         "(the extra thread would only contend)")
     s.set_defaults(fn=_cmd_serve)
+
+    cl = sub.add_parser(
+        "cluster",
+        help="coordinator-less multi-engine scale-out: N supervised "
+             "engine processes, each owning an IP-space shard "
+             "end-to-end, sharing only the gossip blacklist plane "
+             "(docs/CLUSTER.md)")
+    cl.add_argument("--engines", type=int, default=2, metavar="N",
+                    help="engine processes (>= 2; each owns "
+                         "shards/engines ring shards end-to-end)")
+    cl.add_argument("--shards", type=int, default=2,
+                    help="TOTAL daemon ring shards (fsxd --shards "
+                         "value); must be a multiple of --engines")
+    cl.add_argument("--config", help="JSON config file (shared)")
+    cl.add_argument("--feature-ring", default="/tmp/fsx_feature_ring",
+                    help="daemon shm feature-ring base path")
+    cl.add_argument("--verdict-ring", default=None,
+                    help="verdict-ring base path: engine r produces "
+                         "BASE.r<r> (pair with fsxd --verdict-shards "
+                         "N); omit for NullSink engines (bench)")
+    cl.add_argument("--cluster-dir", default=None,
+                    help="gossip/status plane directory (default: "
+                         "<feature-ring>.cluster)")
+    cl.add_argument("--artifact",
+                    help="trained model artifact (.npz), served by "
+                         "every engine")
+    cl.add_argument("--mega", type=_mega_arg, default=0,
+                    help="per-engine coalescing ladder (fsx serve "
+                         "--mega)")
+    cl.add_argument("--device-loop", type=int, default=0, metavar="N",
+                    help="per-engine drain-ring depth (explicit only: "
+                         "the auto calibration is a serve-boot "
+                         "feature; requires --mega)")
+    cl.add_argument("--verdict-k", type=int, default=None,
+                    help="compact verdict-wire slots (fsx serve "
+                         "--verdict-k)")
+    cl.add_argument("--table-capacity", type=int, default=None,
+                    metavar="N",
+                    help="PER-ENGINE flow-table rows (validated "
+                         "pre-boot, same refusal list as fsx serve)")
+    cl.add_argument("--seconds", type=float, default=0,
+                    help="serve for S seconds, then stop-drain every "
+                         "engine (0 = until ^C)")
+    cl.add_argument("--checkpoint", metavar="TEMPLATE",
+                    help="per-engine checkpoint path template; MUST "
+                         "contain {rank} (restarts restore from it)")
+    cl.add_argument("--checkpoint-every", type=float, default=0,
+                    help="checkpoint every S seconds while serving "
+                         "(requires --checkpoint)")
+    cl.add_argument("--max-restarts", type=int, default=2,
+                    help="crash-restarts per rank before the rank is "
+                         "declared failed (default 2)")
+    cl.add_argument("--pin-cores", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="pin rank r to core r with a matching "
+                         "1-thread XLA pool (auto: only when the "
+                         "fleet fits the host's cores; the per-core "
+                         "deployment shape, docs/CLUSTER.md)")
+    cl.set_defaults(fn=_cmd_cluster)
 
     tp = sub.add_parser("top", help="per-IP kernel table, formatted")
     tp.add_argument("--pin", default="/sys/fs/bpf/fsx",
@@ -1940,6 +2326,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="substring filter on scenario names (repeatable)")
     b.add_argument("--scaling", action="store_true",
                    help="step-time vs 1/2/4/8-device mesh at 1M-row capacity")
+    b.add_argument("--cluster", action="store_true",
+                   help="paced 2-engine-vs-single scaling comparison "
+                        "(scripts/cluster_bench.py; interleaved "
+                        "sealed-drain trials, writes the paced half of "
+                        "artifacts/CLUSTER_r14.json)")
+    b.add_argument("--baseline-repo", default="/tmp/fsx_pr9_worktree",
+                   help="pre-cluster checkout the --cluster baseline "
+                        "engine runs from (git worktree add it first)")
     b.set_defaults(fn=_cmd_bench)
 
     return p

@@ -1,0 +1,21 @@
+"""Coordinator-less multi-engine scale-out (docs/CLUSTER.md).
+
+``fsx cluster --engines N`` runs N full engine processes, each owning
+an IP-space shard end-to-end — drain workers, dispatch arena, device
+loop, flow-table partition — with NOTHING shared on the hot path.  The
+one shared plane is the blacklist: pairwise SPSC verdict-gossip
+mailboxes (``mailbox.py``) merged between dispatches (``gossip.py``),
+supervised crash-fail-open with checkpoint restarts
+(``supervisor.py`` / ``runner.py``).
+"""
+
+from flowsentryx_tpu.cluster.gossip import GossipPlane, create_plane
+from flowsentryx_tpu.cluster.mailbox import (
+    StatusBlock, VerdictMailbox, mailbox_path, status_path,
+)
+from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+__all__ = [
+    "ClusterSupervisor", "GossipPlane", "StatusBlock", "VerdictMailbox",
+    "create_plane", "mailbox_path", "status_path",
+]

@@ -1,0 +1,259 @@
+"""Engine-side verdict gossip: publish local blocks, merge peers'.
+
+The coordinator-less blacklist plane (docs/CLUSTER.md).  Each engine
+owns one :class:`GossipPlane`, which owns the engine's half of every
+pair mailbox (``mailbox.py``): N-1 TX queues it publishes to and N-1
+RX queues it merges from, plus the engine's status block.
+
+Threading contract (registered in ``sync/contracts.py``):
+
+* :meth:`publish` runs in the engine's SINK section (called from
+  ``Engine._apply_updates`` right after the local ``sink.apply``), so
+  every mailbox head cursor has exactly one writing thread;
+* :meth:`tick` runs on the DISPATCH thread (called from
+  ``Engine._reap_ready`` every loop iteration — including idle ones,
+  so a quiet engine still merges peers' blocks), so every RX tail
+  cursor has exactly one writing thread;
+* the two directions touch disjoint fields, and the merged output goes
+  to the plane's OWN sink (never the engine's — the engine sink is an
+  SPSC verdict ring whose producer is the sink section; a second
+  producer on the dispatch thread would break the cursor protocol).
+
+Merged verdicts are applied last-wins by key, the kernel map's
+overwrite-on-update semantics — and because the supervisor imposes one
+shared t0 epoch on every engine, the ``until`` an engine publishes is
+byte-identical to the ``until`` every peer enforces (test-pinned).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+from flowsentryx_tpu.cluster.mailbox import (
+    StatusBlock, VerdictMailbox, mailbox_path, status_path,
+)
+from flowsentryx_tpu.sync import tuning
+
+# NOTE: flowsentryx_tpu.engine.writeback (BlacklistUpdate,
+# decode_verdict_wire) is imported INSIDE tick() — writeback pulls
+# ops.agg which pulls jax, and this module must stay on the sub-second
+# jax-free import path: the supervisor, the tier-1 lifecycle stub and
+# the fsx serve cluster-refusal block all import it before (or
+# without) any engine boot.
+
+
+def create_plane(cluster_dir, n_engines: int, k_max: int = 64,
+                 slots: int = 256) -> None:
+    """Create every pair mailbox and status block (the SUPERVISOR —
+    or a test harness standing in for it — calls this exactly once,
+    before any engine opens the plane; engines never create shared
+    files, so two engines can never race a truncate)."""
+    if n_engines < 2:
+        raise ValueError(
+            f"a gossip plane needs >= 2 engines, got {n_engines}")
+    Path(cluster_dir).mkdir(parents=True, exist_ok=True)
+    for src in range(n_engines):
+        StatusBlock.create(status_path(cluster_dir, src), src)
+        for dst in range(n_engines):
+            if dst != src:
+                VerdictMailbox.create(
+                    mailbox_path(cluster_dir, src, dst), slots, k_max)
+    # geometry stamp, written LAST (its presence implies the files
+    # above exist): GossipPlane refuses an n_engines mismatch — an
+    # engine attaching a 3-engine plane as rank 0/2 would otherwise
+    # serve happily while silently excluding rank 2 from gossip
+    (Path(cluster_dir) / "plane.json").write_text(json.dumps(
+        {"n_engines": n_engines, "k_max": k_max, "slots": slots}))
+
+
+class GossipPlane:
+    """One engine's verdict-gossip endpoint (module docstring)."""
+
+    def __init__(self, cluster_dir, rank: int, n_engines: int,
+                 sink=None,
+                 merge_interval_s: float = tuning.GOSSIP_MERGE_INTERVAL_S):
+        if not 0 <= rank < n_engines:
+            raise ValueError(f"rank {rank} not in [0, {n_engines})")
+        meta_path = Path(cluster_dir) / "plane.json"
+        if meta_path.exists():
+            stamped = json.loads(meta_path.read_text()).get("n_engines")
+            if stamped != n_engines:
+                raise ValueError(
+                    f"gossip plane at {cluster_dir} was created for "
+                    f"{stamped} engines, attaching with {n_engines}: "
+                    "a mismatched fleet size would silently exclude "
+                    "peers from gossip")
+        self.rank = rank
+        self.n_engines = n_engines
+        #: Where MERGED peer verdicts go — the engine's second path to
+        #: its kernel tier (a per-rank verdict ring in production, a
+        #: CollectSink in tests), owned by the dispatch thread.  None =
+        #: track-only (the merged map still converges for the report).
+        self.sink = sink
+        self.merge_interval_s = merge_interval_s
+        self.status = StatusBlock(status_path(cluster_dir, rank))
+        self._tx = {
+            peer: VerdictMailbox(mailbox_path(cluster_dir, rank, peer))
+            for peer in range(n_engines) if peer != rank
+        }
+        self._rx = {
+            peer: VerdictMailbox(mailbox_path(cluster_dir, peer, rank))
+            for peer in range(n_engines) if peer != rank
+        }
+        self.k_max = next(iter(self._tx.values())).k_max
+        # -- publish-side state (engine sink section) -------------------
+        self._pub_seq = 0
+        self._published: dict[int, int] = {}   # key -> until f32 bits
+        self._tx_dropped = 0
+        self._tx_wires = 0
+        # -- merge-side state (dispatch thread) -------------------------
+        self._merged: dict[int, int] = {}      # key -> until f32 bits
+        self._rx_wires = 0
+        self._rx_seq_gaps = 0
+        self._rx_next_seq = {peer: 1 for peer in self._rx}
+        self._merge_ticks = 0
+        self._next_tick = 0.0
+
+    # -- publish side (engine sink section) ---------------------------------
+
+    def publish(self, upd: BlacklistUpdate, now: float) -> None:
+        """Republish one sink group's blacklist updates to every peer,
+        chunked into ``[2K+4]`` compact verdict wires (overflow never
+        set: a group bigger than K simply ships more wires — unlike
+        the device wire there is no fixed-size readback to protect)."""
+        n = len(upd.key)
+        if not n:
+            return
+        k = self.k_max
+        keys = np.asarray(upd.key, np.uint32)
+        untils = np.asarray(upd.until_s, np.float32)
+        self._published.update(
+            zip(keys.tolist(), untils.view(np.uint32).tolist()))
+        for lo in range(0, n, k):
+            ck = keys[lo:lo + k]
+            cu = untils[lo:lo + k]
+            wire = np.zeros(2 * k + 4, np.uint32)
+            wire[:len(ck)] = ck
+            wire[k:k + len(cu)] = cu.view(np.uint32)
+            wire[2 * k] = len(ck)
+            wire[2 * k + 3] = np.float32(now).view(np.uint32)
+            self._pub_seq += 1
+            for mbx in self._tx.values():
+                if mbx.publish(wire, self._pub_seq, len(ck)):
+                    self._tx_wires += 1
+                else:
+                    self._tx_dropped += 1
+
+    # -- merge side (dispatch thread) ---------------------------------------
+
+    def tick(self, force: bool = False) -> int:
+        """Heartbeat + merge every peer's pending wires into the local
+        blacklist view (and the plane's sink).  Throttled to the merge
+        interval — called from the engine loop every iteration, so an
+        unthrottled tick would stat N-1 mailboxes per batch.  Returns
+        the number of verdicts merged this call."""
+        t = time.monotonic()
+        if not force and t < self._next_tick:
+            return 0
+        # module NOTE: keeps the plane's import jax-free; by the first
+        # tick the serving engine has long since paid the jax import
+        from flowsentryx_tpu.engine.writeback import (
+            BlacklistUpdate, decode_verdict_wire,
+        )
+
+        self._next_tick = t + self.merge_interval_s
+        self.status.ctl_set(
+            "c_hbeat", time.clock_gettime_ns(time.CLOCK_MONOTONIC))
+        merged_k: list[np.ndarray] = []
+        merged_u: list[np.ndarray] = []
+        for peer, mbx in self._rx.items():
+            while True:
+                got = mbx.pop_wires(64)
+                if not got:
+                    break
+                for seq, wire in got:
+                    if seq != self._rx_next_seq[peer]:
+                        # a torn restart re-publishing old numbers or a
+                        # dropped-at-full gap: counted, never silent
+                        self._rx_seq_gaps += 1
+                    self._rx_next_seq[peer] = seq + 1
+                    vw = decode_verdict_wire(wire)
+                    merged_k.append(vw.key)
+                    merged_u.append(vw.until_s)
+                    self._rx_wires += 1
+        if not merged_k:
+            return 0
+        self._merge_ticks += 1
+        keys = np.concatenate(merged_k)
+        untils = np.concatenate(merged_u)
+        # last-wins by key in arrival order — the kernel map's
+        # overwrite-on-update semantics, same as CollectSink
+        self._merged.update(
+            zip(keys.tolist(),
+                untils.astype(np.float32).view(np.uint32).tolist()))
+        if self.sink is not None:
+            self.sink.apply(BlacklistUpdate(key=keys, until_s=untils))
+        return int(len(keys))
+
+    def quiesce(self, timeout_s: float, peers_quiet=None) -> None:
+        """Converge-on-shutdown drain of the RX mailboxes: force-tick
+        until they run dry (3 consecutive idle ticks) — and, when
+        ``peers_quiet`` is given, until that predicate also reports
+        every peer has stopped publishing — bounded by ``timeout_s``.
+        Bounded because a peer that serves on for minutes is a live
+        cluster, not a drain: its later blocks merge in this rank's
+        next life — and a peer that never boots can't hold us past
+        the deadline.  Runs in the merge section (it is a tick
+        loop)."""
+        idle = 0
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            idle = idle + 1 if self.tick(force=True) == 0 else 0
+            if idle >= 3 and (peers_quiet is None or peers_quiet()):
+                return
+            time.sleep(self.merge_interval_s)
+
+    def stop_requested(self) -> bool:
+        return self.status.ctl_get("c_stop") != 0
+
+    # -- lifecycle (engine runner; quiescent — no engine worker alive) ------
+
+    def set_state(self, state: int) -> None:
+        self.status.ctl_set("c_state", state)
+
+    def note_progress(self, batches: int, records: int) -> None:
+        """Progress counters for the supervisor/monitoring (between
+        run chunks — quiescent, like set_state)."""
+        self.status.ctl_set("c_batches", batches)
+        self.status.ctl_set("c_records", records)
+
+    @staticmethod
+    def _digest(d: dict[int, int]) -> str:
+        """Order-insensitive digest of a ``key -> until-bits`` map, so
+        two processes can assert byte-identical blacklist agreement
+        through a JSON report without shipping the whole map."""
+        import zlib
+
+        items = np.array(sorted(d.items()), np.uint64)
+        return f"{zlib.crc32(items.tobytes()):08x}.{len(d)}"
+
+    def report(self) -> dict:
+        return {
+            "rank": self.rank,
+            "n_engines": self.n_engines,
+            "k_max": self.k_max,
+            "merge_interval_s": self.merge_interval_s,
+            "published_sources": len(self._published),
+            "published_digest": self._digest(self._published),
+            "tx_wires": self._tx_wires,
+            "tx_dropped": self._tx_dropped,
+            "merged_sources": len(self._merged),
+            "merged_digest": self._digest(self._merged),
+            "rx_wires": self._rx_wires,
+            "rx_seq_gaps": self._rx_seq_gaps,
+            "merge_ticks": self._merge_ticks,
+        }

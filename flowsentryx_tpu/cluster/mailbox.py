@@ -1,0 +1,227 @@
+"""Cross-engine shared-memory plane: gossip mailboxes + status blocks.
+
+The cluster's one piece of shared state is the blacklist (docs/
+CLUSTER.md): every engine owns its IP-space shard end-to-end — drain
+workers, dispatch arena, device loop, flow-table partition — so the
+hot path never crosses an engine boundary.  What must cross is the
+*verdict stream*: an engine that condemns a source republishes the
+verdict to every peer so the whole cluster (and, multi-host, every
+host's XDP tier) mitigates it, and a dying engine leaves its blocks
+already replicated — crash-fail-open needs no coordinator.
+
+Two shm objects implement that, both on the :class:`~flowsentryx_tpu
+.engine.shm.ShmRing` header geometry and x86-TSO plain-store cursor
+protocol (one writer per cursor, memcpy-before-publish ordering):
+
+* :class:`VerdictMailbox` — one SPSC queue per ORDERED engine pair
+  ``src -> dst``.  Each slot carries a 4-word header (seq, entry
+  count) plus one ``[2K+4]``-word compact verdict wire in the exact
+  ``ops/fused.py`` layout, so the consumer decodes with the same
+  :func:`~flowsentryx_tpu.engine.writeback.decode_verdict_wire` the
+  sink thread uses.  A full mailbox NEVER blocks the publisher — the
+  verdict was already applied locally and to the kernel tier; the
+  drop is counted and the blacklist converges on the next publish
+  (fail-open, the posture of every other seam in this system).
+* :class:`StatusBlock` — one per engine: the supervisor <-> engine
+  lifecycle contract.  Engine-written fields (heartbeat, state,
+  progress counters) and supervisor-written fields (stop request,
+  restart generation, the shared cluster t0 epoch) live on SEPARATE
+  cache lines, each with exactly one writer side — registered and
+  AST-enforced in ``sync/contracts.py`` (``CTL_WRITERS`` /
+  ``CTL_MODULE_SIDE``), the same discipline as the sealed-batch
+  queue's control block.
+
+Everything here is numpy + mmap — no jax — so the supervisor and the
+contract checker stay on the sub-second import path.
+"""
+
+from __future__ import annotations
+
+import mmap
+from pathlib import Path
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.engine.shm import RingNotReady, _require_tso
+
+
+def mailbox_path(cluster_dir: str | Path, src: int, dst: int) -> str:
+    """The ``src -> dst`` mailbox file — the naming contract between
+    the supervisor (creator) and the two engine sides."""
+    return str(Path(cluster_dir) / f"gossip_{src}to{dst}.mbx")
+
+
+def status_path(cluster_dir: str | Path, rank: int) -> str:
+    return str(Path(cluster_dir) / f"status_r{rank}.blk")
+
+
+class VerdictMailbox:
+    """SPSC queue of compact verdict wires between one engine pair.
+
+    ``k_max`` (wire slots per payload) is baked into the file header at
+    :meth:`create` — both sides derive it from ``slot_words``, so a
+    k-mismatch between publisher and consumer is structurally
+    impossible, not merely checked.
+    """
+
+    def __init__(self, path: str | Path):
+        _require_tso()
+        self.path = Path(path)
+        with open(self.path, "r+b") as f:
+            self._mm = mmap.mmap(f.fileno(), 0)
+        hdr = np.frombuffer(self._mm, np.uint64, 3, 0)
+        if int(hdr[0]) != schema.SHM_GOSSIP_MAGIC:
+            raise RingNotReady(
+                f"gossip mailbox magic not published yet in {self.path}")
+        self.slots = int(hdr[1])
+        self.slot_words = int(hdr[2]) // 4
+        self.wire_words = self.slot_words - schema.GOSSIP_SLOT_HDR_WORDS
+        #: Verdict slots per wire (the ``[2K+4]`` layout inverted).
+        self.k_max = (self.wire_words - 4) // 2
+        self._cells = np.frombuffer(
+            self._mm, np.uint32, self.slots * self.slot_words,
+            schema.SHM_HDR_SIZE,
+        ).reshape(self.slots, self.slot_words)
+        self._head = np.frombuffer(self._mm, np.uint64, 1,
+                                   schema.SHM_HEAD_OFFSET)
+        self._tail = np.frombuffer(self._mm, np.uint64, 1,
+                                   schema.SHM_TAIL_OFFSET)
+
+    @classmethod
+    def create(cls, path: str | Path, slots: int,
+               k_max: int) -> "VerdictMailbox":
+        """Create a mailbox file (the SUPERVISOR does this for every
+        pair BEFORE any engine spawns, so neither side races a missing
+        file).  Publish protocol: geometry first, magic last."""
+        _require_tso()
+        if slots < 2 or slots & (slots - 1):
+            raise ValueError(
+                f"slots must be a power of two >= 2, got {slots}")
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        slot_bytes = (schema.GOSSIP_SLOT_HDR_WORDS + 2 * k_max + 4) * 4
+        nbytes = schema.SHM_HDR_SIZE + slots * slot_bytes
+        path = Path(path)
+        with open(path, "wb") as f:
+            f.truncate(nbytes)
+        with open(path, "r+b") as f:
+            mm = mmap.mmap(f.fileno(), 0)
+        hdr = np.frombuffer(mm, np.uint64, 3, 0)
+        hdr[1] = slots
+        hdr[2] = slot_bytes
+        hdr[0] = schema.SHM_GOSSIP_MAGIC  # publish last
+        del hdr
+        mm.close()
+        return cls(path)
+
+    # -- producer (publishing engine) side ----------------------------------
+
+    def publish(self, wire: np.ndarray, seq: int, count: int) -> bool:
+        """Copy one ``[2K+4]`` u32 verdict wire in; False when the
+        mailbox is full (the caller counts the drop and moves on — a
+        blocked publisher would let one slow peer stall every engine's
+        sink path, exactly the coordinator coupling this plane
+        exists to avoid)."""
+        h = int(self._head[0])
+        t = int(self._tail[0])
+        if h - t >= self.slots:
+            return False
+        cell = self._cells[h & (self.slots - 1)]
+        cell[0] = seq & 0xFFFFFFFF
+        cell[1] = (seq >> 32) & 0xFFFFFFFF
+        cell[2] = count
+        cell[3] = 0
+        cell[schema.GOSSIP_SLOT_HDR_WORDS:] = wire
+        self._head[0] = h + 1  # publish after the copy
+        return True
+
+    # -- consumer (merging peer) side ---------------------------------------
+
+    def pop_wires(
+        self, max_wires: int
+    ) -> list[tuple[int, np.ndarray]]:
+        """``(seq, wire u32 copy)`` of up to ``max_wires`` oldest
+        published wires, oldest first, releasing each slot as it is
+        copied out.  Wires are 528 B at K=64 — copying beats the
+        peek/release view protocol's bookkeeping here, and the copy
+        makes the returned wire safe past the producer's next
+        wraparound by construction."""
+        t = int(self._tail[0])
+        h = int(self._head[0])
+        n = min(h - t, max_wires)
+        out: list[tuple[int, np.ndarray]] = []
+        for j in range(n):
+            cell = self._cells[(t + j) & (self.slots - 1)]
+            seq = int(cell[0]) | (int(cell[1]) << 32)
+            out.append((seq, cell[schema.GOSSIP_SLOT_HDR_WORDS:].copy()))
+        if n:
+            self._tail[0] = t + n  # release after the copies
+        return out
+
+    def readable(self) -> int:
+        return int(self._head[0]) - int(self._tail[0])
+
+
+class StatusBlock:
+    """One engine's supervisor<->engine lifecycle block (module
+    docstring: one writer SIDE per field, cache-line-split by writer).
+
+    A field is its writer's LAST WORDS: nothing resets the engine line
+    when an engine dies, so a corpse still reads SERVING until its
+    replacement's first store (the SPAWNING entry stamp).  Readers
+    judge liveness from (process alive?, ``c_gen``) and treat
+    ``c_state`` as the engine's last claim — the supervisor's restart
+    logic and the smoke's restart detection both lean on this.
+    """
+
+    _CTL = {
+        "c_hbeat": schema.STATUS_HBEAT_OFFSET,
+        "c_state": schema.STATUS_STATE_OFFSET,
+        "c_batches": schema.STATUS_BATCHES_OFFSET,
+        "c_records": schema.STATUS_RECORDS_OFFSET,
+        "c_stop": schema.STATUS_STOP_OFFSET,
+        "c_gen": schema.STATUS_GEN_OFFSET,
+        "c_t0": schema.STATUS_T0_OFFSET,
+    }
+
+    def __init__(self, path: str | Path):
+        _require_tso()
+        self.path = Path(path)
+        with open(self.path, "r+b") as f:
+            self._mm = mmap.mmap(f.fileno(), 0)
+        hdr = np.frombuffer(self._mm, np.uint64, 2, 0)
+        if int(hdr[0]) != schema.SHM_STATUS_MAGIC:
+            raise RingNotReady(
+                f"status-block magic not published yet in {self.path}")
+        self.rank = int(hdr[1])
+        self._ctl = {
+            name: np.frombuffer(self._mm, np.uint64, 1, off)
+            for name, off in self._CTL.items()
+        }
+
+    @classmethod
+    def create(cls, path: str | Path, rank: int) -> "StatusBlock":
+        """Create one engine's block (supervisor, pre-spawn; fields
+        start zeroed — CSTATE 0 reads as "never booted")."""
+        _require_tso()
+        path = Path(path)
+        with open(path, "wb") as f:
+            f.truncate(schema.SHM_STATUS_SIZE)
+        with open(path, "r+b") as f:
+            mm = mmap.mmap(f.fileno(), 0)
+        hdr = np.frombuffer(mm, np.uint64, 2, 0)
+        hdr[1] = rank
+        hdr[0] = schema.SHM_STATUS_MAGIC  # publish last
+        del hdr
+        mm.close()
+        return cls(path)
+
+    # one writer side per field; plain u64 stores under TSO (the
+    # SealedBatchQueue ctl-block idiom — sync/contracts.py enforces
+    # which module side may ctl_set which field)
+    def ctl_get(self, name: str) -> int:
+        return int(self._ctl[name][0])
+
+    def ctl_set(self, name: str, value: int) -> None:
+        self._ctl[name][0] = value

@@ -1,0 +1,280 @@
+"""Cluster engine-process entrypoints.
+
+:func:`engine_main` is what one ``fsx cluster`` engine process runs: a
+full serving engine (jax, drain workers, dispatch arena, optional
+device loop) owning one IP-space shard span, wired into the gossip
+plane, honoring the supervisor's lifecycle protocol (status block
+states, heartbeats via the gossip tick, stop-drain on ``c_stop``) and
+writing its :class:`~flowsentryx_tpu.engine.engine.EngineReport` as
+JSON where the supervisor can aggregate it.
+
+:func:`stub_engine_main` is the lifecycle-protocol conformance stub:
+it speaks the SAME status-block protocol (spawning → serving →
+done/failed, heartbeats, stop, scripted crash) but boots in
+milliseconds with no jax import — the supervisor's restart machinery
+is tested against it in tier-1 without paying four engine boots, and
+the real-engine integration is proved once per verify run by
+``scripts/cluster_smoke.py``.
+
+Both run as ``multiprocessing`` spawn targets and immediately move
+into their OWN process group: the engine's drain workers inherit it,
+so the supervisor can ``killpg`` the whole tree when cleaning up a
+crashed engine — an orphaned worker left consuming a ring shard while
+its replacement boots would be a second consumer on an SPSC ring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from pathlib import Path
+
+from flowsentryx_tpu.cluster.gossip import GossipPlane
+from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
+from flowsentryx_tpu.core import schema
+
+
+def _own_process_group() -> None:
+    try:
+        os.setpgid(0, 0)
+    except OSError:
+        pass  # already a group leader, or a platform without setpgid
+
+
+def pin_core_for(rank: int, n_engines: int, mode: str = "auto",
+                 ncpu: int | None = None) -> int | None:
+    """Pinning policy (pure): which core rank ``rank`` of ``n_engines``
+    should own, or None to leave placement to the scheduler.
+
+    ``auto`` pins rank r to core r exactly when the fleet fits the
+    host (``n_engines <= ncpu``) — the per-core deployment shape
+    (FENXI-style parallel pipelines): each engine and the drain
+    workers that inherit its mask own one core, so co-scheduled
+    engines never thrash each other's XLA pools.  An oversubscribed
+    fleet is left unpinned (forcing two engines to time-slice one
+    core while another idles is strictly worse than letting the
+    scheduler balance).  ``on`` pins regardless (modulo the host);
+    ``off`` never pins.
+    """
+    ncpu = ncpu or os.cpu_count() or 1
+    if mode == "off":
+        return None
+    if mode == "auto" and n_engines > ncpu:
+        return None
+    return rank % ncpu
+
+
+def pin_to_core(core: int) -> None:
+    """Pin this engine process to ``core`` and right-size the XLA:CPU
+    intra-op pool to match.  The pool is sized from
+    ``hardware_concurrency``, which ignores the affinity mask — a
+    pinned rank would otherwise time-slice an ncpu-thread pool on its
+    single core (measured ~10-20% per-core throughput loss on the
+    sealed-drain shape).  XLA reads ``XLA_FLAGS`` at backend
+    initialization, not at import, so setting it here — before the
+    engine's first jax use — is early enough even though the spawn
+    target's module imports already pulled jax in."""
+    os.sched_setaffinity(0, {core})
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_multi_thread_eigen=false"
+          " intra_op_parallelism_threads=1").strip()
+
+
+def _wait_for_token(path: str, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"start token {path} never appeared")
+        time.sleep(0.005)
+
+
+def engine_main(spec: dict) -> int:
+    """One cluster engine process (module docstring).  ``spec`` is a
+    plain JSON-able dict assembled by the supervisor/CLI — see
+    ``supervisor.py::engine_spec`` for the fields."""
+    _own_process_group()
+    os.environ.setdefault("JAX_PLATFORMS",
+                          spec.get("jax_platform", "cpu"))
+    if spec.get("pin_core") is not None:
+        pin_to_core(spec["pin_core"])
+    plane = GossipPlane(spec["cluster_dir"], spec["rank"],
+                        spec["n_engines"])
+    plane.set_state(schema.CSTATE_SPAWNING)
+    try:
+        _serve(spec, plane)
+        plane.set_state(schema.CSTATE_DONE)
+        return 0
+    except BaseException:  # noqa: BLE001 — the crash IS the payload
+        traceback.print_exc()
+        plane.set_state(schema.CSTATE_FAILED)
+        return 1
+
+
+def _serve(spec: dict, plane: GossipPlane) -> None:
+    # jax and the engine import only here, inside the child
+    from flowsentryx_tpu.core.config import FsxConfig
+    from flowsentryx_tpu.engine import Engine, NullSink
+    from flowsentryx_tpu.ingest import ShardedIngest
+
+    rank, n = spec["rank"], spec["n_engines"]
+    w = spec["workers"]
+    cfg = FsxConfig.from_json(spec["cfg_json"])
+    source = ShardedIngest(
+        spec["ring_base"], w,
+        shard_offset=rank * w,
+        total_shards=spec["total_shards"],
+        precompact=spec.get("precompact"),
+        queue_slots=spec.get("queue_slots", 8),
+    )
+    if spec.get("verdict_ring"):
+        from flowsentryx_tpu.engine.shm import ShmVerdictSink
+
+        sink = ShmVerdictSink(spec["verdict_ring"])
+    else:
+        sink = NullSink()
+    if spec.get("gossip_ring"):
+        # multi-host deployments: merged PEER verdicts also reach this
+        # host's daemon (single-host clusters leave it unset — the
+        # peer's own verdict ring already fed the shared kernel map)
+        from flowsentryx_tpu.engine.shm import ShmVerdictSink
+
+        plane.sink = ShmVerdictSink(spec["gossip_ring"])
+    params = None
+    if spec.get("artifact"):
+        from flowsentryx_tpu.models.registry import load_artifact
+
+        params = load_artifact(cfg.model.name, spec["artifact"])
+    eng = Engine(
+        cfg, source, sink,
+        params=params,
+        t0_ns=spec["t0_ns"],
+        mega_n=spec.get("mega") or 0,
+        device_loop=spec.get("device_loop", 0),
+        gossip=plane,
+    )
+    restore_info = None
+    if spec.get("restore"):
+        restore_info = eng.restore(spec["restore"])
+    eng.warm()
+    if spec.get("ready_token"):
+        Path(spec["ready_token"]).touch()
+    if spec.get("start_token"):
+        _wait_for_token(spec["start_token"])
+    plane.set_state(schema.CSTATE_SERVING)
+
+    chunk_s = spec.get("chunk_s", 0.5)
+    ckpt = spec.get("checkpoint")
+    every = spec.get("checkpoint_every") or 0
+    max_seconds = spec.get("max_seconds")
+    max_batches = spec.get("max_batches")
+    t0 = time.perf_counter()
+    next_ckpt = time.monotonic() + every if (ckpt and every) else None
+    rep = None
+    stopped = False
+    if spec.get("drain"):
+        # drain mode (bench/smoke): the ring shards are prefilled and
+        # the fleet runs stop-to-exhaustion in ONE timed run — the
+        # sealed-drain trial shape every paced artifact uses, with no
+        # chunk-boundary overhead inside the measured wall
+        source.request_stop()
+        rep = eng.run()
+        plane.note_progress(rep.batches, rep.records)
+    else:
+        while True:
+            rep = eng.run(max_seconds=chunk_s)
+            plane.note_progress(rep.batches, rep.records)
+            if next_ckpt is not None and time.monotonic() >= next_ckpt:
+                eng.checkpoint(ckpt)
+                next_ckpt = time.monotonic() + every
+            if plane.stop_requested() and not stopped:
+                # drain-on-stop: workers empty their ring shards, the
+                # engine serves the tail, THEN we exit — the fleet's
+                # drain-on-shutdown contract, cluster-wide
+                stopped = True
+                source.request_stop()
+                rep = eng.run()
+                plane.note_progress(rep.batches, rep.records)
+                break
+            if source.exhausted():
+                break
+            if (max_seconds is not None
+                    and time.perf_counter() - t0 >= max_seconds):
+                break
+            if max_batches is not None and rep.batches >= max_batches:
+                break
+    wall = time.perf_counter() - t0
+    # Converge-on-shutdown: serving is done and the LOCAL wall is
+    # closed, but peers draining the same fleet may still be sinking
+    # their tails — stamp DRAINING (every publish this engine will
+    # ever make happened-before the store) and keep force-merging
+    # peers' wires until each peer has ALSO left SERVING and the
+    # mailboxes run dry, so co-terminating drains write byte-identical
+    # blacklist views into their reports (the smoke's convergence
+    # check).
+    plane.set_state(schema.CSTATE_DRAINING)
+    peers = {p: StatusBlock(status_path(spec["cluster_dir"], p))
+             for p in range(n) if p != rank}
+    _QUIET = (schema.CSTATE_DRAINING, schema.CSTATE_DONE,
+              schema.CSTATE_FAILED)
+    plane.quiesce(
+        spec.get("gossip_quiesce_s", 2.0),
+        peers_quiet=lambda: all(st.ctl_get("c_state") in _QUIET
+                                for st in peers.values()))
+    # re-snapshot the gossip accounting: the quiesce merges above are
+    # exactly what the report's convergence digests exist to show
+    rep = rep._replace(cluster=plane.report())
+    if ckpt:
+        eng.checkpoint(ckpt)
+    source.close()
+    rep = rep._replace(
+        wall_s=round(wall, 4),
+        records_per_s=round(rep.records / max(wall, 1e-9), 1),
+        ingest=source.ingest_stats(),
+    )
+    if spec.get("report_path"):
+        out = {
+            "rank": rank, "n_engines": n, "gen": spec.get("gen", 0),
+            "restored": restore_info,
+            "report": rep._asdict(),
+        }
+        p = Path(spec["report_path"])
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(out, indent=2) + "\n")
+
+
+def stub_engine_main(spec: dict) -> int:
+    """Lifecycle-protocol stub (module docstring): heartbeats, honors
+    stop, optionally crashes on schedule (``stub_crash_after_s``, first
+    generation only — the restart must then succeed), and records the
+    restore path the supervisor handed it, so tier-1 can prove the
+    supervision protocol in milliseconds."""
+    _own_process_group()
+    plane = GossipPlane(spec["cluster_dir"], spec["rank"],
+                        spec["n_engines"])
+    plane.set_state(schema.CSTATE_SPAWNING)
+    gen = spec.get("gen", 0)
+    crash_after = spec.get("stub_crash_after_s")
+    serve_s = spec.get("stub_serve_s", 0.5)
+    plane.set_state(schema.CSTATE_SERVING)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < serve_s:
+        plane.tick(force=True)  # heartbeat + merge, the engine cadence
+        if plane.stop_requested() and not spec.get("stub_ignore_stop"):
+            break
+        if crash_after is not None and gen == 0 \
+                and time.monotonic() - t0 >= crash_after:
+            os._exit(17)  # simulated hard death: no cleanup, no DONE
+        time.sleep(0.01)
+    if spec.get("report_path"):
+        p = Path(spec["report_path"])
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps({
+            "rank": spec["rank"], "gen": gen, "stub": True,
+            "restored": spec.get("restore"),
+            "report": {"records": 0, "batches": 0},
+        }) + "\n")
+    plane.set_state(schema.CSTATE_DONE)
+    return 0

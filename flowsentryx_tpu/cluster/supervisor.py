@@ -1,0 +1,339 @@
+"""Cluster supervisor: spawn, watch, restart — never on the data path.
+
+"Coordinator-less" is a DATA-plane property: verdict gossip is
+pairwise SPSC mailboxes, every engine owns its IP-space shard
+end-to-end, and no packet ever waits on anything cluster-wide.  The
+supervisor here is pure CONTROL plane — it creates the shm plane,
+stamps the shared t0 epoch, spawns one engine process per rank,
+watches liveness, and restarts the dead from their last checkpoint.
+Its own death changes nothing for the engines already serving; a new
+supervisor re-attaches to the same status blocks.
+
+Crash-fail-open (docs/CLUSTER.md §fail-open): when an engine dies,
+
+* its IP-space shard keeps being mitigated at the XDP tier — the
+  blocks it published are already in the kernel map (its own verdict
+  ring) and in every peer's merged view (the gossip plane), and the
+  kernel limiter stands alone for NEW flows in that span, the same
+  posture every other degradation in this system takes;
+* the supervisor ``killpg``\\s the corpse's process group first (an
+  orphaned drain worker still consuming a ring shard would be a
+  second consumer on an SPSC ring the moment the replacement boots),
+  then respawns the rank with ``gen+1`` and ``restore=`` its last
+  checkpoint, so the replacement resumes with its flow memory intact
+  (PR 8 restore/reshard machinery);
+* surviving engines never notice: their mailboxes to the dead rank
+  fill and drop (counted), their own serving is untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import signal
+import time
+from pathlib import Path
+
+from flowsentryx_tpu.cluster import gossip as gplane
+from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
+from flowsentryx_tpu.core import schema
+
+
+class ClusterSupervisor:
+    """Supervise ``len(specs)`` engine processes (module docstring).
+
+    ``specs[r]`` is the rank-r engine spec consumed by
+    :func:`~flowsentryx_tpu.cluster.runner.engine_main` (or the
+    ``entry`` override — the lifecycle stub in tier-1 tests).  The
+    supervisor fills in the lifecycle fields it owns: ``gen``,
+    ``t0_ns``, ``report_path`` and — on a restart, when the rank's
+    checkpoint exists — ``restore``.
+    """
+
+    def __init__(
+        self,
+        cluster_dir: str | Path,
+        specs: list[dict],
+        *,
+        entry=None,
+        max_restarts: int = 2,
+        heartbeat_timeout_s: float = 5.0,
+        k_max: int = 64,
+        mailbox_slots: int = 256,
+        t0_ns: int | None = None,
+    ):
+        if len(specs) < 2:
+            raise ValueError(
+                f"a cluster needs >= 2 engines, got {len(specs)} "
+                "(one engine is fsx serve)")
+        self.cluster_dir = Path(cluster_dir)
+        self.n = len(specs)
+        self.specs = specs
+        if entry is None:
+            from flowsentryx_tpu.cluster.runner import engine_main
+
+            entry = engine_main
+        self._entry = entry
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.k_max = k_max
+        self.mailbox_slots = mailbox_slots
+        self.t0_ns = t0_ns
+        self._ctx = mp.get_context("spawn")  # engines own jax + workers
+        self._procs: list[mp.process.BaseProcess | None] = [None] * self.n
+        self._status: list[StatusBlock] = []
+        self._gen = [0] * self.n
+        self.restarts = [0] * self.n
+        self._failed: set[int] = set()
+        self._done: set[int] = set()
+        self._stalled: set[int] = set()
+        self._booted = False
+        self._stop_sent = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def boot(self) -> None:
+        """Create the shm plane, stamp the epoch, spawn every rank."""
+        if self._booted:
+            raise RuntimeError("ClusterSupervisor already booted")
+        self._booted = True
+        self.cluster_dir.mkdir(parents=True, exist_ok=True)
+        self._refuse_live_plane()
+        gplane.create_plane(self.cluster_dir, self.n, k_max=self.k_max,
+                            slots=self.mailbox_slots)
+        if self.t0_ns is None:
+            # the shared epoch: every engine's device clock and every
+            # gossiped `until` is relative to this one anchor, which is
+            # what makes cross-engine untils byte-comparable
+            self.t0_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        for r in range(self.n):
+            st = StatusBlock(status_path(self.cluster_dir, r))
+            st.ctl_set("c_t0", self.t0_ns)
+            st.ctl_set("c_gen", 0)
+            self._status.append(st)
+        for r in range(self.n):
+            self._spawn(r)
+
+    def _refuse_live_plane(self) -> None:
+        """Booting over a LIVE plane must refuse: ``create_plane``
+        re-truncates every mailbox/status file, which yanks the pages
+        out from under serving engines' mmaps (SIGBUS on their next
+        publish/tick) and would attach this fleet as a SECOND consumer
+        to ring shards the orphans still drain.  A dead fleet's
+        leftover plane is fine to stomp; true supervisor re-attach is
+        a ROADMAP follow-up."""
+        now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        _LIVE = (schema.CSTATE_SPAWNING, schema.CSTATE_SERVING,
+                 schema.CSTATE_DRAINING)
+        live = []
+        for r in range(self.n):
+            p = Path(status_path(self.cluster_dir, r))
+            if not p.exists():
+                continue
+            try:
+                st = StatusBlock(p)
+                state, hb = st.ctl_get("c_state"), st.ctl_get("c_hbeat")
+            except Exception:
+                continue  # partial/corrupt leftover: not a live fleet
+            # a heartbeat FROM THE FUTURE (now_ns - hb < 0) is a stale
+            # plane from before a host reboot — CLOCK_MONOTONIC
+            # restarted under it; only a non-negative fresh age is live
+            if (state in _LIVE and hb
+                    and 0 <= now_ns - hb
+                    < 2 * self.heartbeat_timeout_s * 1e9):
+                live.append(r)
+        if live:
+            raise RuntimeError(
+                f"cluster dir {self.cluster_dir} has live engines "
+                f"(ranks {live} heartbeated within "
+                f"{2 * self.heartbeat_timeout_s:.0f}s): re-creating "
+                "the plane would truncate their mailboxes mid-serve — "
+                "stop the old fleet first, or use a fresh cluster dir")
+
+    def _spawn(self, rank: int) -> None:
+        spec = dict(self.specs[rank])
+        gen = self._gen[rank]
+        spec["rank"] = rank
+        spec["n_engines"] = self.n
+        spec["cluster_dir"] = str(self.cluster_dir)
+        spec["gen"] = gen
+        spec["t0_ns"] = self.t0_ns
+        # per-gen default; a caller-provided report_path is honored for
+        # every generation (later gens overwrite it — aggregate()'s
+        # latest-gen pick only needs the per-rank dedup)
+        spec.setdefault(
+            "report_path",
+            str(self.cluster_dir / f"report_r{rank}_g{gen}.json"))
+        if gen > 0:
+            ckpt = spec.get("checkpoint")
+            if ckpt and Path(self._ckpt_file(ckpt)).exists():
+                # resume with flow memory intact (Engine.restore; the
+                # geometry matches by construction — same spec)
+                spec["restore"] = str(self._ckpt_file(ckpt))
+        p = self._ctx.Process(target=self._entry, args=(spec,),
+                              name=f"fsx-cluster-r{rank}")
+        p.start()
+        self._procs[rank] = p
+        self._status[rank].ctl_set("c_gen", gen)
+
+    @staticmethod
+    def _ckpt_file(path: str) -> str:
+        """checkpoint.save_state normalizes suffix-less paths to .npz —
+        mirror that when probing for a restorable file."""
+        p = Path(path)
+        return str(p if p.suffix == ".npz"
+                   else p.with_suffix(p.suffix + ".npz"))
+
+    def _killpg(self, proc: mp.process.BaseProcess) -> None:
+        """Kill a dead engine's whole process group (module docstring:
+        orphaned drain workers must not outlive their engine)."""
+        if proc.pid is None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def kill(self, rank: int) -> None:
+        """Chaos hook: SIGKILL one rank's whole process group, exactly
+        the death the crash-fail-open path must absorb (the smoke and
+        the fail-open tests drive this; the next :meth:`poll` observes
+        the corpse and restarts it from its last checkpoint)."""
+        p = self._procs[rank]
+        if p is not None and p.is_alive():
+            self._killpg(p)
+            # a child killed before its setpgid makes killpg a no-op
+            # (no such group yet) — SIGKILL the process itself too, so
+            # the chaos hook's contract ("rank is dead on return") holds
+            # at every point of the child's life
+            p.kill()
+            p.join(timeout=2.0)
+
+    def poll(self) -> None:
+        """One supervision pass: liveness, heartbeat staleness,
+        restart-or-fail decisions."""
+        now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        for r in range(self.n):
+            if r in self._failed or r in self._done:
+                continue
+            p = self._procs[r]
+            st = self._status[r]
+            state = st.ctl_get("c_state")
+            if p is not None and not p.is_alive():
+                if state == schema.CSTATE_DONE:
+                    self._done.add(r)
+                    continue
+                # died without DONE: crash-fail-open — clean up the
+                # whole tree, then restart from the last checkpoint
+                self._killpg(p)
+                p.join(timeout=1.0)
+                if self.restarts[r] < self.max_restarts:
+                    self.restarts[r] += 1
+                    self._gen[r] += 1
+                    self._spawn(r)
+                else:
+                    self._failed.add(r)
+                continue
+            hb = st.ctl_get("c_hbeat")
+            if (hb and state == schema.CSTATE_SERVING
+                    and now_ns - hb > self.heartbeat_timeout_s * 1e9):
+                self._stalled.add(r)
+            else:
+                self._stalled.discard(r)
+
+    def request_stop(self) -> None:
+        """Ask every engine to drain its shard and exit (the fleet's
+        drain-on-shutdown contract, cluster-wide)."""
+        self._stop_sent = True
+        for st in self._status:
+            st.ctl_set("c_stop", 1)
+
+    def run(self, max_seconds: float | None = None,
+            poll_s: float = 0.05,
+            drain_timeout_s: float = 60.0) -> dict:
+        """Supervise until every rank is DONE (or terminally failed).
+        ``max_seconds`` bounds the SERVING phase: when it trips, the
+        supervisor requests stop-drain and waits (bounded) for the
+        tails to be served."""
+        t0 = time.monotonic()
+        deadline = None if max_seconds is None else t0 + max_seconds
+        while len(self._done) + len(self._failed) < self.n:
+            self.poll()
+            if (deadline is not None and not self._stop_sent
+                    and time.monotonic() >= deadline):
+                self.request_stop()
+                deadline = time.monotonic() + drain_timeout_s
+            elif (self._stop_sent and deadline is not None
+                    and time.monotonic() >= deadline):
+                break  # drain overran its bound: terminate below
+            time.sleep(poll_s)
+        self.close()
+        return self.aggregate()
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        if not self._stop_sent:
+            self.request_stop()
+        deadline = time.monotonic() + timeout_s
+        for r, p in enumerate(self._procs):
+            if p is None:
+                continue
+            p.join(timeout=max(0.0, deadline - time.monotonic()))
+            if p.is_alive():
+                # force-killed mid-drain: this rank did NOT finish
+                # serving its shard — it must surface in failed_ranks
+                # (and flip the CLI exit code), never read as success
+                self._killpg(p)
+                p.terminate()
+                p.join(timeout=1.0)
+                self._failed.add(r)
+            elif self._status[r].ctl_get("c_state") == schema.CSTATE_DONE:
+                self._done.add(r)
+            elif r not in self._done:
+                # exited without DONE after the terminal stop: no
+                # restart is coming, so the rank is failed, not lost
+                self._failed.add(r)
+
+    # -- reporting ----------------------------------------------------------
+
+    def aggregate(self) -> dict:
+        """Collect every generation's report JSON into one cluster
+        view: per-rank reports, totals, and the aggregate serving rate
+        (total records over the SLOWEST rank's wall — the honest
+        cluster number; a sum of rates would hide a straggler)."""
+        reports = []
+        for f in sorted(self.cluster_dir.glob("report_r*_g*.json")):
+            try:
+                reports.append(json.loads(f.read_text()))
+            except (OSError, ValueError):
+                continue
+        latest: dict[int, dict] = {}
+        for rep in reports:
+            r = rep.get("rank", -1)
+            if r not in latest or rep.get("gen", 0) >= latest[r].get(
+                    "gen", 0):
+                latest[r] = rep
+        # totals and walls BOTH come from each rank's latest
+        # generation: a rank that wrote a report and was then killed
+        # and restarted would otherwise have its records counted
+        # twice against a single (latest-gen) wall
+        total_records = sum(r["report"].get("records", 0)
+                            for r in latest.values() if "report" in r)
+        total_batches = sum(r["report"].get("batches", 0)
+                            for r in latest.values() if "report" in r)
+        walls = [r["report"].get("wall_s", 0.0)
+                 for r in latest.values() if "report" in r]
+        max_wall = max(walls) if walls else 0.0
+        return {
+            "engines": self.n,
+            "t0_ns": self.t0_ns,
+            "restarts": list(self.restarts),
+            "failed_ranks": sorted(self._failed),
+            "stalled_ranks": sorted(self._stalled),
+            "records": total_records,
+            "batches": total_batches,
+            "max_wall_s": round(max_wall, 4),
+            "aggregate_records_per_s": round(
+                total_records / max(max_wall, 1e-9), 1),
+            "reports": reports,
+        }

@@ -444,6 +444,45 @@ BATCHQ_SLOT_HDR_WORDS = 8
 WIRE_ID_RAW48 = 0
 WIRE_ID_COMPACT16 = 1
 
+# -- cluster gossip/status shm layout (flowsentryx_tpu/cluster/) ------------
+# Same 192 B header geometry and x86-TSO plain-store cursor protocol as
+# the rings above.  A gossip mailbox slot is a 4-word header (seq lo/hi,
+# entry count, reserved) followed by one [2K+4]-word compact verdict
+# wire (ops/fused.py layout — decode_verdict_wire reads it unchanged).
+
+SHM_GOSSIP_MAGIC = 0x465358474F535331   # "FSXGOSS1"
+GOSSIP_SLOT_HDR_WORDS = 4
+
+#: Per-engine cluster status block (supervisor <-> engine lifecycle).
+#: One writer side per field, cache-line-split by writer exactly like
+#: the ring cursors: ENGINE-written fields live on the 64-byte line at
+#: 64.., SUPERVISOR-written fields on the line at 128.. — so the
+#: plain-store single-writer premise holds per line, not just per
+#: field.  The writer sides are registered (and AST-enforced) in
+#: sync/contracts.py CTL_WRITERS.
+SHM_STATUS_MAGIC = 0x4653585354415431   # "FSXSTAT1"
+SHM_STATUS_SIZE = 192
+STATUS_RANK_OFFSET = 8                  # u64, creator-written geometry
+# engine-written line
+STATUS_HBEAT_OFFSET = 64                # u64 CLOCK_MONOTONIC ns
+STATUS_STATE_OFFSET = 72                # u64 CSTATE_*
+STATUS_BATCHES_OFFSET = 80              # u64 batches served (monitor)
+STATUS_RECORDS_OFFSET = 88              # u64 records served (monitor)
+# supervisor-written line
+STATUS_STOP_OFFSET = 128                # u64 drain-and-exit request
+STATUS_GEN_OFFSET = 136                 # u64 restart generation
+STATUS_T0_OFFSET = 144                  # u64 shared cluster epoch (ns)
+
+CSTATE_SPAWNING = 1
+CSTATE_SERVING = 2
+CSTATE_DONE = 3
+CSTATE_FAILED = 4
+#: Local serving finished, gossip still quiescing: the engine's LAST
+#: publish happened-before this store (TSO), so a peer that reads
+#: DRAINING + an idle mailbox has provably merged everything this
+#: engine will ever say — the co-terminating-drain convergence signal.
+CSTATE_DRAINING = 5
+
 
 def wire_id_of(wire: str) -> int:
     return WIRE_ID_COMPACT16 if wire == WIRE_COMPACT16 else WIRE_ID_RAW48

@@ -121,6 +121,10 @@ class EngineReport(NamedTuple):
     #: (``fsx status --pin``: dropped_ml / ml_pass / ml_escalated).
     #: None when no kernel tier fronts the engine.
     escalation: dict | None = None
+    #: Cluster gossip accounting (``flowsentryx_tpu/cluster/``): rank,
+    #: published/merged blacklist digests and wire/drop counters of the
+    #: coordinator-less verdict plane.  None outside cluster serving.
+    cluster: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -178,10 +182,16 @@ class Engine:
         sink_thread: bool | None = None,
         audit: bool | None = None,
         kernel_tier: Any | None = None,
+        gossip: Any | None = None,
     ):
         self.cfg = cfg
         self.source = source
         self.sink = sink
+        #: Cluster verdict-gossip plane (cluster/gossip.py GossipPlane
+        #: protocol: ``publish(upd, now)`` from the sink section,
+        #: ``tick()`` from the dispatch thread, ``report() -> dict``).
+        #: None = single-engine serving, the byte-identical baseline.
+        self.gossip = gossip
         #: Simulated kernel tier (distill.SimKernelTier protocol:
         #: ``filter(records) -> records`` + ``report() -> dict``): band-
         #: splits drained records BEFORE the batcher, exactly where the
@@ -896,6 +906,13 @@ class Engine:
         # one place the artifact watcher's throttled mtime check covers
         # inline, sealed, and ring loops alike
         self._maybe_reload_artifact()
+        if self.gossip is not None:
+            # merge peers' gossiped verdicts between dispatches (also
+            # on idle iterations — a quiet engine still mitigates what
+            # its peers condemn).  RX mailboxes + the plane's own sink
+            # are dispatch-thread-owned; the engine sink is not touched
+            # here (its producer is the sink section).
+            self.gossip.tick()
         if self._sink_active:
             self._handoff()
             self._check_sink()
@@ -1159,6 +1176,11 @@ class Engine:
         the per-batch reap hook (record-FIFO order — both sink modes
         process groups oldest-first on a single thread)."""
         self.sink.apply(upd)
+        if self.gossip is not None:
+            # republish to every peer engine RIGHT where the local
+            # sink applied — the gossip TX mailboxes' single producer
+            # is this sink section, whichever thread owns it
+            self.gossip.publish(upd, now)
         self._blocked.update(upd.key.tolist())
         self._device_now = max(self._device_now, now)
         self._sunk_batches += sum(g.n_chunks for g in group)
@@ -1999,4 +2021,72 @@ class Engine:
             readback=readback,
             dispatch=dispatch,
             escalation=escalation,
+            cluster=(self.gossip.report()
+                     if self.gossip is not None else None),
         )
+
+
+# ---------------------------------------------------------------------------
+# ring-depth autotuning (fsx serve --device-loop auto)
+# ---------------------------------------------------------------------------
+
+def calibrate_ring_depth(
+    cfg: FsxConfig,
+    params: Any | None = None,
+    mesh: Any | None = None,
+    mega_n: int | str = "auto",
+    candidates: tuple[int, ...] = (2, 4, 8),
+    batches: int = 48,
+    seed: int = 17,
+) -> tuple[int, dict]:
+    """Measure a short synthetic calibration drain at each candidate
+    ring depth and pick one (``fsx serve --device-loop auto``).
+
+    The drive half of the autotuner: for every candidate depth a
+    throwaway engine serves a deep prefilled synthetic backlog through
+    the inline ring path, and the measured
+    ``dispatch["device_loop"]`` block — H2D ``overlap_fraction`` above
+    all, the number the ring exists to maximize — feeds the pure
+    policy in :func:`flowsentryx_tpu.fused.device_loop
+    .choose_ring_depth`.  Each candidate stages its own deep-scan
+    graph, so calibration costs one XLA compile per depth — seconds,
+    paid once at the boot of a long-lived server (announced by the
+    CLI), exactly like ``warm()``.
+
+    Table/stats state never leaks into serving: every candidate runs
+    its own engine and the caller boots a FRESH engine at the chosen
+    depth.
+    """
+    from flowsentryx_tpu.engine.sources import ArraySource
+    from flowsentryx_tpu.engine.traffic import (
+        Scenario, TrafficGen, TrafficSpec,
+    )
+    from flowsentryx_tpu.engine.writeback import NullSink
+
+    recs = TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=8, n_benign_ips=24, attack_fraction=0.8,
+        seed=seed,
+    )).next_records(batches * cfg.batch.max_batch)
+    measurements: list[dict] = []
+    for d in sorted(set(int(c) for c in candidates)):
+        eng = Engine(cfg, ArraySource(np.copy(recs)), NullSink(),
+                     params=params, mesh=mesh, mega_n=mega_n,
+                     device_loop=d, sink_thread=False)
+        eng.warm()
+        t0 = time.perf_counter()
+        rep = eng.run()
+        wall = time.perf_counter() - t0
+        dl = rep.dispatch["device_loop"]
+        measurements.append({
+            "ring": d,
+            "rounds": dl["rounds"],
+            "ring_occupancy": dl["ring_occupancy"],
+            "overlap_fraction": dl["h2d"]["overlap_fraction"],
+            "records_per_s": round(rep.records / max(wall, 1e-9), 1),
+        })
+    from flowsentryx_tpu.fused.device_loop import choose_ring_depth
+
+    depth, detail = choose_ring_depth(measurements)
+    detail["calibration_batches"] = batches
+    return depth, detail

@@ -198,3 +198,60 @@ def make_sharded_compact_device_loop(
                                            donate=False, **quant)
     return wrap_device_loop(base, ring_depth, n_chunks,
                             (0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# ring-depth autotuning (fsx serve --device-loop auto)
+# ---------------------------------------------------------------------------
+
+def choose_ring_depth(measurements: list[dict],
+                      knee_fraction: float = 0.9) -> tuple[int, dict]:
+    """Pick a ring depth from short calibration-drain measurements —
+    the policy half of ``--device-loop auto`` (the drive half is
+    :func:`flowsentryx_tpu.engine.engine.calibrate_ring_depth`).
+
+    Each measurement is one candidate depth's
+    ``EngineReport.dispatch["device_loop"]`` summary:
+    ``{"ring", "overlap_fraction", "rounds", "ring_occupancy"}``.
+
+    Policy: depth buys H2D overlap (more uploads issued while a round
+    is still in flight) until the pipeline saturates; past the knee it
+    only adds in-flight arena slots, device output memory and round
+    latency (``readback_depth`` grows with ``ring * chunks``).  So:
+    the SHALLOWEST candidate whose measured ``overlap_fraction``
+    reaches ``knee_fraction`` of the best observed wins; candidates
+    whose calibration never completed a full round (``rounds == 0``)
+    measured nothing and are skipped.  If no candidate fired a round —
+    a drain too short or a backlog too shallow — the smallest
+    candidate is returned with the reason recorded, matching the
+    ring's graceful-degradation posture (a shallow ring is the safe
+    default, never a refusal: the flags were already validated
+    pre-boot).
+    """
+    detail: dict = {"candidates": measurements,
+                    "knee_fraction": knee_fraction}
+    fired = [m for m in measurements if m.get("rounds", 0) >= 1]
+    if not fired:
+        depth = min(m["ring"] for m in measurements)
+        detail["reason"] = ("no candidate completed a full round "
+                           "during calibration; defaulting shallow")
+        return depth, detail
+    best = max(m.get("overlap_fraction", 0.0) for m in fired)
+    detail["best_overlap"] = best
+    if best <= 0.0:
+        # no overlap anywhere (e.g. a single-core host where the
+        # pipeline worker never runs concurrently): depth buys nothing,
+        # keep the ring shallow
+        depth = min(m["ring"] for m in fired)
+        detail["reason"] = "no H2D overlap measured at any depth"
+        return depth, detail
+    # non-empty by construction: the best-overlap candidate always
+    # clears its own knee (knee_fraction is clamped to <= 1)
+    eligible = [m for m in fired
+                if m.get("overlap_fraction", 0.0)
+                >= min(knee_fraction, 1.0) * best]
+    m = min(eligible, key=lambda m: m["ring"])
+    detail["reason"] = (
+        f"shallowest depth within {knee_fraction:.0%} of the "
+        f"best measured overlap ({best})")
+    return m["ring"], detail

@@ -111,9 +111,26 @@ class ShardedIngest:
         spin_us: int | None = None,
         idle_us: int = 200,
         strict: bool = False,
+        shard_offset: int = 0,
+        total_shards: int | None = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
+        # Cluster-rank fronting (fsx cluster, docs/CLUSTER.md): the
+        # daemon fans over ``total_shards`` rings and THIS fleet drains
+        # the contiguous span [shard_offset, shard_offset + n_workers)
+        # — engine rank r of N owns shards [r*W, (r+1)*W), extending
+        # the ingest IP-hash partition to the whole engine.  The
+        # defaults (offset 0, total = n_workers) are the historical
+        # whole-fan-out fleet, bit-identical.
+        if total_shards is None:
+            total_shards = n_workers
+        if shard_offset < 0 or shard_offset + n_workers > total_shards:
+            raise ValueError(
+                f"shard span [{shard_offset}, {shard_offset + n_workers})"
+                f" does not fit the {total_shards}-shard fan-out")
+        self.shard_offset = int(shard_offset)
+        self.total_shards = int(total_shards)
         if spin_us is None:
             # AUTO (the Engine sink_thread=None idiom): a spinning
             # worker needs a core to burn — with fewer cores than
@@ -160,7 +177,8 @@ class ShardedIngest:
         self.strict = bool(strict)
         self._crash: WorkerCrash | None = None
         self.ring_paths = [
-            schema.shard_ring_path(self.ring_base, k, n_workers)
+            schema.shard_ring_path(self.ring_base, self.shard_offset + k,
+                                   self.total_shards)
             for k in range(n_workers)
         ]
         # ``precompact=None`` probes the shard-0 ring header (blocks

@@ -23,17 +23,31 @@ salted hash whose LOW bits pick the slot inside the owner's shard
 migrates) — lookups are shard-local BY CONSTRUCTION, and the only
 cross-device traffic is the step's two ``all_to_all`` flow routings
 plus scalar reductions (the audited collective census).
+
+The CLUSTER tier (``fsx cluster``, docs/CLUSTER.md) extends the same
+partition rule one level up: the daemon's IP-hash fan-out
+(``schema.shard_of`` over ``n_engines * workers_per_engine`` ring
+shards) assigns each ENGINE a contiguous span of ring shards, so a
+flow's records reach exactly one engine process — drain workers,
+dispatch arena, device loop and flow-table partition included — and
+no cross-engine traffic exists on the hot path.
+:class:`ClusterLayout` / :func:`cluster_rank_of` are that rule as one
+value plus its host twin (what the cluster smoke proves engine-local
+residency with, exactly as ``engine/table.py::owner_of`` does for
+table shards).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import Callable
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from flowsentryx_tpu.core.schema import GlobalStats, IpTableState
+from flowsentryx_tpu.core.schema import GlobalStats, IpTableState, shard_of
 
 #: The partition rules, first match wins.  Each entry is
 #: ``(leaf-path regex, spec builder taking the mesh's table axis)``.
@@ -85,3 +99,60 @@ def shard_table(table: IpTableState, mesh: Mesh) -> IpTableState:
     return IpTableState(*(
         jax.device_put(leaf, sharding_for(mesh, f"table.{f}"))
         for f, leaf in zip(IpTableState._fields, table)))
+
+
+# ---------------------------------------------------------------------------
+# cluster tier: the partition rule extended to whole engines
+# ---------------------------------------------------------------------------
+
+def cluster_rank_of(saddr, n_engines: int,
+                    workers_per_engine: int = 1) -> np.ndarray:
+    """Owner ENGINE of each folded source address — the host twin of
+    the cluster's end-to-end ownership rule (module docstring): the
+    daemon fans records over ``n_engines * workers_per_engine`` ring
+    shards by ``schema.shard_of``, and engine ``r`` drains the
+    contiguous span ``[r*w, (r+1)*w)``, so
+    ``rank = shard_of(saddr, n*w) // w``."""
+    return (shard_of(saddr, n_engines * workers_per_engine)
+            // np.uint32(workers_per_engine)).astype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterLayout:
+    """One engine's slice of the cluster partition, as one comparable
+    value (the :class:`~flowsentryx_tpu.engine.table.TablePlan` idiom,
+    one level up)."""
+
+    rank: int
+    n_engines: int
+    workers_per_engine: int = 1
+
+    def __post_init__(self) -> None:
+        if self.n_engines < 2:
+            raise ValueError(
+                f"a cluster layout needs >= 2 engines, got "
+                f"{self.n_engines} (one engine is fsx serve)")
+        if not 0 <= self.rank < self.n_engines:
+            raise ValueError(
+                f"cluster rank {self.rank} not in [0, {self.n_engines})")
+        if self.workers_per_engine < 1:
+            raise ValueError(
+                f"workers_per_engine must be >= 1, got "
+                f"{self.workers_per_engine}")
+
+    @property
+    def total_shards(self) -> int:
+        """Ring shards the daemon must fan over (``fsxd --shards``)."""
+        return self.n_engines * self.workers_per_engine
+
+    @property
+    def shard_span(self) -> range:
+        """The GLOBAL ring-shard indices this engine drains."""
+        lo = self.rank * self.workers_per_engine
+        return range(lo, lo + self.workers_per_engine)
+
+    def owns(self, saddr) -> np.ndarray:
+        """Bool mask: which of these sources this engine owns (what
+        the cluster smoke proves engine-local residency with)."""
+        return (cluster_rank_of(saddr, self.n_engines,
+                                self.workers_per_engine) == self.rank)

@@ -233,6 +233,84 @@ ENGINE_PLAN = ClassPlan(
             "documented",
             "bound by the caller before run() and cleared quiescent "
             "(reset_stream); read-only during serving"),
+        "gossip": FieldContract(
+            "documented",
+            "cluster verdict plane (cluster/gossip.py): the reference "
+            "is __init__-set and never rebound; its two directions "
+            "have disjoint owners — publish() runs in the sink "
+            "section (TX mailbox heads get one writing thread), "
+            "tick() on the dispatch thread (RX tails likewise) — "
+            "enforced field-by-field in GOSSIP_PLAN"),
+    },
+)
+
+GOSSIP_PLAN = ClassPlan(
+    module="flowsentryx_tpu/cluster/gossip.py",
+    cls="GossipPlane",
+    sections={
+        # publish: called from Engine._apply_updates — the engine's
+        # SINK section, single owner at a time (dispatch thread in
+        # single-thread mode, else the sink/pipeline worker).
+        "publish": ("publish",),
+        # merge: called from Engine._reap_ready — always the dispatch
+        # thread.  The two sections therefore CAN run concurrently,
+        # which is exactly why their fields are disjoint.  quiesce is
+        # the shutdown-convergence tick loop (same thread, after the
+        # local drain closed).
+        "merge": ("tick", "quiesce"),
+    },
+    quiescent=("__init__", "report", "set_state", "note_progress",
+               "stop_requested", "_digest"),
+    fields={
+        # -- publish side (engine sink section owns these) ------------
+        "_pub_seq": FieldContract(
+            "section:publish", "wire sequence counter, one publisher"),
+        "_published": FieldContract(
+            "section:publish",
+            "this engine's own blocked map (last-wins), the published "
+            "half of the convergence digest"),
+        "_tx_wires": FieldContract(
+            "section:publish", "publish accounting"),
+        "_tx_dropped": FieldContract(
+            "section:publish",
+            "full-mailbox drops: the publisher NEVER blocks — a slow "
+            "peer must not stall the sink path (fail-open)"),
+        "_tx": FieldContract(
+            "section:publish",
+            "TX mailboxes: their head cursors are single-writer "
+            "because only the publish section touches them"),
+        # -- merge side (dispatch thread owns these) ------------------
+        "_merged": FieldContract(
+            "section:merge",
+            "peers' blocked map (last-wins), the merged half of the "
+            "convergence digest"),
+        "_rx_wires": FieldContract("section:merge", "merge accounting"),
+        "_rx_seq_gaps": FieldContract(
+            "section:merge",
+            "torn-restart / dropped-publish gap detector (counted, "
+            "never silent)"),
+        "_rx_next_seq": FieldContract(
+            "section:merge", "per-peer expected sequence"),
+        "_merge_ticks": FieldContract("section:merge",
+                                      "merge accounting"),
+        "_next_tick": FieldContract(
+            "section:merge", "tick throttle clock (tuning"
+            ".GOSSIP_MERGE_INTERVAL_S)"),
+        "_rx": FieldContract(
+            "section:merge",
+            "RX mailboxes: their tail cursors are single-writer "
+            "because only the merge section touches them"),
+        # -- cross-section by protocol --------------------------------
+        "sink": FieldContract(
+            "documented",
+            "merged-verdict sink, applied only in the merge section; "
+            "rebindable only before serving (runner wiring) — the "
+            "ENGINE sink is deliberately never reachable from here"),
+        "status": FieldContract(
+            "documented",
+            "status-block wrapper: per-FIELD writer sides are the "
+            "CTL_WRITERS contract (heartbeat from the merge tick, "
+            "lifecycle fields from quiescent methods)"),
     },
 )
 
@@ -281,7 +359,8 @@ INGEST_PLAN = ClassPlan(
     },
 )
 
-REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN)
+REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN,
+                                   GOSSIP_PLAN)
 
 CURSORS: tuple[CursorPlan, ...] = (
     CursorPlan(module="flowsentryx_tpu/engine/shm.py", cls="ShmRing",
@@ -290,6 +369,13 @@ CURSORS: tuple[CursorPlan, ...] = (
                cls="SealedBatchQueue",
                producer=("produce_batch",),
                consumer=("consume_batch", "release")),
+    # cluster gossip mailbox: publish side lives in the SOURCE
+    # engine's sink section, pop side on the DEST engine's dispatch
+    # thread — one process per side, one thread per cursor
+    CursorPlan(module="flowsentryx_tpu/cluster/mailbox.py",
+               cls="VerdictMailbox",
+               producer=("publish",),
+               consumer=("pop_wires",)),
 )
 
 #: One writer side per sealed-queue control field (engine/shm.py
@@ -300,6 +386,16 @@ CTL_WRITERS: dict[str, str] = {
     "emit_drop": "worker",
     "t0": "engine", "stop": "engine", "spin_us": "engine",
     "idle_us": "engine",
+    # cluster status block (cluster/mailbox.py StatusBlock): the
+    # supervisor <-> engine lifecycle fields, cache-line-split by
+    # writer side exactly like the queue cursors.  ENGINE-written:
+    # heartbeat, lifecycle state, progress counters.
+    "c_hbeat": "cluster-engine", "c_state": "cluster-engine",
+    "c_batches": "cluster-engine", "c_records": "cluster-engine",
+    # SUPERVISOR-written: stop request, restart generation, the shared
+    # cluster t0 epoch every gossiped `until` is relative to.
+    "c_stop": "supervisor", "c_gen": "supervisor",
+    "c_t0": "supervisor",
 }
 
 #: Which side each production module writes from.  Modules not listed
@@ -308,11 +404,15 @@ CTL_WRITERS: dict[str, str] = {
 CTL_MODULE_SIDE: dict[str, str] = {
     "flowsentryx_tpu/ingest/worker.py": "worker",
     "flowsentryx_tpu/ingest/sharded.py": "engine",
+    "flowsentryx_tpu/cluster/gossip.py": "cluster-engine",
+    "flowsentryx_tpu/cluster/runner.py": "cluster-engine",
+    "flowsentryx_tpu/cluster/supervisor.py": "supervisor",
 }
 
 #: Production modules swept for ctl_set sites.
 _CTL_SCOPE = ("flowsentryx_tpu/ingest", "flowsentryx_tpu/engine",
-              "flowsentryx_tpu/fused", "flowsentryx_tpu/daemon")
+              "flowsentryx_tpu/fused", "flowsentryx_tpu/daemon",
+              "flowsentryx_tpu/cluster")
 
 
 # ---------------------------------------------------------------------------

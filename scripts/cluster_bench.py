@@ -1,0 +1,576 @@
+"""Paced cluster scaling evidence — the CLUSTER_r14 "paced" half.
+
+The scale-out headline claim (ISSUE 10 / docs/CLUSTER.md): two engine
+processes, each owning one ring shard of the IP-hash fan-out
+end-to-end, drain a sealed backlog at ≥ 1.6× the aggregate Mpps of the
+SINGLE-engine PR 9 baseline given the SAME two-shard fan-out and the
+same host — because the single engine funnels both shards through one
+dispatch thread (the measured bottleneck in every paced artifact since
+DISPATCH_r09), while the cluster gives each shard its own.
+
+Like DEVLOOP_r11, the claim is measured PER REGIME, because the two
+serving shapes bottleneck differently on a 2-vCPU host:
+
+* ``latency`` tier (batch 128, no mega coalescing — the PR 7 ring's
+  small-batch shape): per-batch dispatch overhead dominates, and the
+  single engine serializes BOTH shards' batches through its one
+  dispatch thread — exactly the bottleneck every paced artifact since
+  DISPATCH_r09 measured and the seam this cluster exists to break.
+  Replication gives each shard its own dispatch thread on its own
+  core, with the XLA pool right-sized to it (``runner.pin_to_core``
+  — without the pool fix each pinned rank time-slices an ncpu-thread
+  pool on one core and the margin drowns).  This is the HEADLINE
+  shape.
+* ``throughput`` tier (batch 256, mega-auto — the production serving
+  default): coalesced steps are big enough that XLA's intra-op pool
+  already spreads the single engine over ~1.4 of the 2 cores, so the
+  host is compute-bound and 2-engine scaling is bounded by core
+  count over pool efficiency (~2/1.4 plus the ~10-20% pinned-rank
+  margin).  Reported alongside, not headlined.
+
+Methodology (the DEVLOOP_r11 discipline, adapted to processes):
+
+* the baseline runs from a PR 9 **worktree** (``git worktree add``,
+  the commit before the cluster plane existed), so the comparison is
+  against real shipped code, not a de-configured version of today's;
+* all engine processes (1 baseline + 2 cluster ranks, one warmed
+  engine per shape each) are PERSISTENT — XLA compiles never touch a
+  trial wall;
+* trials are interleaved ABAB (config order alternates per shape per
+  trial), synchronized by file tokens, with every trial's rings
+  freshly created and prefilled by the orchestrator — this host's
+  noise swings 2-3× within minutes, so only interleaving + raw-trial
+  disclosure makes a ratio claim honest;
+* a cluster trial's aggregate rate is total records over the SLOWEST
+  rank's wall (a sum of rates would hide a straggler), both ranks
+  released by the same go token;
+* losslessness is asserted per trial per shard (records served ==
+  records produced into that shard), and the gossip plane must end
+  every trial converged: each rank's merged digest equals its peer's
+  published digest, zero RX sequence gaps.
+
+Usage:
+  python scripts/cluster_bench.py [--trials 6]
+      [--baseline-repo /tmp/fsx_pr9_worktree]
+      [--out artifacts/CLUSTER_r14.json]
+
+(The ``--role single|rank`` invocations are internal: the orchestrator
+spawns them.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (name, batch, mega_n, total_batches).  ``latency`` is the headline
+#: regime (see module docstring); ``throughput`` the disclosure.
+SHAPES = [
+    ("latency", 128, 0, 2400),
+    ("throughput", 256, "auto", 1600),
+]
+
+
+def _records(n: int, seed: int, batch: int):
+    from flowsentryx_tpu.engine.traffic import (
+        Scenario, TrafficGen, TrafficSpec,
+    )
+
+    # MANY flows, not the 8-attacker test corpus: the IP-hash fan-out
+    # splits FLOWS, so few hot sources would land one shard with most
+    # of the records and the straggler rank's wall would measure data
+    # skew, not engine scaling (observed: 89k/218k with 32 flows, and
+    # still ~7% median record skew — a direct slowest-rank-wall tax —
+    # with 64).  2048 attack flows put the binomial split noise at
+    # ~2%, the production condition the fan-out's balance rests on
+    # (millions of flows per shard).
+    return TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=2048, n_benign_ips=4096, attack_fraction=0.8,
+        seed=seed,
+    )).next_records(batch * n)
+
+
+def _cfg(batch: int):
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=batch),
+        table=dataclasses.replace(cfg.table, capacity=1 << 16),
+        limiter=dataclasses.replace(cfg.limiter, pps_threshold=200.0,
+                                    bps_threshold=1e9),
+    )
+
+
+def _wait(path: str, timeout_s: float = 900.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"sync token {path} never appeared")
+        time.sleep(0.01)
+
+
+def _ring_base(sync: str, config: str, shape: str, trial: int) -> str:
+    return os.path.join(sync, f"rings_{config}_{shape}_{trial}", "fring")
+
+
+# ---------------------------------------------------------------------------
+# runner roles (spawned by the orchestrator; --repo picks the code tree)
+# ---------------------------------------------------------------------------
+
+
+def _drain_one(eng, src, t0_ns: int, seal_timeout_s: float = 180.0):
+    """The shared trial shape: impose the epoch, let the drain workers
+    seal the WHOLE corpus (queue_slots covers every batch, so they
+    never block on the consumer and exit DONE), then time the pure
+    sealed drain stop-to-exhaustion.  Fully pre-sealing keeps the
+    Python stand-in for the daemon's compaction out of the measured
+    wall — in production that work is C at line rate — so the trial
+    measures exactly the pipeline the cluster replicates: dequeue →
+    stage → upload → dispatch → reap."""
+    from flowsentryx_tpu.core import schema
+
+    src.set_t0(t0_ns)
+    src.request_stop()
+    deadline = time.monotonic() + seal_timeout_s
+    while any(q.ctl_get("wstate") != schema.WSTATE_DONE
+              for q in src._queues):
+        if time.monotonic() > deadline:
+            raise TimeoutError("drain workers never finished sealing")
+        time.sleep(0.02)
+    tw = time.perf_counter()
+    rep = eng.run()
+    return rep, time.perf_counter() - tw
+
+
+def _queue_slots(total_batches: int) -> int:
+    """Power-of-two sealed-queue depth covering every batch a shard
+    could seal (the whole corpus in the worst skew), so pre-sealing
+    never blocks on the consumer."""
+    return 1 << (total_batches + 2).bit_length()
+
+
+def _build_engines(t0_ns: int, gossip=None) -> dict:
+    import numpy as np
+
+    from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+
+    dtype = _records(1, 0, 1).dtype
+    engines = {}
+    for name, batch, mega, _tb in SHAPES:
+        kw = {"gossip": gossip} if gossip is not None else {}
+        eng = Engine(_cfg(batch), ArraySource(np.empty(0, dtype)),
+                     CollectSink(), mega_n=mega,
+                     sink_thread=False, t0_ns=t0_ns, **kw)
+        eng.warm()
+        engines[name] = eng
+    return engines
+
+
+def run_single(args) -> int:
+    sys.path.insert(0, args.repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from flowsentryx_tpu.engine import CollectSink
+    from flowsentryx_tpu.ingest import ShardedIngest
+
+    meta = json.load(open(os.path.join(args.sync, "meta.json")))
+    t0_ns = meta["t0_ns"]
+    engines = _build_engines(t0_ns)
+    open(os.path.join(args.sync, "ready_single"), "w").write("1")
+    out = open(os.path.join(args.sync, "single.jsonl"), "w")
+    for t in range(args.trials):
+        for name, batch, mega, tb in SHAPES:
+            _wait(os.path.join(args.sync, f"go_single_{name}_{t}"))
+            src = ShardedIngest(_ring_base(args.sync, "s", name, t), 2,
+                                queue_slots=_queue_slots(tb),
+                                precompact=False)
+            sink = CollectSink()
+            eng = engines[name]
+            eng.reset_stream(src, sink, t0_ns=t0_ns)
+            try:
+                rep, wall = _drain_one(eng, src, t0_ns)
+            finally:
+                src.close()
+            print(json.dumps({
+                "trial": t, "shape": name, "records": rep.records,
+                "batches": rep.batches, "wall_s": round(wall, 4),
+                "mpps": round(rep.records / wall / 1e6, 4),
+                "blocked": len(sink.blocked),
+            }), file=out, flush=True)
+            open(os.path.join(args.sync, f"done_single_{name}_{t}"),
+                 "w").write("1")
+    return 0
+
+
+def run_rank(args) -> int:
+    sys.path.insert(0, args.repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from flowsentryx_tpu.cluster.gossip import GossipPlane
+    from flowsentryx_tpu.cluster.runner import pin_core_for, pin_to_core
+    from flowsentryx_tpu.engine import CollectSink
+    from flowsentryx_tpu.ingest import ShardedIngest
+
+    meta = json.load(open(os.path.join(args.sync, "meta.json")))
+    t0_ns = meta["t0_ns"]
+    r = args.rank
+    # the per-core deployment shape (runner.pin_core_for — what fsx
+    # cluster --pin-cores auto boots): each rank — and the drain
+    # worker it owns, which inherits the mask — is pinned to its own
+    # core with a 1-thread XLA pool to match, so two engines never
+    # thrash each other's pools.  The BASELINE is deliberately NOT
+    # pinned: it keeps the whole host, the most favorable
+    # configuration a single engine has (its XLA pool spreads over
+    # every core).
+    pin_to_core(pin_core_for(r, 2, "on"))
+    plane = GossipPlane(os.path.join(args.sync, "plane"), r, 2,
+                        sink=CollectSink())
+    engines = _build_engines(t0_ns, gossip=plane)
+    open(os.path.join(args.sync, f"ready_rank{r}"), "w").write("1")
+    out = open(os.path.join(args.sync, f"rank{r}.jsonl"), "w")
+    for t in range(args.trials):
+        for name, batch, mega, tb in SHAPES:
+            _wait(os.path.join(args.sync, f"go_cluster_{name}_{t}"))
+            src = ShardedIngest(_ring_base(args.sync, "c", name, t), 1,
+                                shard_offset=r, total_shards=2,
+                                queue_slots=_queue_slots(tb),
+                                precompact=False)
+            sink = CollectSink()
+            eng = engines[name]
+            eng.reset_stream(src, sink, t0_ns=t0_ns)
+            try:
+                rep, wall = _drain_one(eng, src, t0_ns)
+            finally:
+                src.close()
+            # local drain done; now quiesce the gossip so both ranks'
+            # digests cover everything either will ever publish this
+            # step
+            open(os.path.join(args.sync,
+                              f"drained_rank{r}_{name}_{t}"),
+                 "w").write("1")
+            _wait(os.path.join(args.sync,
+                               f"drained_rank{1 - r}_{name}_{t}"))
+            plane.quiesce(10.0)
+            g = plane.report()
+            print(json.dumps({
+                "trial": t, "shape": name, "rank": r,
+                "records": rep.records, "batches": rep.batches,
+                "wall_s": round(wall, 4),
+                "mpps": round(rep.records / wall / 1e6, 4),
+                "blocked": len(sink.blocked),
+                "published_digest": g["published_digest"],
+                "merged_digest": g["merged_digest"],
+                "rx_seq_gaps": g["rx_seq_gaps"],
+                "tx_dropped": g["tx_dropped"],
+            }), file=out, flush=True)
+            open(os.path.join(args.sync, f"done_rank{r}_{name}_{t}"),
+                 "w").write("1")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _prefill(sync: str, config: str, shape: str, trial: int,
+             recs) -> list[int]:
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine.shm import ShmRing
+
+    base = _ring_base(sync, config, shape, trial)
+    os.makedirs(os.path.dirname(base), exist_ok=True)
+    shard = schema.shard_of(recs["saddr"], 2)
+    counts = []
+    cap = 1 << max(16, int(len(recs)).bit_length())
+    for k in range(2):
+        ring = ShmRing.create(schema.shard_ring_path(base, k, 2),
+                              cap, schema.FLOW_RECORD_DTYPE)
+        part = recs[shard == k]
+        assert ring.produce(part) == len(part), f"shard {k} overflow"
+        counts.append(int(len(part)))
+    return counts
+
+
+def _summarize(trials: list[dict]) -> dict:
+    # a TRUE median (mean of the middle pair for even counts):
+    # the upper-middle order statistic would bias the headline
+    # optimistically on even trial counts
+    med = round(statistics.median(
+        t["scaling_x"] for t in trials), 3)
+    med_single = round(statistics.median(
+        t["single_mpps"] for t in trials), 4)
+    med_cluster = round(statistics.median(
+        t["cluster_agg_mpps"] for t in trials), 4)
+    s_range = [min(t["single_mpps"] for t in trials),
+               max(t["single_mpps"] for t in trials)]
+    c_range = [min(t["cluster_agg_mpps"] for t in trials),
+               max(t["cluster_agg_mpps"] for t in trials)]
+    return {
+        "median_single_mpps": med_single,
+        "median_cluster_agg_mpps": med_cluster,
+        "median_scaling_x": med,
+        "single_range_mpps": s_range,
+        "cluster_range_mpps": c_range,
+        "ranges_disjoint": c_range[0] > s_range[1],
+    }
+
+
+def orchestrate(args) -> int:
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from flowsentryx_tpu.cluster.gossip import create_plane
+
+    if not os.path.isdir(os.path.join(args.baseline_repo,
+                                      "flowsentryx_tpu")):
+        print(f"baseline repo {args.baseline_repo} is not a checkout "
+              "(git worktree add it from the pre-cluster commit first)",
+              file=sys.stderr)
+        return 2
+    sync = tempfile.mkdtemp(prefix="fsx_clbench_")
+    t_start = time.time()
+    load0 = os.getloadavg()
+    # one shared epoch for every engine in every config, like the
+    # supervisor stamps: sample trial-0's corpus for a plausible anchor
+    probe = _records(SHAPES[0][3], 100, SHAPES[0][1])
+    meta = {"t0_ns": int(probe["ts_ns"].min())}
+    json.dump(meta, open(os.path.join(sync, "meta.json"), "w"))
+    create_plane(os.path.join(sync, "plane"), 2)
+
+    common = ["--sync", sync, "--trials", str(args.trials)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "single", "--repo", args.baseline_repo] + common,
+            stderr=open(os.path.join(sync, "single.err"), "w")),
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "rank", "--rank", "0", "--repo", REPO] + common,
+            stderr=open(os.path.join(sync, "rank0.err"), "w")),
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "rank", "--rank", "1", "--repo", REPO] + common,
+            stderr=open(os.path.join(sync, "rank1.err"), "w")),
+    ]
+    try:
+        for name in ("ready_single", "ready_rank0", "ready_rank1"):
+            _wait(os.path.join(sync, name))
+        print("bench: all three engines warmed (one per shape each)",
+              flush=True)
+
+        produced: dict[str, list[list[int]]] = {
+            name: [] for name, *_ in SHAPES}
+        for t in range(args.trials):
+            for si, (name, batch, mega, tb) in enumerate(SHAPES):
+                recs = _records(tb, 100 + t * len(SHAPES) + si, batch)
+                counts_s = _prefill(sync, "s", name, t, recs)
+                counts_c = _prefill(sync, "c", name, t, recs)
+                assert counts_s == counts_c
+                produced[name].append(counts_c)
+                # alternate which config goes first per shape per
+                # trial (ABAB at the step level)
+                order = ("single", "cluster") if (t + si) % 2 == 0 \
+                    else ("cluster", "single")
+                for config in order:
+                    open(os.path.join(sync, f"go_{config}_{name}_{t}"),
+                         "w").write("1")
+                    if config == "single":
+                        _wait(os.path.join(
+                            sync, f"done_single_{name}_{t}"))
+                    else:
+                        _wait(os.path.join(
+                            sync, f"done_rank0_{name}_{t}"))
+                        _wait(os.path.join(
+                            sync, f"done_rank1_{name}_{t}"))
+                for k in range(2):
+                    shutil.rmtree(os.path.dirname(_ring_base(
+                        sync, "sc"[k], name, t)), ignore_errors=True)
+                print(f"bench: trial {t} shape {name} done "
+                      f"({order[0]} first)", flush=True)
+        for p in procs:
+            p.wait(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    single = [json.loads(ln) for ln in
+              open(os.path.join(sync, "single.jsonl"))]
+    ranks = [[json.loads(ln) for ln in
+              open(os.path.join(sync, f"rank{r}.jsonl"))]
+             for r in range(2)]
+    load1 = os.getloadavg()
+
+    failures: list[str] = []
+    by_shape: dict[str, list[dict]] = {name: [] for name, *_ in SHAPES}
+    for i in range(args.trials * len(SHAPES)):
+        s = single[i]
+        r0, r1 = ranks[0][i], ranks[1][i]
+        name, t = s["shape"], s["trial"]
+        assert (r0["shape"], r0["trial"]) == (name, t)
+        want = produced[name][t]
+        if s["records"] != sum(want):
+            failures.append(
+                f"{name} trial {t}: single served {s['records']} != "
+                f"{sum(want)} produced")
+        for r, rep in enumerate((r0, r1)):
+            if rep["records"] != want[r]:
+                failures.append(
+                    f"{name} trial {t}: rank {r} served "
+                    f"{rep['records']} != {want[r]} produced into its "
+                    f"shard")
+        for a, b in ((r0, r1), (r1, r0)):
+            if a["merged_digest"] != b["published_digest"]:
+                failures.append(
+                    f"{name} trial {t}: rank {a['rank']} merged "
+                    f"digest != peer published (gossip did not "
+                    f"converge)")
+            if a["rx_seq_gaps"]:
+                failures.append(
+                    f"{name} trial {t}: rank {a['rank']} saw "
+                    f"{a['rx_seq_gaps']} gossip seq gaps")
+        agg_wall = max(r0["wall_s"], r1["wall_s"])
+        agg_mpps = round((r0["records"] + r1["records"])
+                         / agg_wall / 1e6, 4)
+        by_shape[name].append({
+            "trial": t,
+            "order": "single-first"
+                     if (t + [n for n, *_ in SHAPES].index(name)) % 2
+                     == 0 else "cluster-first",
+            "produced_per_shard": want,
+            "single_mpps": s["mpps"], "single_wall_s": s["wall_s"],
+            "rank_mpps": [r0["mpps"], r1["mpps"]],
+            "rank_walls_s": [r0["wall_s"], r1["wall_s"]],
+            "cluster_agg_mpps": agg_mpps,
+            "scaling_x": round(agg_mpps / s["mpps"], 3),
+        })
+
+    shapes_out = {}
+    for name, batch, mega, tb in SHAPES:
+        shapes_out[name] = {
+            "config": {"batch": batch, "mega": mega,
+                       "total_batches": tb,
+                       "fully_presealed": True},
+            "headline": _summarize(by_shape[name]),
+            "trials": by_shape[name],
+        }
+    head = dict(shapes_out["latency"]["headline"])
+    head.update({
+        "shape": "latency",
+        "target_scaling_x": 1.6,
+        "meets_target": head["median_scaling_x"] >= 1.6,
+    })
+    paced = {
+        "ts": t_start,
+        "method": (
+            "Interleaved ABAB sealed-drain trials vs the single-engine "
+            "PR 9 worktree, measured PER SERVING REGIME (the "
+            "DEVLOOP_r11 discipline): three persistent engine "
+            "processes (one baseline with 2 drain workers from the "
+            "pre-cluster commit, two cluster ranks with 1 worker each "
+            "from this tree), each holding one warmed engine per "
+            "shape, released per-step by shared file tokens over "
+            "freshly prefilled 2-shard fan-outs of the same corpus. "
+            "Shapes: 'latency' (batch 128, no mega coalescing — "
+            "per-batch dispatch overhead dominates and the single "
+            "engine serializes both shards through ONE dispatch "
+            "thread, the measured bottleneck every paced artifact "
+            "since DISPATCH_r09; the regime the cluster exists for, "
+            "and the headline) and 'throughput' (batch 256, mega-auto "
+            "— each coalesced step already spreads over ~1.4 of the "
+            "2 cores via XLA's intra-op pool, so the host is "
+            "compute-bound and N-engine scaling is core-limited; "
+            "disclosed, not headlined). Cluster ranks run core-pinned "
+            "with the XLA pool right-sized to one thread "
+            "(runner.pin_to_core, what fsx cluster --pin-cores auto "
+            "boots: the per-core production shape — two unpinned "
+            "engines thrash each other's pools, and an unshrunk pool "
+            "time-slices ncpu threads on one core) while the "
+            "baseline keeps the WHOLE host, its most favorable "
+            "shape. Per-step wall = pure "
+            "sealed-drain stop-to-exhaustion (the whole corpus is "
+            "pre-sealed and the workers have exited before the clock "
+            "starts, keeping the Python stand-in for the daemon's "
+            "line-rate C compaction out of the measured wall); "
+            "cluster aggregate = total records / slowest rank wall. "
+            "Losslessness per shard, gossip digest convergence and "
+            "zero seq gaps asserted every step."),
+        "host_noise": (
+            "2-vCPU throttled container, noise swings 2-3x within "
+            "minutes (DEVLOOP_r11 finding); ABAB order alternates "
+            "per shape per trial, raw per-trial data below is the "
+            f"evidence — loadavg {load0} -> {load1}."),
+        "baseline_repo": args.baseline_repo,
+        "config": {"trials": args.trials,
+                   "shapes": {n: {"batch": b, "mega": m,
+                                  "total_batches": tb}
+                              for n, b, m, tb in SHAPES}},
+        "headline": head,
+        "shapes": shapes_out,
+        "lost_batches": 0 if not any("produced" in f
+                                     for f in failures) else None,
+        "ok": not failures,
+        "failures": failures,
+    }
+
+    try:
+        artifact = json.loads(open(args.out).read())
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["paced"] = paced
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"bench: wrote {args.out}")
+    for name in shapes_out:
+        h = shapes_out[name]["headline"]
+        print(f"bench: [{name}] median single "
+              f"{h['median_single_mpps']} Mpps, cluster agg "
+              f"{h['median_cluster_agg_mpps']} Mpps, scaling "
+              f"{h['median_scaling_x']}x")
+    print(f"bench: headline (latency tier) scaling "
+          f"{head['median_scaling_x']}x (target 1.6x "
+          f"met={head['meets_target']}, evidence ok={paced['ok']})")
+    for msg in failures:
+        print(f"bench: FAIL {msg}", file=sys.stderr)
+    shutil.rmtree(sync, ignore_errors=True)
+    return 1 if failures or not head["meets_target"] else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="orchestrate",
+                    choices=("orchestrate", "single", "rank"))
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--sync")
+    ap.add_argument("--trials", type=int, default=6)
+    ap.add_argument("--baseline-repo",
+                    default="/tmp/fsx_pr9_worktree")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "CLUSTER_r14.json"))
+    args = ap.parse_args()
+    if args.role == "single":
+        return run_single(args)
+    if args.role == "rank":
+        return run_rank(args)
+    return orchestrate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,419 @@
+"""Bounded CPU cluster smoke — the scale-out CI gate.
+
+Drives the REAL thing twice per verify run (docs/CLUSTER.md):
+
+Phase A — lossless 2-engine drain + gossip convergence: a
+:class:`~flowsentryx_tpu.cluster.supervisor.ClusterSupervisor` spawns
+two full engine processes, each owning one prefilled ring shard of the
+IP-hash fan-out end-to-end (its own drain worker, dispatch arena and
+flow-table partition).  Asserts
+
+* **lossless**: every rank serves exactly the records produced into
+  its shard span (per-rank counts, not just the total — a record
+  served by the wrong engine would also be a partition violation);
+* **engine-local residency**: every record landed on the rank
+  ``parallel/layout.py::cluster_rank_of`` says owns it (checked at
+  fill time — the fan-out and the layout are the same rule);
+* **gossip convergence**: each rank's final MERGED blacklist digest
+  equals its peer's PUBLISHED digest — byte-identical keys AND untils,
+  which the shared supervisor t0 epoch makes meaningful — with zero
+  RX sequence gaps.
+
+Phase B — crash-fail-open kill/restart cycle: two engines serve a
+LIVE trickle-fed fleet with periodic checkpoints; the smoke SIGKILLs
+rank 1's whole process group mid-serve (``ClusterSupervisor.kill``,
+the chaos hook).  Asserts the supervisor restarts the rank exactly
+once (gen 1, ``restore=`` its last checkpoint — the report records
+the restore actually happened), the SURVIVOR loses nothing (rank 0
+serves every record of its shard, keeps publishing, and still holds
+the dead engine's pre-crash blocks in its merged view), and nobody
+ends FAILED.
+
+Results merge into ``artifacts/CLUSTER_r14.json`` under ``"smoke"``
+(the ``"paced"`` scaling comparison vs the single-engine PR 9 worktree
+in the same artifact is preserved), so the cluster invariants are
+re-proved by every ``scripts/verify_tier1.sh`` run.
+
+Usage: JAX_PLATFORMS=cpu python scripts/cluster_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ENGINES = 2
+BATCH = 256
+RING_SLOTS = 1 << 15
+BOOT_TIMEOUT_S = 240
+
+
+def _records(n: int, seed: int):
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficGen, TrafficSpec
+
+    return TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=8, n_benign_ips=24, attack_fraction=0.8, seed=seed,
+    )).next_records(n)
+
+
+def _cfg_json() -> str:
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=BATCH),
+        table=dataclasses.replace(cfg.table, capacity=1 << 14),
+        limiter=dataclasses.replace(
+            cfg.limiter, pps_threshold=200.0, bps_threshold=1e9),
+    ).to_json()
+
+
+def _make_rings(base: str):
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine.shm import ShmRing
+
+    return [
+        ShmRing.create(schema.shard_ring_path(base, k, ENGINES),
+                       RING_SLOTS, schema.FLOW_RECORD_DTYPE)
+        for k in range(ENGINES)
+    ]
+
+
+def _fan_out(rings, recs) -> list[int]:
+    """The daemon's IP-hash fan-out, emulated: shard k gets the
+    records ``schema.shard_of`` routes there — which is BY THE SAME
+    RULE the span ``cluster_rank_of`` assigns engine k (w=1), the
+    engine-local-residency half of the smoke."""
+    import numpy as np
+
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.parallel.layout import cluster_rank_of
+
+    shard = schema.shard_of(recs["saddr"], ENGINES)
+    assert (shard == cluster_rank_of(recs["saddr"], ENGINES)).all(), \
+        "fan-out rule and ClusterLayout rule disagree"
+    counts = []
+    for k, ring in enumerate(rings):
+        part = recs[shard == np.uint32(k)]
+        wrote = ring.produce(part)
+        assert wrote == len(part), f"shard {k} ring overflow"
+        counts.append(int(len(part)))
+    return counts
+
+
+def _specs(base: str, cfg_json: str, **extra):
+    return [dict(cfg_json=cfg_json, ring_base=base, workers=1,
+                 total_shards=ENGINES, precompact=False,
+                 queue_slots=16, **extra)
+            for _ in range(ENGINES)]
+
+
+def _wait_counters(status, want: list[int], deadline_s: float,
+                   sup=None) -> list[int]:
+    """Poll the engine status blocks until every rank's served-record
+    counter reaches its shard's produced count (exact — the lossless
+    claim), supervising along the way."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        if sup is not None:
+            sup.poll()
+        got = [st.ctl_get("c_records") for st in status]
+        if all(g >= w for g, w in zip(got, want)):
+            return got
+        if time.monotonic() > deadline:
+            return got
+        time.sleep(0.05)
+
+
+def _phase_a(tmp: str) -> dict:
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+    base = os.path.join(tmp, "a_ring")
+    cluster_dir = os.path.join(tmp, "a_cluster")
+    recs = _records(BATCH * 80, seed=31)
+    rings = _make_rings(base)
+    counts = _fan_out(rings, recs)
+    t0_ns = int(recs["ts_ns"].min())
+
+    sup = ClusterSupervisor(
+        cluster_dir,
+        _specs(base, _cfg_json(), drain=True,
+               gossip_quiesce_s=4.0),
+        t0_ns=t0_ns, heartbeat_timeout_s=60.0)
+    sup.boot()
+    # bounded like every other smoke in verify_tier1.sh: drain-mode
+    # engines exit on exhaustion long before this; if one wedges, the
+    # serving bound trips a stop-drain whose own bound force-kills the
+    # rank into failed_ranks instead of hanging CI forever
+    agg = sup.run(max_seconds=BOOT_TIMEOUT_S * 2,
+                  drain_timeout_s=BOOT_TIMEOUT_S)
+
+    failures: list[str] = []
+    per_rank = {r["rank"]: r for r in agg["reports"]}
+    if agg["restarts"] != [0] * ENGINES:
+        # name the root cause, not just the served-0 symptom below: a
+        # rank that died mid-drain was restarted over an already
+        # part-consumed ring, so its gen-1 report cannot be lossless
+        failures.append(
+            f"phase A ranks crash-restarted (restarts="
+            f"{agg['restarts']}): the lossless-drain trial is void")
+    if sorted(per_rank) != list(range(ENGINES)):
+        failures.append(f"missing rank reports: have {sorted(per_rank)}")
+    for r, want in enumerate(counts):
+        got = per_rank.get(r, {}).get("report", {}).get("records", -1)
+        if got != want:
+            failures.append(
+                f"rank {r} served {got} != {want} records produced "
+                "into its shard (lossless drain violated)")
+    cl = {r: per_rank.get(r, {}).get("report", {}).get("cluster") or {}
+          for r in range(ENGINES)}
+    for r in range(ENGINES):
+        peer = 1 - r
+        if cl[r].get("merged_digest") != cl[peer].get("published_digest"):
+            failures.append(
+                f"rank {r} merged digest {cl[r].get('merged_digest')} "
+                f"!= rank {peer} published "
+                f"{cl[peer].get('published_digest')} (gossip did not "
+                "converge)")
+        if cl[r].get("rx_seq_gaps", -1) != 0:
+            failures.append(
+                f"rank {r} saw {cl[r].get('rx_seq_gaps')} gossip "
+                "sequence gaps in a clean drain")
+        if not cl[r].get("published_sources"):
+            failures.append(
+                f"rank {r} published no blocks — the corpus must "
+                "exercise the gossip plane on every shard")
+    if agg["failed_ranks"]:
+        failures.append(f"clean drain ended with failed ranks "
+                        f"{agg['failed_ranks']}")
+    return {
+        "records": agg["records"],
+        "per_shard_produced": counts,
+        "aggregate_records_per_s": agg["aggregate_records_per_s"],
+        "gossip": cl,
+        "failures": failures,
+    }
+
+
+def _phase_b(tmp: str) -> dict:
+    import numpy as np
+
+    from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+    from flowsentryx_tpu.core import schema
+
+    base = os.path.join(tmp, "b_ring")
+    cluster_dir = os.path.join(tmp, "b_cluster")
+    recs = _records(BATCH * 96, seed=53)
+    rings = _make_rings(base)
+    shard = schema.shard_of(recs["saddr"], ENGINES)
+    parts = [recs[shard == np.uint32(k)] for k in range(ENGINES)]
+    t0_ns = int(recs["ts_ns"].min())
+
+    sup = ClusterSupervisor(
+        cluster_dir,
+        _specs(base, _cfg_json(),
+               chunk_s=0.1, gossip_quiesce_s=4.0,
+               checkpoint=None),  # filled per-rank below
+        t0_ns=t0_ns, heartbeat_timeout_s=60.0)
+    for r, spec in enumerate(sup.specs):
+        spec["checkpoint"] = os.path.join(tmp, f"b_ckpt_r{r}.npz")
+        spec["checkpoint_every"] = 0.25
+    sup.boot()
+    status = [StatusBlock(status_path(cluster_dir, r))
+              for r in range(ENGINES)]
+
+    failures: list[str] = []
+    # trickle the daemon fan-out: a LIVE fleet, fed while we run the
+    # kill/restart cycle (prefilled-drain engines would exit before
+    # the checkpoint + kill choreography has anything to bite on).
+    # 40% of each shard is the PRE-kill budget; the rest is reserved
+    # for the outage window, so the survivor provably keeps serving
+    # fresh traffic while its peer is down — without the reserve, the
+    # whole corpus drains during the slow engine boots and the
+    # survivor-progress check has nothing to observe.
+    produced = [0, 0]
+    cursor = [0, 0]
+    pre_kill_cap = [int(0.4 * len(p)) for p in parts]
+
+    def feed(n: int, cap=None) -> None:
+        for k, ring in enumerate(rings):
+            lim = len(parts[k]) if cap is None else cap[k]
+            part = parts[k][cursor[k]:min(cursor[k] + n, lim)]
+            if len(part):
+                wrote = ring.produce(part)
+                assert wrote == len(part)
+                cursor[k] += wrote
+                produced[k] += wrote
+
+    feed(BATCH * 8, cap=pre_kill_cap)
+    # wait for rank 1 to be mid-serve with a checkpoint on disk, then
+    # SIGKILL its whole process group — the crash-fail-open drill
+    ckpt1 = sup.specs[1]["checkpoint"]
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while True:
+        sup.poll()
+        feed(BATCH, cap=pre_kill_cap)
+        if (status[1].ctl_get("c_state") == schema.CSTATE_SERVING
+                and status[1].ctl_get("c_batches") >= 2
+                and os.path.exists(ckpt1)):
+            break
+        if time.monotonic() > deadline:
+            failures.append("rank 1 never reached a killable state "
+                            "(serving + checkpointed)")
+            break
+        time.sleep(0.05)
+    r0_before = status[0].ctl_get("c_records")
+    sup.kill(1)
+    killed_at = time.monotonic()
+
+    # survivors keep serving while the corpse is replaced: the outage-
+    # window reserve flows in now, and rank 0 must make progress on it
+    # before the replacement's first serve; the shard-1 reserve lands
+    # in a ring nobody consumes until gen 1's worker attaches, so the
+    # replacement provably serves post-crash traffic too.  The corpse's
+    # status block still reads SERVING (a status field is its writer's
+    # LAST WORDS — nothing resets it at death), so gen alone can't
+    # prove the replacement booted: wait for its own SPAWNING entry
+    # stamp, the first store stale state can't fake, THEN for SERVING.
+    spawned = restarted = False
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        sup.poll()
+        feed(BATCH)
+        st1 = status[1].ctl_get("c_state")
+        if (not spawned and sup.restarts[1] >= 1
+                and status[1].ctl_get("c_gen") == 1
+                and st1 == schema.CSTATE_SPAWNING):
+            spawned = True
+        if spawned and st1 == schema.CSTATE_SERVING:
+            restarted = True
+            break
+        time.sleep(0.05)
+    if not restarted:
+        failures.append(
+            "supervisor never restarted rank 1 into SERVING at gen 1 "
+            f"(spawned={spawned})")
+    feed(len(recs))  # release any reserve remainder for the drain
+    r0_during = status[0].ctl_get("c_records")
+    if r0_during <= r0_before:
+        failures.append(
+            f"rank 0 served nothing while rank 1 was down "
+            f"({r0_before} -> {r0_during}): survivors must keep "
+            "mitigating")
+
+    # stop feeding; the survivor must drain its WHOLE shard (lossless
+    # for surviving shards) and the replacement must drain the ring
+    # tail its predecessor left
+    got = _wait_counters(status, [produced[0], 0], 120.0, sup=sup)
+    if got[0] < produced[0]:
+        failures.append(
+            f"rank 0 served {got[0]} of {produced[0]} records produced "
+            "into the surviving shard")
+    deadline = time.monotonic() + 60.0
+    while rings[1].readable() and time.monotonic() < deadline:
+        sup.poll()
+        time.sleep(0.05)
+    if rings[1].readable():
+        failures.append(
+            f"restarted rank 1 left {rings[1].readable()} records "
+            "unread in its ring shard")
+    sup.request_stop()
+    t_end = time.monotonic() + 60.0
+    while (len(sup._done) + len(sup._failed) < ENGINES
+           and time.monotonic() < t_end):
+        sup.poll()
+        time.sleep(0.05)
+    sup.close()
+    agg = sup.aggregate()
+
+    if agg["restarts"] != [0, 1]:
+        failures.append(f"restarts {agg['restarts']} != [0, 1]")
+    if agg["failed_ranks"]:
+        failures.append(f"failed ranks {agg['failed_ranks']}")
+    gen1 = [r for r in agg["reports"]
+            if r["rank"] == 1 and r.get("gen") == 1]
+    if not gen1:
+        failures.append("no gen-1 report from the restarted rank")
+    elif not gen1[0].get("restored"):
+        failures.append("restarted rank 1 did not restore from its "
+                        "checkpoint (report.restored is empty)")
+    elif not gen1[0]["report"].get("records"):
+        failures.append("restarted rank 1 served no post-crash "
+                        "records (the outage-window reserve lands in "
+                        "its ring untouched — gen 1 must drain it)")
+    rank0 = [r for r in agg["reports"] if r["rank"] == 0]
+    cl0 = (rank0[0]["report"].get("cluster") or {}) if rank0 else {}
+    if not cl0.get("merged_sources"):
+        failures.append(
+            "rank 0 merged no peer blocks — the dead engine's "
+            "pre-crash publishes must survive in the peers' views")
+    if not rank0 or not rank0[0]["report"].get("blocked_sources"):
+        failures.append("rank 0 blocked nothing — the corpus must "
+                        "exercise mitigation on the surviving shard")
+    return {
+        "records": agg["records"],
+        "produced": produced,
+        "restart_latency_s": round(time.monotonic() - killed_at, 2)
+        if restarted else None,
+        "restarts": agg["restarts"],
+        "survivor_records": got[0],
+        "gossip_rank0": cl0,
+        "failures": failures,
+    }
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="fsx_clsmoke_")
+    try:
+        a = _phase_a(tmp)
+        b = _phase_b(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    failures = [f"phase A: {m}" for m in a.pop("failures")] + \
+               [f"phase B: {m}" for m in b.pop("failures")]
+
+    smoke = {
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "engines": ENGINES,
+        "drain": a,
+        "crash_fail_open": b,
+        "ok": not failures,
+        "failures": failures,
+    }
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "CLUSTER_r14.json")
+    try:
+        artifact = json.loads(open(out_path).read())
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["smoke"] = smoke
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"cluster smoke: wrote {out_path}")
+    print(f"cluster smoke: drain records={a['records']} "
+          f"agg={a['aggregate_records_per_s']}/s; crash cycle "
+          f"restarts={b['restarts']} "
+          f"restart_latency={b['restart_latency_s']}s")
+    for msg in failures:
+        print(f"cluster smoke: FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

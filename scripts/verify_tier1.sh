@@ -118,6 +118,17 @@ echo "== dispatch smoke: single-copy staging + adaptive coalescing =="
 # the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/dispatch_smoke.py || exit 1
 
+echo "== cluster smoke: 2-engine drain + gossip + kill/restart =="
+# Bounded CPU smoke of the coordinator-less scale-out (docs/
+# CLUSTER.md): two supervised engine processes each drain their own
+# prefilled ring shard losslessly (per-rank counts), their blacklists
+# gossip-converge to byte-identical digests under the shared t0
+# epoch, and one SIGKILL'd engine is restarted from its checkpoint
+# while the survivor keeps serving — re-writing the "smoke" section
+# of artifacts/CLUSTER_r14.json (the paced 2-engine-vs-single
+# scaling evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py || exit 1
+
 echo "== device-loop smoke: drain ring + double-buffered H2D =="
 # Bounded CPU smoke of the device-resident drain ring: re-proves that
 # full deep-scan rounds fire, copies/batch stays 1.0, and H2D overlap

@@ -33,7 +33,7 @@ class TestContractsOnRepo:
         assert rep.ok, "\n".join(str(f) for f in rep.findings)
         assert rep.stats["classes"] >= 3
         assert rep.stats["registered_fields"] >= 40
-        assert rep.stats["cursor_classes"] == 2
+        assert rep.stats["cursor_classes"] == 3
         assert rep.stats["ctl_sites"] > 0
 
     def test_quick_mode_runs_same_checks(self):
@@ -47,9 +47,18 @@ class TestContractsOnRepo:
 
         declared = set(contracts.CTL_WRITERS)
         assert declared == {"hbeat", "first_ts", "t0", "stop",
-                            "wstate", "emit_drop", "spin_us", "idle_us"}
+                            "wstate", "emit_drop", "spin_us", "idle_us",
+                            # cluster status block (PR 10): engine line
+                            "c_hbeat", "c_state", "c_batches", "c_records",
+                            # supervisor line
+                            "c_stop", "c_gen", "c_t0"}
         for name in declared:
-            assert hasattr(schema, f"SHM_{name.upper()}_OFFSET")
+            if name.startswith("c_"):
+                # cluster status-block fields live in the STATUS_*
+                # layout (cluster/mailbox.py StatusBlock)
+                assert hasattr(schema, f"STATUS_{name[2:].upper()}_OFFSET")
+            else:
+                assert hasattr(schema, f"SHM_{name.upper()}_OFFSET")
 
 
 # ---------------------------------------------------------------------------
@@ -272,9 +281,8 @@ class TestCursorAndCtlViolations:
         from pathlib import Path
 
         root = Path(contracts.__file__).resolve().parents[2]
-        tree = ast.parse(
-            (root / "flowsentryx_tpu/engine/shm.py").read_text())
         for plan in contracts.CURSORS:
+            tree = ast.parse((root / plan.module).read_text())
             assert check_cursors(
                 tree, plan.module, plan) == []
 
@@ -293,6 +301,35 @@ class TestCursorAndCtlViolations:
         src = "def f(q):\n    q.ctl_set('stop', 1)\n"
         out = check_ctl(ast.parse(src), "planted.py", None)
         assert len(out) == 1 and "no declared writer side" in out[0].reason
+
+    # -- cluster plane (PR 10): planted negatives -----------------------
+
+    def test_cluster_supervisor_field_written_from_engine(self):
+        # an engine writing the supervisor-owned restart generation
+        # would forge its own restart epoch — two writers on the
+        # plain-store lifecycle line
+        src = "def f(sb):\n    sb.ctl_set('c_gen', 2)\n"
+        out = check_ctl(ast.parse(src), "planted.py", "cluster-engine")
+        assert len(out) == 1
+        assert "c_gen" in out[0].reason
+        assert "supervisor-written" in out[0].reason
+
+    def test_cluster_mailbox_tail_store_on_publish_side(self):
+        # gossip-mailbox misuse: the publisher releasing slots would
+        # let it overwrite verdict wires the peer has not merged yet
+        src = (
+            "class M:\n"
+            "    def publish(self, n):\n"
+            "        self._head[0] = n\n"
+            "        self._tail[0] = n\n"
+            "    def pop_wires(self, n):\n"
+            "        self._tail[0] = n\n")
+        out = check_cursors(ast.parse(src), "planted.py", CursorPlan(
+            module="planted.py", cls="M",
+            producer=("publish",), consumer=("pop_wires",)))
+        assert len(out) == 1
+        assert "tail cursor stored outside the consumer side" \
+            in out[0].reason
 
 
 # ---------------------------------------------------------------------------
