@@ -782,18 +782,21 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
             # summed drop-attribution block reconciles exactly against
             # the paced runs' record counts.
             eng.stats = jax.device_put(schema.make_stats())
-        from flowsentryx_tpu.benchmarks import paced_latency_run
+        from flowsentryx_tpu.benchmarks import (
+            paced_latency_run, summarize_latencies,
+        )
 
         lats, wall, erep = paced_latency_run(eng, src, readback_depth=depth)
         if not len(lats):
             return None
-        a = lats * 1e3
         rec = {
             "batch": bsz, "depth": depth, "load_mpps": load,
-            "n": len(lats),
-            "p50_ms": round(float(np.percentile(a, 50)), 3),
-            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            **summarize_latencies(lats),
             "achieved_mpps": round(len(lats) / wall / 1e6, 4),
+            # the engine's own in-band seal->verdict measurement (HDR
+            # plane, ISSUE 11) — cross-checks the hook-measured
+            # percentiles above
+            "engine_latency": erep.latency,
             # consumed == reaped (lats), not merely released by the
             # source: a run stopped by the wall cap can leave a batcher
             # residue that was offered but never classified.
@@ -842,6 +845,58 @@ def phase_latency(side: Sidecar, deadline_rel: float) -> dict:
         if time.perf_counter() + 20 > deadline:
             break
         run_paced(bsz, depth, max(round(0.5 * a, 4), 1e-4), auto=True)
+
+    # -- 5. pulse-wave SLO tier (ISSUE 11): the adversarial load the
+    # latency-budget mode exists for.  One pulse stream (mean rate
+    # modest, bursts at 1/duty x the mean, period a few batcher
+    # deadlines) served twice through mega-auto engines — throughput-
+    # tuned (--slo-us 0) vs budget-bounded — reporting the per-record
+    # percentiles AND the engine's own latency block for both.  The
+    # same-build A/B of artifacts/LATENCY_r15.json's paced half.
+    from flowsentryx_tpu.benchmarks import (
+        paced_latency_run, summarize_latencies,
+    )
+
+    result["pulse"] = []
+    pulse_rate = (0.02 if small else 0.25) * 1e6
+    pulse_kw = dict(burst_period_s=0.008, duty_cycle=0.25)
+    pulse_b = sizes[0]
+    slo_us = 4000 if small else 2000
+    for slo in (0, slo_us):
+        if time.perf_counter() + 30 > deadline:
+            log("pulse tier: deadline reached; skipping")
+            break
+        cfg = FsxConfig(
+            table=TableConfig(capacity=TABLE_CAP),
+            batch=BatchConfig(max_batch=pulse_b, deadline_us=200),
+        )
+        total = int(max(min(pulse_rate * 2.0, 2e6), 1))
+        src = PacedSource(pool, rate_pps=pulse_rate, total=total,
+                          **pulse_kw)
+        eng = Engine(cfg, src, NullSink(), params=params, donate=None,
+                     readback_depth=2, wire=schema.WIRE_COMPACT16,
+                     mega_n="auto", slo_us=slo)
+        eng.warm()  # compiles every rung; seeds the SLO EWMA table
+        eng.stats = jax.device_put(schema.make_stats())
+        lats, wall, erep = paced_latency_run(eng, src, readback_depth=2)
+        if not len(lats):
+            # the grid path's guard, mirrored: a throttle-stalled run
+            # that reaped nothing is a void trial, not a percentile row
+            log(f"pulse slo={slo}us: no records reaped (trial void)")
+            continue
+        rec = {
+            "slo_us": slo, "batch": pulse_b,
+            "load_mpps": round(pulse_rate / 1e6, 3), **pulse_kw,
+            **summarize_latencies(lats),
+            "achieved_mpps": round(len(lats) / max(wall, 1e-9) / 1e6, 4),
+            "engine_latency": erep.latency,
+            "dispatch_slo": erep.dispatch.get("slo"),
+            "group_hist": erep.dispatch["group_hist"],
+        }
+        result["pulse"].append(rec)
+        side.emit("pulse", **rec)
+        log(f"pulse slo={slo}us: p50={rec.get('p50_ms')} "
+            f"p99={rec.get('p99_ms')} ({rec.get('n', 0)} recs)")
 
     # Cumulative verdict stats across the paced engine runs (the
     # drop-attribution block prior rounds' evidence files carry).
@@ -904,6 +959,8 @@ def _recover_sidecar(path: str) -> dict | None:
             out["micro"] = rec
         elif kind == "paced":
             out.setdefault("paced", []).append(rec)
+        elif kind == "pulse":
+            out.setdefault("pulse", []).append(rec)
         elif kind in ("device", "compile", "sync_floor", "lat_partial"):
             out.update(rec)
     if last_result is not None:

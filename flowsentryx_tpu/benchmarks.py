@@ -209,14 +209,19 @@ def paced_latency_run(eng, src, readback_depth=None, max_seconds=6.0):
     """Open-loop paced run through a PRE-COMPILED engine.
 
     The one copy of the per-record latency measurement methodology
-    (``bench.py`` phase_latency and ``scripts/paced_profile.py`` both
-    call it): rebind the stream, attach the reap hook that pairs each
-    sunk record with its scheduled arrival, run, return
-    ``(lats_s ndarray, wall_s, EngineReport)`` — the report carries the
-    run's ``readback`` block (D2H bytes/batch, compact vs fallback sink
-    counts, sink-thread occupancy).  The caller compiles the engine
-    outside the paced clock (the open-loop clock starts at the first
-    poll, so XLA compile inside the run would read as queueing)."""
+    (``bench.py`` phase_latency — fixed-load grid AND pulse tier —
+    and ``scripts/paced_profile.py`` all call it): rebind the stream,
+    attach the reap hook that pairs each sunk record with its
+    scheduled arrival, run, return ``(lats_s ndarray, wall_s,
+    EngineReport)``.  The report carries the run's ``readback`` block
+    and, since the seal-timestamp plane landed (ISSUE 11), the
+    engine's OWN ``latency`` block — the always-on HDR seal→verdict
+    histogram with stage decomposition — so callers can cross-check
+    the hook-measured arrival→sunk percentiles
+    (:func:`summarize_latencies`) against the engine's in-band
+    measurement.  The caller compiles the engine outside the paced
+    clock (the open-loop clock starts at the first poll, so XLA
+    compile inside the run would read as queueing)."""
     eng.reset_stream(src, readback_depth=readback_depth)
     lats: list = []
     eng.on_reap = lambda n, t, s=src, l=lats: l.extend(
@@ -225,6 +230,25 @@ def paced_latency_run(eng, src, readback_depth=None, max_seconds=6.0):
     rep = eng.run(max_seconds=max_seconds)
     wall = time.perf_counter() - t0
     return np.asarray(lats), wall, rep
+
+
+def summarize_latencies(lats_s) -> dict:
+    """Percentile summary (ms) of a :func:`paced_latency_run` latency
+    array — the one copy of the reporting half of the methodology;
+    every consumer (bench.py grid + pulse tier, paced_profile rows)
+    previously open-coded its own ``np.percentile`` subset, which is
+    exactly how p90 existed in one report and not another."""
+    a = np.asarray(lats_s, np.float64) * 1e3
+    if not len(a):
+        return {"n": 0}
+    return {
+        "n": int(len(a)),
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p90_ms": round(float(np.percentile(a, 90)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "p999_ms": round(float(np.percentile(a, 99.9)), 3),
+        "max_ms": round(float(a.max()), 3),
+    }
 
 
 def run_scaling(

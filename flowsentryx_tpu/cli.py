@@ -824,6 +824,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("fsx serve: --verdict-k must be >= 0 (0 disables the "
               "compact verdict wire)", file=sys.stderr)
         return 1
+    if args.slo_us < 0:
+        print("fsx serve: --slo-us must be >= 0 (0 = throughput-tuned "
+              "serving, no latency budget)", file=sys.stderr)
+        return 1
     if args.sim_kernel_tier and args.ingest_workers:
         print("fsx serve: --sim-kernel-tier needs the inline record "
               "path; sealed-batch ingest bypasses the record stream "
@@ -1164,7 +1168,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  sink_thread=False if args.no_sink_thread else None,
                  audit=True if args.audit else None,
                  kernel_tier=kernel_tier,
-                 gossip=gossip)
+                 gossip=gossip,
+                 slo_us=args.slo_us)
     if args.restore:
         eng.restore(args.restore)
     if args.artifact_reload:
@@ -1172,9 +1177,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # mid-serve on mtime change (Engine.watch_artifact; the
         # distill --pin push, brought to the TPU tier)
         eng.watch_artifact(args.artifact)
-    if args.mega:
+    if args.mega or args.slo_us:
         # pay every staged compile (each ladder rung, and the deep-scan
-        # ring graph) at boot, not on the first traffic backlog
+        # ring graph) at boot, not on the first traffic backlog; SLO
+        # mode additionally needs warm()'s timed pass to seed the
+        # per-rung step-time EWMA the budget policy reads
         eng.warm()
     if gossip is not None:
         from flowsentryx_tpu.core import schema as _schema
@@ -1330,6 +1337,9 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
               "--verdict-k 0 (the ring's steady-state readback is the "
               "per-slot compact wire)", file=sys.stderr)
         return 1
+    if args.slo_us < 0:
+        print("fsx cluster: --slo-us must be >= 0", file=sys.stderr)
+        return 1
     if not args.feature_ring:
         print("fsx cluster: --feature-ring BASE is required: engines "
               f"front the daemon's ring shards (pair with fsxd "
@@ -1401,6 +1411,7 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
                              if args.verdict_ring else None),
             "mega": args.mega or 0,
             "device_loop": args.device_loop,
+            "slo_us": args.slo_us,
             "artifact": args.artifact,
             "checkpoint": (args.checkpoint.format(rank=r)
                            if args.checkpoint else None),
@@ -1425,6 +1436,59 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
         agg = sup.aggregate()
     print(json.dumps(agg, indent=2))
     return 0 if not agg["failed_ranks"] else 1
+
+
+def _merged_latency(globs: list[str]) -> dict:
+    """Merge the ``latency`` blocks of engine-report JSONs (``fsx
+    serve`` output, or a cluster dir's per-rank ``report_r*_g*.json``
+    wrappers) into ONE seal→verdict percentile view — the HDR bucket
+    counts are mergeable by construction (engine/metrics.py), which is
+    the whole reason the report carries them.  Shared by ``fsx status
+    --engine-report`` and ``fsx monitor --engine-report``; jax-free."""
+    import glob as _glob
+
+    from flowsentryx_tpu.engine.metrics import LatencyHist
+
+    merged = LatencyHist()
+    sources = []
+    per_report = {}
+    seen: set[str] = set()
+    for pat in globs:
+        for path in sorted(_glob.glob(pat)) or [pat]:
+            # overlapping globs (the flag is repeatable) must not
+            # double-merge a report — n would inflate and every
+            # percentile would skew toward the duplicated rank
+            key = os.path.realpath(path)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                doc = json.loads(Path(path).read_text())
+            except (OSError, ValueError) as e:
+                per_report[path] = {"error": str(e)}
+                continue
+            lat = (doc.get("latency")
+                   or doc.get("report", {}).get("latency"))
+            if not lat or not lat.get("hist"):
+                per_report[path] = {"error": "no latency block"}
+                continue
+            try:
+                h = LatencyHist.from_counts(lat["hist"])
+            except ValueError as e:
+                per_report[path] = {"error": str(e)}
+                continue
+            merged.merge(h)
+            sources.append(path)
+            sv = lat.get("seal_to_verdict") or {}
+            per_report[path] = {
+                "n": sv.get("n", 0),
+                "p99_us": sv.get("p99"),
+            }
+    return {
+        "reports_merged": len(sources),
+        "per_report": per_report,
+        "seal_to_verdict_us": merged.to_dict(),
+    }
 
 
 def _cmd_status(args: argparse.Namespace) -> int:
@@ -1461,6 +1525,12 @@ def _cmd_status(args: argparse.Namespace) -> int:
         # live kernel counters off the pinned maps (the reference's
         # planned "display network statistics", README.md:143-146)
         out["kernel"] = _read_kernel(args.pin)
+    if args.engine_report:
+        # engine-side seal->verdict latency: the report JSON is the
+        # interface (the kernel maps can't carry it — it's a host/TPU
+        # pipeline property), merged across however many engines the
+        # glob names via the HDR bucket counts
+        out["latency"] = _merged_latency(args.engine_report)
     print(json.dumps(out, indent=2))
     return 0
 
@@ -1532,6 +1602,14 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
     the log store and the alert source."""
     import time as _time
 
+    if args.alert_p99_us and not args.engine_report:
+        # the latency alert is evaluated off the merged engine-report
+        # block; without a report source it would silently never fire
+        # — refuse up front, the fsx serve/cluster flag-pair idiom
+        print("fsx monitor: --alert-p99-us requires --engine-report "
+              "GLOB (the p99 comes from merged engine reports; the "
+              "kernel maps cannot carry it)", file=sys.stderr)
+        return 1
     prev: dict | None = None
     prev_t = 0.0
     fh = open(args.out, "a") if args.out else None
@@ -1542,6 +1620,15 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
             rec: dict = {"ts": round(t, 3), "kernel": kern}
             stats = kern.get("stats", {})
             alerts = []
+            if args.engine_report:
+                lat = _merged_latency(args.engine_report)
+                rec["latency"] = lat
+                p99 = lat["seal_to_verdict_us"].get("p99", 0)
+                if (args.alert_p99_us and p99
+                        and p99 >= args.alert_p99_us):
+                    alerts.append(
+                        f"engine p99 latency {p99:.0f} us >= "
+                        f"{args.alert_p99_us:.0f}")
             if prev is not None and "error" not in stats:
                 dt = max(t - prev_t, 1e-9)
                 rec["per_s"] = {
@@ -2182,6 +2269,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "collectives) at boot and refuse to serve on a "
                         "violation; also on via FSX_AUDIT=1 (fsx audit "
                         "is the standalone form)")
+    s.add_argument("--slo-us", type=int, default=0, metavar="N",
+                   help="latency-budget serving mode: bound the "
+                        "feature->verdict path at N µs — the oldest "
+                        "staged record's age caps coalescing (rungs "
+                        "whose warm-measured EWMA step time would "
+                        "breach the budget are skipped), the device-"
+                        "loop round sizer stops waiting for full "
+                        "rings, and the batcher deadline-flush fires "
+                        "at the budget — so under pulse load the "
+                        "engine degrades to smaller groups/singles "
+                        "instead of queueing.  0 (default) is the "
+                        "throughput-tuned engine, bit-identical to "
+                        "prior releases.  The report's latency block "
+                        "carries p50/p90/p99/p999 and budget-miss "
+                        "accounting either way")
     s.add_argument("--no-sink-thread", action="store_true",
                    help="run the verdict sink on the dispatch thread "
                         "(the pre-threaded single-loop engine). Default "
@@ -2242,6 +2344,10 @@ def build_parser() -> argparse.ArgumentParser:
     cl.add_argument("--max-restarts", type=int, default=2,
                     help="crash-restarts per rank before the rank is "
                          "declared failed (default 2)")
+    cl.add_argument("--slo-us", type=int, default=0, metavar="N",
+                    help="per-engine latency budget (fsx serve "
+                         "--slo-us); the aggregate report merges every "
+                         "rank's latency histogram")
     cl.add_argument("--pin-cores", choices=("auto", "on", "off"),
                     default="auto",
                     help="pin rank r to core r with a matching "
@@ -2270,6 +2376,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="alert when total drop rate reaches N pps")
     mo.add_argument("--alert-blacklist", type=int, default=0,
                     help="alert when blacklist size reaches N sources")
+    mo.add_argument("--engine-report", action="append", default=None,
+                    metavar="GLOB",
+                    help="also merge engine-report JSONs matching this "
+                         "glob each tick (fsx serve output, or a "
+                         "cluster dir's report_r*_g*.json) into one "
+                         "seal->verdict latency block; repeatable")
+    mo.add_argument("--alert-p99-us", type=float, default=0,
+                    help="alert when the merged engine p99 "
+                         "seal->verdict latency reaches N µs "
+                         "(requires --engine-report)")
     mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")
@@ -2278,6 +2394,13 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--pin",
                     help="also read kernel stats/blacklist off this "
                          "bpffs pin dir (e.g. /sys/fs/bpf/fsx)")
+    st.add_argument("--engine-report", action="append", default=None,
+                    metavar="GLOB",
+                    help="also merge engine-report JSONs matching this "
+                         "glob (fsx serve output, or a cluster dir's "
+                         "report_r*_g*.json) into one seal->verdict "
+                         "latency block (HDR bucket merge; "
+                         "repeatable)")
     st.set_defaults(fn=_cmd_status)
 
     pc = sub.add_parser("pcap", help="convert a capture to flow records")

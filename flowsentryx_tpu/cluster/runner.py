@@ -153,6 +153,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         t0_ns=spec["t0_ns"],
         mega_n=spec.get("mega") or 0,
         device_loop=spec.get("device_loop", 0),
+        slo_us=spec.get("slo_us") or 0,
         gossip=plane,
     )
     restore_info = None

@@ -38,6 +38,10 @@ from pathlib import Path
 from flowsentryx_tpu.cluster import gossip as gplane
 from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
 from flowsentryx_tpu.core import schema
+# numpy-only (engine/__init__ is lazy — no jax rides in): the HDR
+# histogram class whose bucket counts the per-rank reports carry,
+# merged here into the cluster latency view
+from flowsentryx_tpu.engine.metrics import LatencyHist
 
 
 class ClusterSupervisor:
@@ -324,6 +328,31 @@ class ClusterSupervisor:
         walls = [r["report"].get("wall_s", 0.0)
                  for r in latest.values() if "report" in r]
         max_wall = max(walls) if walls else 0.0
+        # per-rank latency merge (ISSUE 11): each rank's report
+        # carries its HDR bucket counts precisely so the cluster
+        # percentiles can be computed EXACTLY (bucket-resolution)
+        # here, instead of averaging per-rank percentiles — which is
+        # statistically meaningless for a p99.  Latest gen only, same
+        # double-count rule as the totals.
+        latency = None
+        merged = LatencyHist()
+        per_rank_p99: dict[str, float] = {}
+        for r, rep in sorted(latest.items()):
+            lat = rep.get("report", {}).get("latency")
+            if not lat or not lat.get("hist"):
+                continue
+            try:
+                merged.merge(LatencyHist.from_counts(lat["hist"]))
+            except ValueError:
+                continue  # foreign scheme: skip, never mis-merge
+            per_rank_p99[str(r)] = (
+                lat.get("seal_to_verdict") or {}).get("p99")
+        if merged.n:
+            latency = {
+                "unit": "us",
+                "seal_to_verdict": merged.to_dict(),
+                "per_rank_p99": per_rank_p99,
+            }
         return {
             "engines": self.n,
             "t0_ns": self.t0_ns,
@@ -335,5 +364,6 @@ class ClusterSupervisor:
             "max_wall_s": round(max_wall, 4),
             "aggregate_records_per_s": round(
                 total_records / max(max_wall, 1e-9), 1),
+            "latency": latency,
             "reports": reports,
         }

@@ -441,6 +441,22 @@ WSTATE_DONE = 2
 WSTATE_FAILED = 3
 
 BATCHQ_SLOT_HDR_WORDS = 8
+#: Named slot-header word indices (the seal block above).  The seal
+#: stamp pair is the per-record latency plane's measurement anchor
+#: (ISSUE 11): every record of a sealed batch is timestamped at shm
+#: seal by its worker (words 4/5, CLOCK_MONOTONIC ns — the same clock
+#: as ``time.perf_counter`` on Linux), with word 6 recovering the
+#: batch's first-record arrival; ``SealedBatchQueue.peek_batches``
+#: surfaces the header and the engine's sink section closes the
+#: seal→verdict interval against it.
+BATCHQ_SEQ_LO_WORD = 0
+BATCHQ_SEQ_HI_WORD = 1
+BATCHQ_N_RECORDS_WORD = 2
+BATCHQ_WIRE_ID_WORD = 3
+BATCHQ_SEAL_NS_LO_WORD = 4
+BATCHQ_SEAL_NS_HI_WORD = 5
+BATCHQ_FILL_DUR_US_WORD = 6
+BATCHQ_RESERVED_WORD = 7
 WIRE_ID_RAW48 = 0
 WIRE_ID_COMPACT16 = 1
 

@@ -211,6 +211,15 @@ class MicroBatcher:
             >= self.cfg.deadline_us
         )
 
+    def pending_age_s(self) -> float:
+        """Age of the oldest UNSEALED record (0.0 when nothing is
+        pending) — the engine's SLO mode bounds batcher residency by
+        the latency budget with this, on top of ``flush_due``'s fixed
+        ``deadline_us`` trigger."""
+        if self.fill == 0 or self._first_add_t is None:
+            return 0.0
+        return time.perf_counter() - self._first_add_t
+
     def take(self) -> np.ndarray | None:
         """Flush whatever is pending (deadline path); None if empty."""
         return self._seal() if self.fill else None

@@ -59,7 +59,7 @@ from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.core.config import FsxConfig
 from flowsentryx_tpu.engine.arena import DispatchArena
 from flowsentryx_tpu.engine.batcher import MicroBatcher
-from flowsentryx_tpu.engine.metrics import PipelineMetrics
+from flowsentryx_tpu.engine.metrics import LatencyRecorder, PipelineMetrics
 from flowsentryx_tpu.engine.sources import RecordSource
 from flowsentryx_tpu.engine.writeback import (
     VerdictSink, decode_verdict_wire, extract_updates,
@@ -125,6 +125,15 @@ class EngineReport(NamedTuple):
     #: published/merged blacklist digests and wire/drop counters of the
     #: coordinator-less verdict plane.  None outside cluster serving.
     cluster: dict | None = None
+    #: Per-record seal→verdict latency plane (engine/metrics.py
+    #: LatencyRecorder): HDR log-bucketed percentiles of the
+    #: feature→verdict path (p50/p90/p99/p999/max, µs), the
+    #: staged-wait / upload / compute / sink stage decomposition, the
+    #: mergeable bucket counts (cluster aggregate, ``fsx status
+    #: --engine-report``), and — when serving under ``--slo-us`` — the
+    #: budget-miss accounting.  Always measured; None only before the
+    #: first run.
+    latency: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -132,6 +141,23 @@ class _InFlight(NamedTuple):
     t_enqueue: float    # when the batch's first record entered the batcher
     n_records: int      # valid records in the batch (wire meta row)
     n_chunks: int = 1   # batches in this entry (mega_n for a mega dispatch)
+    # latency-plane stamps (engine/metrics.py LatencyRecorder): when
+    # the launch section picked the entry up, how long its explicit
+    # H2D put took, and the step call's own wall — on synchronously-
+    # dispatching backends (XLA:CPU scatter custom-calls) the latter
+    # IS the compute time; see EngineReport.latency["compute_is_wall"].
+    t_launch: float = 0.0
+    put_s: float = 0.0
+    launch_s: float = 0.0
+
+
+class _Uploaded(NamedTuple):
+    """One staged-and-uploaded ring slot awaiting its round."""
+
+    dev: Any            # device buffer ([chunks, B+1, words])
+    t_enqueue: float    # oldest member batch's first-record arrival
+    n_records: int
+    put_s: float        # the slot's explicit H2D wall
 
 
 class Engine:
@@ -183,6 +209,7 @@ class Engine:
         audit: bool | None = None,
         kernel_tier: Any | None = None,
         gossip: Any | None = None,
+        slo_us: int = 0,
     ):
         self.cfg = cfg
         self.source = source
@@ -213,6 +240,32 @@ class Engine:
         #: Compact-verdict-wire slots (cfg.batch.verdict_k; 0 = the
         #: legacy full [B] fetch per batch).
         self.verdict_k = cfg.batch.verdict_k
+        #: Latency-budget serving mode (``fsx serve --slo-us N``): the
+        #: feature→verdict budget, µs, that the coalescing ladder, the
+        #: device-loop round sizer and the batcher deadline flush are
+        #: bounded by (docs/ENGINE.md §latency).  0 — the default — is
+        #: the throughput-tuned engine, BIT-IDENTICAL to every prior
+        #: PR (test-pinned like every other mode flag): no EWMA
+        #: bookkeeping, no policy checks on the hot path.
+        self.slo_us = int(slo_us)
+        if self.slo_us < 0:
+            raise ValueError(f"slo_us must be >= 0, got {slo_us}")
+        self._slo_budget_s = self.slo_us * 1e-6
+        #: Warm-measured per-group-size step-time EWMA (seconds), keyed
+        #: by dispatched chunk count (1 and each ladder rung); a ring
+        #: ROUND keys as the NEGATED round size (a ``device_loop=1``
+        #: round spans exactly the top rung's chunk count, and the
+        #: round wall includes uploads+reap — sharing the key would
+        #: cross-contaminate the two estimates).  Seeded by
+        #: :meth:`warm`'s timed second pass when SLO mode is on;
+        #: refined online by the launch section whenever a launch call
+        #: absorbed its compute (synchronous backends).  The
+        #: deadline-aware policy reads it advisorily — a stale
+        #: estimate can only mis-size a group, never corrupt state.
+        self._rung_ewma_s: dict[int, float] = {}
+        #: Per-record seal→verdict latency plane (always on; the sink
+        #: section is its single writer — sync/contracts.py).
+        self._lat = LatencyRecorder()
         #: Run the verdict sink on a dedicated thread (module
         #: docstring); False = single-thread readiness reaping.
         #: None = auto, the ``donate=None`` idiom: a sink thread needs
@@ -621,19 +674,41 @@ class Engine:
         return (jax.device_put(a, self._in_sharding)
                 if self._in_sharding is not None else jax.device_put(a))
 
+    def _note_step_s(self, key: int, dt: float, out: Any) -> None:
+        """Online refinement of the per-rung step-time EWMA (SLO mode
+        only — the default path records nothing).  Only launches whose
+        call absorbed the compute count: the output being READY the
+        moment the call returns proves the backend executed
+        synchronously (XLA:CPU's scatter custom-calls), so ``dt`` is a
+        true step time; on async backends the call is a cheap enqueue
+        and the warm-pass seed stands unrefined."""
+        if not self._slo_budget_s or not self._out_ready(out):
+            return
+        prev = self._rung_ewma_s.get(key)
+        self._rung_ewma_s[key] = (
+            dt if prev is None
+            else prev + tuning.SLO_EWMA_ALPHA * (dt - prev))
+
     def _launch_single(self, raw: Any, t_enqueue: float,
                        n_records: int) -> _InFlight:
         """The step call + accounting of a single-batch dispatch (runs
         on the dispatch thread directly, or on the device-pipeline
         worker in device-loop mode)."""
         with self.metrics.dispatch.time():
+            t_l = time.perf_counter()
+            dev = self._put(raw)
+            t_p = time.perf_counter()
             self.table, self.stats, out = self.step(
-                self.table, self.stats, self.params, self._put(raw)
+                self.table, self.stats, self.params, dev
             )
+            t_d = time.perf_counter()
         self._dispatch_calls += 1
         self._dispatched_chunks += 1
         self._group_hist[1] = self._group_hist.get(1, 0) + 1
-        return _InFlight(out, t_enqueue, n_records)
+        self._note_step_s(1, t_d - t_p, out)
+        return _InFlight(out, t_enqueue, n_records,
+                         t_launch=t_l, put_s=t_p - t_l,
+                         launch_s=t_d - t_p)
 
     def _dispatch(self, raw: np.ndarray, t_enqueue: float) -> None:
         n_records = int(raw[self.cfg.batch.max_batch, 0])
@@ -644,22 +719,31 @@ class Engine:
                                                   n_records))
 
     def _launch_group(self, raws: Any, t_enqueue: float, n_records: int,
-                      on_device: bool = False) -> _InFlight:
+                      on_device: bool = False,
+                      put_s: float = 0.0) -> _InFlight:
         """The megastep call + accounting of a group dispatch.
         ``on_device=True`` skips the H2D put — the buffer is an
-        already-uploaded ring slot."""
+        already-uploaded ring slot (``put_s`` then carries the upload
+        wall :meth:`_upload_slot` already paid for it)."""
         g = int(raws.shape[0])
         with self.metrics.dispatch.time():
+            t_l = time.perf_counter()
+            dev = raws if on_device else self._put(raws)
+            t_p = time.perf_counter()
             self.table, self.stats, out = self.megasteps[g](
-                self.table, self.stats, self.params,
-                raws if on_device else self._put(raws)
+                self.table, self.stats, self.params, dev
             )
+            t_d = time.perf_counter()
         self._dispatch_calls += 1
         self._dispatched_chunks += g
         self._group_hist[g] = self._group_hist.get(g, 0) + 1
         if on_device:
             self._ring_partial_slots += 1
-        return _InFlight(out, t_enqueue, n_records, n_chunks=g)
+        self._note_step_s(g, t_d - t_p, out)
+        return _InFlight(out, t_enqueue, n_records, n_chunks=g,
+                         t_launch=t_l,
+                         put_s=put_s if on_device else t_p - t_l,
+                         launch_s=t_d - t_p)
 
     def _dispatch_group(self, raws: np.ndarray, t_enqueue: float,
                         n_records: int) -> None:
@@ -697,7 +781,7 @@ class Engine:
     # -- device-loop (drain ring) dispatch ----------------------------------
 
     def _upload_slot(self, rows: np.ndarray, t_enqueue: float,
-                     n_records: int) -> tuple:
+                     n_records: int) -> _Uploaded:
         """EXPLICIT H2D of one staged ring slice — issued the moment
         the slot fills, so the transfer overlaps whatever round is
         still computing (the double-buffered half of the ring).  The
@@ -715,51 +799,62 @@ class Engine:
         if busy:
             self._h2d_overlap_s += dt
             self._h2d_puts_overlapped += 1
-        return buf, t_enqueue, n_records
+        return _Uploaded(buf, t_enqueue, n_records, dt)
 
     def _launch_ring(self, devs: list, t_enqueue: float,
-                     n_records: int) -> _InFlight:
+                     n_records: int, put_s: float = 0.0) -> _InFlight:
         """The deep-scan call + accounting of a full ring round."""
         g = self.ring * self._ring_chunks
         with self.metrics.dispatch.time():
+            t_l = time.perf_counter()
             self.table, self.stats, out = self.ring_step(
                 self.table, self.stats, self.params, *devs
             )
+            t_d = time.perf_counter()
         self._dispatch_calls += 1
         self._dispatched_chunks += g
         self._group_hist[g] = self._group_hist.get(g, 0) + 1
         self._ring_rounds += 1
-        return _InFlight(out, t_enqueue, n_records, n_chunks=g)
+        # NO online refinement for the ring-round key: the launch
+        # wall here omits the uploads+reap the warm seed deliberately
+        # includes, so feeding it in would decay the round estimate
+        # below the true round cost and let _slo_round_fits keep
+        # waiting for rounds that land past the budget — a static
+        # conservative seed beats a decaying optimistic one
+        return _InFlight(out, t_enqueue, n_records, n_chunks=g,
+                         t_launch=t_l, put_s=put_s, launch_s=t_d - t_l)
 
-    def _dispatch_ring(self, uploaded: list[tuple]) -> None:
+    def _dispatch_ring(self, uploaded: list[_Uploaded]) -> None:
         """ONE deep-scan dispatch over a full ring round (R uploaded
         slot buffers; fused/device_loop.py): one in-flight entry of
         ``ring * chunks`` batches whose RingOutput carries one merged
         verdict wire PER SLOT — the sink harvests the round as a
         single ``[R, 2K+4]`` fetch."""
-        devs = [u[0] for u in uploaded]
-        t_enqueue = min(u[1] for u in uploaded)
-        n_records = sum(u[2] for u in uploaded)
+        devs = [u.dev for u in uploaded]
+        t_enqueue = min(u.t_enqueue for u in uploaded)
+        n_records = sum(u.n_records for u in uploaded)
+        put_s = sum(u.put_s for u in uploaded)
         if self._pipe_active:
             self._submit("ring", devs, t_enqueue, n_records,
-                         self.ring * self._ring_chunks)
+                         self.ring * self._ring_chunks, put_s)
             return
         self._inflight.append(self._launch_ring(devs, t_enqueue,
-                                                n_records))
+                                                n_records, put_s))
 
     def _dispatch_group_dev(self, dev: Any, t_enqueue: float,
-                            n_records: int) -> None:
+                            n_records: int, put_s: float = 0.0) -> None:
         """Megastep dispatch of an ALREADY-UPLOADED ring slot (a short
         backlog left the round partial: the uploaded slices flush
         through the ordinary top-rung megastep, byte-identical by
         construction — the ring's slot body IS that megastep)."""
         if self._pipe_active:
             self._submit("group_dev", dev, t_enqueue, n_records,
-                         self._ring_chunks)
+                         self._ring_chunks, put_s)
             return
         self._inflight.append(self._launch_group(dev, t_enqueue,
                                                  n_records,
-                                                 on_device=True))
+                                                 on_device=True,
+                                                 put_s=put_s))
 
     def _ring_from_pending(self) -> None:
         """Stage one full ring round out of the inline pending list:
@@ -790,6 +885,65 @@ class Engine:
         backlog fills, else 1 (a single)."""
         return next((s for s in self._mega_sizes if s <= backlog), 1)
 
+    # -- latency-budget (SLO) policy ----------------------------------------
+    # Three advisory predicates over the warm-measured per-rung step-
+    # time EWMA, all no-ops at --slo-us 0.  They bound COALESCING, not
+    # results: whatever group shapes they pick, the verdict state is
+    # byte-identical (grouping is dispatch-granularity only, the PR 5
+    # invariant) — only latency and amortization change.
+
+    def _slo_cap(self, t_oldest: float) -> int:
+        """Largest staged rung whose expected completion — the oldest
+        pending record's age plus the rung's EWMA step time — still
+        fits the budget; 1 when even the smallest rung would breach
+        (minimum-work path: a single is the least latency the engine
+        can add).  A record ALREADY past its budget gets no cap: a
+        late record cannot be saved by a rung choice, and shrinking
+        groups exactly when a backlog exists collapses drain capacity
+        into a queueing spiral (measured: forced singles under a
+        saturating pulse took p99 from ~100 ms to ~700 ms) — the
+        budget-exceeded path is the greedy flush at FULL
+        amortization, which recovers the backlog fastest and so
+        minimizes how many MORE records go late.  A rung without a
+        measurement yet is assumed free: the dispatch that follows
+        seeds it (self-correcting, and warm() pre-seeds every rung in
+        SLO mode anyway)."""
+        headroom = self._slo_budget_s - (time.perf_counter() - t_oldest)
+        if headroom <= 0.0:
+            return self._mega_sizes[0] if self._mega_sizes else 1
+        for s in self._mega_sizes:
+            if self._rung_ewma_s.get(s, 0.0) <= headroom:
+                return s
+        return 1
+
+    def _slo_pressed(self, t_oldest: float) -> bool:
+        """Stop holding for a deeper backlog: once a TOP-rung step no
+        longer fits the oldest pending record's remaining headroom —
+        including a record already late, whose headroom is gone —
+        waiting can only make things worse; flush now through
+        :meth:`_slo_cap`'s choice (the existing greedy flush IS the
+        budget-exceeded path).  Before this point, holding is free (a
+        fuller group dispatched within budget is strictly better
+        amortization)."""
+        top = self._mega_sizes[0] if self._mega_sizes else 1
+        headroom = self._slo_budget_s - (time.perf_counter() - t_oldest)
+        return self._rung_ewma_s.get(top, 0.0) >= headroom
+
+    def _slo_round_fits(self, t_oldest: float) -> bool:
+        """Device-loop round sizer: whether waiting to launch a FULL
+        deep-scan round can still land the oldest staged record
+        inside the budget.  With positive headroom smaller than a
+        round, the loops degrade to megastep slot flushes / the
+        ladder — smaller rungs instead of queueing; once the record
+        is already late the ring is BACK on (it is the
+        highest-throughput recovery path, the same reasoning as
+        :meth:`_slo_cap`'s no-cap rule)."""
+        headroom = self._slo_budget_s - (time.perf_counter() - t_oldest)
+        if headroom <= 0.0:
+            return True
+        key = -(self.ring * self._ring_chunks)  # ring-round EWMA key
+        return self._rung_ewma_s.get(key, 0.0) < headroom
+
     def _drain_pending(self, short: bool) -> None:
         """Apply the coalescing ladder to the inline pending list.
 
@@ -807,24 +961,49 @@ class Engine:
         deep-scan dispatch; anything less falls through to the ladder
         exactly as before — the ring only ever engages on backlogs
         that were queueing anyway, so light-load latency is untouched
-        and ``device_loop=0`` remains the byte-identical baseline."""
+        and ``device_loop=0`` remains the byte-identical baseline.
+
+        Under ``--slo-us`` the WAITING is budget-bounded (policy block
+        above): budget pressure turns the hold-for-backlog into the
+        greedy flush — the existing flush IS the budget-exceeded
+        path, just entered earlier — and the greedy flush skips
+        CLIMBING to a rung whose expected step time the oldest
+        record's remaining headroom no longer covers.  Existing
+        full-rung/round backlogs dispatch at full amortization either
+        way (the sub-linear-step argument in the SLO note below)."""
+        # SLO note: the full-amortization paths below (an EXISTING
+        # round/top-rung backlog) deliberately stay un-capped even in
+        # budget mode — step time is sub-linear in group size, so for
+        # a backlog that already exists the largest rung finishes
+        # EVERY record soonest (splitting it only delays the tail and
+        # collapses capacity; measured: capping a saturated drain's
+        # rungs cost ~35 % throughput and spiralled pulse p99 ~50x).
+        # The budget bounds what the engine WAITS for — holds, round
+        # fills, batcher residency — and the greedy flush's climb.
+        slo = self._slo_budget_s
         if self.ring:
             while len(self._pending) >= self._pending_cap:
                 self._ring_from_pending()
                 self._reap(self.readback_depth)
-            if not short:
+            if not short and not (
+                    slo and self._pending
+                    and self._slo_pressed(self._pending[0][1])):
                 # a full poll means the backlog is still building
-                # toward the next round — hold the remainder
+                # toward the next round — hold the remainder (unless
+                # the budget says holding is no longer free)
                 return
         top = self._mega_sizes[0]
         while len(self._pending) >= top:
             self._dispatch_mega(self._pending[:top])
             del self._pending[:top]
             self._reap(self.readback_depth)
-        if not short or not self._pending:
+        if not self._pending or not (short or (
+                slo and self._slo_pressed(self._pending[0][1]))):
             return
         while self._pending:
             g = self._rung_for(len(self._pending))
+            if slo:
+                g = min(g, self._slo_cap(self._pending[0][1]))
             if g > 1:
                 self._dispatch_mega(self._pending[:g])
                 del self._pending[:g]
@@ -845,6 +1024,44 @@ class Engine:
         in-sink) — the 'pipe is busy' predicate the deadline-flush and
         idle-sleep decisions key on."""
         return sum(g.n_chunks for g in self._inflight) + self._chan.pending
+
+    def _deadline_flush_due(self) -> bool:
+        """THE idle-pipe deadline-flush rule (previously inline in
+        :meth:`_run_inline`; extracted because it is load-bearing for
+        the SLO path and must be testable directly).
+
+        Deadline flush ONLY into an idle pipe: while batches are in
+        flight — including batches queued to the sink thread,
+        dispatched-but-unsunk is still a busy pipe — an early flush
+        cannot reduce latency (the new batch queues behind them
+        anyway) but it does burn a full padded step per near-empty
+        buffer; the r4 open-loop collapse at tiny loads was exactly
+        this flush-faster-than-the-step-drains spiral.  When the pipe
+        drains (<= one step time) the deadline fires.
+
+        Under ``--slo-us`` the batcher's residency is ALSO bounded by
+        the budget: once the oldest pending record's age plus a
+        single-batch EWMA step would land on the budget, the flush
+        fires even before ``deadline_us`` — but never into a busy
+        pipe; the rule above dominates.  The flush age is floored at
+        HALF the budget: when the single-step estimate inflates past
+        the budget itself (a throttled host), the naive ``age + step
+        >= budget`` fires on any nonzero age and degenerates into
+        flush-every-poll — the r4 spiral in budget clothing, measured
+        decaying the SLO arm trial over trial; a record that cannot
+        make the budget anyway still batches for up to budget/2."""
+        if self._busy_depth() != 0:
+            return False
+        if self.batcher.flush_due():
+            return True
+        if not self._slo_budget_s:
+            return False
+        age = self.batcher.pending_age_s()
+        if age <= 0.0:
+            return False
+        return age >= max(
+            self._slo_budget_s - self._rung_ewma_s.get(1, 0.0),
+            self._slo_budget_s / 2)
 
     def _check_sink(self) -> None:
         """Propagate a worker crash into the dispatch thread — the
@@ -994,13 +1211,15 @@ class Engine:
             self._chan.record_exc(e)
 
     def _submit(self, kind: str, payload: Any, t_enqueue: float,
-                n_records: int, n_chunks: int) -> None:
+                n_records: int, n_chunks: int,
+                put_s: float = 0.0) -> None:
         """Hand one pre-launch work item to the device-pipeline worker
         (device-loop mode).  The channel's pending count rises at
         SUBMIT time, so the ``readback_depth`` backpressure bound
         covers queued-but-unlaunched work too — the wire/arena
         reuse-safety arguments both lean on that."""
-        self._chan.submit((kind, payload, t_enqueue, n_records, n_chunks),
+        self._chan.submit((kind, payload, t_enqueue, n_records, n_chunks,
+                           put_s),
                           n_chunks)
 
     def _ring_worker(self) -> None:
@@ -1019,15 +1238,17 @@ class Engine:
                 got = self._chan.pop()
                 if got is None:
                     return  # stop requested and queue drained
-                kind, payload, t_e, n_rec, n_chunks = got[0]
+                kind, payload, t_e, n_rec, n_chunks, put_s = got[0]
                 t0 = time.perf_counter()
                 exc: BaseException | None = None
                 try:
                     if kind == "ring":
-                        entry = self._launch_ring(payload, t_e, n_rec)
+                        entry = self._launch_ring(payload, t_e, n_rec,
+                                                  put_s)
                     elif kind == "group_dev":
                         entry = self._launch_group(payload, t_e, n_rec,
-                                                   on_device=True)
+                                                   on_device=True,
+                                                   put_s=put_s)
                     elif kind == "group":
                         entry = self._launch_group(payload, t_e, n_rec)
                     else:
@@ -1067,6 +1288,7 @@ class Engine:
         if group[0].out.wire is not None:
             self._sink_group_wire(group)
             return
+        t_fetch = time.perf_counter()
         # .reshape(-1) everywhere: a mega-dispatch entry carries stacked
         # [N, B] fields (now/route_drop [N]); single entries are [B]/[].
         with self.metrics.readback.time():
@@ -1109,7 +1331,8 @@ class Engine:
                 self._route_drop += int(jax.device_get(jnp.sum(
                     jnp.concatenate([jnp.ravel(jnp.asarray(rd))
                                      for rd in rds]))))
-        self._apply_updates(extract_updates(keys, untils), now, group)
+        self._apply_updates(extract_updates(keys, untils), now, group,
+                            t_fetch)
 
     def _sink_group_wire(self, group: list[_InFlight]) -> None:
         """The compact-wire sink (see :meth:`_sink_group`).
@@ -1121,6 +1344,7 @@ class Engine:
         slot wire falls back to the full block-array fetch for the
         whole entry — the arrays cover every slot in chunk order, so
         last-wins decode stays exact and no block is lost."""
+        t_fetch = time.perf_counter()
         with self.metrics.readback.time():
             if len(group) <= 2 or any(g.out.wire.ndim == 2
                                       for g in group):
@@ -1168,13 +1392,17 @@ class Engine:
                     else parts_k[0])
             untils = (np.concatenate(parts_u) if len(parts_u) > 1
                       else parts_u[0])
-        self._apply_updates(extract_updates(keys, untils), now, group)
+        self._apply_updates(extract_updates(keys, untils), now, group,
+                            t_fetch)
 
-    def _apply_updates(self, upd, now: float,
-                       group: list[_InFlight]) -> None:
-        """Shared sink tail: writeback, clock/metric bookkeeping, and
-        the per-batch reap hook (record-FIFO order — both sink modes
-        process groups oldest-first on a single thread)."""
+    def _apply_updates(self, upd, now: float, group: list[_InFlight],
+                       t_fetch: float) -> None:
+        """Shared sink tail: writeback, clock/metric bookkeeping, the
+        per-record latency plane, and the per-batch reap hook
+        (record-FIFO order — both sink modes process groups
+        oldest-first on a single thread).  ``t_fetch`` is when the
+        group's wire fetch began — the sink-stage anchor of the
+        latency decomposition."""
         self.sink.apply(upd)
         if self.gossip is not None:
             # republish to every peer engine RIGHT where the local
@@ -1186,8 +1414,23 @@ class Engine:
         self._sunk_batches += sum(g.n_chunks for g in group)
         t_done = time.perf_counter()
         self._last_sink_t = t_done
+        sink_s = t_done - t_fetch
         for g in group:
             self.metrics.e2e.add(t_done - g.t_enqueue)
+            # per-record accounting: every record of the entry is
+            # charged the entry's OLDEST-record path (a conservative
+            # upper bound — earlier members waited for the group, the
+            # same anchoring e2e has always used)
+            self._lat.record(
+                total_s=t_done - g.t_enqueue,
+                staged_s=(g.t_launch - g.t_enqueue
+                          if g.t_launch else 0.0),
+                upload_s=g.put_s,
+                compute_s=g.launch_s,
+                sink_s=sink_s,
+                n=g.n_records,
+                budget_s=self._slo_budget_s,
+            )
             if self.on_reap is not None:
                 self.on_reap(g.n_records, t_done)
 
@@ -1205,23 +1448,48 @@ class Engine:
                  if self.wire == schema.WIRE_COMPACT16
                  else schema.RECORD_WORDS)
         warm = np.zeros((self.cfg.batch.max_batch + 1, words), np.uint32)
-        self._dispatch(warm, time.perf_counter())
-        self._reap(0)
-        # every staged ladder rung is its own compiled scan artifact:
-        # warm each once so no group size pays its XLA compile on the
-        # first backlog that fills it
-        for g in self._mega_sizes:
-            self._dispatch_mega([(warm, time.perf_counter())] * g)
+        # ONE dispatch ladder, run once to compile every staged
+        # variant (each ladder rung and the deep-scan ring graph are
+        # their own XLA artifacts) and — in SLO mode — a second,
+        # TIMED time to seed the per-rung step-time EWMA with
+        # compile-free launch→sunk walls (backend-agnostic: the reap
+        # blocks on the fetch, so the measure covers the compute the
+        # launch call alone would hide on async backends).  Masked
+        # batches run the full fused graph, so the costs are the
+        # served ones; the online refinement (``_note_step_s``)
+        # would otherwise start from compile-poisoned values.  A new
+        # staged variant added here is automatically both compiled
+        # AND seeded — the two passes can never drift apart.
+        for timed in (False, True) if self._slo_budget_s else (False,):
+            if timed:
+                self._rung_ewma_s.clear()
+            t0 = time.perf_counter()
+            self._dispatch(warm, t0)
             self._reap(0)
-        if self.ring:
-            # the deep-scan ring graph is its own compiled artifact:
-            # one all-masked round pays its XLA compile at boot
-            zero_slot = np.zeros(
-                (self._ring_chunks,) + warm.shape, np.uint32)
-            self._dispatch_ring([
-                self._upload_slot(zero_slot, time.perf_counter(), 0)
-                for _ in range(self.ring)])
-            self._reap(0)
+            if timed:
+                self._rung_ewma_s[1] = time.perf_counter() - t0
+            for g in self._mega_sizes:
+                t0 = time.perf_counter()
+                self._dispatch_mega([(warm, t0)] * g)
+                self._reap(0)
+                if timed:
+                    self._rung_ewma_s[g] = time.perf_counter() - t0
+            if self.ring:
+                zero_slot = np.zeros(
+                    (self._ring_chunks,) + warm.shape, np.uint32)
+                t0 = time.perf_counter()
+                self._dispatch_ring([
+                    self._upload_slot(zero_slot, t0, 0)
+                    for _ in range(self.ring)])
+                self._reap(0)
+                if timed:
+                    # ring ROUNDS key negated (attribute docstring):
+                    # a depth-1 round spans the top rung's chunk
+                    # count but its wall includes uploads+reap —
+                    # never share slots
+                    self._rung_ewma_s[
+                        -(self.ring * self._ring_chunks)] = (
+                        time.perf_counter() - t0)
         # warm dispatches are compile triggers, not traffic — keep them
         # out of the dispatch-block accounting
         self._reset_dispatch_counters()
@@ -1293,6 +1561,11 @@ class Engine:
         elif not self._t0_auto and hasattr(self.sink, "t0_ns"):
             self.sink.t0_ns = keep_t0  # a swapped-in sink needs the anchor
         self.metrics = PipelineMetrics()
+        # per-stream latency plane restarts with the metrics; the
+        # per-rung EWMA table deliberately SURVIVES a rebind — it is a
+        # property of the compiled step graphs, not of the stream
+        # (paying a re-warm per paced trial would poison short runs)
+        self._lat = LatencyRecorder()
         self._blocked = set()
         self._route_drop = 0
         # per-stream readback accounting restarts with the metrics
@@ -1526,6 +1799,17 @@ class Engine:
         this call: started here, drained and joined before the report
         is built, crash surfaced as a RuntimeError (module
         docstring)."""
+        if (self._slo_budget_s and self.ring
+                and -(self.ring * self._ring_chunks)
+                not in self._rung_ewma_s):
+            # the device-loop round sizer has NO online refinement
+            # (its estimate must include uploads+reap, which only the
+            # warm pass measures) — an unseeded key would silently
+            # disable the degrade-to-smaller-rungs behavior the SLO
+            # flag advertises on the ring path.  Nothing is in flight
+            # at run() start, so self-warming here is safe; callers
+            # that already warmed skip it (the key persists).
+            self.warm()
         self._start_sink_thread()
         try:
             rep = (self._run_sealed(max_batches, max_seconds)
@@ -1592,17 +1876,12 @@ class Engine:
                     sealed = self.batcher.add_precompact(records)
                 else:
                     sealed = self.batcher.add(records)
-                # Deadline flush ONLY into an idle pipe: while batches
-                # are in flight, an early flush cannot reduce latency
-                # (the new batch queues behind them anyway) but it does
-                # burn a full padded step per near-empty buffer — the r4
-                # open-loop collapse at tiny loads was exactly this
-                # flush-faster-than-the-step-drains spiral.  When the
-                # pipe drains (<= one step time) the deadline fires.
-                # "In flight" includes batches queued to the sink
-                # thread — dispatched-but-unsunk is still a busy pipe.
-                if (not sealed and self._busy_depth() == 0
-                        and self.batcher.flush_due()):
+                # The idle-pipe deadline-flush rule lives in
+                # _deadline_flush_due (flush ONLY when the pipe is
+                # fully drained, never mid-flight; SLO mode adds the
+                # budget bound on batcher residency) — extracted so
+                # the rule is tested directly, not just documented.
+                if not sealed and self._deadline_flush_due():
                     took = self.batcher.take()
                     sealed = [took] if took is not None else []
             if self.mega_n > 0:
@@ -1743,6 +2022,7 @@ class Engine:
         the arena's reuse-safety rule (a slot recycles only after its
         batches are sunk; engine/arena.py) holds by construction."""
         top = self._mega_sizes[0] if self._mega_sizes else 0
+        slo = self._slo_budget_s
         rows: np.ndarray | None = None
         fill = done = 0
         metas: list[tuple[float, int]] = []  # (t_enqueue, n_records)/row
@@ -1785,13 +2065,22 @@ class Engine:
                 self._reap(self.readback_depth)
 
             while top and fill - done >= top:
+                # an existing top-rung backlog stays un-capped in SLO
+                # mode (the sub-linear-step argument; _drain_pending)
                 flush(top)
             # no ladder staged → singles dispatch as they arrive;
             # with a ladder, the remainder flushes only on a short
-            # poll (a full poll means a backlog is still building)
-            if short or not top:
+            # poll (a full poll means a backlog is still building) —
+            # or, under --slo-us, the moment holding would cost the
+            # oldest staged record its budget
+            if short or not top or (
+                    slo and fill - done
+                    and self._slo_pressed(metas[done][0])):
                 while fill - done:
-                    flush(self._rung_for(fill - done))
+                    g = self._rung_for(fill - done)
+                    if slo:
+                        g = min(g, self._slo_cap(metas[done][0]))
+                    flush(g)
             self._reap_ready()
             if not batches and self._sealed_idle(src):
                 break
@@ -1822,7 +2111,8 @@ class Engine:
         (``DispatchArena.ring_safe_slots``) covers the up-to-``ring``
         in-flight uploads this loop adds."""
         c = self._ring_chunks
-        uploaded: list[tuple] = []   # (dev_buf, t_enqueue, n_records)
+        slo = self._slo_budget_s
+        uploaded: list[_Uploaded] = []
         rows: np.ndarray | None = None
         fill = 0
         metas: list[tuple[float, int]] = []  # (t_enqueue, n_records)/row
@@ -1859,15 +2149,19 @@ class Engine:
             elif short:
                 # partial round: flush uploaded slots as megasteps
                 # (arrival order before the younger partial slot)...
-                for dev, t_e, n in uploaded:
-                    self._dispatch_group_dev(dev, t_e, n)
+                for u in uploaded:
+                    self._dispatch_group_dev(u.dev, u.t_enqueue,
+                                             u.n_records, u.put_s)
                     self._reap(self.readback_depth)
                 uploaded = []
-                # ...then the partial slot through the ladder
+                # ...then the partial slot through the ladder (rungs
+                # budget-capped under --slo-us, like every ladder)
                 if fill:
                     done = 0
                     while fill - done:
                         g = self._rung_for(fill - done)
+                        if slo:
+                            g = min(g, self._slo_cap(metas[done][0]))
                         if g > 1:
                             self._dispatch_group(
                                 rows[done:done + g],
@@ -1878,12 +2172,26 @@ class Engine:
                         done += g
                         self._reap(self.readback_depth)
                     rows = None
+            if (slo and uploaded
+                    and not self._slo_round_fits(uploaded[0].t_enqueue)):
+                # the device-loop round sizer: waiting to fill the
+                # whole ring would cost the oldest uploaded slot its
+                # budget — flush the uploaded slots through the
+                # ordinary top-rung megastep NOW (byte-identical; the
+                # ring's slot body IS that megastep) and let the next
+                # round start fresh.  Degrade-to-smaller, not queue.
+                for u in uploaded:
+                    self._dispatch_group_dev(u.dev, u.t_enqueue,
+                                             u.n_records, u.put_s)
+                    self._reap(self.readback_depth)
+                uploaded = []
             self._reap_ready()
             if not batches and self._sealed_idle(src):
                 break
         # bounded exit: drain uploaded slots, then any staged rows
-        for dev, t_e, n in uploaded:
-            self._dispatch_group_dev(dev, t_e, n)
+        for u in uploaded:
+            self._dispatch_group_dev(u.dev, u.t_enqueue, u.n_records,
+                                     u.put_s)
         if rows is not None and fill:
             for i in range(fill):
                 self._dispatch(rows[i], metas[i][0])
@@ -1979,6 +2287,17 @@ class Engine:
                      else "fixed" if self.mega_n else "single"),
             "mega_n": self.mega_n,
             "device_loop": device_loop,
+            # latency-budget serving (--slo-us): the budget and the
+            # warm-measured per-rung step-time EWMA the deadline-aware
+            # policy bounded coalescing with.  None = throughput mode.
+            "slo": ({
+                "slo_us": self.slo_us,
+                # negated keys are ring ROUNDS (attribute docstring)
+                "rung_ewma_ms": {
+                    (str(k) if k > 0 else f"round{-k}"):
+                        round(v * 1e3, 4)
+                    for k, v in sorted(self._rung_ewma_s.items())},
+            } if self.slo_us else None),
             "group_sizes": list(self._mega_sizes),
             "group_hist": {str(k): v for k, v in
                            sorted(self._group_hist.items())},
@@ -2023,6 +2342,13 @@ class Engine:
             escalation=escalation,
             cluster=(self.gossip.report()
                      if self.gossip is not None else None),
+            # compute_is_wall: on backends that execute the step graph
+            # synchronously at dispatch (XLA:CPU scatter custom-calls)
+            # the launch wall IS the compute; a CPU backend is the
+            # honest proxy for that here
+            latency=self._lat.to_dict(
+                self.slo_us,
+                compute_is_wall=jax.devices()[0].platform == "cpu"),
         )
 
 

@@ -314,14 +314,18 @@ class SealedBatchQueue:
         if h - t >= self.slots:
             return False
         cell = self._cells[h & (self.slots - 1)]
-        cell[0] = seq & 0xFFFFFFFF
-        cell[1] = (seq >> 32) & 0xFFFFFFFF
-        cell[2] = n_records
-        cell[3] = wire_id
-        cell[4] = seal_ns & 0xFFFFFFFF
-        cell[5] = (seal_ns >> 32) & 0xFFFFFFFF
-        cell[6] = min(int(fill_dur_us), 0xFFFFFFFF)
-        cell[7] = 0
+        cell[schema.BATCHQ_SEQ_LO_WORD] = seq & 0xFFFFFFFF
+        cell[schema.BATCHQ_SEQ_HI_WORD] = (seq >> 32) & 0xFFFFFFFF
+        cell[schema.BATCHQ_N_RECORDS_WORD] = n_records
+        cell[schema.BATCHQ_WIRE_ID_WORD] = wire_id
+        # the seal stamp: the latency plane's per-record measurement
+        # anchor (schema.py seal block) — every record of this batch
+        # is timestamped here, at shm seal
+        cell[schema.BATCHQ_SEAL_NS_LO_WORD] = seal_ns & 0xFFFFFFFF
+        cell[schema.BATCHQ_SEAL_NS_HI_WORD] = (seal_ns >> 32) & 0xFFFFFFFF
+        cell[schema.BATCHQ_FILL_DUR_US_WORD] = min(int(fill_dur_us),
+                                                   0xFFFFFFFF)
+        cell[schema.BATCHQ_RESERVED_WORD] = 0
         cell[schema.BATCHQ_SLOT_HDR_WORDS:] = payload.reshape(-1)
         self._head[0] = h + 1  # publish after the copy
         return True

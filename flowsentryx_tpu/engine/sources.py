@@ -17,7 +17,9 @@ from typing import Protocol
 
 import numpy as np
 
-from flowsentryx_tpu.engine.traffic import TrafficGen, TrafficSpec
+from flowsentryx_tpu.engine.traffic import (
+    TrafficGen, TrafficSpec, pulse_offsets_ns,
+)
 
 
 class RecordSource(Protocol):
@@ -129,30 +131,62 @@ class PacedSource:
     reaps batches in record-FIFO order, so a reap callback can
     :meth:`pop_scheduled` one time per sunk record and compute
     arrival→verdict-sunk latency exactly (queueing included).
+
+    ``burst_period_s`` > 0 makes the offered process a PULSE WAVE
+    (same mean rate, each period's records compressed into its first
+    ``duty_cycle`` fraction — the schedule is
+    :func:`~flowsentryx_tpu.engine.traffic.pulse_offsets_ns`, shared
+    with the synthetic-clock generator): the adversarial arrival
+    process the ``--slo-us`` serving mode is measured under, where a
+    drain-tuned policy queues the burst head.
     """
 
-    def __init__(self, pool: np.ndarray, rate_pps: float, total: int):
+    def __init__(self, pool: np.ndarray, rate_pps: float, total: int,
+                 burst_period_s: float = 0.0, duty_cycle: float = 1.0):
         if rate_pps <= 0:
             raise ValueError("rate_pps must be positive")
         self.pool = pool
         self.rate = float(rate_pps)
         self.total = int(total)
+        self.burst_period_s = float(burst_period_s)
+        self.duty_cycle = float(duty_cycle)
+        # validate eagerly (the shared schedule function owns the rules)
+        pulse_offsets_ns(np.zeros(1, np.int64), self.rate,
+                         self.burst_period_s, self.duty_cycle)
+        self._pulse = burst_period_s > 0 and duty_cycle < 1.0
         self.emitted = 0
         self.popped = 0
         self.t_start: float | None = None
+
+    def _sched_rel_s(self, idx) -> np.ndarray:
+        """Scheduled arrival offsets (s from stream start) of 0-based
+        record indices — one schedule for emission, ``ts_ns`` stamping
+        and :meth:`pop_scheduled`, steady or pulsed."""
+        return pulse_offsets_ns(idx, self.rate, self.burst_period_s,
+                                self.duty_cycle) / 1e9
+
+    def _due(self, elapsed_s: float) -> int:
+        """How many records the schedule has released by ``elapsed_s``."""
+        if not self._pulse:
+            return int(elapsed_s * self.rate)
+        # >= 1 by the eager pulse_offsets_ns validation at construction
+        per = int(round(self.rate * self.burst_period_s))
+        full, rem = divmod(elapsed_s, self.burst_period_s)
+        on_s = self.burst_period_s * self.duty_cycle
+        return int(full) * per + int(min(rem / on_s, 1.0) * per)
 
     def poll(self, max_records: int) -> np.ndarray:
         import time
 
         if self.t_start is None:
             self.t_start = time.perf_counter()
-        due = int((time.perf_counter() - self.t_start) * self.rate)
+        due = self._due(time.perf_counter() - self.t_start)
         n = min(due - self.emitted, max_records, self.total - self.emitted)
         if n <= 0:
             return np.empty(0, dtype=self.pool.dtype)
         idx = (self.emitted + np.arange(n)) % len(self.pool)
         recs = self.pool[idx]
-        sched_rel = (self.emitted + 1 + np.arange(n)) / self.rate
+        sched_rel = self._sched_rel_s(self.emitted + np.arange(n))
         recs["ts_ns"] = np.round(sched_rel * 1e9).astype(np.uint64)
         self.emitted += n
         return recs
@@ -164,9 +198,9 @@ class PacedSource:
             raise ValueError(
                 f"popping {n} with only {self.emitted - self.popped} emitted"
             )
-        k = self.popped + 1 + np.arange(n)
+        k = self.popped + np.arange(n)
         self.popped += n
-        return (self.t_start or 0.0) + k / self.rate
+        return (self.t_start or 0.0) + self._sched_rel_s(k)
 
     def exhausted(self) -> bool:
         return self.emitted >= self.total

@@ -413,10 +413,15 @@ class ShardedIngest:
     def _note_batch(self, wid: int, hdr: np.ndarray) -> tuple:
         """Header decode + per-worker bookkeeping shared by both
         dequeue paths: ``(seq, n_records, t_seal, fill_s)``."""
-        seq = int(hdr[0]) | (int(hdr[1]) << 32)
-        n = int(hdr[2])
-        seal_ns = int(hdr[4]) | (int(hdr[5]) << 32)
-        fill_s = int(hdr[6]) * 1e-6
+        seq = (int(hdr[schema.BATCHQ_SEQ_LO_WORD])
+               | (int(hdr[schema.BATCHQ_SEQ_HI_WORD]) << 32))
+        n = int(hdr[schema.BATCHQ_N_RECORDS_WORD])
+        # the worker's shm-seal stamp (CLOCK_MONOTONIC ==
+        # perf_counter on Linux): the latency plane's measurement
+        # anchor for every record of this batch
+        seal_ns = (int(hdr[schema.BATCHQ_SEAL_NS_LO_WORD])
+                   | (int(hdr[schema.BATCHQ_SEAL_NS_HI_WORD]) << 32))
+        fill_s = int(hdr[schema.BATCHQ_FILL_DUR_US_WORD]) * 1e-6
         t_seal = seal_ns * 1e-9
         self._seqs.note(wid, seq)
         self._batches[wid] += 1

@@ -148,7 +148,9 @@ _ENGINE_LAUNCH = (
     # sink-thread mode, on the device-pipeline worker in ring mode —
     # never both: _pipe_active routes every _dispatch* through _submit
     # while the worker owns launches (interleave.py exercises this).
-    "_launch_single", "_launch_group", "_launch_ring",
+    # _note_step_s is the launch tail that folds the measured step
+    # wall into the SLO EWMA table — same single-launcher exclusivity.
+    "_launch_single", "_launch_group", "_launch_ring", "_note_step_s",
 )
 
 _ENGINE_SINK = (
@@ -193,6 +195,32 @@ ENGINE_PLAN = ClassPlan(
             # single-thread mode only: _reap_ready returns before this
             # read whenever _sink_active (mode-guarded access)
             extra=("_reap_ready",)),
+        "_lat": FieldContract(
+            "section:sink",
+            "the per-record latency plane (metrics.LatencyRecorder): "
+            "recorded where the seal→verdict interval CLOSES — the "
+            "sink section, single owner at a time; read only by the "
+            "quiescent report/reset methods"),
+        # -- SLO (latency-budget) serving state ------------------------
+        "_rung_ewma_s": FieldContract(
+            "section:launch",
+            "per-rung step-time EWMA: written by the launch tail "
+            "(_note_step_s, single launcher at a time) and seeded by "
+            "the quiescent warm pass; the dispatch-thread policy "
+            "helpers read it ADVISORILY — a stale float read can only "
+            "mis-size a coalescing group, never corrupt state (each "
+            "value is a whole-object float store, atomic in CPython); "
+            "run()'s ring-seed probe reads it BEFORE any worker "
+            "thread is started (the auto-warm gate)",
+            extra=("_slo_cap", "_slo_pressed", "_slo_round_fits",
+                   "_deadline_flush_due", "run")),
+        "slo_us": FieldContract(
+            "quiescent-write",
+            "latency-budget mode flag (--slo-us): written only at "
+            "construction; racy reads are stable"),
+        "_slo_budget_s": FieldContract(
+            "quiescent-write",
+            "the budget in seconds, same lifecycle as slo_us"),
         # -- dispatch-thread-owned ------------------------------------
         "_inflight": _DISP, "_pending": _DISP, "_arena": _DISP,
         "batcher": _DISP, "_staged_batches": _DISP,

@@ -79,6 +79,16 @@ MIN_SINK_GAP_S = 0.3e-3
 #: test-pinned).
 GOSSIP_MERGE_INTERVAL_S = 5e-3
 
+#: Per-rung step-time EWMA smoothing for the latency-budget serving
+#: mode (``fsx serve --slo-us``; engine ``_note_step_s``).  The
+#: estimate gates COALESCING only (never correctness), so it wants to
+#: track regime shifts — table growth, host throttling — within a few
+#: dozen dispatches without chasing single-step noise: 0.2 reaches
+#: ~90 % of a step-time shift in ~10 dispatches.  Applied only to
+#: launches whose call absorbed the compute (synchronous backends);
+#: elsewhere the warm-pass seed stands.
+SLO_EWMA_ALPHA = 0.2
+
 #: Bounded wait on a full sealed-batch queue once stop was requested —
 #: the consumer may already be gone and worker shutdown must not hang.
 #: A give-up is NOT silent: the seq is un-burned and the loss lands in

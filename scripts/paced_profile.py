@@ -99,19 +99,20 @@ def main() -> int:
                 eng.table, eng.stats, eng.params, warm)
             jax.block_until_ready(o.verdict)
             engines[key] = eng
-        from flowsentryx_tpu.benchmarks import paced_latency_run
+        from flowsentryx_tpu.benchmarks import (
+            paced_latency_run, summarize_latencies,
+        )
 
         lats, wall, erep = paced_latency_run(eng, src, readback_depth=depth)
-        a = lats * 1e3
         row = {
             "batch": bsz, "depth": depth, "load_mpps": load,
-            "deadline_us": dl, "n": len(lats),
+            "deadline_us": dl,
+            **summarize_latencies(lats),
             "achieved_mpps": round(len(lats) / wall / 1e6, 4),
-            "p50_ms": round(float(np.percentile(a, 50)), 2),
-            "p90_ms": round(float(np.percentile(a, 90)), 2),
-            "p99_ms": round(float(np.percentile(a, 99)), 2),
             "offered_all_consumed": bool(len(lats) >= total),
             "readback": erep.readback,
+            # the engine's in-band seal->verdict HDR block (ISSUE 11)
+            "engine_latency": erep.latency,
         }
         out["rows"].append(row)
         print(json.dumps(row), flush=True)

@@ -129,6 +129,17 @@ echo "== cluster smoke: 2-engine drain + gossip + kill/restart =="
 # scaling evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py || exit 1
 
+echo "== latency smoke: seal->verdict plane + SLO degradation =="
+# Bounded CPU smoke of the per-record latency plane (docs/ENGINE.md
+# §latency): re-proves the seal/launch/sink stamps are monotone
+# (negatives == 0), the HDR percentile chain is finite and ordered
+# with every record accounted, --slo-us keeps stats/blacklist
+# byte-identical while provably degrading the ladder under a breached
+# budget, and warm() seeds the per-rung EWMA table — re-writing the
+# "smoke" section of artifacts/LATENCY_r15.json (the paced pulse-wave
+# A/B evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/latency_smoke.py || exit 1
+
 echo "== device-loop smoke: drain ring + double-buffered H2D =="
 # Bounded CPU smoke of the device-resident drain ring: re-proves that
 # full deep-scan rounds fire, copies/batch stays 1.0, and H2D overlap

@@ -180,3 +180,19 @@ def test_meshed_engine_checkpoint_roundtrip(tmp_path):
     rep3 = e3.run()
     assert rep3.stats["dropped_blacklist"] > 0
     jax.block_until_ready(e3.stats.allowed)
+
+
+def test_summarize_latencies_is_the_one_reporting_copy():
+    """The percentile-summary half of the paced-latency methodology
+    (benchmarks.summarize_latencies): bench.py's grid + pulse tier and
+    scripts/paced_profile.py all consume this one dict shape."""
+    from flowsentryx_tpu.benchmarks import summarize_latencies
+
+    assert summarize_latencies([]) == {"n": 0}
+    lats = np.array([0.001, 0.002, 0.003, 0.004, 0.100])
+    d = summarize_latencies(lats)
+    assert d["n"] == 5
+    assert d["p50_ms"] == 3.0
+    assert d["max_ms"] == 100.0
+    assert d["p50_ms"] <= d["p90_ms"] <= d["p99_ms"] \
+        <= d["p999_ms"] <= d["max_ms"]

@@ -398,6 +398,45 @@ class TestClusterSupervisor:
         assert agg["records"] == 40 + 60
         assert agg["max_wall_s"] == 2.0
 
+    def test_aggregate_merges_latency_hists_exactly(self, tmp_path):
+        import json
+
+        from flowsentryx_tpu.engine.metrics import LatencyHist
+
+        # per-rank HDR bucket counts merge into EXACT cluster
+        # percentiles (never averaged per-rank p99s); a rank without
+        # a latency block (a stub, an old report) is skipped
+        h0, h1 = LatencyHist(), LatencyHist()
+        for _ in range(99):
+            h0.add(100e-6)
+        h0.add(50e-3)          # rank 0's one slow record
+        for _ in range(100):
+            h1.add(200e-6)
+        sup = self._sup(tmp_path, [{}, {}])
+        d = tmp_path / "cl"
+        d.mkdir(parents=True, exist_ok=True)
+        for r, h in ((0, h0), (1, h1)):
+            (d / f"report_r{r}_g0.json").write_text(json.dumps(
+                {"rank": r, "gen": 0,
+                 "report": {"records": h.n, "batches": 1, "wall_s": 1.0,
+                            "latency": {
+                                "seal_to_verdict": h.to_dict(),
+                                "hist": h.to_counts()}}}))
+        (d / "report_r2_g0.json").write_text(json.dumps(
+            {"rank": 2, "gen": 0,
+             "report": {"records": 0, "batches": 0, "wall_s": 0.1}}))
+        agg = sup.aggregate()
+        lat = agg["latency"]
+        ref = LatencyHist()
+        ref.merge(h0)
+        ref.merge(h1)
+        assert lat["seal_to_verdict"] == ref.to_dict()
+        assert lat["seal_to_verdict"]["n"] == 200
+        # the merged p999 sees rank 0's slow tail, the p50 the bulk
+        assert lat["seal_to_verdict"]["p999"] > 10_000
+        assert lat["seal_to_verdict"]["p50"] < 500
+        assert set(lat["per_rank_p99"]) == {"0", "1"}
+
     def test_boot_ignores_future_heartbeat_as_stale(self, tmp_path):
         # CLOCK_MONOTONIC restarts at reboot: a persisted plane whose
         # heartbeats are AHEAD of the current clock is a dead fleet,

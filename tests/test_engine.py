@@ -1560,3 +1560,500 @@ class TestTransferGuard:
         eng2 = Engine(cfg, ArraySource(self._recs(256)), NullSink(),
                       sink_thread=False, readback_depth=5)
         assert eng2.readback_depth == 5  # explicit arg still wins
+
+
+class TestLatencyHist:
+    """The HDR log-bucketed latency histogram (engine/metrics.py):
+    fixed memory, O(buckets) percentiles, lossless JSON merge — the
+    measurement substrate of the seal→verdict plane."""
+
+    def test_percentiles_within_bucket_error(self):
+        from flowsentryx_tpu.engine.metrics import LAT_SUB, LatencyHist
+
+        rng = np.random.default_rng(7)
+        vals_us = rng.lognormal(5.5, 1.2, 50_000)
+        h = LatencyHist()
+        for v in vals_us:
+            h.add(v * 1e-6)
+        for q in (50, 90, 99, 99.9):
+            true = float(np.percentile(vals_us, q))
+            est = h.percentile_us(q)
+            # conservative upper edge: never under-reports beyond
+            # interpolation noise, never over by more than 1/SUB
+            assert est >= true * (1 - 0.02)
+            assert est <= true * (1 + 1 / LAT_SUB + 0.02)
+        assert h.percentile_us(100) == round(float(vals_us.max()), 1)
+
+    def test_weighted_add_and_ordering(self):
+        from flowsentryx_tpu.engine.metrics import LatencyHist
+
+        h = LatencyHist()
+        h.add(100e-6, n=99)
+        h.add(10e-3, n=1)
+        assert h.n == 100
+        assert h.percentile_us(50) < 200
+        assert h.percentile_us(99.9) > 5000
+        d = h.to_dict()
+        chain = [d[k] for k in ("p50", "p90", "p99", "p999", "max")]
+        assert all(a <= b for a, b in zip(chain, chain[1:]))
+
+    def test_counts_roundtrip_and_merge(self):
+        from flowsentryx_tpu.engine.metrics import LatencyHist
+
+        rng = np.random.default_rng(3)
+        a, b = LatencyHist(), LatencyHist()
+        for v in rng.lognormal(4, 1, 2000):
+            a.add(v * 1e-6)
+        for v in rng.lognormal(7, 1, 2000):
+            b.add(v * 1e-6)
+        # JSON roundtrip is lossless at bucket resolution
+        a2 = LatencyHist.from_counts(
+            __import__("json").loads(
+                __import__("json").dumps(a.to_counts())))
+        assert a2.to_dict() == a.to_dict()
+        # merge == summing the bucket counts, exactly
+        merged = LatencyHist.from_counts(a.to_counts())
+        merged.merge(b)
+        assert merged.n == a.n + b.n
+        assert np.array_equal(merged.counts, a.counts + b.counts)
+        assert merged.max_us == max(a.max_us, b.max_us)
+
+    def test_scheme_mismatch_refused(self):
+        from flowsentryx_tpu.engine.metrics import LatencyHist
+
+        with pytest.raises(ValueError, match="scheme"):
+            LatencyHist.from_counts({"scheme": "linear", "buckets": {}})
+
+    def test_recorder_counts_negatives_and_misses(self):
+        from flowsentryx_tpu.engine.metrics import LatencyRecorder
+
+        r = LatencyRecorder()
+        r.record(1e-3, 5e-4, 1e-5, 4e-4, 1e-4, n=10, budget_s=2e-3)
+        assert r.negatives == 0 and r.slo_miss_records == 0
+        r.record(3e-3, -1e-6, 1e-5, 4e-4, 1e-4, n=4, budget_s=2e-3)
+        assert r.negatives == 1
+        assert r.slo_miss_records == 4
+        r.record(1.0, 0, 0, 0, 0, n=0, budget_s=1e-9)  # warm: no-op
+        assert r.total.n == 14
+        d = r.to_dict(slo_us=2000)
+        assert d["slo"]["miss_records"] == 4
+
+
+class TestPulseTraffic:
+    """Pulse-wave arrival process (engine/traffic.py): one schedule
+    function shared by the synthetic clock and the open-loop paced
+    generator, steady case bit-identical to the historical stream."""
+
+    def test_steady_schedule_matches_historical(self):
+        from flowsentryx_tpu.engine.traffic import pulse_offsets_ns
+
+        o = pulse_offsets_ns(np.arange(5), 1e6, 0.0, 1.0)
+        assert list(o) == [1000, 2000, 3000, 4000, 5000]
+
+    def test_pulse_compresses_into_on_window_at_same_mean_rate(self):
+        from flowsentryx_tpu.engine.traffic import pulse_offsets_ns
+
+        # 1 Mpps mean, 1 ms period, 25% duty: 1000 records per period,
+        # all inside the first 250 us of each period
+        p = pulse_offsets_ns(np.arange(3000), 1e6, 1e-3, 0.25)
+        assert p[999] <= 250_000
+        assert p[1000] >= 1_000_000
+        assert abs(int(p[2999]) - 3_000_000 + 750_000) < 2
+        # mean rate preserved: 3000 records span 3 periods
+        assert p[2999] < 3_000_000
+
+    def test_pulse_param_validation(self):
+        from flowsentryx_tpu.engine import PacedSource
+        from flowsentryx_tpu.engine.traffic import pulse_offsets_ns
+
+        with pytest.raises(ValueError, match="duty_cycle"):
+            pulse_offsets_ns(np.arange(2), 1e6, 1e-3, 0.0)
+        with pytest.raises(ValueError, match="burst_period_s"):
+            pulse_offsets_ns(np.arange(2), 1e6, -1.0, 0.5)
+        with pytest.raises(ValueError, match="duty_cycle"):
+            TrafficGen(TrafficSpec(duty_cycle=1.5))
+        # a period holding < 1 record would silently multiply the
+        # offered mean (clamping to 1/period); refused EAGERLY at
+        # every construction seam that shares the schedule
+        with pytest.raises(ValueError, match="fewer than one"):
+            pulse_offsets_ns(np.arange(2), 100.0, 1e-3, 0.25)
+        pool = TrafficGen(TrafficSpec(seed=1)).next_records(16)
+        with pytest.raises(ValueError, match="fewer than one"):
+            PacedSource(pool, rate_pps=100.0, total=8,
+                        burst_period_s=1e-3, duty_cycle=0.25)
+        with pytest.raises(ValueError, match="fewer than one"):
+            TrafficGen(TrafficSpec(rate_pps=100.0, burst_period_s=1e-3,
+                                   duty_cycle=0.25)).next_records(0)
+
+    def test_trafficgen_steady_bit_identical_to_pre_pulse(self):
+        a = TrafficGen(TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI,
+                                   seed=5)).next_records(1024)
+        b = TrafficGen(TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI,
+                                   seed=5, burst_period_s=0.0,
+                                   duty_cycle=1.0)).next_records(1024)
+        assert (a == b).all()
+
+    def test_trafficgen_pulse_timestamps(self):
+        gen = TrafficGen(TrafficSpec(
+            scenario=Scenario.UDP_FLOOD_MULTI, seed=5, rate_pps=1e6,
+            burst_period_s=1e-3, duty_cycle=0.25))
+        # across two polls the schedule is continuous (index-based)
+        r1, r2 = gen.next_records(600), gen.next_records(600)
+        ts = np.concatenate([r1["ts_ns"], r2["ts_ns"]]).astype(np.int64)
+        ts -= 1_000_000_000
+        assert ts[999] <= 250_000 and ts[1000] >= 1_000_000
+        assert (np.diff(ts) >= 0).all()
+
+    def test_paced_source_pulse_schedule_and_pop(self):
+        from flowsentryx_tpu.engine import PacedSource
+
+        pool = TrafficGen(TrafficSpec(seed=1)).next_records(512)
+        src = PacedSource(pool, rate_pps=2e5, total=400,
+                          burst_period_s=4e-3, duty_cycle=0.25)
+        import time as _t
+
+        got = []
+        while not src.exhausted():
+            r = src.poll(10_000)
+            if len(r):
+                got.append(r)
+            _t.sleep(0.0005)
+        recs = np.concatenate(got)
+        assert len(recs) == 400
+        sch = src.pop_scheduled(400)
+        # the ts_ns stamps ARE the schedule (offset from t_start)
+        rel = recs["ts_ns"].astype(np.int64) / 1e9
+        np.testing.assert_allclose(sch - src.t_start, rel, atol=1e-6)
+        # within each 800-record period, records land in the on-window
+        per = int(2e5 * 4e-3)
+        assert (np.diff(sch) >= -1e-9).all()
+        off = (sch - src.t_start) % 4e-3
+        assert (off <= 1e-3 + 1e-6).sum() == len(off)  # all in 25% duty
+
+
+class TestSloServing:
+    """Latency-budget serving (``Engine(slo_us=N)`` / ``fsx serve
+    --slo-us``): parity gates (the policy bounds COALESCING only —
+    results stay byte-identical), the warm EWMA seed, the policy
+    helpers, the budget-bounded deadline flush, and the degradation
+    behavior under a breached budget."""
+
+    @staticmethod
+    def _recs(n_batches, batch=256, seed=17, n_attack=32):
+        return TrafficGen(
+            TrafficSpec(scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+                        n_attack_ips=n_attack, attack_fraction=0.8,
+                        seed=seed)
+        ).next_records(batch * n_batches)
+
+    @staticmethod
+    def _run(recs, warm=False, tweak=None, **kw):
+        import jax
+
+        cfg = small_cfg(batch=256, pps_threshold=200.0,
+                        bps_threshold=1e9)
+        sink = CollectSink()
+        kw.setdefault("readback_depth", 4)
+        eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                     sink_thread=False, **kw)
+        if warm:
+            eng.warm()
+            eng.reset_stream(ArraySource(recs.copy()))
+        if tweak is not None:
+            tweak(eng)
+        with jax.transfer_guard("disallow"):
+            rep = eng.run()
+        return rep, sink, eng
+
+    def test_slo_zero_is_todays_path(self):
+        """slo_us=0 must be EXACTLY the throughput-tuned engine: no
+        EWMA bookkeeping, no slo report block — while the latency
+        measurement plane itself is always on."""
+        recs = self._recs(6)
+        rep, _, eng = self._run(recs, mega_n="auto")
+        assert eng.slo_us == 0 and eng._rung_ewma_s == {}
+        assert rep.dispatch["slo"] is None
+        assert rep.latency is not None
+        assert rep.latency["seal_to_verdict"]["n"] == rep.records
+        assert "slo" not in rep.latency
+
+    def test_slo_negative_refused(self):
+        with pytest.raises(ValueError, match="slo_us"):
+            Engine(small_cfg(), ArraySource(self._recs(1)), NullSink(),
+                   slo_us=-1)
+
+    def test_slo_parity_byte_identical_single_device(self):
+        """slo on vs off vs singles over one deterministic stream:
+        byte-identical stats, blacklist (keys AND untils), and final
+        table under the transfer guard."""
+        import jax
+
+        recs = self._recs(14)
+        rep1, sink1, eng1 = self._run(recs)
+        repa, sinka, _ = self._run(recs, mega_n="auto")
+        reps, sinks, engs = self._run(recs, mega_n="auto", warm=True,
+                                      slo_us=250_000)
+        assert reps.records == repa.records == rep1.records
+        assert reps.stats == repa.stats == rep1.stats
+        assert sinks.blocked == sinka.blocked == sink1.blocked
+        for a, b in zip(jax.tree_util.tree_leaves(eng1.table),
+                        jax.tree_util.tree_leaves(engs.table)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a quarter-second budget never binds on this drain: the warm
+        # EWMA table exists and the dispatch pattern still coalesced
+        assert reps.dispatch["slo"]["rung_ewma_ms"]
+        assert any(int(g) > 1 for g in reps.dispatch["group_hist"])
+
+    def test_slo_parity_mesh(self):
+        """The sharded half of the parity gate: a binding budget over
+        the meshed ladder keeps results byte-identical."""
+        import jax
+
+        from flowsentryx_tpu.parallel import make_mesh
+
+        recs = self._recs(10, batch=256)
+        cfg = small_cfg(batch=256, pps_threshold=200.0,
+                        bps_threshold=1e9)
+
+        def run(**kw):
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         mesh=make_mesh(8), sink_thread=False,
+                         readback_depth=4, **kw)
+            with jax.transfer_guard("disallow"):
+                rep = eng.run()
+            return rep, sink
+
+        rep0, sink0 = run(mega_n="auto")
+        rep1, sink1 = run(mega_n="auto", slo_us=2000)
+        assert rep0.stats == rep1.stats
+        assert sink0.blocked == sink1.blocked
+        assert rep1.dispatch["slo"]["slo_us"] == 2000
+
+    def test_slo_greedy_flush_skips_unaffordable_rungs(self):
+        """THE deterministic degradation proof, driven through the
+        real greedy-flush path: a sub-top pending backlog whose
+        coalesced rungs all carry unaffordable EWMAs (planted, ample
+        headroom) must dispatch as singles — skip climbing — while
+        the control flushes the same backlog through rung 4.  The
+        dual: a backlog already PAST its budget gets no cap (the
+        greedy flush at full amortization is the recovery path;
+        forced singles under saturation measured a ~50x p99
+        spiral)."""
+        import time as _t
+
+        def seed_pending(eng, n):
+            warm = np.zeros(
+                (eng.cfg.batch.max_batch + 1,
+                 schema.COMPACT_RECORD_WORDS), np.uint32)
+            now = _t.perf_counter()
+            eng._pending = [(warm.copy(), now) for _ in range(n)]
+
+        def mk(**kw):
+            return Engine(small_cfg(batch=256),
+                          ArraySource(self._recs(1)), NullSink(),
+                          sink_thread=False, **kw)
+
+        ctl = mk(mega_n="auto")
+        seed_pending(ctl, 5)
+        ctl._drain_pending(short=True)
+        assert {int(g): n for g, n in ctl._group_hist.items()} \
+            == {4: 1, 1: 1}
+        eng = mk(mega_n="auto", slo_us=10_000_000)
+        eng._rung_ewma_s.update({2: 9e9, 4: 9e9, 8: 9e9})
+        seed_pending(eng, 5)
+        eng._drain_pending(short=True)
+        assert {int(g): n for g, n in eng._group_hist.items()} == {1: 5}
+        # already-late: no cap — the flush coalesces like the control
+        late = mk(mega_n="auto", slo_us=1)
+        late._rung_ewma_s.update({2: 9e9, 4: 9e9, 8: 9e9})
+        seed_pending(late, 5)
+        late._pending = [(r, t - 1.0) for r, t in late._pending]
+        late._drain_pending(short=True)
+        assert {int(g): n for g, n in late._group_hist.items()} \
+            == {4: 1, 1: 1}
+
+    def test_slo_existing_top_rung_backlog_stays_uncapped(self):
+        """An EXISTING top-rung backlog dispatches at full
+        amortization whatever the budget: step time is sub-linear in
+        group size, so the largest rung finishes every record of a
+        backlog soonest — capping it only delays the tail and
+        collapses capacity (the saturated-drain regression the first
+        policy cut measured)."""
+        import time as _t
+
+        eng = Engine(small_cfg(batch=256), ArraySource(self._recs(1)),
+                     NullSink(), sink_thread=False, mega_n="auto",
+                     slo_us=1000)
+        eng._rung_ewma_s.update({2: 9e9, 4: 9e9, 8: 9e9})
+        warm = np.zeros((257, schema.COMPACT_RECORD_WORDS), np.uint32)
+        now = _t.perf_counter()
+        eng._pending = [(warm.copy(), now) for _ in range(8)]
+        eng._drain_pending(short=True)
+        assert {int(g): n for g, n in eng._group_hist.items()} == {8: 1}
+
+    def test_warm_seeds_rung_ewma(self):
+        recs = self._recs(2)
+        eng = Engine(small_cfg(batch=256), ArraySource(recs), NullSink(),
+                     sink_thread=False, mega_n="auto", slo_us=10_000)
+        assert eng._rung_ewma_s == {}
+        eng.warm()
+        assert set(eng._rung_ewma_s) == {1, 2, 4, 8}
+        assert all(v > 0 for v in eng._rung_ewma_s.values())
+        # a rebind keeps the seed (it is a property of the compiled
+        # graphs, not the stream)
+        eng.reset_stream(ArraySource(self._recs(1)))
+        assert set(eng._rung_ewma_s) == {1, 2, 4, 8}
+
+    def test_slo_cap_and_pressed_policy(self):
+        """The policy helpers, driven with a hand-set EWMA table."""
+        import time as _t
+
+        eng = Engine(small_cfg(batch=256), ArraySource(self._recs(1)),
+                     NullSink(), sink_thread=False, mega_n="auto",
+                     slo_us=10_000)  # 10 ms budget
+        eng._rung_ewma_s = {1: 0.0005, 2: 0.001, 4: 0.003, 8: 0.02}
+        now = _t.perf_counter()
+        # fresh record: 8 needs 20 ms > 10 ms budget -> capped at 4
+        assert eng._slo_cap(now) == 4
+        # 8 ms old: only the 1 ms rung (2) still fits
+        assert eng._slo_cap(now - 0.008) == 2
+        # 9.8 ms old: positive headroom but nothing fits -> singles
+        assert eng._slo_cap(now - 0.0098) == 1
+        # 11 ms old: ALREADY LATE -> no cap (greedy-flush recovery at
+        # full amortization; singles would collapse drain capacity)
+        assert eng._slo_cap(now - 0.011) == 8
+        # pressed: ewma(top 8 = 20 ms) >= headroom (10 ms) is true
+        # even for a fresh record here (top rung unaffordable)
+        assert eng._slo_pressed(now)
+        eng._rung_ewma_s[8] = 0.001
+        assert not eng._slo_pressed(now)
+        assert eng._slo_pressed(now - 0.0095)
+        assert eng._slo_pressed(now - 0.011)  # late: flush, never hold
+
+    def test_deadline_flush_only_into_idle_pipe(self):
+        """The engine.py idle-pipe deadline-flush rule, tested
+        DIRECTLY (it was previously only documented in a comment):
+        the flush fires only when the pipe is fully drained — never
+        mid-flight, including work queued to the sink channel."""
+        import dataclasses
+
+        cfg = small_cfg(batch=256)
+        cfg = dataclasses.replace(
+            cfg, batch=dataclasses.replace(cfg.batch, deadline_us=1))
+        eng = Engine(cfg, ArraySource(self._recs(1)), NullSink(),
+                     sink_thread=False)
+        gen = TrafficGen(TrafficSpec(seed=2))
+        eng.batcher.add(gen.next_records(10))  # partial fill
+        import time as _t
+
+        _t.sleep(0.001)  # 1 us deadline: long expired
+        assert eng.batcher.flush_due()
+        assert eng._deadline_flush_due()  # idle pipe: fires
+        # in-flight work (dispatch-staged entry) blocks the flush
+        from flowsentryx_tpu.engine.engine import _InFlight
+
+        eng._inflight.append(_InFlight(out=None, t_enqueue=0.0,
+                                       n_records=1))
+        assert eng._busy_depth() == 1
+        assert not eng._deadline_flush_due()  # never mid-flight
+        eng._inflight.clear()
+        # work queued to the sink channel is STILL a busy pipe
+        eng._chan.submit(("single", None, 0.0, 1, 1, 0.0), 1)
+        assert eng._busy_depth() == 1
+        assert not eng._deadline_flush_due()
+        eng._chan.reset()
+        assert eng._deadline_flush_due()
+
+    def test_deadline_flush_slo_budget_bound(self):
+        """SLO mode bounds batcher residency by the budget even when
+        deadline_us is far larger — but still only into an idle
+        pipe."""
+        import dataclasses
+        import time as _t
+
+        cfg = small_cfg(batch=256)
+        cfg = dataclasses.replace(
+            cfg, batch=dataclasses.replace(cfg.batch,
+                                           deadline_us=50_000))
+        eng = Engine(cfg, ArraySource(self._recs(1)), NullSink(),
+                     sink_thread=False, slo_us=5_000)
+        eng._rung_ewma_s = {1: 0.001}
+        gen = TrafficGen(TrafficSpec(seed=2))
+        eng.batcher.add(gen.next_records(10))
+        # fresh fill: age < 4ms flush point -> not due (deadline far)
+        assert not eng._deadline_flush_due()
+        _t.sleep(0.006)
+        # age ~6ms >= budget - ewma(1) = 4ms -> budget flush fires
+        assert not eng.batcher.flush_due()
+        assert eng._deadline_flush_due()
+        # the budget/2 floor: an inflated single-step estimate (>=
+        # the whole budget) must NOT degenerate into flush-on-any-age
+        eng2 = Engine(cfg, ArraySource(self._recs(1)), NullSink(),
+                      sink_thread=False, slo_us=5_000)
+        eng2._rung_ewma_s = {1: 9.0}
+        eng2.batcher.add(gen.next_records(10))
+        assert not eng2._deadline_flush_due()  # fresh: floored
+        _t.sleep(0.003)
+        assert eng2._deadline_flush_due()      # past budget/2 = 2.5ms
+        from flowsentryx_tpu.engine.engine import _InFlight
+
+        eng._inflight.append(_InFlight(out=None, t_enqueue=0.0,
+                                       n_records=1))
+        assert not eng._deadline_flush_due()  # idle-pipe rule dominates
+
+    def test_slo_report_miss_accounting(self):
+        recs = self._recs(8)
+        rep, _, _ = self._run(recs, mega_n="auto", slo_us=1)
+        lat = rep.latency
+        assert lat["slo"]["slo_us"] == 1
+        # a 1 us budget is missed by every record of a real drain
+        assert lat["slo"]["miss_records"] == rep.records
+        assert lat["slo"]["miss_fraction"] == 1.0
+        assert lat["negatives"] == 0
+
+    def test_latency_stage_decomposition_populated(self):
+        recs = self._recs(6)
+        rep, _, _ = self._run(recs, mega_n="auto")
+        lat = rep.latency
+        assert lat["seal_to_verdict"]["n"] == rep.records
+        for s in ("staged_wait", "upload", "compute", "sink"):
+            assert lat["stages"][s]["n"] == rep.records
+        chain = [lat["seal_to_verdict"][k]
+                 for k in ("p50", "p90", "p99", "p999", "max")]
+        assert all(a <= b for a, b in zip(chain, chain[1:]))
+        assert chain[0] > 0
+
+    def test_slo_device_loop_parity_and_round_sizer(self):
+        """Ring mode under a budget: an EXISTING full-round backlog
+        still engages the deep scan (the un-capped recovery/
+        throughput path) with byte-identical results; the round
+        SIZER predicate — what the sealed ring loop consults before
+        WAITING for a round to fill — degrades only while headroom is
+        positive and smaller than a round, and is back on once the
+        record is already late."""
+        import time as _t
+
+        recs = self._recs(38)
+        repr_, sinkr, _ = self._run(recs, mega_n="auto", device_loop=2,
+                                    readback_depth=None)
+        reps, sinks, enge = self._run(recs, mega_n="auto",
+                                      device_loop=2, slo_us=2000,
+                                      warm=True, readback_depth=None)
+        assert reps.stats == repr_.stats
+        assert sinks.blocked == sinkr.blocked
+        assert repr_.dispatch["device_loop"]["rounds"] >= 2
+        assert reps.dispatch["device_loop"]["rounds"] >= 2
+        # the sizer predicate (2 ms budget, ring round EWMA 8 ms);
+        # rounds key NEGATED so a depth-1 ring can never alias the
+        # top rung's estimate (the round wall includes uploads+reap)
+        enge._rung_ewma_s[-16] = 0.008
+        now = _t.perf_counter()
+        assert not enge._slo_round_fits(now)          # would breach
+        enge._rung_ewma_s[-16] = 0.0005
+        assert enge._slo_round_fits(now)              # fits fresh
+        assert not enge._slo_round_fits(now - 0.0018)  # headroom gone
+        assert enge._slo_round_fits(now - 0.5)        # late: ring on
+        # warm() seeded the ring round under the negated key, leaving
+        # the top-rung estimate intact (device_loop=1 would alias)
+        assert "round16" in reps.dispatch["slo"]["rung_ewma_ms"]

@@ -677,3 +677,95 @@ class TestCrashPropagationPerWorker:
 
         sig = inspect.signature(ShardedIngest.__init__)
         assert sig.parameters["strict"].default is False
+
+
+# ---------------------------------------------------------------------------
+# SLO / latency-plane registry (PR 11): the new shared fields are
+# registered with the correct disciplines, and each discipline's
+# planted violation is caught — the PR 9 convention for every new
+# piece of cross-thread engine state.
+# ---------------------------------------------------------------------------
+
+class TestSloRegistry:
+    def test_new_fields_registered_with_expected_disciplines(self):
+        f = contracts.ENGINE_PLAN.fields
+        assert f["_rung_ewma_s"].discipline == "section:launch"
+        # the dispatch-thread policy readers are explicit grants, part
+        # of the documented discipline (advisory float reads)
+        for reader in ("_slo_cap", "_slo_pressed", "_slo_round_fits",
+                       "_deadline_flush_due"):
+            assert reader in f["_rung_ewma_s"].extra
+        assert f["_lat"].discipline == "section:sink"
+        assert f["slo_us"].discipline == "quiescent-write"
+        assert f["_slo_budget_s"].discipline == "quiescent-write"
+        # the EWMA writer is part of the launch section
+        assert "_note_step_s" in contracts.ENGINE_PLAN.sections["launch"]
+
+    def test_planted_ewma_write_outside_launch_section(self):
+        # an EWMA store from a worker-reachable method that is NOT in
+        # the launch section (and not a granted reader) must be a
+        # discipline finding — this is what makes the registry entry
+        # enforceable rather than documentation
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def run(self):\n"
+            "        threading.Thread(target=self._sink_worker).start()\n"
+            "    def _launch(self):\n"
+            "        self._ewma[1] = 0.5\n"
+            "    def _sink_worker(self):\n"
+            "        self._ewma[1] = 0.9\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            worker_targets=("_sink_worker",),
+            sections={"launch": ("_launch",)},
+            fields={"_ewma": FieldContract("section:launch",
+                                           "per-rung EWMA")}))
+        assert len(out) == 1
+        assert out[0].line == 8 and "_ewma" in out[0].reason
+
+    def test_planted_latency_recorder_touched_off_sink_section(self):
+        src = (
+            "class C:\n"
+            "    def _sink(self):\n"
+            "        self._lat.record(1)\n"
+            "    def poll(self):\n"
+            "        self._lat.record(2)\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            sections={"sink": ("_sink",)},
+            fields={"_lat": FieldContract("section:sink",
+                                          "latency plane")}))
+        assert len(out) == 1
+        assert out[0].line == 5 and "'sink' section" in out[0].reason
+
+    def test_planted_slo_flag_written_while_serving(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.slo_us = 0\n"
+            "    def serve(self):\n"
+            "        self.slo_us = 100\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C", quiescent=("__init__",),
+            fields={"slo_us": FieldContract("quiescent-write",
+                                            "budget flag")}))
+        assert len(out) == 1 and out[0].line == 5
+
+    def test_unregistered_ewma_like_state_is_flagged(self):
+        # deleting the registry entry must not be silent: a dict
+        # mutated from both the dispatch path and a worker without an
+        # entry trips the unregistered-shared-state detector
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def run(self):\n"
+            "        threading.Thread(target=self._worker).start()\n"
+            "        self._ewma[1] = 0.1\n"
+            "    def _worker(self):\n"
+            "        self._ewma[2] = 0.2\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            worker_targets=("_worker",), fields={}))
+        assert any(f.contract == "unregistered"
+                   and "_ewma" in f.reason for f in out)
