@@ -277,7 +277,31 @@ def _zeros_raw(cfg: FsxConfig, compact: bool) -> np.ndarray:
     return np.zeros((cfg.batch.max_batch + 1, words), np.uint32)
 
 
-def run_audit(
+@dataclasses.dataclass
+class StagedVariant:
+    """One stageable step variant plus the metadata every static pass
+    over it needs — the shared staging surface of the device-plane
+    static suite (``fsx audit`` consumes it here;
+    :mod:`flowsentryx_tpu.ranges` re-stages the same set for the
+    integer value-range proof, so the two legs can never audit
+    different graphs for one config)."""
+
+    name: str
+    jitted: Any
+    make_args: Callable[[], tuple]
+    verdict_k: int
+    expect_sharded: bool
+    donate_leaves: int
+    quantized: bool
+    n_param_leaves: int
+    ring_depth: int = 0
+    n_shards: int = 1
+    wire: str = schema.WIRE_COMPACT16  # which wire format `make_args`
+    #                                    builds (the range seeder keys
+    #                                    its per-word seeds on this)
+
+
+def stage_variants(
     cfg: FsxConfig,
     params: Any | None = None,
     mesh: Any | None = None,
@@ -286,34 +310,27 @@ def run_audit(
     donate: bool | None = None,
     mega_sizes: tuple[int, ...] | None = None,
     device_loop: int = 0,
-) -> AuditReport:
-    """Stage and audit the requested step variants under ``cfg``.
+) -> tuple[list[StagedVariant], list[str], Any]:
+    """Build (without tracing) every requested step variant under
+    ``cfg``; returns ``(staged, notes, params)``.  Argument semantics
+    are exactly :func:`run_audit`'s — this IS its staging loop,
+    factored out so other static passes prove the same artifacts."""
+    staged, notes, params, _donate, _sizes = _stage_variants(
+        cfg, params, mesh, mega_n, variants, donate, mega_sizes,
+        device_loop)
+    return staged, notes, params
 
-    ``variants`` defaults to everything stageable here: raw + compact +
-    megastep always, sharded when ``mesh`` spans more than one device.
-    ``donate=None`` follows the backend
-    (:func:`~flowsentryx_tpu.ops.fused.donation_supported`) exactly as
-    the engine does; ``False`` skips the donation contract with a note
-    (axon's compute-only epochs), any other value is audited as given.
 
-    ``mega_sizes`` audits the megastep variants once PER group size —
-    the adaptive-coalescing engine's ladder
-    (:func:`~flowsentryx_tpu.ops.fused.pow2_group_sizes`), where every
-    rung is its own compiled scan artifact whose contracts (528 B wire
-    after ``merge_verdict_wires``, donation through the scan carry,
-    collective budget per chunk) must be proved individually.  With
-    more than one size the per-size reports are named
-    ``megastep@<n>``; ``None`` keeps the single-``mega_n`` staging and
-    plain names.
-
-    ``device_loop >= 1`` additionally stages the drain-ring deep scan
-    (``device_loop@<ring>x<chunks>``, chunks = the ladder's top rung):
-    the 528 B-PER-SLOT wire pin on the ``[ring, 2K+4]`` output, the
-    donation aliasing proof for the carried ring state (table/stats
-    threading the nested scan), the no-hidden-callback sweep, and the
-    retrace sentinel, each on the graph a ``--device-loop`` engine
-    actually serves.
-    """
+def _stage_variants(
+    cfg: FsxConfig,
+    params: Any | None,
+    mesh: Any | None,
+    mega_n: int,
+    variants: tuple[str, ...] | None,
+    donate: bool | None,
+    mega_sizes: tuple[int, ...] | None,
+    device_loop: int,
+) -> tuple[list[StagedVariant], list[str], Any, bool, tuple[int, ...]]:
     notes: list[str] = []
     if donate is None:
         donate = fused.donation_supported()
@@ -366,7 +383,7 @@ def run_audit(
             table = par.shard_table(table, mesh)
         return table, schema.make_stats()
 
-    reports: list[VariantReport] = []
+    staged: list[StagedVariant] = []
     for name in variants:
         if name == "raw":
             jitted = fused.make_jitted_raw_step(
@@ -375,8 +392,13 @@ def run_audit(
             def mk():
                 return (*table_args(False), params,
                         _zeros_raw(cfg, compact=False))
-            sharded = False
-            donate_leaves = len(CARRY_NAMES) if donate else 0
+            staged.append(StagedVariant(
+                name, jitted, mk, verdict_k=cfg.batch.verdict_k,
+                expect_sharded=False,
+                donate_leaves=len(CARRY_NAMES) if donate else 0,
+                quantized=cfg.model.quantized,
+                n_param_leaves=n_param_leaves,
+                wire=schema.WIRE_RAW48))
         elif name == "compact":
             jitted = fused.make_jitted_compact_step(
                 cfg, spec.classify_batch, donate=donate, **quant)
@@ -384,12 +406,13 @@ def run_audit(
             def mk():
                 return (*table_args(False), params,
                         _zeros_raw(cfg, compact=True))
-            sharded = False
-            donate_leaves = len(CARRY_NAMES) if donate else 0
+            staged.append(StagedVariant(
+                name, jitted, mk, verdict_k=cfg.batch.verdict_k,
+                expect_sharded=False,
+                donate_leaves=len(CARRY_NAMES) if donate else 0,
+                quantized=cfg.model.quantized,
+                n_param_leaves=n_param_leaves))
         elif name == "sharded":
-            if not shardable:
-                raise ValueError("sharded variant requires a >1-device "
-                                 "mesh")
             from flowsentryx_tpu import parallel as par
 
             jitted = par.make_sharded_compact_step(
@@ -398,14 +421,19 @@ def run_audit(
             def mk():
                 return (*table_args(True), params,
                         _zeros_raw(cfg, compact=True))
-            sharded = True
-            donate_leaves = 2 if donate else 0  # table only (stats
-            #                                     replicate, cannot alias)
+            staged.append(StagedVariant(
+                name, jitted, mk, verdict_k=cfg.batch.verdict_k,
+                expect_sharded=True,
+                # table only (stats replicate, cannot alias)
+                donate_leaves=2 if donate else 0,
+                quantized=cfg.model.quantized,
+                n_param_leaves=n_param_leaves,
+                n_shards=int(mesh.devices.size)))
         elif name in ("megastep", "sharded_megastep"):
             is_sh = name == "sharded_megastep"
-            # one staged artifact — and one report — PER group size:
-            # an adaptive engine serves every rung of its ladder, so
-            # every rung's graph must be proved, not just the largest
+            # one staged artifact PER group size: an adaptive engine
+            # serves every rung of its ladder, so every rung's graph
+            # must be proved, not just the largest
             for n_sz in sizes:
                 if is_sh:
                     from flowsentryx_tpu import parallel as par
@@ -423,7 +451,7 @@ def run_audit(
                         (n_sz, cfg.batch.max_batch + 1,
                          schema.COMPACT_RECORD_WORDS), np.uint32)
                     return (*table_args(is_sh), params, raws)
-                reports.append(_audit_one(
+                staged.append(StagedVariant(
                     name if len(sizes) == 1 else f"{name}@{n_sz}",
                     jitted, mk, verdict_k=cfg.batch.verdict_k,
                     expect_sharded=is_sh,
@@ -432,7 +460,6 @@ def run_audit(
                     quantized=cfg.model.quantized,
                     n_param_leaves=n_param_leaves,
                     n_shards=(int(mesh.devices.size) if is_sh else 1)))
-            continue
         elif name in ("device_loop", "sharded_device_loop"):
             # the drain-ring deep scan: ring slots of top-rung groups,
             # staged with the exact shapes a --device-loop engine
@@ -456,7 +483,7 @@ def run_audit(
                               schema.COMPACT_RECORD_WORDS), np.uint32)
                     for _ in range(device_loop))
                 return (*table_args(is_sh), params, *slots)
-            reports.append(_audit_one(
+            staged.append(StagedVariant(
                 f"{name}@{device_loop}x{chunks}", jitted, mk,
                 verdict_k=cfg.batch.verdict_k, expect_sharded=is_sh,
                 donate_leaves=((2 if is_sh else len(CARRY_NAMES))
@@ -465,15 +492,60 @@ def run_audit(
                 n_param_leaves=n_param_leaves,
                 ring_depth=device_loop,
                 n_shards=(int(mesh.devices.size) if is_sh else 1)))
-            continue
         else:
             raise ValueError(f"unknown audit variant {name!r}")
-        reports.append(_audit_one(
-            name, jitted, mk, verdict_k=cfg.batch.verdict_k,
-            expect_sharded=sharded, donate_leaves=donate_leaves,
-            quantized=cfg.model.quantized,
-            n_param_leaves=n_param_leaves,
-            n_shards=(int(mesh.devices.size) if sharded else 1)))
+    return staged, notes, params, donate, sizes
+
+
+def run_audit(
+    cfg: FsxConfig,
+    params: Any | None = None,
+    mesh: Any | None = None,
+    mega_n: int = 2,
+    variants: tuple[str, ...] | None = None,
+    donate: bool | None = None,
+    mega_sizes: tuple[int, ...] | None = None,
+    device_loop: int = 0,
+) -> AuditReport:
+    """Stage and audit the requested step variants under ``cfg``.
+
+    ``variants`` defaults to everything stageable here: raw + compact +
+    megastep always, sharded when ``mesh`` spans more than one device.
+    ``donate=None`` follows the backend
+    (:func:`~flowsentryx_tpu.ops.fused.donation_supported`) exactly as
+    the engine does; ``False`` skips the donation contract with a note
+    (axon's compute-only epochs), any other value is audited as given.
+
+    ``mega_sizes`` audits the megastep variants once PER group size —
+    the adaptive-coalescing engine's ladder
+    (:func:`~flowsentryx_tpu.ops.fused.pow2_group_sizes`), where every
+    rung is its own compiled scan artifact whose contracts (528 B wire
+    after ``merge_verdict_wires``, donation through the scan carry,
+    collective budget per chunk) must be proved individually.  With
+    more than one size the per-size reports are named
+    ``megastep@<n>``; ``None`` keeps the single-``mega_n`` staging and
+    plain names.
+
+    ``device_loop >= 1`` additionally stages the drain-ring deep scan
+    (``device_loop@<ring>x<chunks>``, chunks = the ladder's top rung):
+    the 528 B-PER-SLOT wire pin on the ``[ring, 2K+4]`` output, the
+    donation aliasing proof for the carried ring state (table/stats
+    threading the nested scan), the no-hidden-callback sweep, and the
+    retrace sentinel, each on the graph a ``--device-loop`` engine
+    actually serves.
+    """
+    staged, notes, params, donate, sizes = _stage_variants(
+        cfg, params, mesh, mega_n, variants, donate, mega_sizes,
+        device_loop)
+    reports = [
+        _audit_one(
+            sv.name, sv.jitted, sv.make_args, verdict_k=sv.verdict_k,
+            expect_sharded=sv.expect_sharded,
+            donate_leaves=sv.donate_leaves, quantized=sv.quantized,
+            n_param_leaves=sv.n_param_leaves, ring_depth=sv.ring_depth,
+            n_shards=sv.n_shards)
+        for sv in staged
+    ]
 
     return AuditReport(
         ok=all(v.ok for v in reports),

@@ -235,15 +235,24 @@ class VerifierReport:
     states_pruned: int
     subprog_entries: list[int]
     map_names: list[str]
+    #: Joined scalar ranges observed at probed instructions
+    #: (``check_program(probes={idx: reg})``): idx ->
+    #: {"reg", "umin", "umax", "hits"}.  The ``fsx ranges`` cross-lane
+    #: containment bridge reads the MAC/band-select ranges this way —
+    #: purely observational, never affects accept/reject.
+    probes: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "program": self.name, "insns": self.n_insns,
             "insns_visited": self.insns_visited,
             "states_pruned": self.states_pruned,
             "subprogs": len(self.subprog_entries),
             "maps": self.map_names,
         }
+        if self.probes:
+            out["probes"] = self.probes
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -344,12 +353,16 @@ _HELPERS: dict[int, dict] = {
 class _Checker:
     def __init__(self, name: str, insns: list[Insn],
                  relocs: dict[int, str], maps: dict[str, MapInfo],
-                 budget: int):
+                 budget: int, probes: dict[int, int] | None = None):
         self.name = name
         self.insns = insns
         self.relocs = relocs  # slot idx -> map name
         self.maps = maps
         self.budget = budget
+        self.probes = probes or {}
+        #: idx -> [umin, umax, hits]: the join over every abstract
+        #: state reaching the probed instruction (pre-execution)
+        self.probe_acc: dict[int, list[int]] = {}
         self.visited: set[int] = set()
         self.pruned = 0
         self.steps = 0
@@ -1178,6 +1191,14 @@ class _Checker:
                           "control flow falls off the end of the program")
             if idx in self.wide_lo:
                 self._die(idx, st, "jump into the middle of a ld_imm64")
+            if idx in self.probes:
+                r = st.regs[self.probes[idx]]
+                if r.kind == SCALAR:
+                    acc = self.probe_acc.setdefault(
+                        idx, [r.umin, r.umax, 0])
+                    acc[0] = min(acc[0], r.umin)
+                    acc[1] = max(acc[1], r.umax)
+                    acc[2] += 1
             self.steps += 1
             if self.steps > self.budget:
                 self._die(idx, st,
@@ -1220,9 +1241,21 @@ def _entry_state(main: bool) -> State:
 def check_program(prog: Program | list[Insn],
                   maps: dict[str, MapInfo] | None = None,
                   *, name: str | None = None,
-                  budget: int = 1_000_000) -> VerifierReport:
+                  budget: int = 1_000_000,
+                  probes: dict[int, int] | None = None,
+                  entry_main: bool = True) -> VerifierReport:
     """Statically verify one program; raises :class:`StaticVerifierError`
-    with an instruction-level diagnostic on the first violation."""
+    with an instruction-level diagnostic on the first violation.
+
+    ``probes`` maps instruction index -> register number: the report's
+    ``probes`` field then carries the joined (umin, umax) of that
+    register over every abstract state REACHING that instruction —
+    observational only (the ``fsx ranges`` containment bridge).
+
+    ``entry_main=False`` verifies instruction 0 under the bpf-to-bpf
+    CALLEE contract (r1-r5 unknown scalars, no ctx) — for standalone
+    subprogram extracts like ``progs.build_ml_scorer``, whose entry is
+    a local-call target in the shipped programs."""
     if isinstance(prog, Program):
         insns = prog.insns
         relocs = {r.slot: r.map_name for r in prog.relocs}
@@ -1239,7 +1272,7 @@ def check_program(prog: Program | list[Insn],
                                   f"program references unknown maps "
                                   f"{missing}")
 
-    ck = _Checker(name, insns, relocs, maps, budget)
+    ck = _Checker(name, insns, relocs, maps, budget, probes=probes)
     # subprograms: every local-call target verifies standalone
     entries = [0]
     for i, ins in enumerate(insns):
@@ -1248,7 +1281,7 @@ def check_program(prog: Program | list[Insn],
             if tgt not in entries:
                 entries.append(tgt)
     for e in entries:
-        ck.run(e, _entry_state(main=e == 0))
+        ck.run(e, _entry_state(main=entry_main and e == 0))
     unreachable = sorted(set(range(len(insns))) - ck.visited)
     if unreachable:
         ck._die(unreachable[0], None,
@@ -1257,6 +1290,11 @@ def check_program(prog: Program | list[Insn],
         name=name, n_insns=len(insns), insns_visited=ck.steps,
         states_pruned=ck.pruned, subprog_entries=entries[1:],
         map_names=sorted(set(relocs.values())),
+        probes={
+            idx: {"reg": probes[idx], "umin": acc[0], "umax": acc[1],
+                  "hits": acc[2]}
+            for idx, acc in sorted(ck.probe_acc.items())
+        } if probes else {},
     )
 
 

@@ -258,6 +258,46 @@ def _cmd_check(args: argparse.Namespace) -> int:
     return 0 if out["ok"] else 1
 
 
+def _quick_shapes(cfg):
+    """The --quick staging shapes (small table/batch; the static
+    contracts are shape-generic) — one definition for every verb that
+    stages the variant set."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        cfg,
+        table=_dc.replace(cfg.table, capacity=1 << 12),
+        batch=_dc.replace(cfg.batch, max_batch=256),
+    )
+
+
+def _stage_mesh_and_mega(args: argparse.Namespace) -> tuple:
+    """THE one resolution of the staged-variant sizing flags the
+    ``audit`` and ``ranges`` verbs expose identically (``--mesh 0`` =
+    every visible device when they form a >1 power-of-two mesh;
+    ``--mega auto`` = the adaptive power-of-two ladder) — shared so
+    the two static legs can never stage different variant sets for
+    the same flags.  Returns ``(mesh, mega_kwargs)``."""
+    mesh = None
+    n_mesh = args.mesh
+    if n_mesh == 0:
+        import jax
+
+        n = len(jax.devices())
+        n_mesh = n if n > 1 and not (n & (n - 1)) else 1
+    if n_mesh > 1:
+        from flowsentryx_tpu.parallel import make_mesh
+
+        mesh = make_mesh(n_mesh)
+    if args.mega == "auto":
+        from flowsentryx_tpu.engine.engine import MEGA_AUTO_MAX
+        from flowsentryx_tpu.ops.fused import pow2_group_sizes
+
+        return mesh, {"mega_n": MEGA_AUTO_MAX,
+                      "mega_sizes": pow2_group_sizes(MEGA_AUTO_MAX)}
+    return mesh, {"mega_n": args.mega}
+
+
 def _cmd_audit(args: argparse.Namespace) -> int:
     """Static dtype/donation/transfer audit of the staged TPU step
     graphs — the device-plane half of the static-analysis suite
@@ -312,34 +352,10 @@ def _cmd_audit(args: argparse.Namespace) -> int:
         # small shapes, same contracts: every check here is
         # shape-generic except the byte budgets, which scale with the
         # quick config and are labeled as such in the report
-        cfg = _dc.replace(
-            cfg,
-            table=_dc.replace(cfg.table, capacity=1 << 12),
-            batch=_dc.replace(cfg.batch, max_batch=256),
-        )
-    mesh = None
-    n_mesh = args.mesh
-    if n_mesh == 0:  # auto: all devices when they form a >1 pow2 mesh
-        import jax
-
-        n = len(jax.devices())
-        n_mesh = n if n > 1 and not (n & (n - 1)) else 1
-    if n_mesh > 1:
-        from flowsentryx_tpu.parallel import make_mesh
-
-        mesh = make_mesh(n_mesh)
-    if args.mega == "auto":
-        # audit the exact ladder an Engine(mega_n="auto") serves: one
-        # staged scan artifact per power-of-two group size
-        from flowsentryx_tpu.engine.engine import MEGA_AUTO_MAX
-        from flowsentryx_tpu.ops.fused import pow2_group_sizes
-
-        rep = run_audit(cfg, mesh=mesh, mega_n=MEGA_AUTO_MAX,
-                        mega_sizes=pow2_group_sizes(MEGA_AUTO_MAX),
-                        device_loop=args.device_loop)
-    else:
-        rep = run_audit(cfg, mesh=mesh, mega_n=args.mega,
-                        device_loop=args.device_loop)
+        cfg = _quick_shapes(cfg)
+    mesh, mega = _stage_mesh_and_mega(args)
+    rep = run_audit(cfg, mesh=mesh, device_loop=args.device_loop,
+                    **mega)
     if args.out:
         runner.write_artifact(rep, args.out)
     if args.json:
@@ -439,6 +455,88 @@ def _cmd_sync(args: argparse.Namespace) -> int:
     else:
         print("fsx sync: FAIL", file=sys.stderr)
     return 0 if out["ok"] else 1
+
+
+def _cmd_ranges(args: argparse.Namespace) -> int:
+    """Static integer value-range proof over the staged step graphs —
+    the fourth leg of the static suite (``fsx check`` proves the BPF
+    bytecode, ``fsx audit`` the device graphs' transfer contracts,
+    ``fsx sync`` the host concurrency plane; docs/RANGES.md,
+    docs/STATIC.md).
+
+    Stages every step variant (same staging as ``fsx audit``), seeds
+    the inputs from the declared range registry, and proves no
+    equation can silently wrap a fixed-width integer — modulo the
+    audited ``WRAP_OK`` registry, itself checked for staleness every
+    run.  Also re-proves the three planted negative controls fire and,
+    when the shipped distill artifact is present, the BPF↔jaxpr
+    interval-containment bridge."""
+    import dataclasses as _dc
+
+    _honor_jax_platform()
+    from flowsentryx_tpu.ranges import runner as ranges_runner
+
+    if args.device_loop < 0:
+        print("fsx ranges: --device-loop must be >= 0", file=sys.stderr)
+        return 1
+    if args.device_loop and not args.mega:
+        print("fsx ranges: --device-loop needs --mega N|auto (the ring "
+              "scans top-rung mega groups)", file=sys.stderr)
+        return 1
+    cfg = _load_cfg(args)
+    if args.evict_ttl < 0:
+        print("fsx ranges: --evict-ttl must be >= 0", file=sys.stderr)
+        return 1
+    if args.evict_every < 1:
+        print("fsx ranges: --evict-every must be >= 1", file=sys.stderr)
+        return 1
+    if args.evict_ttl:
+        cfg = _dc.replace(cfg, table=_dc.replace(
+            cfg.table, evict_ttl_s=args.evict_ttl,
+            evict_every=args.evict_every))
+    if args.quick:
+        cfg = _quick_shapes(cfg)
+    mesh, mega = _stage_mesh_and_mega(args)
+    rep = ranges_runner.run_ranges(
+        cfg, mesh=mesh, device_loop=args.device_loop,
+        artifact=args.artifact, **mega)
+    if args.out:
+        ranges_runner.write_artifact(rep, args.out)
+    if args.json:
+        print(json.dumps(rep.to_json(), indent=2))
+    else:
+        for note in rep.notes:
+            print(f"fsx ranges: note: {note}")
+        for v in rep.variants:
+            if v.ok:
+                wraps = sum(v.wrap_ok_matches.values())
+                print(f"fsx ranges: {v.name}: OK ({v.n_eqns} eqns, "
+                      f"{v.n_checked} checked, {wraps} audited "
+                      "wrap-ok)")
+            else:
+                print(f"fsx ranges: {v.name}: FAILED", file=sys.stderr)
+                for f in v.findings:
+                    print(f"  {f}", file=sys.stderr)
+        for f in rep.registry_findings:
+            print(f"fsx ranges: registry: {f}", file=sys.stderr)
+        neg = rep.negatives
+        print("fsx ranges: negative controls: "
+              + ("all fire" if neg.get("ok") else "FAILED (a finding "
+                 "class no longer fires — prover regression)"))
+        if rep.bridge is not None:
+            b = rep.bridge
+            if b.get("ok"):
+                print("fsx ranges: BPF<->jaxpr containment: OK (acc "
+                      f"{b['kernel_acc']} within the verifier's MAC "
+                      "range; bands "
+                      f"{b['jax_bands']} within "
+                      f"[{b['bpf_band']['umin']}, "
+                      f"{b['bpf_band']['umax']}])")
+            else:
+                print(f"fsx ranges: BPF<->jaxpr containment: FAILED "
+                      f"({b.get('error', b)})", file=sys.stderr)
+        print(f"fsx ranges: {'PASS' if rep.ok else 'FAIL'}")
+    return 0 if rep.ok else 1
 
 
 def _cmd_distill(args: argparse.Namespace) -> int:
@@ -2073,6 +2171,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also write the JSON report here (the "
                          "artifacts/SYNC_*.json evidence file)")
     sy.set_defaults(fn=_cmd_sync)
+
+    rg = sub.add_parser(
+        "ranges",
+        help="statically prove no staged step variant can silently "
+             "wrap a fixed-width integer (interval abstract "
+             "interpretation over the jaxprs; the fourth static leg)")
+    rg.add_argument("--config", help="JSON config file")
+    rg.add_argument("--mesh", type=int, default=0,
+                    help="stage the sharded variants over an N-device "
+                         "mesh (0 = auto, as fsx audit)")
+    rg.add_argument("--mega", type=_mega_arg, default=2,
+                    help="megastep chunk count, or 'auto' for every "
+                         "rung of the adaptive ladder")
+    rg.add_argument("--device-loop", type=int, default=0, metavar="N",
+                    help="also prove the drain-ring deep scan at ring "
+                         "depth N (needs --mega)")
+    rg.add_argument("--evict-ttl", type=float, default=0.0,
+                    metavar="S",
+                    help="prove the eviction-epoch variants (the "
+                         "batches-counter window arithmetic stages "
+                         "only when eviction is on)")
+    rg.add_argument("--evict-every", type=int, default=64, metavar="N",
+                    help="sweep epoch period for --evict-ttl "
+                         "(default 64)")
+    rg.add_argument("--quick", action="store_true",
+                    help="small table/batch shapes (CI gate); the "
+                         "interval contracts are shape-generic")
+    rg.add_argument("--artifact",
+                    default="artifacts/logreg_int8.npz",
+                    help="distill artifact for the BPF<->jaxpr "
+                         "containment bridge (skipped with a note "
+                         "when absent; pass '' to disable)")
+    rg.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    rg.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/RANGES_*.json evidence file)")
+    rg.set_defaults(fn=_cmd_ranges)
 
     # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
     # construction never imports the bpf loader (lazy-import rule).

@@ -825,7 +825,7 @@ def decode_raw(raw) -> "FeatureBatch":
     return FeatureBatch(
         key=words[:, 2],
         feat=words[:, 4:12].astype(jnp.float32),
-        pkt_len=(w3 & np.uint32(0xFFFF)).astype(jnp.float32),
+        pkt_len=(w3 & np.uint32(RANGE_PKT_LEN_MAX)).astype(jnp.float32),
         ts=ts,
         valid=jnp.arange(words.shape[0]) < n,
     )
@@ -835,7 +835,8 @@ def raw_proto_flags(raw) -> tuple:
     """(ip_proto, flags) u32 vectors from the wire format, for consumers
     that need the L4 breakdown (stats attribution, per-proto policy)."""
     w3 = raw[:-1, 3]
-    return (w3 >> np.uint32(16)) & np.uint32(0xFF), w3 >> np.uint32(24)
+    return ((w3 >> np.uint32(16)) & np.uint32(RANGE_PROTO_MAX),
+            w3 >> np.uint32(24))
 
 
 # ---------------------------------------------------------------------------
@@ -897,6 +898,34 @@ COMPACT_RECORD_SIZE = COMPACT_RECORD_WORDS * 4  # 16
 WIRE_RAW48 = "raw48"
 WIRE_COMPACT16 = "compact16"
 
+# -- declared field-width / value-range constants ---------------------------
+#
+# ONE source of truth for the magic widths of the wire formats: the
+# encode/decode/quantize paths below mask and clip with these names, and
+# the ``fsx ranges`` prover (flowsentryx_tpu/ranges/seeds.py) seeds its
+# input intervals from the SAME names — so what the prover assumes about
+# a field is, by construction, what the runtime enforces.
+
+#: u8 quantized-feature ceiling (both wire quantizers clip here).
+RANGE_FEAT_Q8_MAX = 255
+#: u16 wire-length field of the 48 B record (``pkt_len``).
+RANGE_PKT_LEN_MAX = 0xFFFF
+#: u8 IPPROTO field packed into raw w3 bits 16-23.
+RANGE_PROTO_MAX = 0xFF
+#: the 5 FLAG_* bits of compact w3 (bits 11-15).
+RANGE_FLAGS_MAX = 0x1F
+#: 11-bit pkt_len/8 field of compact w3 (bits 0-10; covers jumbo frames).
+RANGE_LEN8_MAX = 0x7FF
+#: 16-bit compact ts delta field (µs from the batch base; bits 16-31).
+RANGE_DT_US_MAX = 0xFFFF
+#: Declared deployment-horizon bound (seconds) on boot-relative ns
+#: stamps (``bpf_ktime_get_ns`` / the engine epoch ``t0_ns``): ~48.5
+#: days.  Not enforced per record — it is the range registry's declared
+#: assumption about how long one serving process lives, bounding the
+#: u64 timestamp HI words the split-word decodes see.  A redeploy past
+#: the horizon restarts the epoch.
+RANGE_DEPLOY_HORIZON_S = 1 << 22
+
 
 def quantize_feat_model(
     feat: np.ndarray, in_scale: float, in_zp: int, log1p: bool
@@ -908,7 +937,7 @@ def quantize_feat_model(
     if log1p:
         x = np.log1p(x)
     q = np.rint(x / np.float32(in_scale)) + in_zp
-    return np.clip(q, 0, 255).astype(np.uint32)
+    return np.clip(q, 0, RANGE_FEAT_Q8_MAX).astype(np.uint32)
 
 
 def _minifloat_ref(feat: np.ndarray) -> np.ndarray:
@@ -931,7 +960,7 @@ def _minifloat_ref(feat: np.ndarray) -> np.ndarray:
     e = np.where(r == 16, e + 1, e)
     r = np.where(r == 16, np.uint64(8), r)
     q = np.where(bl <= 3, f, (e + np.uint64(1)) * 8 + (r - 8))
-    return np.minimum(q, 255).astype(np.uint32)
+    return np.minimum(q, RANGE_FEAT_Q8_MAX).astype(np.uint32)
 
 
 #: Concatenated encode tables: ``[0, 2^16)`` maps f directly,
@@ -1056,11 +1085,13 @@ def compact_pack(
     qw = np.ascontiguousarray(q8).view(np.uint32)
     out[:, 1] = qw[:, 0]
     out[:, 2] = qw[:, 1]
-    len8 = np.minimum((rec["pkt_len"].astype(np.uint32) + 4) >> 3, 2047)
+    len8 = np.minimum((rec["pkt_len"].astype(np.uint32) + 4) >> 3,
+                      RANGE_LEN8_MAX)
     # records can arrive slightly out of order; clamp below base to 0
     dt = rec["ts_ns"].astype(np.int64) - np.int64(base_ns)
-    dt_us = np.clip(dt // 1000, 0, 65535).astype(np.uint32)
-    out[:, 3] = (len8 | (rec["flags"].astype(np.uint32) & 0x1F) << 11
+    dt_us = np.clip(dt // 1000, 0, RANGE_DT_US_MAX).astype(np.uint32)
+    out[:, 3] = (len8
+                 | (rec["flags"].astype(np.uint32) & RANGE_FLAGS_MAX) << 11
                  | dt_us << 16)
     return out
 
@@ -1132,10 +1163,11 @@ def decode_compact(
     base = (meta[2].astype(jnp.float32) * np.float32(4294.967296)
             + meta[1].astype(jnp.float32) * np.float32(1e-6))
     w1, w2, w3 = words[:, 1], words[:, 2], words[:, 3]
+    q8 = RANGE_FEAT_Q8_MAX  # the byte lanes carry u8 quantized features
     q = jnp.stack(
         [
-            w1 & 0xFF, (w1 >> 8) & 0xFF, (w1 >> 16) & 0xFF, w1 >> 24,
-            w2 & 0xFF, (w2 >> 8) & 0xFF, (w2 >> 16) & 0xFF, w2 >> 24,
+            w1 & q8, (w1 >> 8) & q8, (w1 >> 16) & q8, w1 >> 24,
+            w2 & q8, (w2 >> 8) & q8, (w2 >> 16) & q8, w2 >> 24,
         ],
         axis=1,
     )
@@ -1148,7 +1180,8 @@ def decode_compact(
     return FeatureBatch(
         key=words[:, 0],
         feat=feat,
-        pkt_len=((w3 & np.uint32(0x7FF)) << np.uint32(3)).astype(jnp.float32),
+        pkt_len=((w3 & np.uint32(RANGE_LEN8_MAX))
+                 << np.uint32(3)).astype(jnp.float32),
         ts=base + (w3 >> np.uint32(16)).astype(jnp.float32) * np.float32(1e-6),
         valid=jnp.arange(words.shape[0]) < n,
     )
@@ -1156,7 +1189,7 @@ def decode_compact(
 
 def compact_flags(raw):
     """FLAG_* bits vector from the compact wire format."""
-    return (raw[:-1, 3] >> np.uint32(11)) & np.uint32(0x1F)
+    return (raw[:-1, 3] >> np.uint32(11)) & np.uint32(RANGE_FLAGS_MAX)
 
 
 #: One KERNEL-emitted compact record (struct fsx_compact_record): the
@@ -1178,7 +1211,9 @@ def unwrap_kernel_ts16(w3: np.ndarray, now_ns: int) -> np.ndarray:
     n·65.5 ms late — bounded skew, never corruption)."""
     now_us = np.uint64(now_ns // 1000)
     ts16 = (w3 >> np.uint32(16)).astype(np.uint64)
-    return (now_us - ((now_us - ts16) & np.uint64(0xFFFF))) * np.uint64(1000)
+    return (now_us
+            - ((now_us - ts16) & np.uint64(RANGE_DT_US_MAX))
+            ) * np.uint64(1000)
 
 
 def decode_records(buf: np.ndarray, batch_size: int, t0_ns: int) -> FeatureBatch:

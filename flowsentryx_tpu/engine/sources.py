@@ -184,9 +184,10 @@ class PacedSource:
         n = min(due - self.emitted, max_records, self.total - self.emitted)
         if n <= 0:
             return np.empty(0, dtype=self.pool.dtype)
-        idx = (self.emitted + np.arange(n)) % len(self.pool)
+        idx = (self.emitted + np.arange(n, dtype=np.int64)) % len(self.pool)
         recs = self.pool[idx]
-        sched_rel = self._sched_rel_s(self.emitted + np.arange(n))
+        sched_rel = self._sched_rel_s(
+            self.emitted + np.arange(n, dtype=np.int64))
         recs["ts_ns"] = np.round(sched_rel * 1e9).astype(np.uint64)
         self.emitted += n
         return recs
@@ -198,7 +199,7 @@ class PacedSource:
             raise ValueError(
                 f"popping {n} with only {self.emitted - self.popped} emitted"
             )
-        k = self.popped + np.arange(n)
+        k = self.popped + np.arange(n, dtype=np.int64)
         self.popped += n
         return (self.t_start or 0.0) + self._sched_rel_s(k)
 

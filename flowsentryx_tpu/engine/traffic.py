@@ -255,7 +255,7 @@ class TrafficGen:
         elif spec.scenario is Scenario.UDP_FLOOD_MULTI:
             proto = np.where(is_attack, _PROTO["udp"], _PROTO["tcp"])
         elif spec.scenario is Scenario.SYN_BENIGN_MIX:
-            proto = np.full(n, _PROTO["tcp"])
+            proto = np.full(n, _PROTO["tcp"], np.uint8)
             buf["flags"][is_attack] |= schema.FLAG_TCP_SYN | schema.FLAG_TCP
         else:  # mixed L3/L4
             proto = self.rng.choice(list(_PROTO.values()), n)

@@ -1,0 +1,19 @@
+"""``fsx ranges`` — the whole-pipeline integer value-range prover.
+
+Fourth leg of the static suite (``fsx check`` proves the BPF bytecode,
+``fsx audit`` the staged device graphs' transfer/donation contracts,
+``fsx sync`` the host concurrency plane): an abstract interpreter over
+the staged serving jaxprs that propagates per-variable integer
+intervals and proves, without executing a batch, that no staged
+variant can silently wrap a fixed-width integer.  docs/RANGES.md has
+the operator view; docs/STATIC.md frames the four legs together.
+"""
+
+from flowsentryx_tpu.ranges.interval import IVal  # noqa: F401
+from flowsentryx_tpu.ranges.prover import Analysis, analyze  # noqa: F401
+from flowsentryx_tpu.ranges.registry import (  # noqa: F401
+    WRAP_OK, WrapOk, audit_registry,
+)
+from flowsentryx_tpu.ranges.runner import (  # noqa: F401
+    RangesReport, run_ranges, write_artifact,
+)

@@ -1,0 +1,174 @@
+"""The cross-lane interval-containment proof: BPF verifier ⊇ jaxpr.
+
+The distilled kernel scorer (``bpf/progs.py fn_ml_score``) and the
+served int8 lane (``models/logreg.classify_batch_int8_matmul``) compute
+the same weighted rank sum.  PR 6 proved them equal *concretely* (the
+lock-step bytecode emulator over a corpus); this module adds the first
+**static** parity bridge: for the shipped distill artifact,
+
+* the BPF verifier's ``umin/umax`` at the scorer's MAC accumulate
+  instructions and at the band-select exit (read through the
+  observational probe API, :func:`~flowsentryx_tpu.bpf.verifier
+  .check_program` ``probes=``) must **contain**
+* the jaxpr-derived accumulator interval for the same computation
+  (the range prover run over the staged int8 matmul lane with the
+  artifact's exact parameter values as seeds), mapped into the
+  kernel's raw-``Σ w·q`` domain (zero-point folded the way the
+  distiller folds it) and into u64 two's-complement.
+
+A containment failure means one lane's emission or staging drifted —
+the scorer packs registers differently, the matmul recentering
+changed, the verifier lost range precision where the proof needs it —
+caught with no kernel and no execution, before the concrete emulator
+ever runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+
+U64 = (1 << 64) - 1
+
+
+def locate_probe_sites(prog: Any) -> dict:
+    """Find the MAC accumulates and the band-select exit in the
+    assembled scorer (``bpf/progs.build_ml_scorer``) by instruction
+    pattern, not by hard-coded offsets — re-emission may shift
+    indices, never shapes:
+
+    * MAC: ``r6 += r4`` (ALU64 ADD X, dst=6, src=4) — one per feature;
+      probed one slot later, where r6 holds the partial sum.
+    * band: the ``exit`` directly following ``r0 -= r1`` (the
+      branch-free band-select tail); probed at the exit, where r0
+      holds the band code.
+    """
+    from flowsentryx_tpu.bpf import isa
+
+    mac_after: list[int] = []
+    band_exit = None
+    add_r6 = isa.BPF_ALU64 | isa.BPF_ADD | isa.BPF_X
+    sub_r0 = isa.BPF_ALU64 | isa.BPF_SUB | isa.BPF_X
+    exit_op = isa.BPF_JMP | isa.BPF_EXIT
+    for i, ins in enumerate(prog.insns):
+        if ins.op == add_r6 and ins.dst == 6 and ins.src == 4:
+            mac_after.append(i + 1)
+        if (ins.op == exit_op and i > 0
+                and prog.insns[i - 1].op == sub_r0
+                and prog.insns[i - 1].dst == 0):
+            band_exit = i
+    if len(mac_after) != schema.NUM_FEATURES or band_exit is None:
+        raise ValueError(
+            f"fn_ml_score shape drift: found {len(mac_after)} MAC "
+            f"accumulates (want {schema.NUM_FEATURES}) and band exit "
+            f"{band_exit} — the containment bridge's instruction "
+            "patterns no longer match the emitted scorer")
+    return {"mac_after": mac_after, "band_exit": band_exit}
+
+
+def _twos_complement_segments(lo: int, hi: int) -> list[tuple]:
+    """A signed interval as u64 two's-complement segment(s)."""
+    if lo >= 0:
+        return [(lo, hi)]
+    if hi < 0:
+        return [(lo + (1 << 64), hi + (1 << 64))]
+    return [(0, hi), (lo + (1 << 64), U64)]
+
+
+def _contained(lo: int, hi: int, umin: int, umax: int) -> bool:
+    return all(umin <= s0 and s1 <= umax
+               for s0, s1 in _twos_complement_segments(lo, hi))
+
+
+def jax_acc_interval(params: Any, batch: int = 8) -> tuple:
+    """The served int8 lane's accumulator interval, derived from its
+    STAGED jaxpr by the range prover (exact artifact values seeding
+    the parameter leaves; features unconstrained floats):
+    ``(acc_jax_lo, acc_jax_hi)`` in the jax zero-point-folded domain
+    ``Σ w·(q - zp)``."""
+    import jax
+
+    from flowsentryx_tpu.models import logreg
+    from flowsentryx_tpu.ranges import interval as iv
+    from flowsentryx_tpu.ranges import prover
+
+    jitted = jax.jit(logreg.classify_batch_int8_matmul)
+    x = np.zeros((batch, schema.NUM_FEATURES), np.float32)
+    closed = jitted.trace(params, x).jaxpr
+    leaves = jax.tree_util.tree_leaves(params)
+    seeds = [iv.const_of(np.asarray(leaf)) for leaf in leaves]
+    seeds.append(iv.float_top())
+    an = prover.analyze(
+        closed, seeds,
+        collect=lambda w, e: ("dot" if e.primitive.name == "dot_general"
+                              else None))
+    if an.findings:
+        raise ValueError(
+            "range prover found escapes in the int8 classifier lane: "
+            + "; ".join(str(f) for f in an.findings))
+    if "dot" not in an.collected:
+        raise ValueError("no dot_general in the staged int8 lane — "
+                         "the MXU matmul form changed; retarget the "
+                         "bridge's collect hook")
+    dlo, dhi = an.collected["dot"]
+    # undo the [-128, 127] recentering the MXU form applies:
+    # acc_jax = dot + (128 - in_zp) * Σw  (classify_batch_int8_matmul)
+    w_sum = int(np.asarray(params.w_int8, np.int64).sum())
+    in_zp = int(np.asarray(params.in_zp))
+    corr = (128 - in_zp) * w_sum
+    return int(dlo) + corr, int(dhi) + corr
+
+
+def containment_proof(params: Any, budget: int = 2_000_000) -> dict:
+    """Run both sides and check containment (module docstring).
+
+    Returns the JSON-able proof record; ``ok`` is True iff the full
+    kernel-domain accumulator interval is contained in the verifier's
+    range at the FINAL MAC accumulate and the jax band set {PASS,
+    ESCALATE, DROP} is contained at the band-select exit."""
+    from flowsentryx_tpu.bpf import progs, verifier
+
+    prog = progs.build_ml_scorer()
+    sites = locate_probe_sites(prog)
+    probes = {i: 6 for i in sites["mac_after"]}
+    probes[sites["band_exit"]] = 0
+    # entry_main=False: fn_ml_score is a local-call target in the
+    # shipped programs (r1-r4 carry the packed features as scalars)
+    rep = verifier.check_program(prog, name="fsx_ml_scorer",
+                                 budget=budget, probes=probes,
+                                 entry_main=False)
+
+    acc_jax = jax_acc_interval(params)
+    w_sum = int(np.asarray(params.w_int8, np.int64).sum())
+    in_zp = int(np.asarray(params.in_zp))
+    # kernel domain: s = Σ w·q = acc_jax + zp·Σw (the distiller's fold)
+    acc_lo = acc_jax[0] + in_zp * w_sum
+    acc_hi = acc_jax[1] + in_zp * w_sum
+
+    final_mac = sites["mac_after"][-1]
+    mac_probe = rep.probes.get(final_mac)
+    band_probe = rep.probes.get(sites["band_exit"])
+    mac_ok = (mac_probe is not None and mac_probe["hits"] > 0
+              and _contained(acc_lo, acc_hi,
+                             mac_probe["umin"], mac_probe["umax"]))
+    bands = (int(schema.ML_BAND_PASS), int(schema.ML_BAND_DROP))
+    band_ok = (band_probe is not None and band_probe["hits"] > 0
+               and _contained(bands[0], bands[1],
+                              band_probe["umin"], band_probe["umax"]))
+    return {
+        "ok": bool(mac_ok and band_ok),
+        "jax_acc_zp_folded": [acc_jax[0], acc_jax[1]],
+        "kernel_acc": [acc_lo, acc_hi],
+        "jax_bands": list(bands),
+        "mac_sites": sites["mac_after"],
+        "band_exit": sites["band_exit"],
+        "bpf_final_mac": mac_probe,
+        "bpf_band": band_probe,
+        "bpf_mac_all": {str(i): rep.probes.get(i)
+                        for i in sites["mac_after"]},
+        "mac_contained": bool(mac_ok),
+        "band_contained": bool(band_ok),
+    }

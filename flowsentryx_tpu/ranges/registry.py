@@ -1,0 +1,131 @@
+"""The ``WRAP_OK`` registry: audited exemptions for deliberate wraps.
+
+A handful of sites in the serving path wrap fixed-width integers *by
+design* — the Murmur avalanche, the probe-ring walk, the ``(lo, hi)``
+carry-pair add, the split-word timestamp rebase.  Each gets ONE entry
+here, naming the source function it lives in, the primitives it may
+exempt, and a rationale; the prover matches an escaping equation
+against the registry through the equation's jaxpr source frames.
+
+Discipline (mirrors the ``fsx sync`` contract registry): entries are
+**audited for staleness** every run —
+
+* the named function must still exist in the named file (deleted code
+  cannot leave a dangling exemption), and
+* the entry must have matched at least one equation across the run's
+  staged variants (an exemption nothing uses is dead weight that would
+  silently cover a future accidental wrap at the same site).
+
+Either failure is a finding, exactly like a violated range contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+from flowsentryx_tpu.audit.graph import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class WrapOk:
+    """One audited wrap exemption."""
+
+    name: str            # slug (artifact/report key)
+    file: str            # repo-relative source file the wrap lives in
+    func: str            # function whose staged equations are exempt
+    prims: frozenset     # primitive names the exemption covers
+    rationale: str       # why the wrap is sound (report-facing)
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["prims"] = sorted(self.prims)
+        return d
+
+
+def _ok(name, file, func, prims, rationale) -> WrapOk:
+    return WrapOk(name, file, func, frozenset(prims), rationale)
+
+
+#: The shipped registry.  Keep it MINIMAL: the staleness audit fails on
+#: any entry that stops matching, so speculative entries cannot live
+#: here — every line is a wrap the staged graphs actually perform.
+WRAP_OK: tuple[WrapOk, ...] = (
+    _ok("hash-avalanche",
+        "flowsentryx_tpu/ops/hashtable.py", "hash_u32",
+        {"mul", "add"},
+        "Murmur3 finalizer: the multiply avalanches mod 2^32 by "
+        "design; every output bit is used as hash state, never as a "
+        "count"),
+    _ok("probe-ring-walk",
+        "flowsentryx_tpu/ops/hashtable.py", "probe_slots",
+        {"mul", "add"},
+        "(h1 + p*step) wraps mod 2^32 and is immediately masked to "
+        "the power-of-two capacity: the AND absorbs the wrap, the "
+        "walk is a ring by construction"),
+    _ok("stat-carry-add",
+        "flowsentryx_tpu/core/schema.py", "u64_add",
+        {"add"},
+        "the (lo, hi) uint32 carry pair: the lo add is INTENDED to "
+        "wrap — the carry compare detects exactly that — and the hi "
+        "add wraps only at the 2^64 counter horizon, the same "
+        "rollover the kernel's u64 counters accept"),
+    _ok("raw-ts-rebase",
+        "flowsentryx_tpu/core/schema.py", "decode_raw",
+        {"sub", "convert_element_type"},
+        "split-u64 timestamp rebase: (ts_hi - t0_hi) wraps u32 for "
+        "records stamped just before the epoch and the int32 "
+        "reinterpret turns the wrap into the intended small negative "
+        "delta (schema.decode_raw docstring)"),
+)
+
+
+def match(entries: tuple[WrapOk, ...], prim_name: str,
+          frames: list) -> WrapOk | None:
+    """First entry covering ``prim_name`` at one of the equation's
+    user source frames (``frames``: (file_name, function_name) pairs,
+    innermost first)."""
+    for fname, func in frames:
+        for e in entries:
+            if (prim_name in e.prims and func == e.func
+                    and fname.replace("\\", "/").endswith(e.file)):
+                return e
+    return None
+
+
+def audit_registry(entries: tuple[WrapOk, ...],
+                   match_counts: dict[str, int],
+                   root: Path | None = None) -> list[Finding]:
+    """The staleness audit (module docstring): every entry must name a
+    still-existing function AND have matched during the run."""
+    root = root or Path(__file__).resolve().parents[2]
+    findings: list[Finding] = []
+    for e in entries:
+        src_path = root / e.file
+        if not src_path.is_file():
+            findings.append(Finding(
+                contract="wrap-ok", where=e.name,
+                reason=(f"stale WRAP_OK entry: file {e.file} does not "
+                        "exist — the exempted code was deleted or "
+                        "moved; delete or retarget the entry")))
+            continue
+        src = src_path.read_text()
+        if not re.search(rf"^\s*def {re.escape(e.func)}\b", src,
+                         re.MULTILINE):
+            findings.append(Finding(
+                contract="wrap-ok", where=e.name,
+                reason=(f"stale WRAP_OK entry: no function "
+                        f"{e.func!r} in {e.file} — the exempted code "
+                        "was deleted or renamed; delete or retarget "
+                        "the entry")))
+            continue
+        if not match_counts.get(e.name):
+            findings.append(Finding(
+                contract="wrap-ok", where=e.name,
+                reason=(f"stale WRAP_OK entry: {e.name} matched no "
+                        "equation in any staged variant this run — an "
+                        "unused exemption would silently cover a "
+                        "future accidental wrap at "
+                        f"{e.file}:{e.func}; delete it")))
+    return findings

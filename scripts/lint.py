@@ -18,7 +18,14 @@ Stages, in order; the gate fails if any stage fails:
    shadows a name a module-level import bound (the drift PR 3 had to
    clean out of the engine's sink paths by hand).  ``# noqa`` exempts
    a line.
-4. **device-loop purity** — an AST pass over
+4. **np default int** — an AST pass over the hot-path packages
+   (core/ops/fused/engine/ingest/cluster) that bans dtype-less
+   ``np.array``/``np.zeros``/``np.ones``/``np.empty``/``np.arange``/
+   ``np.full``: the default integer dtype is the platform C long,
+   whose width varies by platform/ABI — an overflow hazard the
+   ``fsx ranges`` prover cannot see from the staged graph.  ``# noqa``
+   exempts a line.
+5. **device-loop purity** — an AST pass over
    ``flowsentryx_tpu/fused/`` (the traced-region package: everything
    in it runs inside ``jit``) that bans host round-trips —
    ``device_get`` and the callback primitives (``pure_callback``,
@@ -26,18 +33,18 @@ Stages, in order; the gate fails if any stage fails:
    review speed.  ``fsx audit`` proves the same property statically on
    the staged graph; this stage catches it before anything compiles.
    ``# noqa`` exempts a line.
-5. **sync contracts** — the thread-contract checker
+6. **sync contracts** — the thread-contract checker
    (``flowsentryx_tpu/sync/contracts.py``) in ``--quick`` mode: every
    registered shared field's thread discipline, the SPSC cursor
    single-writer rule and the ctl-block writer sides re-proved over
    the real source by AST walk.  ``fsx sync`` is the full surface
    (it adds the bounded-interleaving model checker); this stage is
    its review-speed gate, jax-free like the rest of the module.
-6. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+7. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-5 there.
-7. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-6 there.
+8. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -267,6 +274,74 @@ def stage_device_loop_purity() -> list[str]:
     return fails
 
 
+#: Hot-path packages where a dtype-less numpy constructor is an
+#: overflow hazard: the default integer dtype is the platform C long
+#: (32-bit on Windows and 32-bit ABIs), so index/counter arrays built
+#: without an explicit dtype silently change width across platforms —
+#: a wrap class the ``fsx ranges`` prover cannot see (it analyzes the
+#: staged graph, where the dtype is already whatever numpy picked).
+NP_DEFAULT_INT_TREES = (
+    "flowsentryx_tpu/core", "flowsentryx_tpu/ops",
+    "flowsentryx_tpu/fused", "flowsentryx_tpu/engine",
+    "flowsentryx_tpu/ingest", "flowsentryx_tpu/cluster",
+)
+
+#: Banned-without-dtype numpy constructors -> positional index at
+#: which a dtype argument may appear instead of the ``dtype=`` kwarg
+#: (matching numpy's signatures: array/zeros/ones/empty take it
+#: second, full third, arange fourth).
+NP_DEFAULT_INT_CTORS = {
+    "array": 1, "zeros": 1, "ones": 1, "empty": 1,
+    "full": 2, "arange": 3,
+}
+
+
+def _np_default_int_findings(path: Path) -> list[str]:
+    """Dtype-less ``np.<ctor>`` findings for one hot-path module."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "np"
+                and fn.attr in NP_DEFAULT_INT_CTORS):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) > NP_DEFAULT_INT_CTORS[fn.attr]:
+            continue  # dtype passed positionally
+        line = (lines[node.lineno - 1]
+                if node.lineno <= len(lines) else "")
+        if "noqa" in line:
+            continue
+        try:
+            rel = path.relative_to(REPO)
+        except ValueError:
+            rel = path
+        out.append(
+            f"{rel}:{node.lineno}: dtype-less np.{fn.attr} in a "
+            "hot-path package — the default int is the platform C "
+            "long (width varies by platform/ABI), an overflow hazard "
+            "the fsx ranges prover cannot see; pass an explicit dtype")
+    return out
+
+
+def stage_np_default_int() -> list[str]:
+    fails = []
+    for tree in NP_DEFAULT_INT_TREES:
+        for path in sorted((REPO / tree).rglob("*.py")):
+            fails.extend(_np_default_int_findings(path))
+    return fails
+
+
 def stage_sync_contracts() -> list[str]:
     """The thread-contract half of ``fsx sync`` as a lint stage (quick
     mode: pure AST, no model checking, no jax)."""
@@ -315,6 +390,7 @@ def main(argv: list[str] | None = None) -> int:
         "syntax": stage_syntax(),
         "unused_imports": stage_unused_imports(),
         "local_imports": stage_local_imports(),
+        "np_default_int": stage_np_default_int(),
         "device_loop_purity": stage_device_loop_purity(),
         "sync_contracts": stage_sync_contracts(),
         "ruff": stage_ruff(),

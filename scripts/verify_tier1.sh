@@ -20,6 +20,10 @@
 #     virtual CPU devices so the sharded variant stages too); writes
 #     the machine-readable artifacts/AUDIT_r08.json byte-budget
 #     artifact
+#   * fsx ranges      — whole-pipeline integer value-range proof over
+#     the same staged variants (+ the WRAP_OK staleness audit, the
+#     planted negative controls and the BPF<->jaxpr containment
+#     bridge); writes artifacts/RANGES_r16.json
 # Exit code: pytest's (a pre-stage failure exits early).  Prints
 # DOTS_PASSED=<n> as a tamper-evident passed-test count derived from
 # the progress dots, not the summary.
@@ -91,6 +95,20 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m flowsentryx_tpu.cli audit --mesh 8 --mega 2 \
     --device-loop 2 --evict-ttl 30 --quick \
     --out artifacts/AUDIT_evict_r12.json || exit 1
+
+echo "== fsx ranges: whole-pipeline integer value-range proof =="
+# The fourth static leg (docs/RANGES.md): interval abstract
+# interpretation over every staged variant — singles, sharded, every
+# rung of the adaptive mega ladder, the drain-ring deep scan, the
+# eviction-epoch family (--evict-ttl stages the rolling-window
+# batches-counter arithmetic) — proving no equation can silently wrap
+# modulo the audited WRAP_OK registry (staleness-checked per run).
+# Also re-proves the planted negative controls fire and the BPF<->jaxpr
+# interval-containment bridge on the shipped distill artifact.
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m flowsentryx_tpu.cli ranges --mesh 8 --mega auto \
+    --device-loop 2 --evict-ttl 30 --quick \
+    --out artifacts/RANGES_r16.json || exit 1
 
 echo "== table-scale smoke: eviction + occupancy bound + shard-local rows =="
 # Bounded CPU smoke of the production flow table: re-proves that the

@@ -1624,6 +1624,52 @@ class TestLatencyHist:
         with pytest.raises(ValueError, match="scheme"):
             LatencyHist.from_counts({"scheme": "linear", "buckets": {}})
 
+    def test_cap_boundary_buckets(self):
+        """Values at/above the [1 µs, 67 s] cap land in the LAST
+        bucket — never raise, never wrap (ISSUE 12 boundary
+        hardening, complementing PR 11's from_counts range check)."""
+        from flowsentryx_tpu.engine.metrics import (
+            LAT_BUCKETS, LAT_OCTAVES, LatencyHist, _lat_bucket,
+            _lat_edge_us,
+        )
+
+        cap_us = 1 << LAT_OCTAVES  # one past the top octave's base
+        # exactly at the top octave base, just below, and far above
+        assert _lat_bucket(float(1 << (LAT_OCTAVES - 1))) < LAT_BUCKETS
+        assert _lat_bucket(float(cap_us)) == LAT_BUCKETS - 1
+        assert _lat_bucket(float(cap_us) * 1000.0) == LAT_BUCKETS - 1
+        assert _lat_bucket(0.0) == 0          # sub-µs floors to 1 µs
+        assert _lat_bucket(1.0) == 0
+        h = LatencyHist()
+        h.add(3600.0)            # an hour: far past the cap
+        h.add(cap_us * 1e-6)     # exactly the 2^26 µs cap
+        h.add(1e-9)              # sub-µs
+        assert h.n == 3
+        assert int(h.counts[LAT_BUCKETS - 1]) == 2
+        # the top bucket reports the exact max, not a fake edge
+        assert h.percentile_us(99) == round(h.max_us, 1)
+        # every interior bucket's upper edge is finite and ordered
+        edges = [_lat_edge_us(i) for i in range(LAT_BUCKETS - 1)]
+        assert all(a < b for a, b in zip(edges, edges[1:]))
+
+    def test_from_counts_max_valid_index(self):
+        from flowsentryx_tpu.engine.metrics import (
+            LAT_BUCKETS, LAT_SUB, LatencyHist,
+        )
+
+        scheme = f"log2x{LAT_SUB}us"
+        h = LatencyHist.from_counts({
+            "scheme": scheme,
+            "buckets": {str(LAT_BUCKETS - 1): 7},
+            "n": 7, "sum_us": 7e8, "max_us": 1e8,
+        })
+        assert int(h.counts[LAT_BUCKETS - 1]) == 7
+        assert h.percentile_us(50) == round(1e8, 1)  # top bucket → max
+        for bad in (LAT_BUCKETS, -1):
+            with pytest.raises(ValueError, match="outside"):
+                LatencyHist.from_counts({
+                    "scheme": scheme, "buckets": {str(bad): 1}})
+
     def test_recorder_counts_negatives_and_misses(self):
         from flowsentryx_tpu.engine.metrics import LatencyRecorder
 

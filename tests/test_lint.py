@@ -202,3 +202,63 @@ class TestSyncContractsStage:
             lint.REPO = old
         assert any("_staged_batches" in f and "worker" in f
                    for f in out), out
+
+
+def _np_findings(tmp_path, src, rel="flowsentryx_tpu/ops/mod.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    old = lint.REPO
+    lint.REPO = tmp_path
+    try:
+        return lint.stage_np_default_int()
+    finally:
+        lint.REPO = old
+
+
+class TestNpDefaultIntStage:
+    """The dtype-less-constructor gate: platform-C-long width is an
+    overflow hazard the fsx ranges prover cannot see."""
+
+    def test_dtype_less_arange_flagged(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "idx = np.arange(10)\n"))
+        assert len(out) == 1
+        assert "np.arange" in out[0] and "mod.py:2" in out[0]
+
+    def test_dtype_less_full_flagged(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "proto = np.full(8, 6)\n"))
+        assert len(out) == 1 and "np.full" in out[0]
+
+    def test_dtype_kwarg_clean(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "idx = np.arange(10, dtype=np.int64)\n"
+            "z = np.zeros(4, dtype=np.uint32)\n"))
+        assert out == []
+
+    def test_positional_dtype_clean(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "z = np.zeros(4, np.uint32)\n"
+            "b = np.zeros((3,), bool)\n"
+            "f = np.full(8, 6, np.uint8)\n"))
+        assert out == []
+
+    def test_noqa_exempts(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "idx = np.arange(10)  # noqa: host-only index math\n"))
+        assert out == []
+
+    def test_outside_hot_path_not_scanned(self, tmp_path):
+        out = _np_findings(tmp_path, (
+            "import numpy as np\n"
+            "idx = np.arange(10)\n"), rel="flowsentryx_tpu/train/m.py")
+        assert out == []
+
+    def test_repo_is_clean(self):
+        assert lint.stage_np_default_int() == []
