@@ -1,0 +1,38 @@
+"""``fsx chaos`` — deterministic fault-injection campaigns over the
+real stack.
+
+A mitigation plane is only as good as its worst failure mode: Taurus
+frames per-packet ML as infrastructure that must keep forwarding when
+a stage dies, and this repo had grown real resilience machinery —
+supervisor respawn, per-shard ingest fail-open, the unified
+``WorkerCrash`` path — that nothing ever adversarially exercised.
+This package is that exercise, made a first-class re-provable gate:
+
+* :mod:`~flowsentryx_tpu.chaos.faults` — the fault-injector registry:
+  process kills and crash loops, checkpoint byte corruption and
+  truncation, shm sealed-slot header corruption (bad magic, seq gaps,
+  poisoned metadata), gossip mailbox stall/flood, monotonic-clock
+  jumps, a wedged sink (the watchdog's prey).
+* :mod:`~flowsentryx_tpu.chaos.invariants` — the named invariant
+  catalog each fault is judged against (no silent verdict loss,
+  counters conserved across restarts, recovery within a bound,
+  fail-open semantics hold, corrupt state refused loudly).
+* :mod:`~flowsentryx_tpu.chaos.campaign` — the seed-driven campaign
+  runner: every scenario drives REAL protocol objects (a serving
+  ``Engine``, a live ``ShardedIngest`` fleet, the
+  ``ClusterSupervisor``, ``GossipPlane`` pairs), never mocks of them,
+  plus the PLANTED regressions (split-atomicity crash, checkpoint CRC
+  skipped, backoff removed) that prove the invariants have teeth —
+  the same negative-control discipline as ``fsx ranges``/``fsx sync``.
+
+Deterministic by construction: one ``--seed`` fixes the traffic, the
+corruption offsets, and the kill schedule; artifacts record per-fault
+verdicts (``artifacts/CHAOS_r17.json``, rewritten by every tier-1
+run via ``scripts/chaos_smoke.py``).
+
+Import cost: this ``__init__`` is jax-free; scenario functions import
+the engine lazily (the CLI help path must not pay a jax boot).
+"""
+
+from flowsentryx_tpu.chaos.campaign import run_campaign  # noqa: F401
+from flowsentryx_tpu.chaos.invariants import InvariantResult  # noqa: F401

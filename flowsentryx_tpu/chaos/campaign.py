@@ -1,0 +1,791 @@
+"""The seed-driven chaos campaign: real stack in, verdicts out.
+
+Every scenario below drives REAL protocol objects — a compiled serving
+:class:`~flowsentryx_tpu.engine.engine.Engine`, a live
+:class:`~flowsentryx_tpu.ingest.sharded.ShardedIngest` worker fleet
+over real shm rings, the :class:`~flowsentryx_tpu.cluster.supervisor
+.ClusterSupervisor` with real child processes, real
+:class:`~flowsentryx_tpu.cluster.gossip.GossipPlane` mailbox pairs —
+and judges the outcome by the named invariants of
+:mod:`~flowsentryx_tpu.chaos.invariants`.  One jitted engine is booted
+per campaign and shared across the engine-side scenarios (compile is
+the dominant cost; the scenarios are ordered so each leaves the engine
+in the state the next needs, ending with the watchdog wedge that
+deliberately fails it).
+
+The PLANTED regressions at the end are the campaign's negative
+controls, per the ``fsx ranges``/``fsx sync`` discipline: each
+re-introduces a pre-PR-13 weakness (split-atomicity crash accounting,
+CRC-less checkpoint loads, no-backoff respawn) and PASSES only when
+the named invariant FAILS under it — proving the invariants have
+teeth, not just green lights.
+
+Determinism: every random choice flows from one
+``numpy.random.default_rng(seed)``; wall-clock only bounds waits.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+from flowsentryx_tpu.chaos import faults
+from flowsentryx_tpu.chaos.invariants import all_ok, check
+
+#: Bound (seconds) inside which a killed rank must be re-serving (its
+#: next generation heartbeating) — generous against CI throttling, yet
+#: three orders of magnitude under "an operator noticed".
+RECOVERY_BOUND_S = 15.0
+
+
+def _scenario(name: str, invs: list, **extra) -> dict:
+    cls, desc = faults.FAULTS[name]
+    return {
+        "fault": name,
+        "fault_class": cls,
+        "description": desc,
+        "ok": all_ok(invs),
+        "invariants": [r.to_json() for r in invs],
+        **extra,
+    }
+
+
+# ---------------------------------------------------------------------------
+# supervisor scenarios (stub ranks: the real supervision protocol in ms)
+# ---------------------------------------------------------------------------
+
+def scenario_engine_kill(tmp: Path, rng: np.random.Generator) -> dict:
+    """SIGKILL a supervised rank mid-serve at a seeded point; the
+    crash-fail-open contract must hold: respawn from checkpoint within
+    the bound, survivor untouched, aggregation counting each rank's
+    latest generation once."""
+    from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
+    from flowsentryx_tpu.cluster.runner import stub_engine_main
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+    ck = tmp / "kill_ck_r1.npz"
+    ck.write_bytes(b"stub flow memory")
+    kill_at = faults.pick_kill_delay_s(rng)
+    sup = ClusterSupervisor(
+        tmp / "kill_cl",
+        [{"stub_serve_s": 2.0, "workers": 1},
+         {"stub_serve_s": 2.0, "checkpoint": str(ck), "workers": 1}],
+        entry=stub_engine_main)
+    sup.boot()
+    st1 = StatusBlock(status_path(tmp / "kill_cl", 1))
+    t0 = time.monotonic()
+    killed_t = None
+    recovered_t = None
+    hbeat_floor = 0
+    deadline = t0 + 30.0
+    try:
+        while time.monotonic() < deadline:
+            sup.poll()
+            hb = st1.ctl_get("c_hbeat")
+            if killed_t is None:
+                if hb and time.monotonic() - t0 >= kill_at:
+                    hbeat_floor = hb
+                    sup.kill(1)
+                    killed_t = time.monotonic()
+            elif (st1.ctl_get("c_gen") == 1 and hb > hbeat_floor):
+                recovered_t = time.monotonic()
+                break
+            time.sleep(0.02)
+        sup.run()  # serve the remainder to completion
+    finally:
+        sup.close()
+    agg = sup.aggregate()
+    recovery_s = (recovered_t - killed_t) if recovered_t else None
+    invs = [
+        check("recovery_within_bound",
+              recovery_s is not None and recovery_s < RECOVERY_BOUND_S,
+              f"kill->gen1-heartbeat {recovery_s!r}s "
+              f"(bound {RECOVERY_BOUND_S}s, incl. backoff)"),
+        check("fail_open_holds",
+              agg["failed_ranks"] == [] and agg["restarts"] == [0, 1],
+              f"restarts={agg['restarts']} failed={agg['failed_ranks']}"),
+        check("counters_conserved",
+              len({(r["rank"], r["gen"]) for r in agg["reports"]})
+              == len(agg["reports"])
+              and any(r["rank"] == 1 and r["gen"] == 1
+                      and r.get("restored") == str(ck)
+                      for r in agg["reports"]),
+              "latest-gen dedup held and gen-1 restored its checkpoint"),
+    ]
+    return _scenario("engine_kill", invs, kill_at_s=round(kill_at, 3),
+                     recovery_s=(round(recovery_s, 3)
+                                 if recovery_s else None))
+
+
+def scenario_crash_loop(tmp: Path, rng: np.random.Generator,
+                        *, window_s: float = 60.0,
+                        backoff_s: float = 0.05,
+                        max_restarts: int = 2,
+                        name: str = "crash_loop") -> dict:
+    """A rank that dies instantly EVERY generation: the crash-loop
+    discipline must back off exponentially and park it as failed
+    within the sliding-window budget — instead of the pre-PR-13
+    spin (respawn in ms, budget gone before a human reads line one).
+    The ``backoff_removed`` plant re-runs this with the window
+    disabled and must see ``crash_loop_parks`` FAIL."""
+    del rng  # the crash schedule is "always, immediately" by design
+    from flowsentryx_tpu.cluster.runner import stub_engine_main
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+    sup = ClusterSupervisor(
+        tmp / f"{name}_cl",
+        [{"stub_serve_s": 3.0, "workers": 1},
+         {"stub_serve_s": 30.0, "stub_crash_after_s": 0.0,
+          "stub_crash_every_gen": True, "workers": 1}],
+        entry=stub_engine_main,
+        max_restarts=max_restarts,
+        restart_backoff_s=backoff_s,
+        restart_window_s=window_s)
+    sup.boot()
+    deadline = time.monotonic() + 20.0
+    stderr = io.StringIO()
+    try:
+        with contextlib.redirect_stderr(stderr):
+            while (1 not in sup._failed
+                   and sup.restarts[1] <= max_restarts + 2
+                   and time.monotonic() < deadline):
+                sup.poll()
+                time.sleep(0.01)
+    finally:
+        sup.close()
+    deaths = sup._death_times[1]
+    gaps = [round(b - a, 4) for a, b in zip(deaths, deaths[1:])]
+    # death k+1 happens >= the backoff delay after death k (the stub
+    # dies instantly, so the inter-death gap IS the respawn delay);
+    # 0.7x slack absorbs scheduler jitter without hiding a no-backoff
+    # regression (which respawns in ~10 ms)
+    expected = [min(backoff_s * (2 ** k), 5.0)
+                for k in range(len(gaps))]
+    spacing_ok = all(g >= 0.7 * e for g, e in zip(gaps, expected))
+    parked_announced = "PARKED as failed" in stderr.getvalue()
+    parked = (1 in sup._failed and sup.restarts[1] == max_restarts
+              and parked_announced)
+    invs = [
+        check("crash_loop_parks", parked,
+              f"restarts={sup.restarts[1]} (budget {max_restarts}), "
+              f"failed={sorted(sup._failed)}, span "
+              f"announced={parked_announced}"),
+        check("respawn_backoff_spacing",
+              spacing_ok and len(gaps) >= 1,
+              f"inter-death gaps {gaps}s vs backoff ladder "
+              f"{expected}s"),
+        check("fail_open_holds", 0 not in sup._failed,
+              "rank 0 never entered failed"),
+    ]
+    return _scenario("crash_loop", invs, inter_death_gaps_s=gaps,
+                     restarts=sup.restarts[1])
+
+
+# ---------------------------------------------------------------------------
+# checkpoint scenarios
+# ---------------------------------------------------------------------------
+
+def _tiny_snapshot(tmp: Path, name: str = "tiny_snap",
+                   salt: int = 0) -> Path:
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine import checkpoint as ckpt
+
+    tmp.mkdir(parents=True, exist_ok=True)
+    table = schema.make_table(256)
+    table = type(table)(key=np.asarray(table.key),
+                        state=np.asarray(table.state))
+    stats = type(schema.make_stats())(
+        *(np.asarray(v) for v in schema.make_stats()))
+    return ckpt.save_state(tmp / name, table, stats,
+                           t0_ns=12345, hash_salt=salt)
+
+
+def scenario_ckpt_truncate(tmp: Path, rng: np.random.Generator) -> dict:
+    """Truncated and zero-length checkpoints must raise the NAMED
+    error through the pre-boot validation path — a torn-at-create file
+    used to leak a raw struct/IndexError out of ``peek_header``."""
+    from flowsentryx_tpu.engine import checkpoint as ckpt
+
+    path = _tiny_snapshot(tmp, "snap_truncate")
+    frac = float(0.2 + 0.6 * rng.random())
+    faults.truncate_file(path, frac)
+    named_trunc, err_trunc = False, ""
+    try:
+        ckpt.peek_header(path)
+    except ckpt.CheckpointCorrupt as e:
+        named_trunc, err_trunc = True, str(e)
+    except Exception as e:  # noqa: BLE001 — the raw-leak regression
+        err_trunc = f"RAW {type(e).__name__}: {e}"
+    faults.truncate_file(path, 0.0)
+    named_empty, err_empty = False, ""
+    try:
+        ckpt.peek_header(path)
+    except ckpt.CheckpointCorrupt as e:
+        named_empty, err_empty = True, str(e)
+    except Exception as e:  # noqa: BLE001
+        err_empty = f"RAW {type(e).__name__}: {e}"
+    load_refused = False
+    try:
+        ckpt.load_checkpoint(path)
+    except ckpt.CheckpointCorrupt:
+        load_refused = True
+    except ValueError:
+        pass
+    invs = [
+        check("corrupt_ckpt_refused",
+              named_trunc and named_empty and load_refused,
+              f"truncated->({err_trunc!r}) empty->({err_empty!r})"),
+    ]
+    return _scenario("ckpt_truncate", invs,
+                     truncate_fraction=round(frac, 3))
+
+
+def scenario_ckpt_bitflip(tmp: Path, rng: np.random.Generator) -> dict:
+    """Two corruption legs: raw byte flips (structural/zlib refusal)
+    and a CLEAN-DECODE splice — valid zip, wrong bytes — that only the
+    folded CRC32 can catch.  Both must refuse with the named error."""
+    from flowsentryx_tpu.engine import checkpoint as ckpt
+
+    # leg 1: raw flips
+    p1 = _tiny_snapshot(tmp, "snap_flip")
+    offs = faults.flip_bytes(p1, rng)
+    raw_refused = False
+    try:
+        ckpt.load_checkpoint(p1)
+    except ckpt.CheckpointCorrupt:
+        raw_refused = True
+    # leg 2: clean splice — re-encode with one flipped value but the
+    # ORIGINAL stored CRC (a valid zip whose contents lie)
+    p2 = _tiny_snapshot(tmp, "snap_splice")
+    with np.load(p2) as z:
+        data = {k: np.array(z[k]) for k in z.files}
+    data["table_key"] = data["table_key"].copy()
+    data["table_key"][int(rng.integers(0, len(data["table_key"])))] ^= 1
+    np.savez_compressed(p2, **data)
+    crc_refused, crc_msg = False, ""
+    try:
+        ckpt.load_checkpoint(p2)
+    except ckpt.CheckpointCorrupt as e:
+        crc_refused, crc_msg = True, str(e)
+    invs = [
+        check("corrupt_ckpt_refused", raw_refused and crc_refused,
+              f"raw-flip refused={raw_refused} (offsets {offs[:4]}...), "
+              f"clean-splice refused={crc_refused}"),
+        check("no_silent_verdict_loss",
+              "CRC32" in crc_msg or "integrity" in crc_msg,
+              f"the clean splice was caught BY the CRC leg: {crc_msg!r}"),
+    ]
+    return _scenario("ckpt_bitflip", invs, flip_offsets=offs)
+
+
+def scenario_ckpt_fallback(engine, tmp: Path,
+                           rng: np.random.Generator) -> dict:
+    """REAL-engine restore fallback: corrupt the live checkpoint of a
+    serving engine (clean splice, so the CRC is what refuses) and
+    restore — the engine must fall back to the retained ``.prev``
+    generation, loudly, with the restored table provably that
+    generation's."""
+    from flowsentryx_tpu.engine import checkpoint as ckpt
+    import jax
+
+    path = tmp / "eng_ck.npz"
+    engine.checkpoint(path)          # generation A (becomes .prev)
+    engine.checkpoint(path)          # generation B (rotates A out)
+    prev = ckpt.prev_path(path)
+    prev_key = np.asarray(ckpt.load_checkpoint(prev).table.key)
+    with np.load(path) as z:
+        data = {k: np.array(z[k]) for k in z.files}
+    data["stats_allowed"] = data["stats_allowed"].copy()
+    data["stats_allowed"][0] ^= 0xFFFF
+    np.savez_compressed(path, **data)
+    stderr = io.StringIO()
+    with contextlib.redirect_stderr(stderr):
+        info = engine.restore(path)
+    restored_key = np.asarray(jax.device_get(engine.table.key)) \
+        .reshape(-1)
+    direct_refused = False
+    try:
+        ckpt.load_checkpoint(path)
+    except ckpt.CheckpointCorrupt:
+        direct_refused = True
+    invs = [
+        check("corrupt_ckpt_refused", direct_refused,
+              "the spliced checkpoint cannot be loaded directly"),
+        check("ckpt_fallback_to_prev",
+              info.get("fallback_from") == str(path)
+              and np.array_equal(np.sort(restored_key),
+                                 np.sort(prev_key))
+              and "REFUSED" in stderr.getvalue(),
+              f"fallback_from={info.get('fallback_from')!r}, table == "
+              ".prev generation, announced on stderr"),
+        check("health_degraded_reasons",
+              engine._restore_fallbacks >= 1,
+              f"restore_fallbacks={engine._restore_fallbacks} feeds "
+              "the DEGRADED ladder"),
+    ]
+    del rng
+    out = _scenario("ckpt_bitflip", invs)
+    out["fault"] = "ckpt_fallback"
+    out["description"] = ("the ckpt_bitflip fault exercised through "
+                          "the REAL engine's restore path: corrupt "
+                          "live checkpoint -> loud .prev fallback")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# real engine + sharded ingest: slot corruption / poison / watchdog
+# ---------------------------------------------------------------------------
+
+def _engine_cfg(max_batch: int = 64):
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=max_batch,
+                                  deadline_us=2000),
+        table=dataclasses.replace(cfg.table, capacity=1 << 12),
+    )
+
+
+def build_engine_fleet(tmp: Path, rng: np.random.Generator,
+                       n_records: int):
+    """One real serving engine over a real 1-worker sealed-ingest
+    fleet, with ``n_records`` of seeded traffic already in the shard
+    ring.  Shared by the engine-side scenarios (one compile per
+    campaign)."""
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine import CollectSink, Engine
+    from flowsentryx_tpu.engine.shm import ShmRing
+    from flowsentryx_tpu.engine.traffic import (
+        Scenario, TrafficGen, TrafficSpec,
+    )
+    from flowsentryx_tpu.ingest import ShardedIngest
+
+    recs = TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e6,
+        n_attack_ips=8, n_benign_ips=24, attack_fraction=0.8,
+        seed=int(rng.integers(0, 1 << 31)),
+    )).next_records(n_records)
+    base = str(tmp / "chaos_fring")
+    ring = ShmRing.create(schema.shard_ring_path(base, 0, 1), 1 << 13,
+                          schema.FLOW_RECORD_DTYPE)
+    assert ring.produce(recs) == len(recs)
+    src = ShardedIngest(base, 1, queue_slots=16, precompact=False,
+                        t0_grace_s=0.2,
+                        quarantine_dir=str(tmp / "quarantine"))
+    sink = CollectSink()
+    eng = Engine(_engine_cfg(), src, sink, readback_depth=4,
+                 sink_thread=True)
+    return eng, src, sink, recs
+
+
+def scenario_slot_corruption(eng, src, recs,
+                             rng: np.random.Generator,
+                             tmp: Path) -> dict:
+    """Corrupt three SEALED shm slots in place — bad wire-id magic, a
+    forward seq jump, and a well-formed-but-poisoned metadata row
+    (n_records past max_batch, the RANGE_* premise the fsx ranges
+    proof assumes) — then drain through the REAL engine.  The drain
+    must survive, every loss must be counted, and the health ladder
+    must read DEGRADED with exactly these reasons."""
+    del rng
+    # resolve the t0 handshake (the workers buffer, unsealed, until
+    # the engine publishes the agreed epoch — dispatch_smoke idiom)
+    deadline = time.monotonic() + 30.0
+    while src.t0_ns is None:
+        src.poll_batches(0)
+        if time.monotonic() > deadline:
+            raise TimeoutError("ingest t0 handshake did not resolve")
+        time.sleep(0.01)
+    q = src._queues[0]
+    faults._wait_readable(q, 4)
+    # true record count of the bad-magic slot, read BEFORE corrupting:
+    # the conservation invariant needs it (its header is untrusted
+    # after)
+    from flowsentryx_tpu.core import schema as _schema
+
+    t = int(q._tail[0])
+    bad_n_true = int(q._cells[t & (q.slots - 1)][
+        _schema.BATCHQ_N_RECORDS_WORD])
+    inj = [
+        faults.corrupt_sealed_slot(q, "bad_magic", slot_back=0),
+        faults.poison_sealed_meta(
+            q, words_per_record=src._payload_shape[1],
+            max_batch=src._max_batch, slot_back=1),
+        faults.corrupt_sealed_slot(q, "seq_gap", slot_back=3),
+    ]
+    src.request_stop()
+    stderr = io.StringIO()
+    with contextlib.redirect_stderr(stderr):
+        rep = eng.run()
+    stats = rep.ingest
+    served = rep.records
+    quarantined = stats["quarantined_records"]
+    conserved = served + quarantined + bad_n_true == len(recs)
+    dumps = list((tmp / "quarantine").glob("quarantine_*.npy"))
+    reasons = set(rep.health["reasons"])
+    invs = [
+        check("bad_slot_skipped_counted",
+              stats["bad_wire_slots"] == 1
+              and "REFUSED" in stderr.getvalue(),
+              f"bad_wire_slots={stats['bad_wire_slots']}, announced"),
+        check("poison_quarantined",
+              stats["quarantined_batches"] == 1 and len(dumps) == 1,
+              f"quarantined={stats['quarantined_batches']}, "
+              f"spooled={len(dumps)} file(s) in {tmp / 'quarantine'}"),
+        check("seq_gap_counted",
+              sum(w["seq_gaps"]
+                  for w in stats["workers"].values()) >= 1,
+              "the seq jump surfaced in the gap counters"),
+        check("no_silent_verdict_loss", conserved,
+              f"{len(recs)} produced == {served} served + "
+              f"{quarantined} quarantined + {bad_n_true} in the "
+              "bad-magic slot"),
+        check("fail_open_holds",
+              not stats["crashed"] and stats["dead_workers"] == [],
+              "the drain worker survived all three corruptions"),
+        check("health_degraded_reasons",
+              rep.health["state"] == "degraded"
+              and any(r.startswith("bad_wire_slots:") for r in reasons)
+              and any(r.startswith("quarantined_batches:")
+                      for r in reasons)
+              and any(r.startswith("ingest_seq_gaps:")
+                      for r in reasons),
+              f"health={rep.health['state']} reasons={sorted(reasons)}"),
+    ]
+    out = _scenario("shm_bad_magic", invs, injections=inj,
+                    records={"produced": len(recs), "served": served,
+                             "quarantined": quarantined,
+                             "bad_slot": bad_n_true})
+    out["fault"] = "shm_bad_magic+poison_batch+shm_seq_gap"
+    return out
+
+
+def scenario_watchdog(eng, rng: np.random.Generator) -> dict:
+    """Wedge the verdict sink forever with batches in flight: the
+    dispatch watchdog must dump per-thread stacks, count a soft trip,
+    and fail the drain with the named error within 2x its stall bound
+    — never hang.  Runs LAST: it deliberately leaves the engine
+    failed (the wedged worker is released and abandoned)."""
+    del rng
+    from flowsentryx_tpu.engine.sources import ArraySource
+    from flowsentryx_tpu.engine.traffic import (
+        Scenario, TrafficGen, TrafficSpec,
+    )
+    from flowsentryx_tpu.engine.watchdog import (
+        DispatchWatchdog, WatchdogStall,
+    )
+
+    recs = TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, seed=7)).next_records(256)
+    wedge = faults.WedgeSink()
+    stall_s = 0.4
+    eng.reset_stream(ArraySource(recs), sink=wedge)
+    eng._watchdog = DispatchWatchdog(stall_s)  # quiescent swap
+    stderr = io.StringIO()
+    t0 = time.monotonic()
+    raised = None
+    try:
+        with contextlib.redirect_stderr(stderr):
+            eng.run(max_seconds=30.0)
+    except WatchdogStall as e:
+        raised = e
+    elapsed = time.monotonic() - t0
+    wedge.release()  # let the abandoned worker drain and exit
+    err = stderr.getvalue()
+    invs = [
+        check("watchdog_trips_within_bound",
+              raised is not None and elapsed < 10 * stall_s,
+              f"WatchdogStall in {elapsed:.2f}s "
+              f"(stall bound {stall_s}s): {raised}"),
+        check("no_silent_verdict_loss",
+              "per-thread stacks" in err
+              and "fsx-sink" in err,
+              "the stack dump names the wedged sink thread — the "
+              "diagnostic an operator needs, automated"),
+        check("health_degraded_reasons",
+              eng._watchdog.trips >= 1 and eng._watchdog.tripped,
+              f"soft trips={eng._watchdog.trips}, hard tripped — the "
+              "FAILED rung of the ladder"),
+    ]
+    return _scenario("sink_wedge", invs,
+                     elapsed_s=round(elapsed, 3))
+
+
+# ---------------------------------------------------------------------------
+# gossip + clock scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_gossip_stall_flood(tmp: Path,
+                                rng: np.random.Generator) -> dict:
+    """Flood a 4-slot pair mailbox while the peer's merge tick is
+    stalled: the publisher must drop-and-count without ever blocking
+    the sink path, and once the peer resumes, every wire that WAS
+    delivered must merge last-wins — drops + merges accounting every
+    publish."""
+    from flowsentryx_tpu.cluster import gossip as gplane
+    from flowsentryx_tpu.engine.writeback import BlacklistUpdate
+
+    d = tmp / "gossip_cl"
+    k_max, slots = 8, 4
+    gplane.create_plane(d, 2, k_max=k_max, slots=slots)
+    a = gplane.GossipPlane(d, 0, 2)
+    b = gplane.GossipPlane(d, 1, 2)
+
+    def update(n, base):
+        keys = (base + np.arange(n)).astype(np.uint32)
+        untils = (10.0 + 0.25 * np.arange(n)).astype(np.float32)
+        return BlacklistUpdate(key=keys, until_s=untils)
+
+    t0 = time.perf_counter()
+    a.publish(update(40, 1000), now=1.0)   # 5 wires; peer stalled
+    a.publish(update(40, 2000), now=2.0)   # 5 more into a full box
+    publish_wall = time.perf_counter() - t0
+    b.tick(force=True)                      # peer resumes: merges 4
+    a.publish(update(8, 3000), now=3.0)    # 1 wire; lands after gap
+    b.tick(force=True)
+    ra, rb = a.report(), b.report()
+    # expected delivered set: the first `slots` wires of round 1
+    # (32 keys) + the round-3 wire (8 keys), last-wins
+    expected = {}
+    for upd in (update(40, 1000), ):
+        ks = np.asarray(upd.key, np.uint32)[:slots * k_max]
+        us = np.asarray(upd.until_s, np.float32)[:slots * k_max]
+        expected.update(zip(ks.tolist(),
+                            us.view(np.uint32).tolist()))
+    u3 = update(8, 3000)
+    expected.update(zip(np.asarray(u3.key, np.uint32).tolist(),
+                        np.asarray(u3.until_s, np.float32)
+                        .view(np.uint32).tolist()))
+    del rng
+    invs = [
+        check("gossip_drop_counted_never_blocks",
+              ra["tx_dropped"] == 6 and ra["tx_wires"] == 5
+              and publish_wall < 0.5,
+              f"11 wires published: {ra['tx_wires']} delivered, "
+              f"{ra['tx_dropped']} dropped; flood publish wall "
+              f"{publish_wall * 1e3:.1f} ms"),
+        check("counters_conserved",
+              ra["tx_wires"] + ra["tx_dropped"] == 11
+              and rb["rx_wires"] == ra["tx_wires"],
+              "drops + merges account every publish"),
+        check("seq_gap_counted", rb["rx_seq_gaps"] >= 1,
+              f"rx_seq_gaps={rb['rx_seq_gaps']} (the dropped wires' "
+              "hole in the sequence space)"),
+        check("gossip_delivered_converges",
+              rb["merged_digest"]
+              == gplane.GossipPlane._digest(expected),
+              f"merged digest {rb['merged_digest']} == last-wins of "
+              f"the {len(expected)} delivered sources"),
+    ]
+    return _scenario("gossip_stall_flood", invs)
+
+
+def scenario_clock_jump(rng: np.random.Generator) -> dict:
+    """Feed the latency plane stage intervals derived from a clock
+    that jumped backwards: negatives must be counted (the stamp-
+    monotonicity gauge), percentiles must stay finite and ordered,
+    and nothing may raise."""
+    from flowsentryx_tpu.engine.metrics import LatencyRecorder
+
+    stamps = faults.jumped_stamps(rng, 64)
+    lat = LatencyRecorder()
+    neg_expected = 0
+    for i in range(1, len(stamps)):
+        dt = stamps[i] - stamps[i - 1]
+        if dt < 0:
+            neg_expected += 1
+        lat.record(total_s=dt, staged_s=dt / 2, upload_s=0.0,
+                   compute_s=dt / 4, sink_s=dt / 4, n=4)
+    d = lat.to_dict()
+    sv = d["seal_to_verdict"]
+    pcts = [sv.get(k) for k in ("p50", "p90", "p99")]
+    finite = all(p is not None and np.isfinite(p) and p >= 0
+                 for p in pcts)
+    ordered = pcts == sorted(pcts)
+    invs = [
+        check("clock_jump_counted_finite",
+              d["negatives"] > 0 and finite and ordered,
+              f"negatives={d['negatives']} (>= 1 injected jump, "
+              f"{neg_expected} negative deltas), percentiles "
+              f"{pcts} finite+ordered"),
+        check("no_silent_verdict_loss",
+              sv["n"] == 63 * 4,
+              f"every record accounted: n={sv['n']}"),
+    ]
+    return _scenario("clock_jump", invs)
+
+
+# ---------------------------------------------------------------------------
+# planted regressions (negative controls: the invariant must FAIL)
+# ---------------------------------------------------------------------------
+
+def plant_split_atomicity() -> dict:
+    """Re-introduce the split-complete weakness the SinkChannel's
+    atomic ``complete()`` exists to prevent: decrement pending and
+    record the crash under SEPARATE lock acquisitions.  A waiter
+    observing between them sees (pending drained, crash unset) — the
+    silent-verdict-loss window.  ``sink_crash_atomicity`` must FAIL
+    under the plant and HOLD for the real protocol."""
+    from flowsentryx_tpu.sync.channel import SinkChannel
+
+    # plant: the split sequence, observed at its midpoint
+    chan = SinkChannel("sink thread")
+    chan.submit("group", 1)
+    with chan.cv:
+        chan._pending -= 1
+        chan.cv.notify_all()
+    with chan.cv:  # a woken backpressure waiter's view, mid-split
+        planted_bad = (chan._pending == 0 and chan._exc is None)
+    with chan.cv:
+        chan._exc = RuntimeError("worker crashed")
+        chan.cv.notify_all()
+    planted = check(
+        "sink_crash_atomicity", not planted_bad,
+        "under the split plant a waiter observed (pending drained, "
+        "crash unset)")
+    # control: the real atomic complete() on the same protocol object
+    chan2 = SinkChannel("sink thread")
+    chan2.submit("group", 1)
+    chan2.complete(1, 0.0, RuntimeError("worker crashed"))
+    with chan2.cv:
+        control_ok = not (chan2._pending == 0 and chan2._exc is None)
+    return {
+        "plant": "split_atomicity",
+        "reintroduces": "pre-PR9 split crash accounting "
+                        "(SinkChannel.complete's atomicity removed)",
+        "caught_by": "sink_crash_atomicity",
+        "caught": not planted.ok,
+        "control_holds": bool(control_ok),
+        "ok": (not planted.ok) and bool(control_ok),
+    }
+
+
+def plant_crc_skipped(tmp: Path, rng: np.random.Generator) -> dict:
+    """Strip the integrity member and flip a value — the pre-PR-13
+    CRC-less format.  The file is a perfectly valid zip, so the
+    structural checks pass and ``corrupt_ckpt_refused`` FAILS: exactly
+    the silent load the CRC exists to prevent (grandfathered legacy
+    snapshots accept this by documented choice; new writes always
+    carry the CRC)."""
+    from flowsentryx_tpu.engine import checkpoint as ckpt
+
+    p = _tiny_snapshot(tmp, "snap_plant_crc")
+    with np.load(p) as z:
+        data = {k: np.array(z[k]) for k in z.files
+                if k != "integrity_crc32"}
+    data["table_key"] = data["table_key"].copy()
+    data["table_key"][int(rng.integers(0, 256))] ^= 1
+    np.savez_compressed(p, **data)
+    refused = False
+    try:
+        ckpt.load_checkpoint(p)
+    except ckpt.CheckpointCorrupt:
+        refused = True
+    return {
+        "plant": "crc_skipped",
+        "reintroduces": "CRC-less checkpoint loads (the corrupt file "
+                        "decompresses cleanly and loads silently)",
+        "caught_by": "corrupt_ckpt_refused",
+        "caught": not refused,
+        "ok": not refused,
+    }
+
+
+def plant_backoff_removed(tmp: Path, rng: np.random.Generator) -> dict:
+    """Disable the sliding window (every death sees an empty window,
+    so the rank ALWAYS respawns): the crash-loop scenario's
+    ``crash_loop_parks`` invariant must FAIL — the rank burns past its
+    budget instead of parking."""
+    res = scenario_crash_loop(tmp / "plant_backoff", rng,
+                              window_s=0.0, backoff_s=0.02,
+                              max_restarts=2, name="plant_backoff")
+    parks = next(i for i in res["invariants"]
+                 if i["name"] == "crash_loop_parks")
+    return {
+        "plant": "backoff_removed",
+        "reintroduces": "pre-PR-13 unbounded respawn (no sliding-"
+                        "window budget: a crash-looping rank never "
+                        "parks)",
+        "caught_by": "crash_loop_parks",
+        "caught": not parks["ok"],
+        "ok": not parks["ok"],
+        "detail": parks["detail"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+def run_campaign(seed: int = 17, quick: bool = False,
+                 workdir: str | Path | None = None,
+                 out: str | Path | None = None) -> dict:
+    """Run every scenario + every planted regression; return (and
+    optionally write) the artifact.  ``quick`` trims the traffic
+    volume, not the coverage — every fault class and every plant runs
+    either way (the tier-1 smoke IS the quick campaign)."""
+    import tempfile
+
+    rng = np.random.default_rng(seed)
+    tmp = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="fsx_chaos_"))
+    tmp.mkdir(parents=True, exist_ok=True)
+    t_start = time.perf_counter()
+    results: list[dict] = []
+
+    # jax-free scenarios first (they also serve as a fast smoke of the
+    # campaign plumbing itself)
+    results.append(scenario_ckpt_truncate(tmp, rng))
+    results.append(scenario_ckpt_bitflip(tmp, rng))
+    results.append(scenario_engine_kill(tmp, rng))
+    results.append(scenario_crash_loop(tmp, rng))
+    results.append(scenario_gossip_stall_flood(tmp, rng))
+    results.append(scenario_clock_jump(rng))
+
+    # the real engine + fleet (one compile, three scenarios)
+    n_records = 64 * (6 if quick else 24)
+    eng, src, sink, recs = build_engine_fleet(tmp, rng, n_records)
+    try:
+        results.append(scenario_slot_corruption(eng, src, recs, rng,
+                                                tmp))
+        results.append(scenario_ckpt_fallback(eng, tmp, rng))
+        results.append(scenario_watchdog(eng, rng))
+    finally:
+        src.close()
+
+    planted = [
+        plant_split_atomicity(),
+        plant_crc_skipped(tmp, rng),
+        plant_backoff_removed(tmp, rng),
+    ]
+
+    fault_classes = sorted({r["fault_class"] for r in results})
+    n_inv = sum(len(r["invariants"]) for r in results)
+    ok = (all(r["ok"] for r in results)
+          and all(p["ok"] for p in planted))
+    artifact = {
+        "seed": seed,
+        "quick": bool(quick),
+        "ok": ok,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "fault_classes": fault_classes,
+        "n_fault_classes": len(fault_classes),
+        "invariants_checked": n_inv,
+        "faults": results,
+        "planted_regressions": planted,
+        "registry": {k: {"class": c, "description": d}
+                     for k, (c, d) in faults.FAULTS.items()},
+    }
+    if out:
+        Path(out).parent.mkdir(parents=True, exist_ok=True)
+        Path(out).write_text(json.dumps(artifact, indent=2) + "\n")
+    return artifact

@@ -457,6 +457,64 @@ def _cmd_sync(args: argparse.Namespace) -> int:
     return 0 if out["ok"] else 1
 
 
+def _cmd_chaos(args: argparse.Namespace) -> int:
+    """Deterministic fault-injection campaign over the REAL stack —
+    the robustness leg of the verification suite (the static legs
+    prove what the code cannot do; the chaos campaign proves what the
+    system DOES under faults; docs/CHAOS.md).
+
+    One seed fixes the whole campaign: the traffic, the corruption
+    offsets, the kill schedule.  Every scenario drives real protocol
+    objects — a compiled serving engine, a live drain-worker fleet
+    over real shm rings, the cluster supervisor with real child
+    processes, gossip mailbox pairs — and is judged by the named
+    invariant catalog.  The planted regressions (split-atomicity
+    crash, checkpoint CRC skipped, backoff removed) are negative
+    controls: the campaign fails unless each is CAUGHT by its named
+    invariant."""
+    from flowsentryx_tpu.chaos import faults as chaos_faults
+
+    if args.list:
+        for name, (cls, desc) in chaos_faults.FAULTS.items():
+            print(f"{name:20s} [{cls}]\n    {desc}")
+        return 0
+    _honor_jax_platform()
+    from flowsentryx_tpu.chaos import run_campaign
+
+    rep = run_campaign(seed=args.seed, quick=args.quick,
+                       workdir=args.workdir, out=args.out)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        for r in rep["faults"]:
+            status = "OK" if r["ok"] else "FAILED"
+            invs = ", ".join(
+                f"{i['name']}{'' if i['ok'] else '!'}"
+                for i in r["invariants"])
+            print(f"fsx chaos: {r['fault']:40s} {status}  ({invs})")
+            if not r["ok"]:
+                for i in r["invariants"]:
+                    if not i["ok"]:
+                        print(f"  INVARIANT {i['name']}: {i['detail']}",
+                              file=sys.stderr)
+        for p in rep["planted_regressions"]:
+            status = "CAUGHT" if p["ok"] else "MISSED"
+            print(f"fsx chaos: plant {p['plant']:32s} {status}  "
+                  f"(by {p['caught_by']})")
+        print(f"fsx chaos: {rep['n_fault_classes']} fault classes, "
+              f"{rep['invariants_checked']} invariant checks, "
+              f"{len(rep['planted_regressions'])} planted regressions, "
+              f"seed {rep['seed']}, {rep['wall_s']}s")
+    if args.out and not args.json:
+        print(f"fsx chaos: report -> {args.out}")
+    if rep["ok"]:
+        if not args.json:
+            print("fsx chaos: PASS")
+        return 0
+    print("fsx chaos: FAIL", file=sys.stderr)
+    return 1
+
+
 def _cmd_ranges(args: argparse.Namespace) -> int:
     """Static integer value-range proof over the staged step graphs —
     the fourth leg of the static suite (``fsx check`` proves the BPF
@@ -918,6 +976,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "fleet — there is no ingest worker to die on the inline "
               "path", file=sys.stderr)
         return 1
+    if args.quarantine_dir and not args.ingest_workers:
+        # a silently-inert flag is the failure class this refusal
+        # discipline exists for: slot validation/quarantine lives on
+        # the sealed-batch dequeue paths only
+        print("fsx serve: --quarantine-dir requires --ingest-workers "
+              "N (>= 1): sealed-slot validation and quarantine happen "
+              "on the sharded-ingest dequeue path; the inline record "
+              "path has no sealed slots to refuse", file=sys.stderr)
+        return 1
     if args.verdict_k is not None and args.verdict_k < 0:
         print("fsx serve: --verdict-k must be >= 0 (0 disables the "
               "compact verdict wire)", file=sys.stderr)
@@ -1060,10 +1127,35 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.restore:
         import zipfile as _zf
 
-        from flowsentryx_tpu.engine.checkpoint import peek_header
+        from flowsentryx_tpu.engine.checkpoint import (
+            CheckpointCorrupt, peek_header, prev_path,
+        )
 
         try:
             ck_hdr = peek_header(args.restore)
+        except CheckpointCorrupt as e:
+            # corrupt/truncated live checkpoint: the retained previous
+            # generation is what will actually load — validate
+            # geometry/salt against ITS header, but leave
+            # ``args.restore`` pointing at the original file so
+            # ``Engine.restore`` performs the fallback itself and
+            # COUNTS it (``restore_fallbacks`` is a DEGRADED reason;
+            # re-pointing here would silently launder the fallback
+            # into a clean-looking restore)
+            prev = prev_path(args.restore)
+            try:
+                ck_hdr = peek_header(prev)
+            except (OSError, ValueError, KeyError, _zf.BadZipFile):
+                print(f"fsx serve: checkpoint {args.restore!r} is "
+                      f"corrupt ({e}) and no restorable previous "
+                      "generation exists — refusing to boot from "
+                      "garbage", file=sys.stderr)
+                return 1
+            print(f"fsx serve: checkpoint {args.restore!r} REFUSED "
+                  f"({e}); the retained previous generation {prev} "
+                  "will be restored instead (flow memory resumes one "
+                  "generation stale; counted in the health ladder)",
+                  file=sys.stderr)
         except (OSError, ValueError, KeyError, _zf.BadZipFile) as e:
             print(f"fsx serve: cannot read checkpoint "
                   f"{args.restore!r}: {e}", file=sys.stderr)
@@ -1135,7 +1227,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     shard_offset=cluster_rank * args.ingest_workers,
                     total_shards=cluster_n * args.ingest_workers)
             source = ShardedIngest(args.feature_ring, args.ingest_workers,
-                                   strict=args.strict_ingest, **span)
+                                   strict=args.strict_ingest,
+                                   quarantine_dir=args.quarantine_dir,
+                                   **span)
         else:
             source = ShmRingSource(args.feature_ring)
         sink = (
@@ -1267,9 +1361,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  audit=True if args.audit else None,
                  kernel_tier=kernel_tier,
                  gossip=gossip,
-                 slo_us=args.slo_us)
+                 slo_us=args.slo_us,
+                 watchdog_s=args.watchdog_s)
     if args.restore:
-        eng.restore(args.restore)
+        from flowsentryx_tpu.engine.checkpoint import CheckpointCorrupt
+
+        try:
+            eng.restore(args.restore)
+        except CheckpointCorrupt as e:
+            # both generations corrupt (a CRC-level .prev flip passes
+            # the pre-boot peek — only the load verifies payload
+            # bytes): refuse with the named diagnostic, never a raw
+            # traceback, even this late
+            print(f"fsx serve: cannot restore: {e} — refusing to "
+                  "serve from garbage", file=sys.stderr)
+            return 1
     if args.artifact_reload:
         # live model hot-swap: re-stat the artifact and swap it in
         # mid-serve on mtime change (Engine.watch_artifact; the
@@ -1536,56 +1642,121 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
     return 0 if not agg["failed_ranks"] else 1
 
 
-def _merged_latency(globs: list[str]) -> dict:
-    """Merge the ``latency`` blocks of engine-report JSONs (``fsx
-    serve`` output, or a cluster dir's per-rank ``report_r*_g*.json``
-    wrappers) into ONE seal→verdict percentile view — the HDR bucket
-    counts are mergeable by construction (engine/metrics.py), which is
-    the whole reason the report carries them.  Shared by ``fsx status
-    --engine-report`` and ``fsx monitor --engine-report``; jax-free."""
+def _iter_engine_reports(globs: list):
+    """Shared engine-report walk for the ``--engine-report GLOB``
+    consumers: expand each (repeatable) glob, dedupe by realpath so
+    overlapping globs never double-merge a report, and yield
+    ``(path, doc, error)`` — ``doc`` parsed JSON on success, ``error``
+    a string when the file is unreadable/unparseable (the caller
+    decides whether that is a skip or a DEGRADED signal).  A pattern
+    matching nothing yields itself as an unreadable entry rather than
+    vanishing — a typo'd path must surface, not silently merge zero
+    reports."""
     import glob as _glob
 
-    from flowsentryx_tpu.engine.metrics import LatencyHist
-
-    merged = LatencyHist()
-    sources = []
-    per_report = {}
     seen: set[str] = set()
     for pat in globs:
         for path in sorted(_glob.glob(pat)) or [pat]:
-            # overlapping globs (the flag is repeatable) must not
-            # double-merge a report — n would inflate and every
-            # percentile would skew toward the duplicated rank
             key = os.path.realpath(path)
             if key in seen:
                 continue
             seen.add(key)
             try:
-                doc = json.loads(Path(path).read_text())
+                yield path, json.loads(Path(path).read_text()), None
             except (OSError, ValueError) as e:
-                per_report[path] = {"error": str(e)}
-                continue
-            lat = (doc.get("latency")
-                   or doc.get("report", {}).get("latency"))
-            if not lat or not lat.get("hist"):
-                per_report[path] = {"error": "no latency block"}
-                continue
-            try:
-                h = LatencyHist.from_counts(lat["hist"])
-            except ValueError as e:
-                per_report[path] = {"error": str(e)}
-                continue
-            merged.merge(h)
-            sources.append(path)
-            sv = lat.get("seal_to_verdict") or {}
-            per_report[path] = {
-                "n": sv.get("n", 0),
-                "p99_us": sv.get("p99"),
-            }
+                yield path, None, str(e)
+
+
+def _merged_latency(globs: list[str], reports: list | None = None) -> dict:
+    """Merge the ``latency`` blocks of engine-report JSONs (``fsx
+    serve`` output, or a cluster dir's per-rank ``report_r*_g*.json``
+    wrappers) into ONE seal→verdict percentile view — the HDR bucket
+    counts are mergeable by construction (engine/metrics.py), which is
+    the whole reason the report carries them.  Shared by ``fsx status
+    --engine-report`` and ``fsx monitor --engine-report``; jax-free.
+    ``reports`` = a pre-materialized :func:`_iter_engine_reports` list,
+    so one read/parse pass feeds this AND the health merge (the
+    monitor calls both every tick)."""
+    from flowsentryx_tpu.engine.metrics import LatencyHist
+
+    merged = LatencyHist()
+    sources = []
+    per_report = {}
+    for path, doc, err in (reports if reports is not None
+                           else _iter_engine_reports(globs)):
+        if err is not None:
+            per_report[path] = {"error": err}
+            continue
+        lat = (doc.get("latency")
+               or doc.get("report", {}).get("latency"))
+        if not lat or not lat.get("hist"):
+            per_report[path] = {"error": "no latency block"}
+            continue
+        try:
+            h = LatencyHist.from_counts(lat["hist"])
+        except ValueError as e:
+            per_report[path] = {"error": str(e)}
+            continue
+        merged.merge(h)
+        sources.append(path)
+        sv = lat.get("seal_to_verdict") or {}
+        per_report[path] = {
+            "n": sv.get("n", 0),
+            "p99_us": sv.get("p99"),
+        }
     return {
         "reports_merged": len(sources),
         "per_report": per_report,
         "seal_to_verdict_us": merged.to_dict(),
+    }
+
+
+def _merged_engine_health(globs: list, reports: list | None = None) -> dict:
+    """Merge the ``health`` + gossip-counter blocks of engine-report
+    JSONs into one operator view: per-report state/reasons, the gossip
+    plane's drop/seq-gap counters (recorded since PR 10, SHOWN since
+    PR 13 — they feed the DEGRADED reasons), and the worst-of fold.
+    A report that cannot be read folds in as DEGRADED — "the rank
+    whose health cannot be read is not healthy" (engine/health.py),
+    and a crashed-mid-write report is most likely exactly when the
+    fleet is most broken.  Jax-free; shares
+    :func:`_iter_engine_reports` with the latency merge."""
+    from flowsentryx_tpu.engine import health as health_mod
+
+    per_report: dict = {}
+    states: list[str] = []
+    for path, doc, err in (reports if reports is not None
+                           else _iter_engine_reports(globs)):
+        if err is not None:
+            per_report[path] = {
+                "state": health_mod.DEGRADED,
+                "reasons": [f"report_unreadable:{err}"],
+                "error": err,
+            }
+            states.append(health_mod.DEGRADED)
+            continue
+        rep = doc.get("report") if isinstance(doc.get("report"),
+                                              dict) else doc
+        h = rep.get("health") or {}
+        g = rep.get("cluster") or {}
+        entry: dict = {
+            "state": h.get("state"),
+            "reasons": h.get("reasons", []),
+        }
+        if g:
+            entry["gossip"] = {
+                "tx_wires": g.get("tx_wires"),
+                "tx_dropped": g.get("tx_dropped"),
+                "rx_wires": g.get("rx_wires"),
+                "rx_seq_gaps": g.get("rx_seq_gaps"),
+                "merged_digest": g.get("merged_digest"),
+            }
+        per_report[path] = entry
+        if h.get("state"):
+            states.append(h["state"])
+    return {
+        "state": (health_mod.worst(*states) if states else None),
+        "reports": per_report,
     }
 
 
@@ -1624,11 +1795,16 @@ def _cmd_status(args: argparse.Namespace) -> int:
         # planned "display network statistics", README.md:143-146)
         out["kernel"] = _read_kernel(args.pin)
     if args.engine_report:
-        # engine-side seal->verdict latency: the report JSON is the
-        # interface (the kernel maps can't carry it — it's a host/TPU
-        # pipeline property), merged across however many engines the
-        # glob names via the HDR bucket counts
-        out["latency"] = _merged_latency(args.engine_report)
+        # ONE read/parse pass feeds both merges: the engine-side
+        # seal->verdict latency (the report JSON is the interface —
+        # the kernel maps can't carry it), and the health ladder +
+        # gossip drop/seq-gap counters (always recorded; surfaced
+        # here so "is the fleet OK?" is one query, not a log grep)
+        reports = list(_iter_engine_reports(args.engine_report))
+        out["latency"] = _merged_latency(args.engine_report,
+                                         reports=reports)
+        out["health"] = _merged_engine_health(args.engine_report,
+                                              reports=reports)
     print(json.dumps(out, indent=2))
     return 0
 
@@ -1700,6 +1876,11 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
     the log store and the alert source."""
     import time as _time
 
+    if args.alert_degraded and not args.engine_report:
+        print("fsx monitor: --alert-degraded requires --engine-report "
+              "GLOB (health rides the engine reports; the kernel maps "
+              "cannot carry it)", file=sys.stderr)
+        return 1
     if args.alert_p99_us and not args.engine_report:
         # the latency alert is evaluated off the merged engine-report
         # block; without a report source it would silently never fire
@@ -1719,7 +1900,11 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
             stats = kern.get("stats", {})
             alerts = []
             if args.engine_report:
-                lat = _merged_latency(args.engine_report)
+                # one read/parse pass per tick for both merges (this
+                # loop is the monitoring hot path)
+                reports = list(_iter_engine_reports(args.engine_report))
+                lat = _merged_latency(args.engine_report,
+                                      reports=reports)
                 rec["latency"] = lat
                 p99 = lat["seal_to_verdict_us"].get("p99", 0)
                 if (args.alert_p99_us and p99
@@ -1727,6 +1912,17 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
                     alerts.append(
                         f"engine p99 latency {p99:.0f} us >= "
                         f"{args.alert_p99_us:.0f}")
+                hl = _merged_engine_health(args.engine_report,
+                                           reports=reports)
+                rec["health"] = hl
+                if (args.alert_degraded and hl["state"]
+                        and hl["state"] != "healthy"):
+                    reasons = sorted({
+                        r for e in hl["reports"].values()
+                        for r in e.get("reasons", [])})
+                    alerts.append(
+                        f"engine health {hl['state'].upper()}: "
+                        + (", ".join(reasons) or "rank-level failure"))
             if prev is not None and "error" not in stats:
                 dt = max(t - prev_t, 1e-9)
                 rec["per_s"] = {
@@ -2210,6 +2406,32 @@ def build_parser() -> argparse.ArgumentParser:
                          "artifacts/RANGES_*.json evidence file)")
     rg.set_defaults(fn=_cmd_ranges)
 
+    ch = sub.add_parser(
+        "chaos",
+        help="deterministic fault-injection campaign over the real "
+             "stack: kills, crash loops, corrupt checkpoints, shm "
+             "slot corruption, poisoned batches, gossip floods, "
+             "clock jumps, a wedged sink — judged by named "
+             "invariants, with planted regressions as negative "
+             "controls (docs/CHAOS.md)")
+    ch.add_argument("--seed", type=int, default=17,
+                    help="campaign seed: fixes traffic, corruption "
+                         "offsets and kill schedule (default 17)")
+    ch.add_argument("--quick", action="store_true",
+                    help="trim traffic volume, keep full fault-class "
+                         "and plant coverage (the tier-1 smoke shape)")
+    ch.add_argument("--workdir", metavar="DIR",
+                    help="scratch dir for rings/checkpoints/"
+                         "quarantine spools (default: a fresh tempdir)")
+    ch.add_argument("--list", action="store_true",
+                    help="print the fault registry and exit")
+    ch.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ch.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/CHAOS_*.json evidence file)")
+    ch.set_defaults(fn=_cmd_chaos)
+
     # Mirrors bpf.blacklist.DEFAULT_PIN_DIR; kept inline so parser
     # construction never imports the bpf loader (lazy-import rule).
     DEFAULT_PIN_DIR = "/sys/fs/bpf/fsx"
@@ -2420,6 +2642,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "prior releases.  The report's latency block "
                         "carries p50/p90/p99/p999 and budget-miss "
                         "accounting either way")
+    s.add_argument("--quarantine-dir", metavar="DIR",
+                   help="spool refused sealed batches (RANGE_* "
+                        "contract violations) here for post-mortem; "
+                        "default: count-only quarantine (they are "
+                        "never dispatched either way; docs/CHAOS.md)")
+    s.add_argument("--watchdog-s", type=float, default=None,
+                   metavar="S",
+                   help="dispatch-watchdog stall bound: batches in "
+                        "flight with zero completions for S seconds "
+                        "dump per-thread stacks (soft trip), for 2xS "
+                        "fail the drain loudly (default: sync/tuning "
+                        "WATCHDOG_STALL_S; 0 disables)")
     s.add_argument("--no-sink-thread", action="store_true",
                    help="run the verdict sink on the dispatch thread "
                         "(the pre-threaded single-loop engine). Default "
@@ -2522,6 +2756,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="alert when the merged engine p99 "
                          "seal->verdict latency reaches N µs "
                          "(requires --engine-report)")
+    mo.add_argument("--alert-degraded", action="store_true",
+                    help="alert when any merged engine report's "
+                         "health ladder reads DEGRADED or FAILED, "
+                         "naming the reasons (requires "
+                         "--engine-report; docs/CHAOS.md §health)")
     mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")

@@ -128,6 +128,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         total_shards=spec["total_shards"],
         precompact=spec.get("precompact"),
         queue_slots=spec.get("queue_slots", 8),
+        quarantine_dir=spec.get("quarantine_dir"),
     )
     if spec.get("verdict_ring"):
         from flowsentryx_tpu.engine.shm import ShmVerdictSink
@@ -154,6 +155,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         mega_n=spec.get("mega") or 0,
         device_loop=spec.get("device_loop", 0),
         slo_us=spec.get("slo_us") or 0,
+        watchdog_s=spec.get("watchdog_s"),
         gossip=plane,
     )
     restore_info = None
@@ -249,9 +251,11 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
 def stub_engine_main(spec: dict) -> int:
     """Lifecycle-protocol stub (module docstring): heartbeats, honors
     stop, optionally crashes on schedule (``stub_crash_after_s``, first
-    generation only — the restart must then succeed), and records the
-    restore path the supervisor handed it, so tier-1 can prove the
-    supervision protocol in milliseconds."""
+    generation only — the restart must then succeed; with
+    ``stub_crash_every_gen`` EVERY generation — the chaos campaign's
+    crash-loop fault, which the supervisor must park, not chase), and
+    records the restore path the supervisor handed it, so tier-1 can
+    prove the supervision protocol in milliseconds."""
     _own_process_group()
     plane = GossipPlane(spec["cluster_dir"], spec["rank"],
                         spec["n_engines"])
@@ -265,7 +269,8 @@ def stub_engine_main(spec: dict) -> int:
         plane.tick(force=True)  # heartbeat + merge, the engine cadence
         if plane.stop_requested() and not spec.get("stub_ignore_stop"):
             break
-        if crash_after is not None and gen == 0 \
+        if crash_after is not None \
+                and (gen == 0 or spec.get("stub_crash_every_gen")) \
                 and time.monotonic() - t0 >= crash_after:
             os._exit(17)  # simulated hard death: no cleanup, no DONE
         time.sleep(0.01)

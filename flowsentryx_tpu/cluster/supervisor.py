@@ -38,10 +38,13 @@ from pathlib import Path
 from flowsentryx_tpu.cluster import gossip as gplane
 from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
 from flowsentryx_tpu.core import schema
-# numpy-only (engine/__init__ is lazy — no jax rides in): the HDR
-# histogram class whose bucket counts the per-rank reports carry,
-# merged here into the cluster latency view
+# jax-free engine leaves (engine/__init__ is lazy — no jax rides in):
+# the HDR histogram class whose bucket counts the per-rank reports
+# carry, merged here into the cluster latency view, and the health
+# ladder the aggregate folds worst-of across ranks
+from flowsentryx_tpu.engine import health as health_mod
 from flowsentryx_tpu.engine.metrics import LatencyHist
+from flowsentryx_tpu.sync import tuning
 
 
 class ClusterSupervisor:
@@ -62,7 +65,10 @@ class ClusterSupervisor:
         *,
         entry=None,
         max_restarts: int = 2,
-        heartbeat_timeout_s: float = 5.0,
+        heartbeat_timeout_s: float = tuning.SUPERVISOR_HEARTBEAT_TIMEOUT_S,
+        restart_backoff_s: float = tuning.RESPAWN_BACKOFF_BASE_S,
+        restart_backoff_max_s: float = tuning.RESPAWN_BACKOFF_MAX_S,
+        restart_window_s: float = tuning.RESTART_WINDOW_S,
         k_max: int = 64,
         mailbox_slots: int = 256,
         t0_ns: int | None = None,
@@ -81,6 +87,14 @@ class ClusterSupervisor:
         self._entry = entry
         self.max_restarts = max_restarts
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        # crash-loop discipline (sync/tuning.py rationale): respawns
+        # back off exponentially, and only deaths inside the sliding
+        # window count against the budget — a rank that dies instantly
+        # N times PARKS as failed (its span announced) instead of
+        # burning the whole budget in milliseconds.
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.restart_window_s = restart_window_s
         self.k_max = k_max
         self.mailbox_slots = mailbox_slots
         self.t0_ns = t0_ns
@@ -89,6 +103,10 @@ class ClusterSupervisor:
         self._status: list[StatusBlock] = []
         self._gen = [0] * self.n
         self.restarts = [0] * self.n
+        #: monotonic stamps of each rank's deaths inside the window
+        self._death_times: list[list[float]] = [[] for _ in range(self.n)]
+        #: rank -> monotonic due-time of a backoff-delayed respawn
+        self._respawn_at: dict[int, float] = {}
         self._failed: set[int] = set()
         self._done: set[int] = set()
         self._stalled: set[int] = set()
@@ -171,10 +189,26 @@ class ClusterSupervisor:
             str(self.cluster_dir / f"report_r{rank}_g{gen}.json"))
         if gen > 0:
             ckpt = spec.get("checkpoint")
-            if ckpt and Path(self._ckpt_file(ckpt)).exists():
-                # resume with flow memory intact (Engine.restore; the
-                # geometry matches by construction — same spec)
-                spec["restore"] = str(self._ckpt_file(ckpt))
+            if ckpt:
+                ck_file = Path(self._ckpt_file(ckpt))
+                # `<name>.npz.prev` is checkpoint.prev_path's layout
+                # (inlined: engine/checkpoint.py imports jax, and this
+                # module must stay on the jax-free import path): the
+                # retained generation covers both a corrupt live file
+                # (Engine.restore falls back itself) and the crash
+                # window between save_state's two renames, where the
+                # live file is briefly absent.
+                prev = ck_file.with_name(ck_file.name + ".prev")
+                if ck_file.exists() or prev.exists():
+                    # resume with flow memory intact (Engine.restore;
+                    # geometry matches by construction — same spec).
+                    # Always hand over the LIVE path: when it is
+                    # absent or corrupt, Engine.restore performs the
+                    # .prev fallback ITSELF — announced and counted in
+                    # the health ladder (restore_fallbacks); adopting
+                    # .prev here would launder a stale-generation
+                    # resume into a clean-looking restore.
+                    spec["restore"] = str(ck_file)
         p = self._ctx.Process(target=self._entry, args=(spec,),
                               name=f"fsx-cluster-r{rank}")
         p.start()
@@ -214,12 +248,40 @@ class ClusterSupervisor:
             p.kill()
             p.join(timeout=2.0)
 
+    def _announce_park(self, rank: int, recent: int) -> None:
+        """A rank exhausted its sliding-window restart budget: park it
+        as failed with its IP-space span ANNOUNCED — the operator must
+        know which flows just fell to the kernel limiter alone, and a
+        log line at death #1 scrolled away long ago."""
+        import sys
+
+        w = self.specs[rank].get("workers")
+        span = (f"ring shards [{rank * w}, {(rank + 1) * w})"
+                if w else f"rank {rank}'s shard span")
+        print(
+            f"fsx cluster: rank {rank} PARKED as failed — {recent} "
+            f"death(s) within the {self.restart_window_s:.0f}s restart "
+            f"window (budget {self.max_restarts}); {span} fails open "
+            "to the kernel tier. Fix the crash cause and restart the "
+            "fleet to re-serve it.", file=sys.stderr)
+
     def poll(self) -> None:
         """One supervision pass: liveness, heartbeat staleness,
-        restart-or-fail decisions."""
+        restart-or-fail decisions under the crash-loop discipline
+        (exponential backoff + sliding-window budget; sync/tuning.py
+        has the measured rationale for both)."""
         now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        now = time.monotonic()
         for r in range(self.n):
             if r in self._failed or r in self._done:
+                continue
+            # a backoff-delayed respawn whose delay elapsed fires now
+            if r in self._respawn_at:
+                if now >= self._respawn_at[r]:
+                    del self._respawn_at[r]
+                    self.restarts[r] += 1
+                    self._gen[r] += 1
+                    self._spawn(r)
                 continue
             p = self._procs[r]
             st = self._status[r]
@@ -229,15 +291,25 @@ class ClusterSupervisor:
                     self._done.add(r)
                     continue
                 # died without DONE: crash-fail-open — clean up the
-                # whole tree, then restart from the last checkpoint
+                # whole tree, then decide restart-vs-park against the
+                # sliding window (deaths older than the window are
+                # yesterday's incident, not this crash loop's)
                 self._killpg(p)
                 p.join(timeout=1.0)
-                if self.restarts[r] < self.max_restarts:
-                    self.restarts[r] += 1
-                    self._gen[r] += 1
-                    self._spawn(r)
+                self._procs[r] = None  # corpse handled
+                self._death_times[r] = [
+                    t for t in self._death_times[r]
+                    if now - t < self.restart_window_s]
+                recent = len(self._death_times[r])
+                self._death_times[r].append(now)
+                if recent < self.max_restarts:
+                    delay = min(
+                        self.restart_backoff_s * (2 ** recent),
+                        self.restart_backoff_max_s)
+                    self._respawn_at[r] = now + delay
                 else:
                     self._failed.add(r)
+                    self._announce_park(r, recent + 1)
                 continue
             hb = st.ctl_get("c_hbeat")
             if (hb and state == schema.CSTATE_SERVING
@@ -254,7 +326,7 @@ class ClusterSupervisor:
             st.ctl_set("c_stop", 1)
 
     def run(self, max_seconds: float | None = None,
-            poll_s: float = 0.05,
+            poll_s: float = tuning.SUPERVISOR_POLL_S,
             drain_timeout_s: float = 60.0) -> dict:
         """Supervise until every rank is DONE (or terminally failed).
         ``max_seconds`` bounds the SERVING phase: when it trips, the
@@ -281,6 +353,12 @@ class ClusterSupervisor:
         deadline = time.monotonic() + timeout_s
         for r, p in enumerate(self._procs):
             if p is None:
+                if r in self._respawn_at and r not in self._done:
+                    # died, was awaiting its backoff respawn when the
+                    # terminal stop landed: no restart is coming, so
+                    # the rank is failed, not lost
+                    self._respawn_at.pop(r, None)
+                    self._failed.add(r)
                 continue
             p.join(timeout=max(0.0, deadline - time.monotonic()))
             if p.is_alive():
@@ -353,12 +431,24 @@ class ClusterSupervisor:
                 "seal_to_verdict": merged.to_dict(),
                 "per_rank_p99": per_rank_p99,
             }
+        # cluster health ladder (engine/health.py): worst-of every
+        # rank's self-reported health, with the supervisor's own
+        # terminal observations (parked/stalled ranks) layered on top
+        per_rank_health = {
+            r: rep["report"]["health"]
+            for r, rep in latest.items()
+            if isinstance(rep.get("report"), dict)
+            and rep["report"].get("health")
+        }
         return {
             "engines": self.n,
             "t0_ns": self.t0_ns,
             "restarts": list(self.restarts),
             "failed_ranks": sorted(self._failed),
             "stalled_ranks": sorted(self._stalled),
+            "health": health_mod.cluster_health(
+                per_rank_health, sorted(self._failed),
+                sorted(self._stalled)),
             "records": total_records,
             "batches": total_batches,
             "max_wall_s": round(max_wall, 4),

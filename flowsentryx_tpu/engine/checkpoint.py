@@ -26,6 +26,25 @@ Production-scale upgrades (PR 8):
   ``device_get`` of a row-sharded array yields), so every pre-header
   snapshot still loads (``n_shards`` defaults to 1).
 
+Integrity + retention (PR 13, the chaos campaign's forcing function):
+
+* **CRC32 integrity** — the snapshot carries one CRC32 folded over
+  every header AND payload section (each entry's name + raw bytes, in
+  sorted-name order).  A bit-flipped or torn file can therefore never
+  be *silently* loaded: :func:`load_checkpoint` refuses with
+  :class:`CheckpointCorrupt` — a named ``ValueError`` — whether the
+  damage shows as a zip/zlib decode error, a missing member, or clean
+  decompression of wrong bytes (the case only the CRC catches).
+  Pre-CRC snapshots (no ``integrity_crc32`` member) still load; their
+  ``crc_checked`` flag reads False so callers can tell "verified" from
+  "grandfathered".
+* **Previous-generation retention** — before the atomic
+  ``os.replace`` publishes a new snapshot, the incumbent is rotated to
+  ``<name>.prev`` (same-directory rename, atomic on POSIX).  Restore
+  paths that hit a corrupt checkpoint fall back to that retained good
+  generation LOUDLY (``Engine.restore``), instead of crashing — or
+  worse, resuming from garbage — on the only copy.
+
 (Plain npz rather than orbax: the state is a flat dict of arrays,
 ~40 MB at 1M rows; zero-dependency and byte-inspectable wins here.)
 """
@@ -33,6 +52,8 @@ Production-scale upgrades (PR 8):
 from __future__ import annotations
 
 import os
+import zipfile
+import zlib
 from pathlib import Path
 from typing import NamedTuple
 
@@ -42,6 +63,48 @@ import numpy as np
 from flowsentryx_tpu.core import schema
 
 CHECKPOINT_SCHEMA_VERSION = 1
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file failed validation: empty, truncated, torn,
+    bit-flipped (CRC mismatch), or structurally unreadable.  A
+    ``ValueError`` subclass so existing ``except ValueError`` callers
+    keep refusing loudly; the distinct type lets restore paths fall
+    back to the retained ``.prev`` generation on corruption while
+    still propagating genuine contract errors (schema/salt mismatch)."""
+
+
+#: np.load errors that mean "this file is damaged", not "this file
+#: disagrees with me": zip central-directory tears, zlib stream
+#: corruption, short reads, struct decode failures on truncated
+#: members.  (KeyError/IndexError cover a torn-at-create file whose
+#: zip opens but whose members are absent or empty.)
+_DAMAGE_ERRORS = (OSError, EOFError, zipfile.BadZipFile, zlib.error,
+                  KeyError, IndexError, ValueError)
+
+
+def _fold_crc(entries: dict) -> int:
+    """CRC32 over every section, sorted by name: ``name bytes`` then
+    the array's raw bytes.  Folding the NAMES in means a section
+    swapped for another section's bytes (or dropped entirely at
+    truncation) also mismatches — header and payload are both under
+    the same checksum, per the chaos campaign's torn-write faults."""
+    crc = 0
+    for name in sorted(entries):
+        arr = np.ascontiguousarray(np.asarray(entries[name]))
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(arr.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def prev_path(path: str | Path) -> Path:
+    """The retained previous-generation twin of a checkpoint path
+    (``snap.npz`` -> ``snap.npz.prev``), kept same-directory so the
+    rotation rides the existing atomic ``os.replace``."""
+    p = Path(path)
+    if p.suffix != ".npz":
+        p = p.with_suffix(p.suffix + ".npz")
+    return p.with_name(p.name + ".prev")
 
 
 class Checkpoint(NamedTuple):
@@ -57,6 +120,10 @@ class Checkpoint(NamedTuple):
     capacity: int
     missing_columns: tuple       # table columns the snapshot predates
     missing_stats: tuple         # stats counters the snapshot predates
+    #: False only for pre-CRC snapshots (grandfathered in unverified);
+    #: any snapshot written since PR 13 carries ``integrity_crc32`` and
+    #: loads only after the fold re-verifies.
+    crc_checked: bool = True
 
 
 def save_state(
@@ -89,22 +156,34 @@ def save_state(
     # the live checkpoint is either the old complete snapshot or the
     # new complete snapshot — never a torn write
     tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+    entries = {
+        "table_key": key,
+        **cols,
+        **{f"stats_{k}": np.asarray(v)
+           for k, v in stats._asdict().items()},
+        "t0_ns": np.uint64(t0_ns),
+        "hash_salt": np.uint64(hash_salt),
+        "n_shards": np.uint64(n_shards),
+        "capacity": np.uint64(key.shape[0]),
+        "schema_version": np.int64(CHECKPOINT_SCHEMA_VERSION),
+    }
     try:
         np.savez_compressed(
             tmp,
-            table_key=key,
-            **cols,
-            **{f"stats_{k}": np.asarray(v)
-               for k, v in stats._asdict().items()},
-            t0_ns=np.uint64(t0_ns),
-            hash_salt=np.uint64(hash_salt),
-            n_shards=np.uint64(n_shards),
-            capacity=np.uint64(key.shape[0]),
-            schema_version=CHECKPOINT_SCHEMA_VERSION,
+            integrity_crc32=np.uint32(_fold_crc(entries)),
+            **entries,
         )
         # np.savez appends .npz to the temp stem too
         tmp_written = (tmp if tmp.suffix == ".npz"
                        else tmp.with_suffix(tmp.suffix + ".npz"))
+        if path.exists():
+            # retain the incumbent GOOD generation before publishing:
+            # a later restore that finds `path` corrupt (torn disk,
+            # bit flip) falls back to `.prev` instead of dying on the
+            # only copy.  Both renames are atomic; a crash between
+            # them leaves .prev complete and path absent — still a
+            # restorable state, never a torn one.
+            os.replace(path, prev_path(path))
         os.replace(tmp_written, path)
     except BaseException:
         for t in (tmp, tmp.with_suffix(tmp.suffix + ".npz")):
@@ -121,16 +200,41 @@ def peek_header(path: str | Path) -> dict:
     count, capacity, schema version — so servers and the CLI can
     validate (or plan a reshard) before the multi-second JAX boot.
     Pre-header snapshots read as salt 0 / 1 shard; capacity falls back
-    to the key column's length."""
-    with np.load(Path(path)) as z:
-        cap = (int(z["capacity"]) if "capacity" in z
-               else int(z["table_key"].shape[0]))
-        return {
-            "schema_version": int(z["schema_version"]),
-            "hash_salt": int(z["hash_salt"]) if "hash_salt" in z else 0,
-            "n_shards": int(z["n_shards"]) if "n_shards" in z else 1,
-            "capacity": cap,
-        }
+    to the key column's length.
+
+    A zero-length, truncated, or otherwise unreadable file raises
+    :class:`CheckpointCorrupt` (a named ``ValueError``) — previously a
+    file torn at create time leaked a raw struct/IndexError through
+    the pre-boot validation path, which read as a code bug instead of
+    the operational fact it is."""
+    path = Path(path)
+    try:
+        size = path.stat().st_size
+    except OSError as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable: {e}") from e
+    if size == 0:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is empty (0 bytes): a file torn at "
+            "create time, not a snapshot")
+    try:
+        with np.load(path) as z:
+            cap = (int(z["capacity"]) if "capacity" in z
+                   else int(z["table_key"].shape[0]))
+            return {
+                "schema_version": int(z["schema_version"]),
+                "hash_salt": (int(z["hash_salt"])
+                              if "hash_salt" in z else 0),
+                "n_shards": int(z["n_shards"]) if "n_shards" in z else 1,
+                "capacity": cap,
+                "has_crc": "integrity_crc32" in z,
+            }
+    except CheckpointCorrupt:
+        raise
+    except _DAMAGE_ERRORS as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is corrupt or truncated "
+            f"({size} bytes): {type(e).__name__}: {e}") from e
 
 
 def peek_salt(path: str | Path) -> int:
@@ -145,43 +249,83 @@ def load_checkpoint(path: str | Path) -> Checkpoint:
     see :class:`Checkpoint`).  Columns or stats counters added after
     the snapshot was written load zero-filled and are named in the
     ``missing_*`` fields so the caller can apply the right default
-    (e.g. ``Engine.restore`` refills byte-bucket credit)."""
-    with np.load(Path(path)) as z:
-        version = int(z["schema_version"])
-        if version != CHECKPOINT_SCHEMA_VERSION:
-            raise ValueError(
-                f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
-            )
-        cap = int(z["table_key"].shape[0])
-        state = np.zeros((cap, schema.NUM_TABLE_COLS), np.float32)
-        missing = []
-        for i, name in enumerate(schema.TABLE_COLUMN_NAMES):
-            if f"table_{name}" in z:
-                state[:, i] = z[f"table_{name}"]
-            else:
-                missing.append(name)
-        missing_stats = []
-        stats_vals = {}
-        for k in schema.GlobalStats._fields:
-            if f"stats_{k}" in z:
-                stats_vals[k] = np.asarray(z[f"stats_{k}"])
-            else:
-                # a counter added after the snapshot (e.g. ``evicted``
-                # on pre-eviction-era snapshots): zero is the correct
-                # resume value for a monotone counter
-                stats_vals[k] = np.zeros((2,), np.uint32)
-                missing_stats.append(k)
-        return Checkpoint(
-            table=schema.IpTableState(
-                key=np.asarray(z["table_key"]), state=state),
-            stats=schema.GlobalStats(**stats_vals),
-            t0_ns=int(z["t0_ns"]),
-            hash_salt=int(z["hash_salt"]) if "hash_salt" in z else 0,
-            n_shards=int(z["n_shards"]) if "n_shards" in z else 1,
-            capacity=cap,
-            missing_columns=tuple(missing),
-            missing_stats=tuple(missing_stats),
+    (e.g. ``Engine.restore`` refills byte-bucket credit).
+
+    Integrity (module docstring): every member is decompressed and the
+    folded CRC32 recomputed; a mismatch — or any structural damage on
+    the way in — raises :class:`CheckpointCorrupt`.  A corrupt file
+    can therefore never be silently loaded.  Snapshots predating the
+    CRC load with ``crc_checked=False``."""
+    path = Path(path)
+    entries: dict[str, np.ndarray] = {}
+    stored_crc = None
+    try:
+        if path.stat().st_size == 0:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} is empty (0 bytes)")
+        with np.load(path) as z:
+            for name in z.files:
+                if name == "integrity_crc32":
+                    stored_crc = int(z[name])
+                else:
+                    entries[name] = np.asarray(z[name])
+        if "schema_version" not in entries or "table_key" not in entries:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} is missing its "
+                "schema_version/table_key sections (torn write?)")
+    except CheckpointCorrupt:
+        raise
+    except _DAMAGE_ERRORS as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is corrupt or truncated: "
+            f"{type(e).__name__}: {e}") from e
+    if stored_crc is not None:
+        actual = _fold_crc(entries)
+        if actual != stored_crc:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} failed its integrity check: "
+                f"stored CRC32 {stored_crc:#010x} != recomputed "
+                f"{actual:#010x} — the bytes decompressed cleanly but "
+                "are not the bytes that were written (bit flip or "
+                "spliced sections); refusing to resume from garbage")
+    version = int(entries["schema_version"])
+    if version != CHECKPOINT_SCHEMA_VERSION:
+        raise ValueError(
+            f"checkpoint schema {version} != {CHECKPOINT_SCHEMA_VERSION}"
         )
+    cap = int(entries["table_key"].shape[0])
+    state = np.zeros((cap, schema.NUM_TABLE_COLS), np.float32)
+    missing = []
+    for i, name in enumerate(schema.TABLE_COLUMN_NAMES):
+        if f"table_{name}" in entries:
+            state[:, i] = entries[f"table_{name}"]
+        else:
+            missing.append(name)
+    missing_stats = []
+    stats_vals = {}
+    for k in schema.GlobalStats._fields:
+        if f"stats_{k}" in entries:
+            stats_vals[k] = np.asarray(entries[f"stats_{k}"])
+        else:
+            # a counter added after the snapshot (e.g. ``evicted``
+            # on pre-eviction-era snapshots): zero is the correct
+            # resume value for a monotone counter
+            stats_vals[k] = np.zeros((2,), np.uint32)
+            missing_stats.append(k)
+    return Checkpoint(
+        table=schema.IpTableState(
+            key=np.asarray(entries["table_key"]), state=state),
+        stats=schema.GlobalStats(**stats_vals),
+        t0_ns=int(entries["t0_ns"]),
+        hash_salt=(int(entries["hash_salt"])
+                   if "hash_salt" in entries else 0),
+        n_shards=(int(entries["n_shards"])
+                  if "n_shards" in entries else 1),
+        capacity=cap,
+        missing_columns=tuple(missing),
+        missing_stats=tuple(missing_stats),
+        crc_checked=stored_crc is not None,
+    )
 
 
 def load_state(

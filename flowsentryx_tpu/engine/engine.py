@@ -59,8 +59,10 @@ from flowsentryx_tpu.core import schema
 from flowsentryx_tpu.core.config import FsxConfig
 from flowsentryx_tpu.engine.arena import DispatchArena
 from flowsentryx_tpu.engine.batcher import MicroBatcher
+from flowsentryx_tpu.engine import health
 from flowsentryx_tpu.engine.metrics import LatencyRecorder, PipelineMetrics
 from flowsentryx_tpu.engine.sources import RecordSource
+from flowsentryx_tpu.engine.watchdog import DispatchWatchdog
 from flowsentryx_tpu.engine.writeback import (
     VerdictSink, decode_verdict_wire, extract_updates,
 )
@@ -134,6 +136,15 @@ class EngineReport(NamedTuple):
     #: budget-miss accounting.  Always measured; None only before the
     #: first run.
     latency: dict | None = None
+    #: Explicit health ladder (engine/health.py): HEALTHY /
+    #: DEGRADED(reasons) / FAILED, derived from the signals this report
+    #: already carries — dead/stalled ingest shards, seq gaps, emit
+    #: drops, quarantined poisoned batches, corrupt-slot skips, gossip
+    #: TX-drop / RX-gap counters, watchdog trips, ``.prev`` restore
+    #: fallbacks.  Aggregated worst-of across ranks by the cluster
+    #: supervisor; queryable via ``fsx status --engine-report`` and
+    #: alertable via ``fsx monitor --alert-degraded``.
+    health: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -210,6 +221,7 @@ class Engine:
         kernel_tier: Any | None = None,
         gossip: Any | None = None,
         slo_us: int = 0,
+        watchdog_s: float | None = None,
     ):
         self.cfg = cfg
         self.source = source
@@ -663,6 +675,22 @@ class Engine:
         self._watch_mtime = 0
         self._watch_next = 0.0
         self._hot_swaps = 0
+        # -- robustness plane (PR 13; engine/health.py derives the
+        # -- ladder, engine/watchdog.py owns the no-progress detector)
+        #: restores that fell back to the retained .prev generation
+        #: (a DEGRADED reason: flow memory resumed one generation
+        #: stale).  Written only in the quiescent restore().
+        self._restore_fallbacks = 0
+        #: Dispatch watchdog (engine/watchdog.py): trips when batches
+        #: are in flight but nothing sinks for the stall bound —
+        #: dumping per-thread stacks and surfacing loudly instead of
+        #: letting a drain hang forever.  ``watchdog_s=0`` disables;
+        #: None = sync/tuning.py WATCHDOG_STALL_S.  Pure observer on
+        #: the null path: it never changes results, only refuses to
+        #: hang (test-pinned byte-identical at defaults).
+        if watchdog_s is None:
+            watchdog_s = tuning.WATCHDOG_STALL_S
+        self._watchdog = DispatchWatchdog(watchdog_s)
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -1091,7 +1119,13 @@ class Engine:
         here, blocking on device completion."""
         if self._sink_active:
             self._handoff()
-            self._chan.wait_below(down_to)
+            # the watchdog rides the backpressure wait's wakeup
+            # quantum: a wedged-but-alive worker (no WorkerCrash to
+            # break the wait) must dump stacks and fail loudly instead
+            # of parking this wait forever (engine/watchdog.py)
+            self._chan.wait_below(
+                down_to,
+                on_wait=lambda: self._watchdog.check(self._chan.pending))
             self._check_sink()
             return
         total = sum(g.n_chunks for g in self._inflight)
@@ -1121,8 +1155,10 @@ class Engine:
         stacking up, and consecutive ready batches go as one group."""
         # every serving loop passes through here each iteration — the
         # one place the artifact watcher's throttled mtime check covers
-        # inline, sealed, and ring loops alike
+        # inline, sealed, and ring loops alike (and the dispatch
+        # watchdog's no-progress poll, same coverage argument)
         self._maybe_reload_artifact()
+        self._watchdog.check(self._busy_depth())
         if self.gossip is not None:
             # merge peers' gossiped verdicts between dispatches (also
             # on idle iterations — a quiet engine still mitigates what
@@ -1176,7 +1212,15 @@ class Engine:
         if not self._sink_active:
             return
         self._chan.request_stop()
-        self._sink_thread_obj.join()
+        if self._watchdog.tripped:
+            # the watchdog hard-tripped: the worker is WEDGED, not
+            # draining — an unbounded join here would turn "fail
+            # loudly" back into "hang forever".  Bounded join, then
+            # abandon the daemon thread; the WatchdogStall propagating
+            # through run() is the loud failure.
+            self._sink_thread_obj.join(timeout=2.0)
+        else:
+            self._sink_thread_obj.join()
         self._sink_thread_obj = None
         self._sink_active = False
         self._pipe_active = False
@@ -1433,6 +1477,9 @@ class Engine:
             )
             if self.on_reap is not None:
                 self.on_reap(g.n_records, t_done)
+        # a completed sink group is the watchdog's progress signal —
+        # one float store, whichever thread owns the sink section
+        self._watchdog.note_progress()
 
     def warm(self) -> None:
         """Trigger the step's XLA compile with a zero-fill batch.
@@ -1606,11 +1653,38 @@ class Engine:
         with unplaceable rows counted, never silent).  A salt mismatch
         is refused outright: proceeding under either salt would break
         one side's slot layout.  Returns a summary dict
-        (``resharded``/``dropped_rows``/``from``/``to``)."""
+        (``resharded``/``dropped_rows``/``from``/``to``).
+
+        A CORRUPT snapshot (failed CRC, torn/truncated file —
+        :class:`~flowsentryx_tpu.engine.checkpoint.CheckpointCorrupt`)
+        is never loaded: restore falls back to the retained previous
+        generation (``checkpoint.prev_path``; the periodic-snapshot
+        loop rotates it on every save), announced loudly and counted
+        in ``EngineReport.health`` as a DEGRADED reason — flow memory
+        resumes one generation stale, which fail-open serving absorbs
+        the same way it absorbs a restart.  No ``.prev`` (or a
+        ``.prev`` that is itself corrupt) re-raises: there is nothing
+        safe to resume from, and inventing an empty table silently
+        would unblock every previously-blocked source."""
+        import sys
+
         from flowsentryx_tpu.engine import checkpoint as ckpt
         from flowsentryx_tpu.engine import table as tbl
 
-        ck = ckpt.load_checkpoint(path)
+        fallback_from = None
+        try:
+            ck = ckpt.load_checkpoint(path)
+        except ckpt.CheckpointCorrupt as e:
+            prev = ckpt.prev_path(path)
+            if not prev.exists():
+                raise
+            print(
+                f"fsx engine: checkpoint {path} REFUSED ({e}); "
+                f"falling back to the retained previous generation "
+                f"{prev}", file=sys.stderr)
+            ck = ckpt.load_checkpoint(prev)  # corrupt too -> raises
+            fallback_from = str(path)
+            self._restore_fallbacks += 1
         if ck.hash_salt != self.cfg.table.salt:
             # A different salt relocates every slot: lookups would miss
             # all persisted flows and silently rebuild the table from
@@ -1642,6 +1716,8 @@ class Engine:
             "from": {"capacity": ck.capacity, "n_shards": ck.n_shards},
             "to": {"capacity": self.cfg.table.capacity,
                    "n_shards": n_shards},
+            "crc_checked": ck.crc_checked,
+            "fallback_from": fallback_from,
         }
         if (ck.capacity != self.cfg.table.capacity
                 or ck.n_shards != n_shards):
@@ -2323,6 +2399,12 @@ class Engine:
 
         # explicit D2H for the report counters (transfer-guard contract)
         st = schema.GlobalStats(*jax.device_get(tuple(self.stats)))
+        ingest_stats = (self.source.ingest_stats()
+                        if self.sealed and hasattr(self.source,
+                                                   "ingest_stats")
+                        else None)
+        cluster_rep = (self.gossip.report()
+                       if self.gossip is not None else None)
         return EngineReport(
             batches=self.batcher.batches_emitted,
             records=self.batcher.records_emitted,
@@ -2334,14 +2416,11 @@ class Engine:
             table=table_sum,
             ts_wrap_risk_polls=self.batcher.ts_wrap_risk_polls,
             route_drop=self._route_drop,
-            ingest=(self.source.ingest_stats()
-                    if self.sealed and hasattr(self.source, "ingest_stats")
-                    else None),
+            ingest=ingest_stats,
             readback=readback,
             dispatch=dispatch,
             escalation=escalation,
-            cluster=(self.gossip.report()
-                     if self.gossip is not None else None),
+            cluster=cluster_rep,
             # compute_is_wall: on backends that execute the step graph
             # synchronously at dispatch (XLA:CPU scatter custom-calls)
             # the launch wall IS the compute; a CPU backend is the
@@ -2349,6 +2428,13 @@ class Engine:
             latency=self._lat.to_dict(
                 self.slo_us,
                 compute_is_wall=jax.devices()[0].platform == "cpu"),
+            # the health ladder is a pure function of the blocks above
+            # (engine/health.py): impossible to drift from the counters
+            health=health.engine_health(
+                ingest=ingest_stats,
+                gossip=cluster_rep,
+                watchdog=self._watchdog.to_dict(),
+                restore_fallbacks=self._restore_fallbacks),
         )
 
 

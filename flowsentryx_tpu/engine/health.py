@@ -1,0 +1,134 @@
+"""The explicit health state machine: HEALTHY / DEGRADED / FAILED.
+
+Before PR 13 "degraded" was a log line: a dead ingest shard, a gossip
+mailbox dropping wires, a quarantined batch each printed once and
+vanished — an operator asking "is this engine OK?" had no state to
+query.  This module derives one explicit ladder from the signals the
+reports ALREADY carry, so health is a pure function of observable
+counters (deterministic, unit-testable, and impossible to let drift
+from the counters themselves):
+
+* **HEALTHY** — every shard served, nothing dropped, nothing
+  quarantined, watchdog quiet.
+* **DEGRADED(reasons)** — serving continues but something fail-opened:
+  dead/stalled ingest shards (their flows fall to the kernel limiter),
+  sealed-queue emit drops, sequence gaps, quarantined poisoned
+  batches, corrupt-slot skips, gossip TX drops / RX seq gaps, a
+  watchdog soft trip, a restore that fell back to the ``.prev``
+  generation.  Each reason is a ``name:count`` string an alert can key
+  on.
+* **FAILED** — the engine cannot serve its span: every ingest shard is
+  dead, or the watchdog hard-tripped (the process is already dying
+  loudly; the state is its last words).
+
+Carried in ``EngineReport.health``, aggregated across ranks by the
+cluster supervisor (worst-of, with per-rank detail), shown by
+``fsx status --engine-report`` and alertable via ``fsx monitor
+--alert-degraded``.
+
+Jax-free and numpy-free: the supervisor and the CLI monitoring path
+import this without an engine boot.
+"""
+
+from __future__ import annotations
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+FAILED = "failed"
+
+#: Ladder order for worst-of aggregation.
+_RANK = {HEALTHY: 0, DEGRADED: 1, FAILED: 2}
+
+
+def engine_health(
+    ingest: dict | None = None,
+    gossip: dict | None = None,
+    watchdog: dict | None = None,
+    restore_fallbacks: int = 0,
+) -> dict:
+    """Derive one engine's health from its report blocks (module
+    docstring).  Every argument is the corresponding
+    ``EngineReport``/``ingest_stats`` dict (or None when that plane is
+    off); the return is ``{"state": ..., "reasons": [...]}``."""
+    reasons: list[str] = []
+    failed = False
+    if ingest:
+        dead = ingest.get("dead_workers") or []
+        n_workers = int(ingest.get("n_workers") or 0)
+        if dead:
+            reasons.append(f"ingest_shards_dead:{len(dead)}")
+            if n_workers and len(dead) == n_workers:
+                # nothing left serving this span: the kernel limiter
+                # stands alone for every flow the engine owned
+                failed = True
+        stalled = [k for k, w in (ingest.get("workers") or {}).items()
+                   if w.get("stalled")]
+        if stalled:
+            reasons.append(f"ingest_shards_stalled:{len(stalled)}")
+        gaps = sum(w.get("seq_gaps", 0)
+                   for w in (ingest.get("workers") or {}).values())
+        if gaps:
+            reasons.append(f"ingest_seq_gaps:{gaps}")
+        drops = int(ingest.get("dropped_emit_batches") or 0)
+        if drops:
+            reasons.append(f"ingest_emit_drops:{drops}")
+        tail = int(ingest.get("dropped_tail_batches") or 0)
+        if tail:
+            reasons.append(f"ingest_tail_drops:{tail}")
+        quarantined = int(ingest.get("quarantined_batches") or 0)
+        if quarantined:
+            reasons.append(f"quarantined_batches:{quarantined}")
+        bad = int(ingest.get("bad_wire_slots") or 0)
+        if bad:
+            reasons.append(f"bad_wire_slots:{bad}")
+    if gossip:
+        tx = int(gossip.get("tx_dropped") or 0)
+        if tx:
+            reasons.append(f"gossip_tx_dropped:{tx}")
+        rx = int(gossip.get("rx_seq_gaps") or 0)
+        if rx:
+            reasons.append(f"gossip_rx_seq_gaps:{rx}")
+    if watchdog:
+        trips = int(watchdog.get("soft_trips") or 0)
+        if trips:
+            reasons.append(f"watchdog_soft_trips:{trips}")
+        if watchdog.get("hard_tripped"):
+            failed = True
+    if restore_fallbacks:
+        reasons.append(f"restore_fallbacks:{restore_fallbacks}")
+    state = FAILED if failed else (DEGRADED if reasons else HEALTHY)
+    return {"state": state, "reasons": reasons}
+
+
+def worst(*states: str) -> str:
+    """Worst-of fold over ladder states (unknown reads as DEGRADED:
+    a rank whose health cannot be read is not healthy)."""
+    return max((s if s in _RANK else DEGRADED for s in states),
+               key=lambda s: _RANK[s], default=HEALTHY)
+
+
+def cluster_health(per_rank: dict, failed_ranks: list,
+                   stalled_ranks: list) -> dict:
+    """Supervisor-side aggregation: worst-of every rank's reported
+    health, with supervisor-observed terminal states layered on top
+    (a rank parked as failed is FAILED even if its last report said
+    healthy — the report predates the park)."""
+    states = [h.get("state", DEGRADED) for h in per_rank.values()]
+    reasons: list[str] = []
+    for r, h in sorted(per_rank.items()):
+        for reason in h.get("reasons", []):
+            reasons.append(f"r{r}:{reason}")
+    state = worst(*states) if states else HEALTHY
+    if failed_ranks:
+        state = FAILED
+        reasons.append(
+            f"ranks_failed:{','.join(str(r) for r in failed_ranks)}")
+    elif stalled_ranks:
+        state = worst(state, DEGRADED)
+        reasons.append(
+            f"ranks_stalled:{','.join(str(r) for r in stalled_ranks)}")
+    return {
+        "state": state,
+        "reasons": reasons,
+        "per_rank": {str(r): h for r, h in sorted(per_rank.items())},
+    }

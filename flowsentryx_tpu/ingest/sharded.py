@@ -48,6 +48,16 @@ from flowsentryx_tpu.sync import tuning
 from flowsentryx_tpu.sync.channel import WorkerCrash
 
 
+#: Cap on spooled quarantine payloads (and on per-event stderr lines)
+#: per fleet: the metadata contracts exist because slot contents are
+#: ADVERSARIAL, and an attacker sustaining a poisoned stream must not
+#: turn the quarantine spool into a disk-exhaustion primitive or the
+#: refusal print into a stderr flood.  Past the cap the counters keep
+#: counting (nothing ever vanishes silently — the drop-and-count
+#: posture of the gossip mailboxes), the dumps and prints stop.
+QUARANTINE_SPOOL_CAP = 32
+
+
 class SealedBatch(NamedTuple):
     """One dequeued wire buffer plus its cross-process header fields."""
 
@@ -113,6 +123,7 @@ class ShardedIngest:
         strict: bool = False,
         shard_offset: int = 0,
         total_shards: int | None = None,
+        quarantine_dir: str | Path | None = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -202,6 +213,26 @@ class ShardedIngest:
         self._records = [0] * n_workers
         self._dropped_tail = 0
         self._metrics = [WorkerIngestMetrics(k) for k in range(n_workers)]
+        #: Slot-validation plane (PR 13).  Every dequeued slot's header
+        #: and metadata row are checked against the contracts the rest
+        #: of the pipeline ASSUMES (the fsx ranges prover's declared
+        #: metadata-row premises — schema RANGE_* — and the wire id the
+        #: engine fixed at start()).  A violating slot is counted and
+        #: SKIPPED, never dispatched and never a crash: ``_bad_slots``
+        #: counts corrupt headers (wrong wire id — the per-slot magic —
+        #: or a header/meta record-count tear), ``_quarantined`` counts
+        #: poisoned-but-well-formed batches (out-of-range metadata per
+        #: RANGE_*), optionally dumped to ``quarantine_dir`` for the
+        #: post-mortem.  Both feed the engine's health ladder as
+        #: DEGRADED reasons; the records lost land in ingest_stats().
+        self.quarantine_dir = (str(quarantine_dir)
+                               if quarantine_dir is not None else None)
+        self._bad_slots = [0] * n_workers
+        self._quarantined = [0] * n_workers
+        self._quarantined_records = 0
+        self._quarantine_dumps = 0
+        self._wire_id: int | None = None
+        self._meta_ts_hi_max = 0
         self._started = False
         self._stopped = False
 
@@ -245,6 +276,17 @@ class ShardedIngest:
                  if wire == schema.WIRE_COMPACT16 else schema.RECORD_WORDS)
         payload_words = (batch_cfg.max_batch + 1) * words
         self._payload_shape = (batch_cfg.max_batch + 1, words)
+        self._max_batch = batch_cfg.max_batch
+        # per-slot "magic": the worker stamps the wire id it sealed
+        # with; anything else in that header word is a corrupt slot
+        self._wire_id = schema.wire_id_of(wire)
+        # metadata-row timestamp HI-word ceiling — the EXACT premise
+        # the fsx ranges prover seeds (ranges/seeds.py): compact16 meta
+        # carries base_rel_us (µs since t0), raw48 carries t0_ns; both
+        # HI words are bounded by the declared deployment horizon.
+        horizon = schema.RANGE_DEPLOY_HORIZON_S * (
+            10 ** 6 if wire == schema.WIRE_COMPACT16 else 10 ** 9)
+        self._meta_ts_hi_max = horizon >> 32
         ctx = mp.get_context("spawn")  # never fork a jax/XLA process
         from flowsentryx_tpu.ingest.worker import worker_main
 
@@ -431,6 +473,82 @@ class ShardedIngest:
         m.queue.add(max(0.0, time.perf_counter() - t_seal))
         return seq, n, t_seal, fill_s
 
+    def _slot_problem(self, hdr: np.ndarray,
+                      meta: np.ndarray) -> tuple[str, str] | None:
+        """Validate one dequeued slot against the wire contracts
+        (attribute docstring): ``("bad_slot"|"poison", reason)`` for a
+        violating slot, None for a clean one.  "bad_slot" is header
+        corruption — wrong wire id (the per-slot magic) or a
+        header/metadata record-count tear; "poison" is a well-formed
+        slot whose metadata violates the declared RANGE_* contracts
+        the staged step graphs (and the fsx ranges proof) assume."""
+        wire_id = int(hdr[schema.BATCHQ_WIRE_ID_WORD])
+        if wire_id != self._wire_id:
+            return ("bad_slot",
+                    f"slot wire id {wire_id} != expected "
+                    f"{self._wire_id} (bad slot magic)")
+        n = int(hdr[schema.BATCHQ_N_RECORDS_WORD])
+        if n > self._max_batch:
+            return ("poison",
+                    f"n_records {n} > max_batch {self._max_batch} "
+                    "(encoder contract: n_valid <= max_batch)")
+        if int(meta[0]) != n:
+            return ("bad_slot",
+                    f"header n_records {n} != metadata-row n "
+                    f"{int(meta[0])} (torn slot)")
+        if int(meta[2]) > self._meta_ts_hi_max:
+            return ("poison",
+                    f"metadata ts HI word {int(meta[2])} > "
+                    f"{self._meta_ts_hi_max} (RANGE_DEPLOY_HORIZON_S "
+                    "bound — the range proof's declared premise)")
+        return None
+
+    def _discard_slot(self, wid: int, hdr: np.ndarray,
+                      payload: np.ndarray, kind: str,
+                      reason: str) -> None:
+        """Count + (for poison, up to the spool cap) spool one refused
+        slot — skipped, never dispatched, never a crash, never silent
+        (attribute docstring)."""
+        import sys
+
+        seq = (int(hdr[schema.BATCHQ_SEQ_LO_WORD])
+               | (int(hdr[schema.BATCHQ_SEQ_HI_WORD]) << 32))
+        refusals = sum(self._bad_slots) + sum(self._quarantined)
+        if kind == "bad_slot":
+            # a corrupt header's seq is untrustworthy: not noted — the
+            # next good slot's gap is the corruption signal
+            self._bad_slots[wid] += 1
+        else:
+            # well-formed header: burn the seq so later gaps stay a
+            # pure corruption signal, and account the records lost
+            self._seqs.note(wid, seq)
+            self._quarantined[wid] += 1
+            self._quarantined_records += min(
+                int(hdr[schema.BATCHQ_N_RECORDS_WORD]), self._max_batch)
+            if (self.quarantine_dir is not None
+                    and self._quarantine_dumps < QUARANTINE_SPOOL_CAP):
+                import os
+
+                os.makedirs(self.quarantine_dir, exist_ok=True)
+                self._quarantine_dumps += 1
+                dump = (Path(self.quarantine_dir)
+                        / f"quarantine_w{self.shard_offset + wid}"
+                          f"_seq{seq}_{self._quarantine_dumps}.npy")
+                np.save(dump, np.asarray(payload).reshape(
+                    self._payload_shape).copy())
+                reason += f"; payload spooled to {dump}"
+        # cap the refusal prints with the spool (QUARANTINE_SPOOL_CAP
+        # docstring): a sustained poisoned stream must not flood
+        # stderr — the counters stay the authoritative record
+        if refusals < QUARANTINE_SPOOL_CAP:
+            print(f"fsx ingest: worker {wid} slot REFUSED ({kind}, "
+                  f"seq {seq}): {reason}", file=sys.stderr)
+        elif refusals == QUARANTINE_SPOOL_CAP:
+            print(f"fsx ingest: {refusals} slots refused — further "
+                  "refusals counted but not printed/spooled "
+                  "(ingest_stats / EngineReport.health carry the "
+                  "totals)", file=sys.stderr)
+
     def poll_batches(self, max_batches: int) -> list[SealedBatch]:
         """Up to ``max_batches`` sealed batches, round-robin across the
         worker queues (fairness: a hot shard must not starve the rest).
@@ -454,9 +572,15 @@ class ShardedIngest:
             else:
                 empty_streak = 0
                 hdr, payload = got
+                rows = payload.reshape(self._payload_shape)
+                prob = self._slot_problem(hdr, rows[self._max_batch])
+                if prob is not None:
+                    self._discard_slot(wid, hdr, payload, *prob)
+                    wid = (wid + 1) % n_q
+                    continue
                 seq, n, t_seal, fill_s = self._note_batch(wid, hdr)
                 out.append(SealedBatch(
-                    raw=payload.reshape(self._payload_shape),
+                    raw=rows,
                     n_records=n,
                     t_enqueue=t_seal - fill_s,
                     t_seal=t_seal,
@@ -518,6 +642,17 @@ class ShardedIngest:
                 row.reshape(-1)[:] = payload     # THE one host copy
                 stage_s += time.perf_counter() - t0c
                 q.release(1)                     # slot back to the worker
+                prob = self._slot_problem(
+                    hdr, row.reshape(self._payload_shape)[self._max_batch])
+                if prob is not None:
+                    # refused AFTER the arena memcpy (the staged copy is
+                    # what gets validated and spooled — immune to the
+                    # released slot's reuse); the dst row is simply
+                    # re-staged by the next batch, so nothing downstream
+                    # ever sees the refused bytes
+                    self._discard_slot(wid, hdr, row, *prob)
+                    wid = (wid + 1) % n_q
+                    continue
                 seq, n, t_seal, fill_s = self._note_batch(wid, hdr)
                 out.append(SealedBatch(
                     raw=row,
@@ -562,6 +697,8 @@ class ShardedIngest:
                 "seq_gaps": self._seqs.gaps[k],
                 "seq_missing": self._seqs.missing[k],
                 "dropped_emit_batches": self._queues[k].ctl_get("emit_drop"),
+                "bad_wire_slots": self._bad_slots[k],
+                "quarantined_batches": self._quarantined[k],
                 "dead": k in self._dead,
                 "stalled": k in self._stalled,
                 **self._metrics[k].to_dict(),
@@ -575,5 +712,13 @@ class ShardedIngest:
             "dropped_tail_batches": self._dropped_tail,
             "dropped_emit_batches": sum(
                 w["dropped_emit_batches"] for w in workers.values()),
+            # slot-validation plane (PR 13): refused slots are counted
+            # here — the queue accounting a chaos invariant conserves —
+            # and surface as DEGRADED reasons in EngineReport.health
+            "bad_wire_slots": sum(self._bad_slots),
+            "quarantined_batches": sum(self._quarantined),
+            "quarantined_records": self._quarantined_records,
+            "quarantine_dir": self.quarantine_dir,
+            "quarantine_dumps": self._quarantine_dumps,
             "workers": workers,
         }

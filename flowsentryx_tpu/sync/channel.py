@@ -97,13 +97,22 @@ class SinkChannel:
             self.cv.notify_all()
 
     def wait_below(self, down_to: int,
-                   quantum: float = tuning.BACKPRESSURE_WAIT_S) -> None:
+                   quantum: float = tuning.BACKPRESSURE_WAIT_S,
+                   on_wait: Callable[[], None] | None = None) -> None:
         """Block until at most ``down_to`` batches remain pending or
         the worker crashed (the ``readback_depth`` backpressure);
-        :meth:`check` after this surfaces the crash."""
+        :meth:`check` after this surfaces the crash.
+
+        ``on_wait`` runs once per wakeup quantum while still over
+        depth — the engine's dispatch-watchdog hook (a wedged-but-
+        ALIVE worker records no exc, so without it this wait would
+        park forever with no diagnostic).  It may raise; the cv is
+        released on the way out like any exception under ``with``."""
         with self.cv:
             while self._pending > down_to and self._exc is None:
                 self.cv.wait(quantum)
+                if on_wait is not None:
+                    on_wait()
 
     @property
     def pending(self) -> int:

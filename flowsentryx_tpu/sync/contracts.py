@@ -261,6 +261,15 @@ ENGINE_PLAN = ClassPlan(
             "documented",
             "bound by the caller before run() and cleared quiescent "
             "(reset_stream); read-only during serving"),
+        "_watchdog": FieldContract(
+            "documented",
+            "dispatch watchdog (engine/watchdog.py): note_progress() "
+            "runs in the sink section (single owner) storing ONE "
+            "monotonic float — atomic in CPython; check() runs on the "
+            "dispatch thread only (reap paths + the backpressure "
+            "wait's on_wait hook) and a stale stamp read costs at "
+            "worst one quantum of delayed stall detection, never "
+            "corruption"),
         "gossip": FieldContract(
             "documented",
             "cluster verdict plane (cluster/gossip.py): the reference "
@@ -384,6 +393,10 @@ INGEST_PLAN = ClassPlan(
         "_t0": _DISP, "_t0_first_seen": _DISP, "_batches": _DISP,
         "_records": _DISP, "_dropped_tail": _DISP, "_metrics": _DISP,
         "_crash": _DISP,
+        # slot-validation / quarantine plane (PR 13): counted on the
+        # dequeue paths, i.e. the engine's dispatch thread
+        "_bad_slots": _DISP, "_quarantined": _DISP,
+        "_quarantined_records": _DISP, "_quarantine_dumps": _DISP,
     },
 )
 

@@ -94,3 +94,54 @@ SLO_EWMA_ALPHA = 0.2
 #: A give-up is NOT silent: the seq is un-burned and the loss lands in
 #: the queue's ``emit_drop`` counter (``ingest/worker.py::_Emitter``).
 EMIT_STOP_TIMEOUT_S = 2.0
+
+# -- robustness plane (PR 13: fsx chaos + the hardening it forced) ----------
+
+#: Dispatch-watchdog stall bound (``engine/watchdog.py``): batches in
+#: flight with zero completions for this long soft-trips (per-thread
+#: stack dump, DEGRADED reason), for 2x this long hard-trips (the
+#: drain fails loudly instead of hanging forever).  10 s is ~3 orders
+#: of magnitude above the worst healthy gap (a cold ring-round launch
+#: on a throttled host measures tens of ms; this container's cgroup
+#: throttle windows stretch seconds — PR 3/PR 11 measurements), so a
+#: trip means wedged, not slow.  The two-stage form exists precisely
+#: because of those throttle windows: one full bound of grace after
+#: the stack dump lets a starved-but-live pipe recover.
+WATCHDOG_STALL_S = 10.0
+
+#: Supervisor liveness-poll cadence (``ClusterSupervisor.run``):
+#: previously a hard-coded 0.05 in the run signature.  50 ms bounds
+#: corpse-detection latency at one order of magnitude under the stub
+#: serve times tier-1 pins, while keeping the supervisor's idle CPU
+#: (a handful of ctl-block u64 loads per rank per poll) unmeasurable.
+SUPERVISOR_POLL_S = 0.05
+
+#: Supervisor heartbeat staleness bound (``ClusterSupervisor`` —
+#: previously a hard-coded ``heartbeat_timeout_s=5.0`` default).  The
+#: engine heartbeat rides the gossip tick (5 ms cadence,
+#: GOSSIP_MERGE_INTERVAL_S), so 5 s of silence is ~1000 missed beats:
+#: far past any measured GC/throttle pause, short enough that a
+#: wedged-but-alive rank surfaces in ``stalled_ranks`` within one
+#: operator glance.  The boot-over-live-plane refusal uses 2x this.
+SUPERVISOR_HEARTBEAT_TIMEOUT_S = 5.0
+
+#: Crash-loop respawn backoff (``ClusterSupervisor``): the k-th
+#: respawn inside the sliding window waits ``BASE * 2**(k-1)`` capped
+#: at MAX before the rank is re-spawned.  Before PR 13 respawn was
+#: immediate, so a rank dying at boot (bad artifact push, torn
+#: checkpoint) burned its whole restart budget in milliseconds and
+#: parked before an operator could even read the first traceback.
+#: BASE at 100 ms is >= the stub boot and ~the real engine's fork
+#: cost, so a single transient death restarts essentially instantly;
+#: MAX at 5 s keeps a flapping rank from hammering the host while
+#: staying well inside the heartbeat/liveness cadence above.
+RESPAWN_BACKOFF_BASE_S = 0.1
+RESPAWN_BACKOFF_MAX_S = 5.0
+
+#: Crash-loop sliding window (``ClusterSupervisor``): only deaths
+#: within this window count against ``max_restarts`` — a rank that
+#: served cleanly for an hour and then crashed is a fresh incident,
+#: not the tail of last hour's crash loop.  60 s is >> the backoff
+#: ladder's total span (0.1+0.2+...+5 s), so a genuine crash loop
+#: cannot out-wait the window between respawns.
+RESTART_WINDOW_S = 60.0

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Tier-1 chaos gate: the seeded mini-campaign, re-proved every run.
+
+Runs the full quick campaign (every fault class, every planted
+regression — ``quick`` trims traffic volume, not coverage) over the
+REAL stack and rewrites ``artifacts/CHAOS_r17.json`` with per-fault
+invariant verdicts.  Covers the satellite trio explicitly: engine-kill
+(supervised rank SIGKILL + checkpoint respawn), corrupt-checkpoint
+fallback (CRC refusal + loud ``.prev`` restore on a live engine), and
+poisoned-batch quarantine (counted + spooled, drain survives) — plus
+crash-loop parking, gossip stall/flood, clock jumps, and the wedged-
+sink watchdog trip.
+
+A campaign failure — any invariant red, any planted regression NOT
+caught by its named invariant — fails the verify run.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+SEED = 17
+OUT = Path(__file__).resolve().parents[1] / "artifacts" / "CHAOS_r17.json"
+
+
+def main() -> int:
+    from flowsentryx_tpu.chaos import run_campaign
+
+    t0 = time.perf_counter()
+    rep = run_campaign(seed=SEED, quick=True, out=OUT)
+    for r in rep["faults"]:
+        bad = [i for i in r["invariants"] if not i["ok"]]
+        print(f"chaos_smoke: {r['fault']:40s} "
+              f"{'OK' if r['ok'] else 'FAILED'}")
+        for i in bad:
+            print(f"  INVARIANT {i['name']}: {i['detail']}",
+                  file=sys.stderr)
+    for p in rep["planted_regressions"]:
+        print(f"chaos_smoke: plant {p['plant']:32s} "
+              f"{'CAUGHT by ' + p['caught_by'] if p['ok'] else 'MISSED'}")
+    print(f"chaos_smoke: {rep['n_fault_classes']} fault classes, "
+          f"{rep['invariants_checked']} invariants, seed {SEED}, "
+          f"{time.perf_counter() - t0:.1f}s -> {OUT}")
+    if not rep["ok"]:
+        print("chaos_smoke: FAIL", file=sys.stderr)
+        return 1
+    print("chaos_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

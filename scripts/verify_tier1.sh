@@ -147,6 +147,18 @@ echo "== cluster smoke: 2-engine drain + gossip + kill/restart =="
 # scaling evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py || exit 1
 
+echo "== chaos smoke: seeded fault-injection campaign + planted regressions =="
+# The robustness gate (docs/CHAOS.md): the seeded quick campaign over
+# the REAL stack — supervised rank kill/respawn, crash-loop park with
+# backoff, corrupt/truncated checkpoint refusal + loud .prev fallback
+# on a live engine, shm slot corruption (bad magic/seq gap) skipped
+# and counted, poisoned-batch quarantine (counted + spooled), gossip
+# stall/flood drop accounting, clock jumps, the wedged-sink watchdog
+# trip — every invariant green AND all three planted regressions
+# (split-atomicity, CRC skipped, backoff removed) caught by their
+# named invariants.  Rewrites artifacts/CHAOS_r17.json each run.
+env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
+
 echo "== latency smoke: seal->verdict plane + SLO degradation =="
 # Bounded CPU smoke of the per-record latency plane (docs/ENGINE.md
 # §latency): re-proves the seal/launch/sink stamps are monotone

@@ -98,9 +98,13 @@ class TestCheckpoint:
                     CollectSink())
         e1.run()
         path = e1.checkpoint(tmp_path / "old.npz")
-        # strip the tok_bytes column, emulating an r4-era snapshot
+        # strip the tok_bytes column, emulating an r4-era snapshot —
+        # faithfully: that era predates the integrity CRC too (keeping
+        # the CRC while dropping a member would read as the corruption
+        # it technically is)
         with np.load(path) as z:
-            d = {k: z[k] for k in z.files if k != "table_tok_bytes"}
+            d = {k: z[k] for k in z.files
+                 if k not in ("table_tok_bytes", "integrity_crc32")}
         np.savez_compressed(path, **d)
 
         e2 = Engine(cfg, TrafficSource(TrafficSpec(seed=4), total=256),

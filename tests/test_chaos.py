@@ -1,0 +1,267 @@
+"""The robustness plane PR 13 added: checkpoint integrity + ``.prev``
+retention, the dispatch watchdog, the health ladder, the supervisor's
+crash-loop discipline, and the chaos campaign's own plumbing.
+
+The full seeded campaign (every fault class + every planted
+regression over the real stack) is re-proved per verify run by
+``scripts/chaos_smoke.py``; what lives here is the unit layer — each
+hardening mechanism pinned in isolation, fast."""
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.engine import checkpoint as ckpt
+from flowsentryx_tpu.engine import health
+from flowsentryx_tpu.engine.watchdog import DispatchWatchdog, WatchdogStall
+
+
+def _snapshot(tmp_path, name="snap", t0_ns=777, salt=0):
+    table = schema.IpTableState(
+        key=np.arange(1, 257, dtype=np.uint32),
+        state=np.ones((256, schema.NUM_TABLE_COLS), np.float32))
+    stats = schema.GlobalStats(
+        *(np.full((2,), i, np.uint32)
+          for i in range(len(schema.GlobalStats._fields))))
+    return ckpt.save_state(tmp_path / name, table, stats, t0_ns,
+                           hash_salt=salt)
+
+
+class TestCheckpointIntegrity:
+    def test_crc_roundtrip(self, tmp_path):
+        p = _snapshot(tmp_path)
+        ck = ckpt.load_checkpoint(p)
+        assert ck.crc_checked is True
+        assert ckpt.peek_header(p)["has_crc"] is True
+        np.testing.assert_array_equal(
+            ck.table.key, np.arange(1, 257, dtype=np.uint32))
+
+    def test_peek_header_empty_file_named_error(self, tmp_path):
+        """Satellite: a zero-length (torn-at-create) file must raise
+        the NAMED ValueError through pre-boot validation — not a raw
+        struct/IndexError."""
+        p = tmp_path / "empty.npz"
+        p.write_bytes(b"")
+        with pytest.raises(ValueError, match="empty"):
+            ckpt.peek_header(p)
+        with pytest.raises(ckpt.CheckpointCorrupt):
+            ckpt.peek_header(p)
+
+    def test_peek_header_short_file_named_error(self, tmp_path):
+        p = _snapshot(tmp_path)
+        data = p.read_bytes()
+        p.write_bytes(data[: max(8, len(data) // 3)])
+        with pytest.raises(ckpt.CheckpointCorrupt,
+                           match="corrupt or truncated"):
+            ckpt.peek_header(p)
+        missing = tmp_path / "never_written.npz"
+        with pytest.raises(ckpt.CheckpointCorrupt, match="unreadable"):
+            ckpt.peek_header(missing)
+
+    def test_clean_splice_caught_by_crc(self, tmp_path):
+        """Corruption that decompresses CLEANLY — valid zip, wrong
+        bytes — is exactly what only the folded CRC32 can catch."""
+        p = _snapshot(tmp_path)
+        with np.load(p) as z:
+            data = {k: np.array(z[k]) for k in z.files}
+        data["table_key"] = data["table_key"].copy()
+        data["table_key"][7] ^= 1
+        np.savez_compressed(p, **data)
+        with pytest.raises(ckpt.CheckpointCorrupt, match="CRC32"):
+            ckpt.load_checkpoint(p)
+
+    def test_pre_crc_snapshot_grandfathered(self, tmp_path):
+        """Legacy snapshots (no integrity member) still load, flagged
+        unverified — refusing the whole pre-PR-13 era would be a
+        self-inflicted outage."""
+        p = _snapshot(tmp_path)
+        with np.load(p) as z:
+            data = {k: np.array(z[k]) for k in z.files
+                    if k != "integrity_crc32"}
+        np.savez_compressed(p, **data)
+        assert ckpt.load_checkpoint(p).crc_checked is False
+        assert ckpt.peek_header(p)["has_crc"] is False
+
+    def test_prev_generation_retained_on_rotation(self, tmp_path):
+        p1 = _snapshot(tmp_path, t0_ns=111)
+        assert not ckpt.prev_path(p1).exists()
+        _snapshot(tmp_path, t0_ns=222)
+        prev = ckpt.prev_path(p1)
+        assert prev.exists()
+        assert ckpt.load_checkpoint(prev).t0_ns == 111
+        assert ckpt.load_checkpoint(p1).t0_ns == 222
+
+
+class TestDispatchWatchdog:
+    def test_disabled_and_idle_never_trip(self):
+        wd = DispatchWatchdog(0.0)
+        wd.check(busy=5)  # disabled: no-op regardless of stall
+        wd = DispatchWatchdog(10.0)
+        wd._last_progress -= 100.0
+        wd.check(busy=0)  # idle pipe re-arms, never trips
+        assert wd.trips == 0 and not wd.tripped
+
+    def test_soft_then_hard_trip(self, capsys):
+        wd = DispatchWatchdog(0.05)
+        wd._last_progress -= 1.0
+        wd.check(busy=3)  # soft: stacks dumped, counted, no raise
+        assert wd.trips == 1 and not wd.tripped
+        assert "per-thread stacks" in capsys.readouterr().err
+        wd._soft_at -= 1.0
+        with pytest.raises(WatchdogStall, match="refusing to hang"):
+            wd.check(busy=3)
+        assert wd.tripped
+        assert wd.to_dict()["hard_tripped"] is True
+
+    def test_progress_rearms(self):
+        wd = DispatchWatchdog(0.05)
+        wd._last_progress -= 1.0
+        wd.check(busy=1)
+        assert wd.trips == 1
+        wd.note_progress()  # the pipe recovered
+        wd.check(busy=1)    # fresh stall clock: no further trip
+        assert wd.trips == 1 and not wd.tripped
+
+
+class TestHealthLadder:
+    def test_healthy_when_quiet(self):
+        h = health.engine_health(
+            ingest={"n_workers": 2, "dead_workers": [], "workers": {}},
+            gossip={"tx_dropped": 0, "rx_seq_gaps": 0},
+            watchdog={"soft_trips": 0, "hard_tripped": False})
+        assert h == {"state": "healthy", "reasons": []}
+
+    def test_degraded_reasons_are_enumerable(self):
+        h = health.engine_health(
+            ingest={
+                "n_workers": 2, "dead_workers": [1],
+                "dropped_emit_batches": 3, "quarantined_batches": 2,
+                "bad_wire_slots": 1,
+                "workers": {"0": {"seq_gaps": 4, "stalled": True}},
+            },
+            gossip={"tx_dropped": 7, "rx_seq_gaps": 1},
+            restore_fallbacks=1)
+        assert h["state"] == "degraded"
+        assert set(h["reasons"]) == {
+            "ingest_shards_dead:1", "ingest_shards_stalled:1",
+            "ingest_seq_gaps:4", "ingest_emit_drops:3",
+            "quarantined_batches:2", "bad_wire_slots:1",
+            "gossip_tx_dropped:7", "gossip_rx_seq_gaps:1",
+            "restore_fallbacks:1"}
+
+    def test_failed_rungs(self):
+        all_dead = health.engine_health(
+            ingest={"n_workers": 2, "dead_workers": [0, 1],
+                    "workers": {}})
+        assert all_dead["state"] == "failed"
+        wd = health.engine_health(
+            watchdog={"soft_trips": 2, "hard_tripped": True})
+        assert wd["state"] == "failed"
+
+    def test_cluster_worst_of_and_supervisor_overlay(self):
+        agg = health.cluster_health(
+            {0: {"state": "healthy", "reasons": []},
+             1: {"state": "degraded", "reasons": ["bad_wire_slots:1"]}},
+            failed_ranks=[], stalled_ranks=[])
+        assert agg["state"] == "degraded"
+        assert agg["reasons"] == ["r1:bad_wire_slots:1"]
+        # a parked rank is FAILED even if its last report said healthy
+        agg = health.cluster_health(
+            {0: {"state": "healthy", "reasons": []}},
+            failed_ranks=[1], stalled_ranks=[])
+        assert agg["state"] == "failed"
+        assert "ranks_failed:1" in agg["reasons"]
+
+
+class TestSupervisorCrashLoop:
+    def test_instant_crasher_backs_off_then_parks(self, tmp_path):
+        """The crash-loop discipline end-to-end against real child
+        processes: exponential spacing between deaths, then the park
+        with its span announced (the campaign re-proves this plus the
+        backoff-removed plant every verify run)."""
+        import contextlib
+
+        from flowsentryx_tpu.cluster.runner import stub_engine_main
+        from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+        sup = ClusterSupervisor(
+            tmp_path / "cl",
+            [{"stub_serve_s": 3.0},
+             {"stub_serve_s": 30.0, "stub_crash_after_s": 0.0,
+              "stub_crash_every_gen": True, "workers": 2}],
+            entry=stub_engine_main,
+            max_restarts=2, restart_backoff_s=0.05,
+            restart_window_s=60.0)
+        sup.boot()
+        stderr = io.StringIO()
+        deadline = time.monotonic() + 20.0
+        try:
+            with contextlib.redirect_stderr(stderr):
+                while 1 not in sup._failed \
+                        and time.monotonic() < deadline:
+                    sup.poll()
+                    time.sleep(0.01)
+        finally:
+            sup.close()
+        assert 1 in sup._failed
+        assert sup.restarts[1] == 2  # the budget, not budget+ spins
+        deaths = sup._death_times[1]
+        gaps = [b - a for a, b in zip(deaths, deaths[1:])]
+        # deaths spaced by at least the (slack-adjusted) backoff ladder
+        assert len(gaps) == 2
+        assert gaps[0] >= 0.7 * 0.05 and gaps[1] >= 0.7 * 0.10
+        msg = stderr.getvalue()
+        assert "PARKED as failed" in msg
+        assert "ring shards [2, 4)" in msg  # the span, announced
+        assert 0 not in sup._failed  # fail-open: the survivor serves
+
+
+class TestNullPathParity:
+    def test_watchdog_health_defaults_byte_identical(self):
+        """Acceptance pin: with no faults injected, the watchdog +
+        health plane AT DEFAULTS changes nothing — stats, blocked
+        set, and table bytes identical to a watchdog-disabled run,
+        under ``transfer_guard("disallow")``."""
+        import jax
+
+        from flowsentryx_tpu.core.config import (
+            BatchConfig, FsxConfig, LimiterConfig, TableConfig,
+        )
+        from flowsentryx_tpu.engine import ArraySource, CollectSink, Engine
+        from flowsentryx_tpu.engine.traffic import (
+            Scenario, TrafficGen, TrafficSpec,
+        )
+
+        recs = TrafficGen(TrafficSpec(
+            scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+            n_attack_ips=32, attack_fraction=0.8, seed=13,
+        )).next_records(256 * 6)
+        cfg = FsxConfig(
+            table=TableConfig(capacity=1 << 12),
+            batch=BatchConfig(max_batch=256),
+            limiter=LimiterConfig(pps_threshold=200.0,
+                                  bps_threshold=1e9))
+
+        def run(watchdog_s):
+            sink = CollectSink()
+            eng = Engine(cfg, ArraySource(recs.copy()), sink,
+                         readback_depth=4, sink_thread=False,
+                         watchdog_s=watchdog_s)
+            with jax.transfer_guard("disallow"):
+                rep = eng.run()
+            return rep, sink, eng
+
+        rep_def, sink_def, eng_def = run(None)   # PR-13 defaults
+        rep_off, sink_off, eng_off = run(0.0)    # plane disabled
+        assert rep_def.stats == rep_off.stats
+        assert rep_def.records == rep_off.records
+        assert sink_def.blocked == sink_off.blocked
+        for a, b in zip(jax.tree_util.tree_leaves(eng_def.table),
+                        jax.tree_util.tree_leaves(eng_off.table)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the plane observed a fault-free run as exactly that
+        assert rep_def.health["state"] == "healthy"
+        assert rep_def.health["reasons"] == []

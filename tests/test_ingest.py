@@ -484,3 +484,155 @@ class TestWorkerFleet:
         got1 = sum(sb.n_records for sb in batches if sb.worker == 1)
         assert got1 == len(parts[1])
         assert stats["workers"]["1"]["seq_gaps"] == 0
+
+
+class TestSlotValidation:
+    """PR 13 slot-validation plane: corrupt/poisoned sealed slots are
+    counted and SKIPPED — the drain survives, the loss lands in queue
+    accounting, and both dequeue protocols agree (docs/CHAOS.md)."""
+
+    def _fleet_with_sealed(self, tmp_path, n_batches=4, max_batch=256):
+        base = str(tmp_path / "fring")
+        ring = _make_shard_rings(base, 1)[0]
+        rec = make_records(max_batch * n_batches, n_ips=64)
+        assert ring.produce(rec) == len(rec)
+        ing = _start_fleet(base, 1, max_batch=max_batch)
+        deadline = time.monotonic() + 20
+        while ing.t0_ns is None:
+            ing.poll_batches(0)
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        q = ing._queues[0]
+        while q.readable() < n_batches:
+            assert time.monotonic() < deadline, "fleet never sealed"
+            time.sleep(0.005)
+        return ing, q, rec
+
+    def _hdr_cell(self, q, slot_back=0):
+        t = int(q._tail[0])
+        return q._cells[(t + slot_back) & (q.slots - 1)]
+
+    def test_bad_magic_slot_skipped_counted_not_fatal(self, tmp_path):
+        """A sealed slot whose wire-id word (the per-slot magic) is
+        garbage is skipped and counted; the drain worker is untouched
+        and every OTHER record still serves."""
+        ing, q, rec = self._fleet_with_sealed(tmp_path)
+        try:
+            cell = self._hdr_cell(q, 0)
+            n_bad = int(cell[schema.BATCHQ_N_RECORDS_WORD])
+            cell[schema.BATCHQ_WIRE_ID_WORD] = 0xDEAD
+            ing.request_stop()
+            batches = _drain(ing)
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        assert stats["bad_wire_slots"] == 1
+        assert stats["workers"]["0"]["bad_wire_slots"] == 1
+        assert not stats["workers"]["0"]["dead"]
+        # the loss is exactly the refused slot, visible in accounting
+        served = sum(sb.n_records for sb in batches)
+        assert served + n_bad == len(rec)
+        # a corrupt header's seq is not trusted: the NEXT good slot
+        # shows the hole
+        assert stats["workers"]["0"]["seq_gaps"] >= 1
+
+    def test_poisoned_meta_quarantined_and_spooled(self, tmp_path):
+        """A well-formed slot whose metadata violates the declared
+        RANGE_* contracts (n_records > max_batch) is quarantined:
+        counted, spooled to the quarantine dir, never dispatched,
+        never a crash."""
+        base = str(tmp_path / "fring")
+        ring = _make_shard_rings(base, 1)[0]
+        rec = make_records(256 * 3, n_ips=64)
+        assert ring.produce(rec) == len(rec)
+        spool = tmp_path / "spool"
+        ing = ShardedIngest(str(base), 1, queue_slots=16,
+                            precompact=False, t0_grace_s=0.2,
+                            quarantine_dir=str(spool))
+        ing.start(BatchConfig(max_batch=256, deadline_us=10_000),
+                  schema.WIRE_RAW48, None)
+        ing.wait_ready()
+        try:
+            deadline = time.monotonic() + 20
+            while ing.t0_ns is None:
+                ing.poll_batches(0)
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            q = ing._queues[0]
+            while q.readable() < 3:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            t = int(q._tail[0])
+            cell = q._cells[(t + 1) & (q.slots - 1)]
+            bad_n = 256 + 9
+            cell[schema.BATCHQ_N_RECORDS_WORD] = bad_n
+            meta_off = (schema.BATCHQ_SLOT_HDR_WORDS
+                        + 256 * schema.RECORD_WORDS)
+            cell[meta_off] = bad_n  # coherent tear-free poison
+            ing.request_stop()
+            batches = _drain(ing)
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        assert stats["quarantined_batches"] == 1
+        assert stats["quarantined_records"] == 256  # capped at max_batch
+        assert stats["bad_wire_slots"] == 0
+        dumps = list(spool.glob("quarantine_*.npy"))
+        assert len(dumps) == 1
+        # spooled payload is the refused slot's bytes, post-mortem-able
+        assert np.load(dumps[0]).shape == (257, schema.RECORD_WORDS)
+        served = sum(sb.n_records for sb in batches)
+        assert served + 256 == len(rec)
+        # seq was BURNED for the well-formed poisoned slot: no gap
+        assert stats["workers"]["0"]["seq_gaps"] == 0
+
+    def test_seq_gap_slot_counted_and_served(self, tmp_path):
+        """Seq-word corruption surfaces in the gap/missing counters —
+        the batch itself still serves (payload is intact; ordering
+        damage is what the counters exist for)."""
+        ing, q, rec = self._fleet_with_sealed(tmp_path)
+        try:
+            cell = self._hdr_cell(q, 2)
+            seq = (int(cell[schema.BATCHQ_SEQ_LO_WORD])
+                   | (int(cell[schema.BATCHQ_SEQ_HI_WORD]) << 32)) + 5
+            cell[schema.BATCHQ_SEQ_LO_WORD] = seq & 0xFFFFFFFF
+            cell[schema.BATCHQ_SEQ_HI_WORD] = (seq >> 32) & 0xFFFFFFFF
+            ing.request_stop()
+            batches = _drain(ing)
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        # forward jump + the following slot's backward step: >= 1 gap,
+        # 5 phantom "missing" batches — corruption visible, nothing
+        # silently reordered away
+        assert stats["workers"]["0"]["seq_gaps"] >= 1
+        assert stats["workers"]["0"]["seq_missing"] >= 5
+        assert sum(sb.n_records for sb in batches) == len(rec)
+
+    def test_staging_path_skips_bad_slot_identically(self, tmp_path):
+        """poll_batches_into (the engine's zero-copy staging dequeue)
+        applies the same validation: the refused slot's bytes never
+        reach a returned row and the dst row is re-staged by the next
+        good batch."""
+        ing, q, rec = self._fleet_with_sealed(tmp_path)
+        try:
+            cell = self._hdr_cell(q, 0)
+            n_bad = int(cell[schema.BATCHQ_N_RECORDS_WORD])
+            cell[schema.BATCHQ_WIRE_ID_WORD] = 0xBEEF
+            ing.request_stop()
+            dst = np.zeros((4, 257, schema.RECORD_WORDS), np.uint32)
+            total = 0
+            deadline = time.monotonic() + 30
+            while not ing.exhausted():
+                for sb in ing.poll_batches_into(dst, 4):
+                    assert int(sb.raw[256, 0]) == sb.n_records
+                    total += sb.n_records
+                assert time.monotonic() < deadline
+                time.sleep(0.002)
+            total += sum(sb.n_records
+                         for sb in ing.poll_batches_into(dst, 4))
+        finally:
+            ing.close()
+        stats = ing.ingest_stats()
+        assert stats["bad_wire_slots"] == 1
+        assert total + n_bad == len(rec)

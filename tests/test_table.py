@@ -367,7 +367,8 @@ class TestCheckpointV2:
         path = eng.checkpoint(tmp_path / "s.npz")
         hdr = ckpt.peek_header(path)
         assert hdr == {"schema_version": 1, "hash_salt": 0x77,
-                       "n_shards": 1, "capacity": CAP}
+                       "n_shards": 1, "capacity": CAP,
+                       "has_crc": True}
         good = open(path, "rb").read()
 
         # a crash mid-snapshot must leave the previous snapshot intact
@@ -446,8 +447,12 @@ class TestCheckpointV2:
         cfg = evict_cfg()
         eng = self._run_engine(cfg, churn_records(phases=2))
         path = eng.checkpoint(tmp_path / "old.npz")
+        # a faithful pre-eviction-era snapshot predates the integrity
+        # CRC as well; a CRC left behind over edited members would
+        # (correctly) refuse as corruption
         with np.load(path) as z:
-            d = {k: z[k] for k in z.files if k != "stats_evicted"}
+            d = {k: z[k] for k in z.files
+                 if k not in ("stats_evicted", "integrity_crc32")}
         np.savez_compressed(path, **d)
         ck = ckpt.load_checkpoint(path)
         assert ck.missing_stats == ("evicted",)
@@ -661,4 +666,8 @@ class TestServeCLI:
         rc, cap = self._run(
             ["serve", "--scenario", "benign", "--packets", "64",
              "--restore", str(bad)], capsys)
-        assert rc == 1 and "cannot read checkpoint" in cap.err
+        # corrupt + no retained .prev generation: refuse pre-boot with
+        # the named diagnostic (a .prev WOULD be adopted instead —
+        # docs/CHAOS.md §checkpoint integrity)
+        assert rc == 1 and "corrupt" in cap.err
+        assert "refusing to boot from garbage" in cap.err
