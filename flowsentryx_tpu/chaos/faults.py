@@ -72,6 +72,40 @@ FAULTS: dict[str, tuple[str, str]] = {
         "wedge the verdict sink forever with batches in flight; the "
         "dispatch watchdog must dump stacks and fail the drain loudly "
         "within 2x its stall bound"),
+    # -- network faults (ISSUE 15: the multi-host gossip leg) ---------------
+    "net_partition": (
+        "network-partition",
+        "drop every datagram between two gossip hosts mid-publish; "
+        "the publisher must never block (fail-open), everything "
+        "delivered BEFORE the cut must stay converged, and nothing "
+        "may cascade"),
+    "net_heal": (
+        "network-partition",
+        "heal a partition after verdicts were published into it; the "
+        "anti-entropy resync must re-converge the canonical blacklist "
+        "digests within a bounded number of gossip ticks"),
+    "net_reorder": (
+        "network-reorder",
+        "deliver a peer's wire datagrams out of order; the bounded "
+        "reorder buffer must restore per-peer sequence order without "
+        "ever exceeding its window (evict-and-count past it, never "
+        "stall, never grow)"),
+    "net_duplicate": (
+        "network-duplication",
+        "deliver every wire datagram twice; duplicate suppression "
+        "must count (rx_dup) and drop the copies — a verdict is never "
+        "applied twice"),
+    "net_loss_burst": (
+        "network-loss",
+        "silently drop a contiguous burst of wire datagrams; the "
+        "sequence holes must be conceded and counted (rx_gap), the "
+        "survivors delivered, and the resync must close the hole"),
+    "net_stale_epoch": (
+        "network-epoch",
+        "a peer publishing wires under a lying epoch stamp (pre-"
+        "reboot t0_wall); the rebased skew bound (RANGE_EPOCH_SKEW_S) "
+        "must refuse-and-count them — a broken clock must never "
+        "blacklist anyone at the wrong time"),
 }
 
 
@@ -211,6 +245,124 @@ def jumped_stamps(rng: np.random.Generator, n: int,
     k = int(rng.integers(1, n))
     stamps[k:] -= jump_s
     return [float(s) for s in stamps]
+
+
+# -- network faults (cluster/transport.py NetMailbox) ------------------------
+
+class NetChaos:
+    """Deterministic network-fault injector for one
+    :class:`~flowsentryx_tpu.cluster.transport.NetMailbox`.
+
+    Wraps exactly the mailbox's raw ``_sendto`` seam — the single
+    point every datagram leaves through — so the code under test runs
+    its REAL tx path and the fault happens where a real network would
+    inflict it: after a successful send.  A dropped packet therefore
+    returns True to the sender (in-flight loss is invisible to a UDP
+    publisher), unlike the mailbox's own ``tx_sock_drops``, which
+    counts local send failures.
+
+    Modes (mutually exclusive, installed by the scenario):
+
+    * :meth:`partition` — drop everything until :meth:`heal`.
+    * :meth:`duplicate` — deliver every packet twice.
+    * :meth:`reorder` — buffer ``depth`` packets, flush them reversed.
+    * :meth:`drop_burst` — silently drop sends ``[start, start+n)``
+      (0-indexed over this injector's send stream).
+    """
+
+    def __init__(self, mbx):
+        self.mbx = mbx
+        self._real = mbx._sendto
+        mbx._sendto = self._send
+        self.mode = None
+        self._depth = 0
+        self._held: list[tuple[bytes, tuple]] = []
+        self._burst: tuple[int, int] | None = None
+        self.sent = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.reordered = 0
+
+    # -- mode selection ------------------------------------------------------
+
+    def partition(self) -> None:
+        self.mode = "drop"
+
+    def heal(self) -> None:
+        self.mode = None
+        self._flush()
+
+    def duplicate(self) -> None:
+        self.mode = "dup"
+
+    def reorder(self, depth: int = 4) -> None:
+        self.mode = "reorder"
+        self._depth = depth
+
+    def drop_burst(self, start: int, n: int) -> None:
+        self.mode = "burst"
+        self._burst = (start, start + n)
+
+    def uninstall(self) -> None:
+        self._flush()
+        self.mbx._sendto = self._real
+
+    # -- the injected seam ---------------------------------------------------
+
+    def _flush(self) -> None:
+        held, self._held = self._held, []
+        for payload, addr in held:
+            self._real(payload, addr)
+
+    def _send(self, payload: bytes, addr: tuple) -> bool:
+        i = self.sent
+        self.sent += 1
+        if self.mode == "drop":
+            self.dropped += 1
+            return True  # the network ate it AFTER a successful send
+        if self.mode == "burst" and self._burst[0] <= i < self._burst[1]:
+            self.dropped += 1
+            return True
+        if self.mode == "dup":
+            self.duplicated += 1
+            self._real(payload, addr)
+            return self._real(payload, addr)
+        if self.mode == "reorder":
+            self._held.append((payload, addr))
+            if len(self._held) >= self._depth:
+                self.reordered += len(self._held)
+                held, self._held = self._held, []
+                for p, a in reversed(held):
+                    self._real(p, a)
+            return True
+        return self._real(payload, addr)
+
+
+def stale_epoch_packets(host: int, rank: int, t0_wall_ns: int,
+                        skew_s: float, keys, untils,
+                        k_max: int = 8,
+                        start_seq: int = 1) -> list[bytes]:
+    """Craft wire datagrams from a peer whose epoch stamp LIES by
+    ``skew_s`` seconds — the pre-reboot-t0_wall / clockless-host fault
+    the RANGE_EPOCH_SKEW_S bound exists for.  The wire body is
+    well-formed; only the epoch is wrong."""
+    from flowsentryx_tpu.cluster import transport
+
+    bogus_wall = t0_wall_ns - int(skew_s * 1e9)
+    pkts = []
+    keys = np.asarray(keys, np.uint32)
+    untils = np.asarray(untils, np.float32)
+    for j, lo in enumerate(range(0, len(keys), k_max)):
+        ck, cu = keys[lo:lo + k_max], untils[lo:lo + k_max]
+        wire = np.zeros(2 * k_max + 4, np.uint32)
+        wire[:len(ck)] = ck
+        wire[k_max:k_max + len(cu)] = cu.view(np.uint32)
+        wire[2 * k_max] = len(ck)
+        wire[2 * k_max + 3] = np.float32(0.0).view(np.uint32)
+        pkts.append(transport.pack_packet(
+            schema.NET_KIND_WIRE, host, rank, start_seq + j, len(ck),
+            bogus_wall, wire))
+    return pkts
 
 
 def kill_process_group(pid: int) -> None:

@@ -44,6 +44,25 @@ Catalog (one line each; the scenario docstrings carry the detail):
   with the exact reasons the injected faults imply.
 * ``sink_crash_atomicity`` — no backpressure waiter can observe
   (pending drained, crash unset) for a crashed group.
+* ``net_partition_fail_open`` — a partitioned publisher keeps
+  serving: publish and pump stay non-blocking, nothing cascades, and
+  pre-cut state stays converged.
+* ``net_heal_converges`` — after a partition heals, the canonical
+  blacklist digests re-converge within a bounded number of gossip
+  ticks (the anti-entropy resync's contract).
+* ``net_reorder_bounded`` — reordered datagrams deliver in per-peer
+  sequence order through a buffer that NEVER exceeds its window
+  (evict-and-count past it, never stall, never grow).
+* ``no_double_apply`` — duplicated datagrams are suppressed and
+  counted; a verdict is applied to the sink exactly once.
+* ``net_loss_accounted`` — a loss burst's sequence holes are conceded
+  and counted (rx_gap); survivors deliver; delivered + lost accounts
+  every sent wire.
+* ``stale_epoch_refused`` — wires under a lying epoch stamp are
+  refused-and-counted by the RANGE_EPOCH_SKEW_S bound, never applied.
+* ``epoch_rebase_exact`` — a rebased verdict's ABSOLUTE expiry equals
+  the originator's (within f32 quantization): the tx-epoch ->
+  rx-epoch rebase loses no time.
 """
 
 from __future__ import annotations

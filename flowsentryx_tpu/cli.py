@@ -1460,6 +1460,30 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_gossip_addr(text: str, engines: int):
+    """``IP:PORT`` → (``[ip, port]``, None) or (None, error string) —
+    the one parser for --hosts entries AND --gossip-listen, so the
+    derived-engine-port bound (the federation beacon binds PORT,
+    engine r binds PORT+1+r) is enforced identically everywhere."""
+    ip, _, port_s = text.strip().rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        port = -1
+    if not ip or not 0 < port < 65536:
+        return None, ("is not IP:PORT (the gossip base port; the "
+                      "supervisor beacon binds it, engine r binds "
+                      "PORT+1+r)")
+    if port + engines > 65535:
+        # the derived engine ports must fit too, or the refusal would
+        # surface as a bind crash-loop in a spawned child instead of
+        # a named pre-boot message
+        return None, (f"base port {port} + {engines} engine port(s) "
+                      "exceeds 65535 (engine r binds PORT+1+r) — "
+                      "pick a lower base port")
+    return [ip, port], None
+
+
 def _cmd_cluster(args: argparse.Namespace) -> int:
     """Coordinator-less multi-engine scale-out (docs/CLUSTER.md).
 
@@ -1475,10 +1499,15 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
     """
     # Pre-boot refusals, all jax-free, each naming its actual problem
     # (the fsx serve fail-fast ordering).
-    if args.engines < 2:
+    if args.engines < 2 and not args.hosts:
         print(f"fsx cluster: --engines must be >= 2 (got "
-              f"{args.engines}): a 1-engine cluster is fsx serve",
-              file=sys.stderr)
+              f"{args.engines}): a 1-engine cluster is fsx serve "
+              "(unless --hosts makes it one rank of a multi-host "
+              "fleet)", file=sys.stderr)
+        return 1
+    if args.engines < 1:
+        print(f"fsx cluster: --engines must be >= 1 (got "
+              f"{args.engines})", file=sys.stderr)
         return 1
     if args.shards < args.engines:
         print(f"fsx cluster: --shards {args.shards} cannot feed "
@@ -1549,6 +1578,55 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
               f"front the daemon's ring shards (pair with fsxd "
               f"--shards {args.shards})", file=sys.stderr)
         return 1
+    # Multi-host leg (docs/CLUSTER.md §multi-host): --hosts names every
+    # host's gossip base address, --host-id says which one WE are, and
+    # the port arithmetic (supervisor beacon at base, engine r at
+    # base+1+r) assumes a uniform --engines per host — all refused
+    # jax-free with the actual problem named.
+    netspec = None
+    if args.hosts or args.host_id is not None or args.gossip_listen:
+        if not args.hosts:
+            print("fsx cluster: --host-id/--gossip-listen require "
+                  "--hosts IP:PORT,IP:PORT,... (the fleet's host "
+                  "table — every host runs the same list)",
+                  file=sys.stderr)
+            return 1
+        if args.host_id is None:
+            print("fsx cluster: --hosts requires --host-id I (this "
+                  "host's index into the --hosts list; the port "
+                  "layout and the federation identity both derive "
+                  "from it)", file=sys.stderr)
+            return 1
+        hosts = []
+        for ent in args.hosts.split(","):
+            addr, err = _parse_gossip_addr(ent, args.engines)
+            if err:
+                print(f"fsx cluster: --hosts entry {ent.strip()!r} "
+                      f"{err}", file=sys.stderr)
+                return 1
+            hosts.append(addr)
+        if len(hosts) < 2:
+            print(f"fsx cluster: --hosts names {len(hosts)} host(s): "
+                  "a 1-host fleet is fsx cluster without --hosts (the "
+                  "shm gossip plane already covers it)",
+                  file=sys.stderr)
+            return 1
+        if not 0 <= args.host_id < len(hosts):
+            print(f"fsx cluster: --host-id {args.host_id} not in "
+                  f"[0, {len(hosts)}) (the --hosts list has "
+                  f"{len(hosts)} entries)", file=sys.stderr)
+            return 1
+        listen = None
+        if args.gossip_listen:
+            listen, err = _parse_gossip_addr(args.gossip_listen,
+                                             args.engines)
+            if err:
+                print(f"fsx cluster: --gossip-listen "
+                      f"{args.gossip_listen!r} {err}",
+                      file=sys.stderr)
+                return 1
+        netspec = {"hosts": hosts, "host_id": args.host_id,
+                   "engines_per_host": args.engines, "listen": listen}
 
     import dataclasses as _dc
 
@@ -1622,7 +1700,8 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
             "checkpoint_every": args.checkpoint_every,
         })
     sup = ClusterSupervisor(cluster_dir, specs,
-                            max_restarts=args.max_restarts)
+                            max_restarts=args.max_restarts,
+                            net=netspec)
     try:
         sup.boot()
     except RuntimeError as e:
@@ -1630,9 +1709,14 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
         # would truncate mmaps under its serving engines)
         print(f"fsx cluster: {e}", file=sys.stderr)
         return 1
+    net_note = ""
+    if netspec:
+        net_note = (f", host {netspec['host_id']} of "
+                    f"{len(netspec['hosts'])} (UDP gossip + "
+                    "federation beacons)")
     print(f"fsx cluster: {args.engines} engines x {w} worker(s), "
-          f"shards 0..{args.shards - 1}, gossip plane {cluster_dir}",
-          file=sys.stderr)
+          f"shards 0..{args.shards - 1}, gossip plane {cluster_dir}"
+          f"{net_note}", file=sys.stderr)
     try:
         agg = sup.run(max_seconds=args.seconds or None)
     except KeyboardInterrupt:
@@ -1751,6 +1835,19 @@ def _merged_engine_health(globs: list, reports: list | None = None) -> dict:
                 "rx_seq_gaps": g.get("rx_seq_gaps"),
                 "merged_digest": g.get("merged_digest"),
             }
+            net = g.get("net")
+            if net:
+                # the multi-host transport's counters (cluster/
+                # transport.py) — the net_* DEGRADED reasons' raw
+                # numbers, so "why is this rank degraded" is the same
+                # one query
+                entry["gossip"]["net"] = {
+                    k: net.get(k)
+                    for k in ("tx_wires", "tx_drop", "rx_wires",
+                              "rx_gap", "rx_dup", "reorder_evict",
+                              "epoch_skew_dropped", "epoch_skew_max",
+                              "net_digest")
+                }
         per_report[path] = entry
         if h.get("state"):
             states.append(h["state"])
@@ -2718,6 +2815,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-engine latency budget (fsx serve "
                          "--slo-us); the aggregate report merges every "
                          "rank's latency histogram")
+    cl.add_argument("--hosts", default=None, metavar="IP:PORT,...",
+                    help="multi-host fleet: every host's gossip base "
+                         "address, same list on every host (the "
+                         "supervisor's federation beacon binds the "
+                         "base port, engine r binds PORT+1+r; verdict "
+                         "wires gossip over UDP with epoch rebase — "
+                         "docs/CLUSTER.md §multi-host)")
+    cl.add_argument("--host-id", type=int, default=None, metavar="I",
+                    help="this host's index into --hosts (required "
+                         "with --hosts)")
+    cl.add_argument("--gossip-listen", default=None, metavar="IP:PORT",
+                    help="local bind override for this host's --hosts "
+                         "entry (e.g. 0.0.0.0:9000 behind NAT); "
+                         "default: bind the --hosts[--host-id] "
+                         "address itself")
     cl.add_argument("--pin-cores", choices=("auto", "on", "off"),
                     default="auto",
                     help="pin rank r to core r with a matching "

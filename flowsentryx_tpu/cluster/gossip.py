@@ -23,6 +23,15 @@ Merged verdicts are applied last-wins by key, the kernel map's
 overwrite-on-update semantics — and because the supervisor imposes one
 shared t0 epoch on every engine, the ``until`` an engine publishes is
 byte-identical to the ``until`` every peer enforces (test-pinned).
+
+Multi-host fleets (``fsx cluster --hosts``) attach a
+:class:`~flowsentryx_tpu.cluster.transport.NetMailbox` as the plane's
+``net`` leg: publish hands each wire to its sink-section handoff
+queue, tick pumps and merges it on the dispatch thread, and received
+wires arrive already rebased into this host's epoch (transport.py owns
+the unreliable-network discipline: dup suppression, bounded reorder,
+gap accounting, skew bounds).  ``net=None`` — every single-host fleet
+— is byte-identical to the pre-net plane, test-pinned.
 """
 
 from __future__ import annotations
@@ -47,14 +56,19 @@ from flowsentryx_tpu.sync import tuning
 
 
 def create_plane(cluster_dir, n_engines: int, k_max: int = 64,
-                 slots: int = 256) -> None:
+                 slots: int = 256, net: bool = False) -> None:
     """Create every pair mailbox and status block (the SUPERVISOR —
     or a test harness standing in for it — calls this exactly once,
     before any engine opens the plane; engines never create shared
-    files, so two engines can never race a truncate)."""
-    if n_engines < 2:
+    files, so two engines can never race a truncate).  ``net`` marks a
+    multi-host fleet (``fsx cluster --hosts``), where a single-engine
+    LOCAL plane is legitimate — its peers live across the network leg
+    (cluster/transport.py), not in shm mailboxes."""
+    if n_engines < 2 and not net:
         raise ValueError(
             f"a gossip plane needs >= 2 engines, got {n_engines}")
+    if n_engines < 1:
+        raise ValueError(f"n_engines must be >= 1, got {n_engines}")
     Path(cluster_dir).mkdir(parents=True, exist_ok=True)
     for src in range(n_engines):
         StatusBlock.create(status_path(cluster_dir, src), src)
@@ -67,7 +81,8 @@ def create_plane(cluster_dir, n_engines: int, k_max: int = 64,
     # engine attaching a 3-engine plane as rank 0/2 would otherwise
     # serve happily while silently excluding rank 2 from gossip
     (Path(cluster_dir) / "plane.json").write_text(json.dumps(
-        {"n_engines": n_engines, "k_max": k_max, "slots": slots}))
+        {"n_engines": n_engines, "k_max": k_max, "slots": slots,
+         "net": bool(net)}))
 
 
 class GossipPlane:
@@ -75,7 +90,8 @@ class GossipPlane:
 
     def __init__(self, cluster_dir, rank: int, n_engines: int,
                  sink=None,
-                 merge_interval_s: float = tuning.GOSSIP_MERGE_INTERVAL_S):
+                 merge_interval_s: float = tuning.GOSSIP_MERGE_INTERVAL_S,
+                 net=None):
         if not 0 <= rank < n_engines:
             raise ValueError(f"rank {rank} not in [0, {n_engines})")
         meta_path = Path(cluster_dir) / "plane.json"
@@ -95,6 +111,13 @@ class GossipPlane:
         #: track-only (the merged map still converges for the report).
         self.sink = sink
         self.merge_interval_s = merge_interval_s
+        #: Multi-host leg (cluster/transport.py NetMailbox), None on a
+        #: single-host fleet — and the None path is BYTE-identical to
+        #: the pre-net plane (test-pinned): publish queues wires to it
+        #: from the sink section, tick pumps/merges it on the dispatch
+        #: thread, mirroring the shm sections exactly (NETMAILBOX_PLAN
+        #: in sync/contracts.py carries the per-field disciplines).
+        self.net = net
         self.status = StatusBlock(status_path(cluster_dir, rank))
         self._tx = {
             peer: VerdictMailbox(mailbox_path(cluster_dir, rank, peer))
@@ -104,7 +127,15 @@ class GossipPlane:
             peer: VerdictMailbox(mailbox_path(cluster_dir, peer, rank))
             for peer in range(n_engines) if peer != rank
         }
-        self.k_max = next(iter(self._tx.values())).k_max
+        if self._tx:
+            self.k_max = next(iter(self._tx.values())).k_max
+        elif net is not None:
+            self.k_max = net.k_max
+        else:
+            raise ValueError(
+                "a single-engine local plane only makes sense with a "
+                "network leg (fsx cluster --hosts): there is no shm "
+                "peer to gossip with and no NetMailbox was given")
         # -- publish-side state (engine sink section) -------------------
         self._pub_seq = 0
         self._published: dict[int, int] = {}   # key -> until f32 bits
@@ -147,6 +178,13 @@ class GossipPlane:
                     self._tx_wires += 1
                 else:
                     self._tx_dropped += 1
+            if self.net is not None:
+                # hand the same wire to the network leg's merge-side
+                # pump (NetMailbox.queue_tx is this section's only
+                # transport method; a full handoff queue drops-and-
+                # counts — the publisher never blocks on a slow or
+                # partitioned network)
+                self.net.queue_tx(wire, len(ck))
 
     # -- merge side (dispatch thread) ---------------------------------------
 
@@ -185,19 +223,49 @@ class GossipPlane:
                     merged_k.append(vw.key)
                     merged_u.append(vw.until_s)
                     self._rx_wires += 1
-        if not merged_k:
+        # network leg: pump the datagram transport (tx drain, resync,
+        # rx ingest) and merge its delivered wires.  NetMailbox already
+        # rebased each wire tx-epoch -> rx-epoch, so the untils below
+        # are in THIS host's clock; they go to the sink (the kernel
+        # tier must enforce remote verdicts) but NOT into ``_merged``,
+        # whose digest stays the intra-host shm-convergence pin —
+        # cross-host convergence is pinned on the canonical rebased
+        # form (``net_digest``) instead.
+        net_k: list[np.ndarray] = []
+        net_u: list[np.ndarray] = []
+        if self.net is not None:
+            self.net.pump()
+            # drain deeper than the per-pump rx budget so a sustained
+            # inflow converges instead of backing up into the (bounded,
+            # drop-counted) rx staging queue
+            for _src, _seq, _wire, keys, untils in self.net.pop_wires(256):
+                if len(keys):
+                    net_k.append(keys)
+                    net_u.append(untils)
+        if not merged_k and not net_k:
             return 0
         self._merge_ticks += 1
-        keys = np.concatenate(merged_k)
-        untils = np.concatenate(merged_u)
-        # last-wins by key in arrival order — the kernel map's
-        # overwrite-on-update semantics, same as CollectSink
-        self._merged.update(
-            zip(keys.tolist(),
-                untils.astype(np.float32).view(np.uint32).tolist()))
-        if self.sink is not None:
-            self.sink.apply(BlacklistUpdate(key=keys, until_s=untils))
-        return int(len(keys))
+        total = 0
+        if merged_k:
+            keys = np.concatenate(merged_k)
+            untils = np.concatenate(merged_u)
+            # last-wins by key in arrival order — the kernel map's
+            # overwrite-on-update semantics, same as CollectSink
+            self._merged.update(
+                zip(keys.tolist(),
+                    untils.astype(np.float32).view(np.uint32).tolist()))
+            if self.sink is not None:
+                self.sink.apply(BlacklistUpdate(key=keys,
+                                                until_s=untils))
+            total += int(len(keys))
+        if net_k:
+            keys = np.concatenate(net_k)
+            untils = np.concatenate(net_u)
+            if self.sink is not None:
+                self.sink.apply(BlacklistUpdate(key=keys,
+                                                until_s=untils))
+            total += int(len(keys))
+        return total
 
     def quiesce(self, timeout_s: float, peers_quiet=None) -> None:
         """Converge-on-shutdown drain of the RX mailboxes: force-tick
@@ -235,14 +303,16 @@ class GossipPlane:
     def _digest(d: dict[int, int]) -> str:
         """Order-insensitive digest of a ``key -> until-bits`` map, so
         two processes can assert byte-identical blacklist agreement
-        through a JSON report without shipping the whole map."""
-        import zlib
+        through a JSON report without shipping the whole map.  ONE
+        implementation repo-wide (transport.map_digest — u32-range
+        values produce identical bytes under either dtype), so the
+        shm and net digest strings can never drift in format."""
+        from flowsentryx_tpu.cluster.transport import map_digest
 
-        items = np.array(sorted(d.items()), np.uint64)
-        return f"{zlib.crc32(items.tobytes()):08x}.{len(d)}"
+        return map_digest(d)
 
     def report(self) -> dict:
-        return {
+        rep = {
             "rank": self.rank,
             "n_engines": self.n_engines,
             "k_max": self.k_max,
@@ -257,3 +327,12 @@ class GossipPlane:
             "rx_seq_gaps": self._rx_seq_gaps,
             "merge_ticks": self._merge_ticks,
         }
+        if self.net is not None:
+            # the network-leg counters (tx_drop/rx_gap/rx_dup/
+            # reorder_evict/epoch_skew_*) ride EngineReport.cluster
+            # through here, feed the health ladder's DEGRADED reasons
+            # (engine/health.py) and surface in `fsx status/monitor
+            # --engine-report`; single-host reports have no "net" key
+            # at all — byte-identical to the pre-net plane
+            rep["net"] = self.net.report()
+        return rep

@@ -183,6 +183,7 @@ class StatusBlock:
         "c_stop": schema.STATUS_STOP_OFFSET,
         "c_gen": schema.STATUS_GEN_OFFSET,
         "c_t0": schema.STATUS_T0_OFFSET,
+        "c_t0_wall": schema.STATUS_T0_WALL_OFFSET,
     }
 
     def __init__(self, path: str | Path):

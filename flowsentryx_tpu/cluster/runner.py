@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 import traceback
 from pathlib import Path
@@ -34,6 +35,7 @@ from pathlib import Path
 from flowsentryx_tpu.cluster.gossip import GossipPlane
 from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
 from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.sync import tuning
 
 
 def _own_process_group() -> None:
@@ -100,8 +102,18 @@ def engine_main(spec: dict) -> int:
                           spec.get("jax_platform", "cpu"))
     if spec.get("pin_core") is not None:
         pin_to_core(spec["pin_core"])
+    net = None
+    if spec.get("net"):
+        # the multi-host gossip leg (cluster/transport.py): built in
+        # the child — the socket must live in the engine process, its
+        # counters ride EngineReport.cluster.net.  Jax-free, so this
+        # stays on the fast half of the boot.
+        from flowsentryx_tpu.cluster.transport import engine_net_mailbox
+
+        net = engine_net_mailbox(spec["net"], spec["rank"],
+                                 spec["t0_ns"], spec["t0_wall_ns"])
     plane = GossipPlane(spec["cluster_dir"], spec["rank"],
-                        spec["n_engines"])
+                        spec["n_engines"], net=net)
     plane.set_state(schema.CSTATE_SPAWNING)
     try:
         _serve(spec, plane)
@@ -111,6 +123,9 @@ def engine_main(spec: dict) -> int:
         traceback.print_exc()
         plane.set_state(schema.CSTATE_FAILED)
         return 1
+    finally:
+        if net is not None:
+            net.close()
 
 
 def _serve(spec: dict, plane: GossipPlane) -> None:
@@ -166,6 +181,20 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         Path(spec["ready_token"]).touch()
     if spec.get("start_token"):
         _wait_for_token(spec["start_token"])
+    if plane.net is not None:
+        # peer discovery with retry/backoff — and FAIL OPEN on
+        # timeout: a silent peer host is its supervisor's incident,
+        # not a reason to withhold serving this span; when it appears
+        # its first HELLO triggers a full-map resync (transport.py)
+        from flowsentryx_tpu.cluster.transport import NetHandshakeTimeout
+
+        try:
+            plane.net.handshake(
+                spec["net"].get("handshake_timeout_s",
+                                tuning.NET_HANDSHAKE_TIMEOUT_S))
+        except NetHandshakeTimeout as e:
+            print(f"fsx cluster rank {rank}: {e} — serving fail-open",
+                  file=sys.stderr)
     plane.set_state(schema.CSTATE_SERVING)
 
     chunk_s = spec.get("chunk_s", 0.5)

@@ -72,11 +72,15 @@ class ClusterSupervisor:
         k_max: int = 64,
         mailbox_slots: int = 256,
         t0_ns: int | None = None,
+        t0_wall_ns: int | None = None,
+        net: dict | None = None,
     ):
-        if len(specs) < 2:
+        if len(specs) < 2 and net is None:
             raise ValueError(
                 f"a cluster needs >= 2 engines, got {len(specs)} "
                 "(one engine is fsx serve)")
+        if len(specs) < 1:
+            raise ValueError("a cluster needs >= 1 engine")
         self.cluster_dir = Path(cluster_dir)
         self.n = len(specs)
         self.specs = specs
@@ -98,6 +102,14 @@ class ClusterSupervisor:
         self.k_max = k_max
         self.mailbox_slots = mailbox_slots
         self.t0_ns = t0_ns
+        self.t0_wall_ns = t0_wall_ns
+        #: multi-host net spec (``fsx cluster --hosts``): hosts/
+        #: host_id/engines_per_host/listen — consumed by
+        #: transport.engine_net_mailbox in each child and by the
+        #: federation beacon below.  None = single-host, net-free.
+        self.net = net
+        self.federation = None
+        self._dead_hosts_announced: set[int] = set()
         self._ctx = mp.get_context("spawn")  # engines own jax + workers
         self._procs: list[mp.process.BaseProcess | None] = [None] * self.n
         self._status: list[StatusBlock] = []
@@ -123,17 +135,40 @@ class ClusterSupervisor:
         self.cluster_dir.mkdir(parents=True, exist_ok=True)
         self._refuse_live_plane()
         gplane.create_plane(self.cluster_dir, self.n, k_max=self.k_max,
-                            slots=self.mailbox_slots)
+                            slots=self.mailbox_slots,
+                            net=self.net is not None)
         if self.t0_ns is None:
             # the shared epoch: every engine's device clock and every
             # gossiped `until` is relative to this one anchor, which is
-            # what makes cross-engine untils byte-comparable
+            # what makes cross-engine untils byte-comparable — and the
+            # wall twin stamped at the SAME instant is what lets a
+            # PEER HOST rebase this host's wires into its own epoch
+            # (monotonic clocks are per-host; cluster/transport.py)
             self.t0_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+            if self.t0_wall_ns is None:
+                self.t0_wall_ns = time.time_ns()
+        if self.t0_wall_ns is None:
+            # externally-supplied monotonic epoch (tests, re-anchored
+            # fleets): derive the wall stamp so the pair still names
+            # one instant
+            self.t0_wall_ns = time.time_ns() - (
+                time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+                - self.t0_ns)
         for r in range(self.n):
             st = StatusBlock(status_path(self.cluster_dir, r))
             st.ctl_set("c_t0", self.t0_ns)
+            st.ctl_set("c_t0_wall", self.t0_wall_ns)
             st.ctl_set("c_gen", 0)
             self._status.append(st)
+        if self.net is not None:
+            from flowsentryx_tpu.cluster import transport
+
+            self.federation = transport.host_beacon(
+                self.net, self.t0_wall_ns,
+                interval_s=self.net.get(
+                    "beacon_interval_s", tuning.NET_BEACON_INTERVAL_S),
+                timeout_s=self.net.get(
+                    "host_timeout_s", tuning.NET_HOST_TIMEOUT_S))
         for r in range(self.n):
             self._spawn(r)
 
@@ -164,14 +199,22 @@ class ClusterSupervisor:
             if (state in _LIVE and hb
                     and 0 <= now_ns - hb
                     < 2 * self.heartbeat_timeout_s * 1e9):
-                live.append(r)
+                live.append((r, (now_ns - hb) * 1e-9))
         if live:
+            detail = ", ".join(
+                f"rank {r} heartbeated {age:.1f}s ago"
+                for r, age in live)
             raise RuntimeError(
                 f"cluster dir {self.cluster_dir} has live engines "
-                f"(ranks {live} heartbeated within "
+                f"({detail}; liveness bound "
                 f"{2 * self.heartbeat_timeout_s:.0f}s): re-creating "
-                "the plane would truncate their mailboxes mid-serve — "
-                "stop the old fleet first, or use a fresh cluster dir")
+                "the plane would truncate their mmap'd mailboxes "
+                "mid-serve (SIGBUS on their next publish) and attach "
+                "this fleet as a second consumer on their SPSC ring "
+                "shards. Remediation: stop the old fleet (its own "
+                "supervisor's stop-drain, or kill the listed ranks "
+                "and wait for their heartbeats to go stale), or point "
+                "--cluster-dir at a fresh directory")
 
     def _spawn(self, rank: int) -> None:
         spec = dict(self.specs[rank])
@@ -181,6 +224,9 @@ class ClusterSupervisor:
         spec["cluster_dir"] = str(self.cluster_dir)
         spec["gen"] = gen
         spec["t0_ns"] = self.t0_ns
+        spec["t0_wall_ns"] = self.t0_wall_ns
+        if self.net is not None:
+            spec["net"] = self.net
         # per-gen default; a caller-provided report_path is honored for
         # every generation (later gens overwrite it — aggregate()'s
         # latest-gen pick only needs the per-rank dedup)
@@ -265,6 +311,28 @@ class ClusterSupervisor:
             "to the kernel tier. Fix the crash cause and restart the "
             "fleet to re-serve it.", file=sys.stderr)
 
+    def _announce_dead_host(self, host: int) -> None:
+        """A peer HOST went silent past the federation timeout: its
+        whole engine fleet — every IP-hash span it owned — is now
+        mitigated by its local kernel tier alone.  Announced with the
+        span and the remediation, the _announce_park discipline one
+        level up."""
+        import sys
+
+        n_eng = int(self.net.get("engines_per_host", 0) or 0)
+        hosts = self.net.get("hosts") or []
+        addr = (f"{hosts[host][0]}:{hosts[host][1]}"
+                if host < len(hosts) else "?")
+        span = (f"its {n_eng} engine span(s)" if n_eng
+                else "its engine spans")
+        print(
+            f"fsx cluster: peer host {host} ({addr}) DEAD — no "
+            f"federation beacon for "
+            f"{self.federation.timeout_s:.0f}s; {span} fail open to "
+            "that host's kernel tier. Fleet health folds FAILED until "
+            "the host returns (its first beacon/HELLO re-joins it and "
+            "triggers a gossip resync).", file=sys.stderr)
+
     def poll(self) -> None:
         """One supervision pass: liveness, heartbeat staleness,
         restart-or-fail decisions under the crash-loop discipline
@@ -272,6 +340,17 @@ class ClusterSupervisor:
         has the measured rationale for both)."""
         now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
         now = time.monotonic()
+        if self.federation is not None:
+            # federation heartbeats: beacon our liveness, ingest
+            # peers', and announce a peer host's death ONCE per
+            # incident — its span falls open to its local kernel tier
+            # and fleet health folds FAILED (aggregate below)
+            self.federation.tick()
+            dead = set(self.federation.dead_hosts())
+            for h in sorted(dead - self._dead_hosts_announced):
+                self._announce_dead_host(h)
+            # a revived host leaves the set, so a relapse re-announces
+            self._dead_hosts_announced = dead
         for r in range(self.n):
             if r in self._failed or r in self._done:
                 continue
@@ -375,6 +454,8 @@ class ClusterSupervisor:
                 # exited without DONE after the terminal stop: no
                 # restart is coming, so the rank is failed, not lost
                 self._failed.add(r)
+        if self.federation is not None:
+            self.federation.close()
 
     # -- reporting ----------------------------------------------------------
 
@@ -440,15 +521,25 @@ class ClusterSupervisor:
             if isinstance(rep.get("report"), dict)
             and rep["report"].get("health")
         }
+        # federation view (multi-host fleets): per-peer-host beacon
+        # ages and the dead list — a dead peer host folds fleet health
+        # FAILED (its whole IP span is down to its local kernel tier)
+        hosts_block = None
+        dead_hosts: list[int] = []
+        if self.federation is not None:
+            hosts_block = self.federation.report()
+            dead_hosts = self.federation.dead_hosts()
         return {
             "engines": self.n,
             "t0_ns": self.t0_ns,
+            "t0_wall_ns": self.t0_wall_ns,
             "restarts": list(self.restarts),
             "failed_ranks": sorted(self._failed),
             "stalled_ranks": sorted(self._stalled),
+            "hosts": hosts_block,
             "health": health_mod.cluster_health(
                 per_rank_health, sorted(self._failed),
-                sorted(self._stalled)),
+                sorted(self._stalled), dead_hosts=dead_hosts),
             "records": total_records,
             "batches": total_batches,
             "max_wall_s": round(max_wall, 4),

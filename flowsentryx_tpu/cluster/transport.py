@@ -1,0 +1,717 @@
+"""The gossip plane's network leg: loss-tolerant UDP verdict transport.
+
+PR 10's verdict-gossip plane is pairwise SPSC shm — correct on one
+host, where the supervisor's single CLOCK_MONOTONIC ``t0`` makes every
+gossiped ``until`` byte-identical fleet-wide and the TSO cursor
+protocol makes delivery exactly-once and in-order BY CONSTRUCTION.
+None of that survives a wire.  This module carries the SAME ``[2K+4]``
+compact verdict wire and u64-sequence discipline over UDP datagrams
+between hosts, with every unreliable-network failure made EXPLICIT:
+
+* **loss** — sequence holes are counted (``rx_gap``), never repaired
+  by waiting: a verdict stream is last-wins and TTL-bounded, so the
+  periodic anti-entropy resync (own-map re-publish, ``sync/tuning.py::
+  NET_RESYNC_INTERVAL_S``) repairs loss while the verdicts still
+  matter, and nothing ever stalls on a retransmit.
+* **duplication** — per-peer duplicate suppression on the u64 seq (a
+  resent/reflected datagram is counted ``rx_dup`` and dropped, never
+  re-applied).
+* **reorder** — a BOUNDED per-peer reorder buffer restores sequence
+  order up to ``NET_REORDER_WINDOW`` wires; past it the oldest
+  buffered wire is delivered out of order and counted
+  (``reorder_evict``): evict-and-count, never stall, memory bounded.
+* **backpressure** — the publish side never blocks: the sink-section
+  handoff queue drops-and-counts past ``NET_OUTQ_MAX``
+  (``txq_dropped``), and a failed ``sendto`` drops-and-counts
+  (``tx_sock_drops``) — a blocked publisher is the coordinator
+  coupling the gossip plane exists to avoid.
+* **epochs** — monotonic clocks are per-host, so the single-host
+  byte-identical-untils trick cannot cross hosts.  Each host's
+  supervisor stamps a CLOCK_REALTIME wall stamp ``t0_wall_ns`` at the
+  same instant as its monotonic ``t0`` (``schema.STATUS_T0_WALL_
+  OFFSET``); every datagram carries the sender's stamp, and received
+  wires are REBASED tx-epoch -> rx-epoch (``until += (tx_t0_wall -
+  rx_t0_wall)``) before they touch a sink.  A rebased wire whose
+  device-clock ``now`` lands more than ``schema.RANGE_EPOCH_SKEW_S``
+  from the receiver's own clock is a LYING epoch (pre-reboot stamp,
+  no NTP) — dropped and counted (``epoch_skew_dropped``), with the
+  worst observed skew kept as a gauge (``epoch_skew_max``).
+
+**Digest convergence is re-pinned on the rebased form.**  The f32
+rebase is lossy (rounding differs with the epoch delta), so two hosts
+cannot byte-compare their locally-rebased maps.  The canonical rebased
+form is integer ABSOLUTE wall microseconds::
+
+    until_wall_us = tx_t0_wall_ns // 1000 + round(until_f32 * 1e6)
+
+computed from the ORIGINATOR's stamp and f32 bits — both carried
+verbatim in the datagram — so every host derives the identical u64
+from identical integer arithmetic, and ``net_digest`` converges
+byte-exactly.  (The anti-entropy resync re-publishes only wires this
+endpoint ORIGINATED, preserving those bits exactly; engines own
+disjoint IP-hash spans, so each key has exactly one originator and
+last-wins convergence is deterministic.)
+
+Threading contract (registered in ``sync/contracts.py``,
+``NETMAILBOX_PLAN``): :meth:`queue_tx` is the only publish-section
+method (called from ``GossipPlane.publish`` in the engine's SINK
+section); everything else — the socket, every counter, the reorder
+state, the canonical map — runs in the merge section
+(``GossipPlane.tick``, the engine's dispatch thread).  The two sides
+meet only at ``_outq``, a deque whose append/popleft ends are
+single-owner (the SPSC handoff idiom).
+
+Everything here is numpy + socket — no jax — so the supervisor, the
+federation beacon and the chaos harness stay on the sub-second import
+path.
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import time
+
+import numpy as np
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.sync import tuning
+
+
+class NetHandshakeTimeout(RuntimeError):
+    """Peer discovery exhausted its retry/backoff budget; the message
+    names every peer that never answered."""
+
+
+def pack_packet(kind: int, host: int, rank: int, seq: int,
+                count: int, t0_wall_ns: int,
+                wire: np.ndarray | None = None) -> bytes:
+    """One gossip datagram (``schema.NET_*`` word layout).  The u64
+    ``seq`` and ``t0_wall_ns`` are split across two u32 words each —
+    the VerdictMailbox slot-header idiom, boundary-pinned in tests."""
+    hdr = np.zeros(schema.NET_PKT_HDR_WORDS, np.uint32)
+    hdr[schema.NET_MAGIC_WORD] = schema.NET_PKT_MAGIC
+    hdr[schema.NET_KIND_WORD] = kind
+    hdr[schema.NET_HOST_WORD] = host
+    hdr[schema.NET_RANK_WORD] = rank
+    hdr[schema.NET_SEQ_LO_WORD] = seq & 0xFFFFFFFF
+    hdr[schema.NET_SEQ_HI_WORD] = (seq >> 32) & 0xFFFFFFFF
+    hdr[schema.NET_COUNT_WORD] = count
+    hdr[schema.NET_T0_WALL_LO_WORD] = t0_wall_ns & 0xFFFFFFFF
+    hdr[schema.NET_T0_WALL_HI_WORD] = (t0_wall_ns >> 32) & 0xFFFFFFFF
+    if wire is None:
+        return hdr.tobytes()
+    return hdr.tobytes() + np.ascontiguousarray(wire, np.uint32).tobytes()
+
+
+def unpack_packet(data: bytes) -> dict | None:
+    """Parse one datagram; None for anything malformed (an open UDP
+    port receives whatever the network feels like sending)."""
+    if len(data) < schema.NET_PKT_HDR_WORDS * 4 or len(data) % 4:
+        return None
+    words = np.frombuffer(data, np.uint32)
+    if int(words[schema.NET_MAGIC_WORD]) != schema.NET_PKT_MAGIC:
+        return None
+    wire = words[schema.NET_PKT_HDR_WORDS:].copy()
+    if len(wire) and (len(wire) < 6 or len(wire) % 2):
+        return None  # a wire payload must be [2K+4] words, K >= 1
+    return {
+        "kind": int(words[schema.NET_KIND_WORD]),
+        "host": int(words[schema.NET_HOST_WORD]),
+        "rank": int(words[schema.NET_RANK_WORD]),
+        "seq": (int(words[schema.NET_SEQ_LO_WORD])
+                | (int(words[schema.NET_SEQ_HI_WORD]) << 32)),
+        "count": int(words[schema.NET_COUNT_WORD]),
+        "t0_wall_ns": (int(words[schema.NET_T0_WALL_LO_WORD])
+                       | (int(words[schema.NET_T0_WALL_HI_WORD]) << 32)),
+        "wire": wire if len(wire) else None,
+    }
+
+
+def _wire_entries(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """``(keys u32, until-bit u32)`` of one ``[2K+4]`` wire (the tiny
+    numpy-only decode — engine/writeback.py's full decoder rides the
+    jax import chain this module must stay off)."""
+    k = (wire.shape[0] - 4) // 2
+    n = min(int(wire[2 * k]), k)
+    return wire[:n], wire[k:k + n]
+
+
+def until_wall_us(until_bits: np.ndarray, t0_wall_ns: int) -> np.ndarray:
+    """The canonical rebased form (module docstring): absolute wall
+    microseconds as i64, exact integer arithmetic from the originator's
+    epoch stamp and f32 bits — identical on every host."""
+    until = np.asarray(until_bits, np.uint32).view(np.float32)
+    return (np.rint(until.astype(np.float64) * 1e6).astype(np.int64)
+            + np.int64(t0_wall_ns // 1000))
+
+
+def map_digest(d: dict) -> str:
+    """Order-insensitive digest of a ``key -> until_wall_us`` map (the
+    GossipPlane digest idiom, on the canonical rebased form)."""
+    import zlib
+
+    items = np.array(sorted(d.items()), np.int64)
+    return f"{zlib.crc32(items.tobytes()):08x}.{len(d)}"
+
+
+class NetMailbox:
+    """One engine's datagram gossip endpoint (module docstring).
+
+    ``peers`` maps an endpoint key ``(host_id, rank)`` to its UDP
+    address.  One socket serves both directions; bind to port 0 and
+    read :attr:`addr` for harness-assigned loopback ports.
+    """
+
+    def __init__(self, host_id: int, rank: int, t0_ns: int,
+                 t0_wall_ns: int, *,
+                 listen: tuple[str, int] = ("127.0.0.1", 0),
+                 peers: dict | None = None,
+                 k_max: int = 64,
+                 reorder_window: int = tuning.NET_REORDER_WINDOW,
+                 reorder_timeout_s: float = tuning.NET_REORDER_TIMEOUT_S,
+                 outq_max: int = tuning.NET_OUTQ_MAX,
+                 resync_interval_s: float = tuning.NET_RESYNC_INTERVAL_S):
+        if t0_wall_ns <= 0:
+            raise ValueError(
+                "NetMailbox needs the host's stamped t0_wall_ns epoch "
+                "(schema.STATUS_T0_WALL_OFFSET): without it received "
+                "wires cannot be rebased into this host's clock")
+        self.host_id = host_id
+        self.rank = rank
+        self.t0_ns = t0_ns
+        self.t0_wall_ns = t0_wall_ns
+        self.k_max = k_max
+        self.reorder_window = reorder_window
+        self.reorder_timeout_s = reorder_timeout_s
+        #: bounds BOTH handoff queues: the publish-side tx deque and
+        #: the rx staging deque (one knob — each is the same "consumer
+        #: slower than inflow" shape, and each drops-and-counts)
+        self.outq_max = outq_max
+        self.resync_interval_s = resync_interval_s
+        self.peers: dict[tuple[int, int], tuple[str, int]] = dict(
+            peers or {})
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self._sock.bind(tuple(listen))
+        #: actual bound address (port 0 resolves here)
+        self.addr = self._sock.getsockname()
+        # -- publish-side (engine sink section) -------------------------
+        self._outq: collections.deque = collections.deque()
+        self.txq_dropped = 0
+        # -- merge-side (dispatch thread) -------------------------------
+        self._tx_seq: dict[tuple[int, int], int] = {}
+        #: wires this endpoint ORIGINATED: key -> until f32 bits,
+        #: re-published verbatim by the anti-entropy resync
+        self._own_map: dict[int, int] = {}
+        #: the canonical rebased map: key -> until_wall_us (module
+        #: docstring) — own publishes and accepted peer wires alike
+        self.net_map: dict[int, int] = {}
+        self._rx_state: dict[tuple[int, int], dict] = {}
+        self._ready: collections.deque = collections.deque()
+        self._peers_seen: set[tuple[int, int]] = set()
+        self._resync_peers: set[tuple[int, int]] = set()
+        self._next_resync = time.monotonic() + resync_interval_s
+        self.tx_wires = 0
+        self.tx_pkts = 0
+        self.tx_sock_drops = 0
+        self.rx_pkts = 0
+        self.rx_wires = 0
+        self.rx_dup = 0
+        self.rx_gap = 0
+        self.reorder_evict = 0
+        self.gap_timeouts = 0
+        self.rx_alien = 0
+        self.peer_restarts = 0
+        self.epoch_skew_dropped = 0
+        self.epoch_skew_max = 0.0
+        self.resyncs = 0
+        self.hellos_rx = 0
+        self.rx_overflow = 0
+        self.pruned = 0
+
+    # -- lifecycle (quiescent: no serving thread alive) ---------------------
+
+    def add_peer(self, key: tuple[int, int],
+                 addr: tuple[str, int]) -> None:
+        """Register one remote endpoint (harnesses with ephemeral
+        ports; the CLI derives the whole peer table up front)."""
+        self.peers[key] = tuple(addr)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    # -- publish side (engine sink section) ---------------------------------
+
+    def queue_tx(self, wire: np.ndarray, count: int) -> bool:
+        """Hand one outgoing verdict wire to the merge-side pump.
+        False (counted) past the queue bound — the publisher NEVER
+        blocks or bloats on a slow/partitioned network (module
+        docstring)."""
+        if len(self._outq) >= self.outq_max:
+            self.txq_dropped += 1
+            return False
+        self._outq.append((np.array(wire, np.uint32), int(count)))
+        return True
+
+    # -- merge side (dispatch thread) ---------------------------------------
+
+    def _sendto(self, payload: bytes, addr: tuple[str, int]) -> bool:
+        """The one raw send seam (the chaos injector wraps exactly
+        this).  False = dropped-and-counted, never raised: EAGAIN/
+        ENOBUFS is tx backpressure, ECONNREFUSED a dead peer — both
+        fail open."""
+        try:
+            self._sock.sendto(payload, addr)
+            return True
+        except OSError:
+            self.tx_sock_drops += 1
+            return False
+
+    def _send_wire(self, peer: tuple[int, int], wire: np.ndarray,
+                   count: int) -> None:
+        seq = self._tx_seq.get(peer, 0) + 1
+        self._tx_seq[peer] = seq
+        pkt = pack_packet(schema.NET_KIND_WIRE, self.host_id, self.rank,
+                          seq, count, self.t0_wall_ns, wire)
+        self.tx_pkts += 1
+        self._sendto(pkt, self.peers[peer])
+
+    def _send_ctl(self, kind: int, peer: tuple[int, int]) -> None:
+        self.tx_pkts += 1
+        self._sendto(pack_packet(kind, self.host_id, self.rank, 0, 0,
+                                 self.t0_wall_ns), self.peers[peer])
+
+    def pump(self) -> None:
+        """One merge-section service pass: drain the publish handoff
+        onto the network, run the anti-entropy resync when due, and
+        ingest every pending datagram (rx machinery below)."""
+        while True:
+            try:
+                wire, count = self._outq.popleft()
+            except IndexError:
+                break
+            keys, bits = _wire_entries(wire)
+            self._own_map.update(zip(keys.tolist(), bits.tolist()))
+            self.net_map.update(zip(
+                keys.tolist(),
+                until_wall_us(bits, self.t0_wall_ns).tolist()))
+            self.tx_wires += 1
+            for peer in self.peers:
+                self._send_wire(peer, wire, count)
+        now = time.monotonic()
+        if self._resync_peers or now >= self._next_resync:
+            # HELLO-triggered resyncs serve ONLY the (re)appeared
+            # peers and never consume the periodic deadline: a host
+            # mid-handshake with peer C must not postpone the loss
+            # repair the OTHER peers' one-interval bound promises
+            targets = set(self._resync_peers)
+            self._resync_peers.clear()
+            if now >= self._next_resync:
+                self._next_resync = now + self.resync_interval_s
+                targets |= set(self.peers)
+            self._prune_expired()
+            self._resync(targets)
+        self._recv_all()
+        # a sequence hole older than the reorder timeout is loss, not
+        # reorder: concede it (rx_gap) so the wires parked behind it
+        # deliver — a last-wins, resync-repaired stream never waits on
+        # a retransmit that is not coming
+        now_m = time.monotonic()
+        for src, st in self._rx_state.items():
+            while (st["buf"]
+                   and now_m - min(v[0] for v in st["buf"].values())
+                   > self.reorder_timeout_s):
+                self.gap_timeouts += 1
+                self._concede_hole(src, st)
+
+    def _prune_expired(self) -> None:
+        """Drop long-expired verdicts from both maps (resync cadence):
+        the maps hold the LIVE blacklist, and the resync re-publishes
+        ``_own_map`` in full — without pruning, a long-serving engine
+        re-broadcasts every key it ever condemned, forever.  The grace
+        (RANGE_EPOCH_SKEW_S) is the same declared bound the rx side
+        enforces, so every host prunes the same keys by the same
+        absolute-time rule and the canonical digests stay convergent
+        (modulo entries inside the grace window, which both sides
+        still hold)."""
+        grace = schema.RANGE_EPOCH_SKEW_S
+        local_now = ((time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+                      - self.t0_ns) * 1e-9)
+        if self._own_map:
+            floor = local_now - grace
+            dead = [k for k, bits in self._own_map.items()
+                    if float(np.uint32(bits).view(np.float32)) < floor]
+            for k in dead:
+                del self._own_map[k]
+            self.pruned += len(dead)
+        if self.net_map:
+            floor_us = int((time.time_ns() // 1000) - grace * 1e6)
+            dead = [k for k, us in self.net_map.items()
+                    if us < floor_us]
+            for k in dead:
+                del self.net_map[k]
+
+    def _resync(self, targets: set) -> None:
+        """Anti-entropy: re-publish this endpoint's OWN map (original
+        f32 bits — the canonical digest survives the round trip
+        exactly, module docstring) to ``targets``.  Repairs UDP loss
+        and healed partitions within one interval."""
+        if not self._own_map or not targets:
+            return
+        self.resyncs += 1
+        items = sorted(self._own_map.items())
+        k = self.k_max
+        local_now = np.float32(
+            (time.clock_gettime_ns(time.CLOCK_MONOTONIC) - self.t0_ns)
+            * 1e-9)
+        for lo in range(0, len(items), k):
+            chunk = items[lo:lo + k]
+            wire = np.zeros(2 * k + 4, np.uint32)
+            wire[:len(chunk)] = np.array([c[0] for c in chunk],
+                                         np.uint32)
+            wire[k:k + len(chunk)] = np.array([c[1] for c in chunk],
+                                              np.uint32)
+            wire[2 * k] = len(chunk)
+            wire[2 * k + 3] = local_now.view(np.uint32)
+            for peer in targets:
+                if peer in self.peers:
+                    self._send_wire(peer, wire, len(chunk))
+
+    def _recv_all(self, budget: int = 256) -> None:
+        for _ in range(budget):
+            try:
+                data, from_addr = self._sock.recvfrom(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                continue  # ICMP-reflected refusals from dead peers
+            pkt = unpack_packet(data)
+            if pkt is None:
+                self.rx_alien += 1
+                continue
+            self.rx_pkts += 1
+            src = (pkt["host"], pkt["rank"])
+            if (src not in self.peers
+                    or from_addr[0] != self.peers[src][0]):
+                # the claimed endpoint must arrive FROM its registered
+                # host address (IP-level: source ports float through
+                # NAT-less racks, and a UDP source IP is itself
+                # spoofable — the real trust boundary is the network,
+                # the shm plane's posture; this check just stops a
+                # misconfigured process from impersonating a peer and
+                # resetting its dup-suppression state)
+                self.rx_alien += 1
+                continue
+            self._peers_seen.add(src)
+            kind = pkt["kind"]
+            if kind == schema.NET_KIND_HELLO:
+                # a (re)booting peer announcing itself: welcome it,
+                # reset its sequence space (its seqs restart at 1),
+                # and queue a full-map resync so it converges without
+                # waiting for the periodic sweep
+                self.hellos_rx += 1
+                self._rx_state.pop(src, None)
+                self._resync_peers.add(src)
+                self._send_ctl(schema.NET_KIND_WELCOME, src)
+            elif kind == schema.NET_KIND_WIRE and pkt["wire"] is not None:
+                self._rx_wire(src, pkt["seq"], pkt["count"],
+                              pkt["t0_wall_ns"], pkt["wire"])
+            # WELCOME/BEACON: the _peers_seen add above is the payload
+
+    def _rx_wire(self, src: tuple, seq: int, count: int,
+                 t0_wall_ns: int, wire: np.ndarray) -> None:
+        """Per-peer sequence machinery: duplicate suppression, the
+        bounded reorder buffer (evict-and-count, never stall), gap
+        accounting, peer-restart detection (module docstring)."""
+        st = self._rx_state.get(src)
+        if st is None:
+            # first packet from this peer: expect from one window
+            # BEHIND it (seq streams start at 1, but the first packet
+            # to ARRIVE may be a reordered later one — anchoring next
+            # at `seq` would miscount its in-flight predecessors as
+            # duplicates; scenario net_reorder pins this).  A
+            # mid-stream join (our restart) parks at worst one window
+            # behind and concedes the phantom hole at the timeout.
+            st = self._rx_state[src] = {
+                "next": max(1, seq - self.reorder_window), "buf": {}}
+        if seq < st["next"] - tuning.NET_RESTART_JUMP:
+            # far-backward jump: the peer restarted and its sequence
+            # space began again — resetting is the only honest read
+            # (treating its whole new life as "duplicates" would
+            # silently drop every future verdict it publishes)
+            self.peer_restarts += 1
+            st["buf"].clear()
+            st["next"] = seq
+        if seq < st["next"] or seq in st["buf"]:
+            self.rx_dup += 1
+            return
+        st["buf"][seq] = (time.monotonic(), count, t0_wall_ns, wire)
+        self._drain_in_order(src, st)
+        while len(st["buf"]) > self.reorder_window:
+            # bounded memory: concede the hole instead of growing
+            self.reorder_evict += 1
+            self._concede_hole(src, st)
+
+    def _drain_in_order(self, src: tuple, st: dict) -> None:
+        while st["next"] in st["buf"]:
+            self._accept(src, st["next"],
+                         *st["buf"].pop(st["next"])[1:])
+            st["next"] += 1
+
+    def _concede_hole(self, src: tuple, st: dict) -> None:
+        """Accept that the wires below ``min(buf)`` are LOST (count the
+        gap, never silent) and resume in-order delivery from there."""
+        s = min(st["buf"])
+        self.rx_gap += s - st["next"]
+        st["next"] = s
+        self._drain_in_order(src, st)
+
+    def _accept(self, src: tuple, seq: int, count: int,
+                t0_wall_ns: int, wire: np.ndarray) -> None:
+        """Epoch-rebase one in-sequence wire tx->rx and stage it for
+        :meth:`pop_wires`; enforce the RANGE_EPOCH_SKEW_S bound."""
+        k = (wire.shape[0] - 4) // 2
+        n = min(count, k)
+        delta_s = (t0_wall_ns - self.t0_wall_ns) * 1e-9
+        local_now = ((time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+                      - self.t0_ns) * 1e-9)
+        wire_now = float(wire[2 * k + 3:2 * k + 4].view(np.float32)[0])
+        skew = abs((wire_now + delta_s) - local_now)
+        self.epoch_skew_max = max(self.epoch_skew_max, skew)
+        if skew > schema.RANGE_EPOCH_SKEW_S:
+            self.epoch_skew_dropped += 1
+            return
+        rebased = wire.copy()
+        untils = wire[k:k + n].view(np.float32).astype(np.float64)
+        rebased[k:k + n] = ((untils + delta_s).astype(np.float32)
+                            .view(np.uint32))
+        rebased[2 * k + 3] = np.float32(wire_now + delta_s).view(
+            np.uint32)
+        keys = wire[:n].copy()
+        wall_us = until_wall_us(wire[k:k + n], t0_wall_ns)
+        self.net_map.update(zip(keys.tolist(), wall_us.tolist()))
+        self.rx_wires += 1
+        if len(self._ready) >= self.outq_max:
+            # the rx staging queue is bounded like every other queue
+            # in this module: a consumer slower than the inflow sees
+            # drops-and-counts (the canonical map above already took
+            # the entries; the next resync re-delivers them), never
+            # unbounded memory or ever-staler verdicts
+            self.rx_overflow += 1
+            return
+        self._ready.append((src, seq, rebased, keys,
+                            rebased[k:k + n].view(np.float32)))
+
+    def pop_wires(self, max_wires: int) -> list:
+        """Up to ``max_wires`` accepted wires, in per-peer sequence
+        order, each rebased into THIS host's epoch:
+        ``(src_endpoint, seq, rebased_wire, keys u32, untils f32)``."""
+        out = []
+        while len(out) < max_wires:
+            try:
+                out.append(self._ready.popleft())
+            except IndexError:
+                break
+        return out
+
+    def handshake(self, timeout_s: float = tuning.NET_HANDSHAKE_TIMEOUT_S,
+                  ) -> None:
+        """Peer discovery: HELLO every silent peer with exponential
+        backoff (``NET_HANDSHAKE_BACKOFF_*``) until each has answered
+        anything, or raise :class:`NetHandshakeTimeout` naming the
+        silent ones.  Callers that serve anyway (the engine runner)
+        fail OPEN: a late peer's first HELLO triggers the full-map
+        resync, so convergence needs no second boot ordering."""
+        deadline = time.monotonic() + timeout_s
+        backoff = tuning.NET_HANDSHAKE_BACKOFF_BASE_S
+        while True:
+            pending = set(self.peers) - self._peers_seen
+            if not pending:
+                return
+            for peer in pending:
+                self._send_ctl(schema.NET_KIND_HELLO, peer)
+            slice_end = min(time.monotonic() + backoff, deadline)
+            while time.monotonic() < slice_end:
+                self._recv_all()
+                if not set(self.peers) - self._peers_seen:
+                    return
+                time.sleep(0.002)
+            if time.monotonic() >= deadline:
+                still = sorted(set(self.peers) - self._peers_seen)
+                raise NetHandshakeTimeout(
+                    f"gossip peer discovery timed out after "
+                    f"{timeout_s:.1f}s: no answer from "
+                    f"{[f'h{h}r{r}@{self.peers[(h, r)]}' for h, r in still]} "
+                    "(backoff ladder exhausted; the caller may serve "
+                    "fail-open — a late peer's HELLO triggers a full "
+                    "resync)")
+            backoff = min(backoff * 2,
+                          tuning.NET_HANDSHAKE_BACKOFF_MAX_S)
+
+    # -- reporting (quiescent or merge section) ------------------------------
+
+    def report(self) -> dict:
+        return {
+            "host": self.host_id,
+            "rank": self.rank,
+            "peers": len(self.peers),
+            "peers_seen": len(self._peers_seen),
+            "tx_wires": self.tx_wires,
+            "tx_pkts": self.tx_pkts,
+            # the satellite counter: EVERY dropped-on-tx path summed
+            "tx_drop": self.txq_dropped + self.tx_sock_drops,
+            "txq_dropped": self.txq_dropped,
+            "tx_sock_drops": self.tx_sock_drops,
+            "rx_pkts": self.rx_pkts,
+            "rx_wires": self.rx_wires,
+            "rx_dup": self.rx_dup,
+            "rx_gap": self.rx_gap,
+            "reorder_evict": self.reorder_evict,
+            "gap_timeouts": self.gap_timeouts,
+            "rx_alien": self.rx_alien,
+            "peer_restarts": self.peer_restarts,
+            "epoch_skew_dropped": self.epoch_skew_dropped,
+            "epoch_skew_max": round(self.epoch_skew_max, 6),
+            "resyncs": self.resyncs,
+            "hellos_rx": self.hellos_rx,
+            "rx_overflow": self.rx_overflow,
+            "pruned": self.pruned,
+            "net_sources": len(self.net_map),
+            "net_digest": map_digest(self.net_map),
+        }
+
+
+class HostBeacon:
+    """Supervisor federation heartbeats: one per-host liveness beacon.
+
+    Each host's supervisor beacons every ``NET_BEACON_INTERVAL_S`` and
+    listens for its peers'; a peer silent past ``NET_HOST_TIMEOUT_S``
+    (from its last beacon, or from OUR boot if it never spoke) is
+    DEAD: :meth:`dead_hosts` feeds ``supervisor.aggregate`` — the dead
+    host's IP span is announced and fleet health folds FAILED
+    (engine/health.py).  Pure control plane: no verdict ever rides a
+    beacon, and a dead federation changes nothing for serving engines.
+    """
+
+    def __init__(self, host_id: int, t0_wall_ns: int, *,
+                 listen: tuple[str, int] = ("127.0.0.1", 0),
+                 peers: dict | None = None,
+                 interval_s: float = tuning.NET_BEACON_INTERVAL_S,
+                 timeout_s: float = tuning.NET_HOST_TIMEOUT_S):
+        self.host_id = host_id
+        self.t0_wall_ns = t0_wall_ns
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.peers: dict[int, tuple[str, int]] = dict(peers or {})
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self._sock.bind(tuple(listen))
+        self.addr = self._sock.getsockname()
+        self._boot = time.monotonic()
+        self._next_tx = 0.0
+        self._seq = 0
+        self._last_seen: dict[int, float] = {}
+        self.tx_beacons = 0
+        self.rx_beacons = 0
+
+    def add_peer(self, host_id: int, addr: tuple[str, int]) -> None:
+        self.peers[host_id] = tuple(addr)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def tick(self) -> None:
+        """Send when due, ingest everything pending (the supervisor
+        poll cadence drives this — no thread of its own)."""
+        now = time.monotonic()
+        if now >= self._next_tx:
+            self._next_tx = now + self.interval_s
+            self._seq += 1
+            pkt = pack_packet(schema.NET_KIND_BEACON, self.host_id,
+                              schema.NET_RANK_BEACON, self._seq, 0,
+                              self.t0_wall_ns)
+            for addr in self.peers.values():
+                try:
+                    self._sock.sendto(pkt, addr)
+                    self.tx_beacons += 1
+                except OSError:
+                    pass  # fail open: liveness, not delivery
+        for _ in range(64):
+            try:
+                data, from_addr = self._sock.recvfrom(4096)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                continue
+            pkt = unpack_packet(data)
+            if (pkt is None or pkt["kind"] != schema.NET_KIND_BEACON
+                    or pkt["host"] not in self.peers
+                    or from_addr[0] != self.peers[pkt["host"]][0]):
+                # same IP-level source check as the mailbox: a stray
+                # process must not keep a dead host looking alive
+                continue
+            self.rx_beacons += 1
+            self._last_seen[pkt["host"]] = time.monotonic()
+
+    def dead_hosts(self) -> list[int]:
+        """Peer hosts silent past the timeout (never-heard peers count
+        from OUR boot — a host that never joined is as dead as one
+        that stopped)."""
+        now = time.monotonic()
+        dead = []
+        for h in self.peers:
+            last = self._last_seen.get(h, self._boot)
+            if now - last > self.timeout_s:
+                dead.append(h)
+        return sorted(dead)
+
+    def report(self) -> dict:
+        now = time.monotonic()
+        return {
+            "host_id": self.host_id,
+            "tx_beacons": self.tx_beacons,
+            "rx_beacons": self.rx_beacons,
+            "peers": {
+                str(h): {
+                    "age_s": (round(now - self._last_seen[h], 3)
+                              if h in self._last_seen else None),
+                }
+                for h in sorted(self.peers)
+            },
+            "dead": self.dead_hosts(),
+        }
+
+
+def engine_net_mailbox(netspec: dict, rank: int, t0_ns: int,
+                       t0_wall_ns: int, k_max: int = 64) -> NetMailbox:
+    """Build one cluster engine's :class:`NetMailbox` from the CLI's
+    net spec (``fsx cluster --hosts``): host h's supervisor beacon
+    binds its announced base port, engine r binds ``base + 1 + r``,
+    and the peer table is every engine on every OTHER host at the same
+    derived offsets (fleets must run the same ``--engines`` per host —
+    the port arithmetic IS that assumption, stated once here)."""
+    hosts = [tuple(h) for h in netspec["hosts"]]
+    hid = int(netspec["host_id"])
+    n_eng = int(netspec["engines_per_host"])
+    ip, base = netspec.get("listen") or hosts[hid]
+    peers = {
+        (h, r): (hip, int(hport) + 1 + r)
+        for h, (hip, hport) in enumerate(hosts) if h != hid
+        for r in range(n_eng)
+    }
+    return NetMailbox(hid, rank, t0_ns, t0_wall_ns,
+                      listen=(ip, int(base) + 1 + rank), peers=peers,
+                      k_max=k_max)
+
+
+def host_beacon(netspec: dict, t0_wall_ns: int, **kw) -> HostBeacon:
+    """The supervisor-side twin of :func:`engine_net_mailbox`: the
+    federation beacon on host ``host_id``'s announced base port."""
+    hosts = [tuple(h) for h in netspec["hosts"]]
+    hid = int(netspec["host_id"])
+    ip, base = netspec.get("listen") or hosts[hid]
+    peers = {h: (hip, int(hport))
+             for h, (hip, hport) in enumerate(hosts) if h != hid}
+    return HostBeacon(hid, t0_wall_ns, listen=(ip, int(base)),
+                      peers=peers, **kw)

@@ -469,6 +469,35 @@ WIRE_ID_COMPACT16 = 1
 SHM_GOSSIP_MAGIC = 0x465358474F535331   # "FSXGOSS1"
 GOSSIP_SLOT_HDR_WORDS = 4
 
+# -- multi-host gossip datagram layout (cluster/transport.py) ---------------
+# One UDP datagram per verdict wire: a 9-word u32 header followed by the
+# SAME [2K+4]-word compact verdict wire the shm mailboxes carry (564 B
+# at K=64 — comfortably under any MTU, so a wire is never fragmented by
+# us).  The u64 sequence and the u64 t0-wall epoch are split across two
+# u32 words exactly like the VerdictMailbox slot header — the split/
+# reassembly is test-pinned across the 2^32 word boundary on both
+# transports.
+NET_PKT_MAGIC = 0x4653584E              # "FSXN"
+NET_MAGIC_WORD = 0
+NET_KIND_WORD = 1
+NET_HOST_WORD = 2                       # sender host id
+NET_RANK_WORD = 3                       # sender engine rank (or NET_RANK_BEACON)
+NET_SEQ_LO_WORD = 4                     # u64 per-peer wire seq, lo half
+NET_SEQ_HI_WORD = 5
+NET_COUNT_WORD = 6                      # verdicts in the wire payload
+NET_T0_WALL_LO_WORD = 7                 # sender's epoch wall stamp, lo half
+NET_T0_WALL_HI_WORD = 8
+NET_PKT_HDR_WORDS = 9
+#: datagram kinds: verdict wire, peer-discovery handshake (HELLO is
+#: retried with exponential backoff, WELCOME acknowledges), and the
+#: supervisor federation liveness beacon.
+NET_KIND_WIRE = 1
+NET_KIND_HELLO = 2
+NET_KIND_WELCOME = 3
+NET_KIND_BEACON = 4
+#: the rank word of a supervisor beacon (not an engine endpoint)
+NET_RANK_BEACON = 0xFFFFFFFF
+
 #: Per-engine cluster status block (supervisor <-> engine lifecycle).
 #: One writer side per field, cache-line-split by writer exactly like
 #: the ring cursors: ENGINE-written fields live on the 64-byte line at
@@ -488,6 +517,13 @@ STATUS_RECORDS_OFFSET = 88              # u64 records served (monitor)
 STATUS_STOP_OFFSET = 128                # u64 drain-and-exit request
 STATUS_GEN_OFFSET = 136                 # u64 restart generation
 STATUS_T0_OFFSET = 144                  # u64 shared cluster epoch (ns)
+#: CLOCK_REALTIME ns stamped at the SAME instant as the monotonic t0
+#: above.  Monotonic clocks are per-host (each restarts at its own
+#: boot), so the single-host byte-identical-untils trick cannot cross
+#: hosts; the wall stamp is what lets a received verdict wire be
+#: rebased tx-epoch -> rx-epoch (cluster/transport.py).  0 = no
+#: network leg (single-host fleets never stamp it).
+STATUS_T0_WALL_OFFSET = 152             # u64 CLOCK_REALTIME ns at t0
 
 CSTATE_SPAWNING = 1
 CSTATE_SERVING = 2
@@ -925,6 +961,18 @@ RANGE_DT_US_MAX = 0xFFFF
 #: u64 timestamp HI words the split-word decodes see.  A redeploy past
 #: the horizon restarts the epoch.
 RANGE_DEPLOY_HORIZON_S = 1 << 22
+#: Declared cross-host epoch-skew bound (seconds) on REBASED verdict
+#: wires (cluster/transport.py): after tx-epoch -> rx-epoch rebase, the
+#: wire's device-clock `now` word must land within this many seconds of
+#: the receiver's own clock.  The honest contributors — NTP wall-clock
+#: skew (ms), network transit (ms), gossip-tick batching (ms) — sum to
+#: well under a second, so 60 s only ever trips on a LYING epoch: a
+#: peer re-publishing a pre-reboot t0_wall, a corrupted stamp, a host
+#: with no clock discipline at all.  Such wires are dropped and counted
+#: (``epoch_skew_dropped``), never applied: a default block TTL is 10 s,
+#: so a verdict 60 s out of frame is already expired — applying it
+#: under a broken rebase would block innocent sources at wrong times.
+RANGE_EPOCH_SKEW_S = 60
 
 
 def quantize_feat_model(

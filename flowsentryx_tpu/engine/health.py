@@ -13,10 +13,11 @@ from the counters themselves):
 * **DEGRADED(reasons)** — serving continues but something fail-opened:
   dead/stalled ingest shards (their flows fall to the kernel limiter),
   sealed-queue emit drops, sequence gaps, quarantined poisoned
-  batches, corrupt-slot skips, gossip TX drops / RX seq gaps, a
-  watchdog soft trip, a restore that fell back to the ``.prev``
-  generation.  Each reason is a ``name:count`` string an alert can key
-  on.
+  batches, corrupt-slot skips, gossip TX drops / RX seq gaps, the
+  multi-host transport's drop/gap/dup/reorder/skew accounting
+  (``net_*``, cluster/transport.py), a watchdog soft trip, a restore
+  that fell back to the ``.prev`` generation.  Each reason is a
+  ``name:count`` string an alert can key on.
 * **FAILED** — the engine cannot serve its span: every ingest shard is
   dead, or the watchdog hard-tripped (the process is already dying
   loudly; the state is its last words).
@@ -88,6 +89,29 @@ def engine_health(
         rx = int(gossip.get("rx_seq_gaps") or 0)
         if rx:
             reasons.append(f"gossip_rx_seq_gaps:{rx}")
+        net = gossip.get("net")
+        if net:
+            # the multi-host transport's fail-open accounting
+            # (cluster/transport.py): every one of these means a
+            # verdict wire was dropped, delayed past the reorder
+            # window, or refused for a lying epoch — serving
+            # continues (DEGRADED, never FAILED: the local span is
+            # still mitigated; remote convergence is what degraded)
+            for key, name in (("tx_drop", "net_tx_drop"),
+                              ("rx_gap", "net_rx_gap"),
+                              ("rx_dup", "net_rx_dup"),
+                              ("reorder_evict", "net_reorder_evict"),
+                              ("epoch_skew_dropped",
+                               "net_epoch_skew_dropped")):
+                v = int(net.get(key) or 0)
+                if v:
+                    reasons.append(f"{name}:{v}")
+            if int(net.get("epoch_skew_dropped") or 0):
+                # the gauge behind the drops: how far out of frame
+                # the worst wire was (seconds) — names the lying
+                # epoch's magnitude for the operator
+                reasons.append(
+                    f"net_epoch_skew_max:{net.get('epoch_skew_max')}")
     if watchdog:
         trips = int(watchdog.get("soft_trips") or 0)
         if trips:
@@ -108,11 +132,15 @@ def worst(*states: str) -> str:
 
 
 def cluster_health(per_rank: dict, failed_ranks: list,
-                   stalled_ranks: list) -> dict:
+                   stalled_ranks: list,
+                   dead_hosts: list | None = None) -> dict:
     """Supervisor-side aggregation: worst-of every rank's reported
     health, with supervisor-observed terminal states layered on top
     (a rank parked as failed is FAILED even if its last report said
-    healthy — the report predates the park)."""
+    healthy — the report predates the park).  ``dead_hosts`` is the
+    federation beacon's verdict (multi-host fleets): a silent peer
+    HOST means whole IP spans are down to that host's kernel tier —
+    the fleet is FAILED until it returns."""
     states = [h.get("state", DEGRADED) for h in per_rank.values()]
     reasons: list[str] = []
     for r, h in sorted(per_rank.items()):
@@ -127,6 +155,10 @@ def cluster_health(per_rank: dict, failed_ranks: list,
         state = worst(state, DEGRADED)
         reasons.append(
             f"ranks_stalled:{','.join(str(r) for r in stalled_ranks)}")
+    if dead_hosts:
+        state = FAILED
+        reasons.append(
+            f"hosts_dead:{','.join(str(h) for h in dead_hosts)}")
     return {
         "state": state,
         "reasons": reasons,

@@ -348,6 +348,142 @@ GOSSIP_PLAN = ClassPlan(
             "status-block wrapper: per-FIELD writer sides are the "
             "CTL_WRITERS contract (heartbeat from the merge tick, "
             "lifecycle fields from quiescent methods)"),
+        "net": FieldContract(
+            "documented",
+            "multi-host transport (cluster/transport.py NetMailbox): "
+            "the reference is __init__-set and never rebound; its "
+            "per-field disciplines are NETMAILBOX_PLAN — publish() "
+            "only calls its one publish-section method (queue_tx), "
+            "tick() owns everything else"),
+    },
+)
+
+NETMAILBOX_PLAN = ClassPlan(
+    module="flowsentryx_tpu/cluster/transport.py",
+    cls="NetMailbox",
+    sections={
+        # publish: GossipPlane.publish's net leg — the engine's SINK
+        # section, single owner at a time.  Its ONLY transport method:
+        # everything network-facing stays on the merge side.
+        "publish": ("queue_tx",),
+        # merge: GossipPlane.tick's net leg — the engine's dispatch
+        # thread.  The socket, the per-peer sequence/reorder state,
+        # the canonical epoch-rebased map and every counter live
+        # here; handshake runs pre-serving on the same thread.
+        "merge": ("pump", "_resync", "_prune_expired", "_recv_all",
+                  "_rx_wire", "_drain_in_order", "_concede_hole",
+                  "_accept", "_send_wire", "_send_ctl", "_sendto",
+                  "pop_wires", "handshake"),
+    },
+    quiescent=("__init__", "add_peer", "close", "report"),
+    fields={
+        # -- the one cross-section seam -------------------------------
+        "_outq": FieldContract(
+            "documented",
+            "sink-section -> merge-section wire handoff: a deque "
+            "whose append (publish) and popleft (merge) ends are "
+            "single-owner — the SPSC idiom in CPython's atomic deque "
+            "ops; bounded by NET_OUTQ_MAX at the append side"),
+        "txq_dropped": FieldContract(
+            "section:publish",
+            "handoff-full drops: the publisher NEVER blocks or "
+            "bloats on a slow/partitioned network (fail-open, the "
+            "full-shm-mailbox posture)"),
+        # -- merge-side transport state -------------------------------
+        "_sock": FieldContract(
+            "section:merge",
+            "the UDP socket: all sendto/recvfrom on the merge side "
+            "(one thread), so datagram ordering per peer is the "
+            "kernel's, not a race of ours"),
+        "_tx_seq": FieldContract(
+            "section:merge",
+            "per-peer u64 wire sequence (split across two u32 packet "
+            "words; boundary test-pinned)"),
+        "_own_map": FieldContract(
+            "section:merge",
+            "wires this endpoint originated (original f32 bits) — "
+            "the anti-entropy resync re-publishes these verbatim so "
+            "the canonical digest survives the round trip exactly"),
+        "net_map": FieldContract(
+            "section:merge",
+            "the canonical epoch-rebased map (key -> until_wall_us): "
+            "cross-host digest convergence is pinned on this form"),
+        "_rx_state": FieldContract(
+            "section:merge",
+            "per-peer dup-suppression + bounded reorder buffer "
+            "(evict-and-count past NET_REORDER_WINDOW, never stall)"),
+        "_ready": FieldContract(
+            "section:merge",
+            "accepted (rebased) wires staged for pop_wires — both "
+            "ends merge-side"),
+        "_peers_seen": FieldContract(
+            "section:merge",
+            "peer-discovery state: any datagram from a declared peer "
+            "counts as discovery"),
+        "_resync_peers": FieldContract(
+            "section:merge",
+            "peers owed a full-map resync (a HELLO arrived: reboot "
+            "or partition heal)"),
+        "_next_resync": FieldContract(
+            "section:merge", "anti-entropy cadence clock"),
+        "peers": FieldContract(
+            "quiescent-write",
+            "the peer address table: written only at construction/"
+            "add_peer (pre-serving); merge-side reads are stable"),
+        # -- merge-side counters (report reads them quiescent) --------
+        "tx_wires": FieldContract("section:merge", "tx accounting"),
+        "tx_pkts": FieldContract("section:merge", "tx accounting"),
+        "tx_sock_drops": FieldContract(
+            "section:merge",
+            "sendto backpressure/refusal drops: drop-and-count, "
+            "never raise (fail-open)"),
+        "rx_pkts": FieldContract("section:merge", "rx accounting"),
+        "rx_wires": FieldContract("section:merge", "rx accounting"),
+        "rx_dup": FieldContract(
+            "section:merge",
+            "suppressed duplicate deliveries (counted, never "
+            "re-applied)"),
+        "rx_gap": FieldContract(
+            "section:merge",
+            "sequence holes conceded by the bounded reorder buffer "
+            "(loss made countable, never silent)"),
+        "reorder_evict": FieldContract(
+            "section:merge",
+            "wires delivered out of order because the window filled "
+            "(bounded memory, never stall)"),
+        "gap_timeouts": FieldContract(
+            "section:merge",
+            "holes conceded by age (NET_REORDER_TIMEOUT_S): loss "
+            "stops parking its successors"),
+        "rx_alien": FieldContract(
+            "section:merge",
+            "malformed/undeclared-source datagrams (an open UDP port "
+            "hears things)"),
+        "peer_restarts": FieldContract(
+            "section:merge",
+            "far-backward seq jumps read as peer restarts (state "
+            "reset, counted)"),
+        "epoch_skew_dropped": FieldContract(
+            "section:merge",
+            "wires refused for violating RANGE_EPOCH_SKEW_S after "
+            "rebase (a lying epoch must not blacklist anyone)"),
+        "epoch_skew_max": FieldContract(
+            "section:merge",
+            "worst observed post-rebase skew (gauge; feeds the "
+            "net_epoch_skew_max DEGRADED reason)"),
+        "resyncs": FieldContract("section:merge",
+                                 "anti-entropy accounting"),
+        "hellos_rx": FieldContract("section:merge",
+                                   "peer-discovery accounting"),
+        "rx_overflow": FieldContract(
+            "section:merge",
+            "rx staging bound: a consumer slower than the inflow "
+            "drops-and-counts (the resync re-delivers), never grows"),
+        "pruned": FieldContract(
+            "section:merge",
+            "long-expired verdicts dropped from the resync'd own map "
+            "(without it a long-serving engine re-broadcasts every "
+            "key it ever condemned, forever)"),
     },
 )
 
@@ -401,7 +537,7 @@ INGEST_PLAN = ClassPlan(
 )
 
 REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN,
-                                   GOSSIP_PLAN)
+                                   GOSSIP_PLAN, NETMAILBOX_PLAN)
 
 CURSORS: tuple[CursorPlan, ...] = (
     CursorPlan(module="flowsentryx_tpu/engine/shm.py", cls="ShmRing",
@@ -434,9 +570,11 @@ CTL_WRITERS: dict[str, str] = {
     "c_hbeat": "cluster-engine", "c_state": "cluster-engine",
     "c_batches": "cluster-engine", "c_records": "cluster-engine",
     # SUPERVISOR-written: stop request, restart generation, the shared
-    # cluster t0 epoch every gossiped `until` is relative to.
+    # cluster t0 epoch every gossiped `until` is relative to — and its
+    # CLOCK_REALTIME twin, stamped at the same instant, which is what
+    # lets a PEER HOST rebase this host's wires (cluster/transport.py).
     "c_stop": "supervisor", "c_gen": "supervisor",
-    "c_t0": "supervisor",
+    "c_t0": "supervisor", "c_t0_wall": "supervisor",
 }
 
 #: Which side each production module writes from.  Modules not listed

@@ -138,6 +138,77 @@ SUPERVISOR_HEARTBEAT_TIMEOUT_S = 5.0
 RESPAWN_BACKOFF_BASE_S = 0.1
 RESPAWN_BACKOFF_MAX_S = 5.0
 
+# -- multi-host gossip transport (ISSUE 15: cluster/transport.py) -----------
+
+#: Reorder-buffer depth per remote peer (``NetMailbox``): out-of-order
+#: datagrams park here until the sequence hole fills; a buffer past
+#: this depth EVICTS its oldest wire (delivered out of order, counted
+#: ``reorder_evict``) instead of growing — bounded memory, never a
+#: stall.  16 wires ≈ 9 KB/peer covers every reorder depth a same-rack
+#: ECMP/offload path produces (single-digit packets); a hole deeper
+#: than 16 is loss, and waiting on loss is exactly the coordinator
+#: coupling the plane exists to avoid.
+NET_REORDER_WINDOW = 16
+
+#: How long a sequence HOLE may park later wires in the reorder buffer
+#: before the hole is conceded as loss (``rx_gap`` counted, buffered
+#: wires delivered in order).  Genuine in-flight reorder resolves in
+#: sub-ms on a rack; 200 ms is 2-3 orders above that and well under
+#: the 10 s default block TTL, so a lost wire delays its successors'
+#: verdicts imperceptibly instead of parking them until the window
+#: fills.  Waiting longer would be the retransmit coupling a
+#: last-wins, resync-repaired stream does not need.
+NET_REORDER_TIMEOUT_S = 0.2
+
+#: A backward sequence jump deeper than this (in wires) from a peer is
+#: a peer RESTART (its seq space restarted from 1), not a stale
+#: duplicate: the rx state resets and is counted, instead of dropping
+#: every wire of the peer's new life as a "duplicate".  4x the reorder
+#: window keeps genuine late stragglers (bounded by the window by
+#: construction) strictly inside the dup-suppression regime.
+NET_RESTART_JUMP = 4 * NET_REORDER_WINDOW
+
+#: TX handoff queue bound (``NetMailbox.queue_tx``): the engine's sink
+#: section hands wires to the merge-side pump through a deque; past
+#: this depth the PUBLISHER drops-and-counts (``txq_dropped``) rather
+#: than grow without bound — a blocked (or bloating) publisher is the
+#: coordinator coupling the gossip plane exists to avoid, the same
+#: posture as the full shm mailbox.  256 wires ≈ 144 KB and ~1.3 s of
+#: headroom at the 5 ms gossip-tick drain cadence.
+NET_OUTQ_MAX = 256
+
+#: Peer-discovery handshake (``NetMailbox.handshake``): HELLO is
+#: re-sent per silent peer with exponential backoff from BASE doubling
+#: to CAP, bounded by TIMEOUT overall.  BASE at 50 ms is ~100x a
+#: loopback/rack RTT so one lost HELLO costs little; CAP at 1 s keeps
+#: a long wait from hammering a dead address; TIMEOUT at 10 s is the
+#: supervisor heartbeat bound — past it the peer is somebody else's
+#: incident and the caller fails OPEN (serve now, converge when the
+#: peer appears: its first HELLO triggers a full-map resync).
+NET_HANDSHAKE_BACKOFF_BASE_S = 0.05
+NET_HANDSHAKE_BACKOFF_MAX_S = 1.0
+NET_HANDSHAKE_TIMEOUT_S = 10.0
+
+#: Anti-entropy resync cadence (``NetMailbox.pump``): every interval,
+#: each endpoint re-publishes its own full blocked map to every peer —
+#: UDP loss (and a healed partition, where neither side ever died, so
+#: no HELLO fires) is repaired within ONE interval plus delivery.
+#: 0.5 s is two orders of magnitude under the 10 s default block TTL
+#: (a healed partition re-converges while the verdicts still matter)
+#: and the map is TTL-bounded, so the re-publish is a handful of
+#: wires, not a flood.
+NET_RESYNC_INTERVAL_S = 0.5
+
+#: Supervisor federation beacon cadence + death bound
+#: (``cluster/transport.py::HostBeacon``): each host's supervisor
+#: beacons its liveness every interval; a peer host silent past the
+#: timeout is DEAD — its IP span is announced and fleet health folds
+#: FAILED.  The 1 s / 5 s pair mirrors the intra-host heartbeat
+#: discipline (SUPERVISOR_HEARTBEAT_TIMEOUT_S): 5 missed beacons is
+#: far past any GC/throttle pause yet inside one operator glance.
+NET_BEACON_INTERVAL_S = 1.0
+NET_HOST_TIMEOUT_S = 5.0
+
 #: Crash-loop sliding window (``ClusterSupervisor``): only deaths
 #: within this window count against ``max_restarts`` — a rank that
 #: served cleanly for an hour and then crashed is a fresh incident,

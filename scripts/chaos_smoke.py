@@ -8,8 +8,10 @@ invariant verdicts.  Covers the satellite trio explicitly: engine-kill
 (supervised rank SIGKILL + checkpoint respawn), corrupt-checkpoint
 fallback (CRC refusal + loud ``.prev`` restore on a live engine), and
 poisoned-batch quarantine (counted + spooled, drain survives) — plus
-crash-loop parking, gossip stall/flood, clock jumps, and the wedged-
-sink watchdog trip.
+crash-loop parking, gossip stall/flood, clock jumps, the wedged-sink
+watchdog trip, and the six network faults over real loopback UDP
+(partition, heal, reorder, duplication, loss burst, lying epoch —
+ISSUE 15, docs/CLUSTER.md §multi-host).
 
 A campaign failure — any invariant red, any planted regression NOT
 caught by its named invariant — fails the verify run.

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Tier-1 gate for the multi-host gossip transport (docs/CLUSTER.md
+§multi-host): two simulated hosts on loopback, re-proved per verify
+run, writing ``artifacts/NET_r19.json``.
+
+Four sections, each a hard assertion:
+
+* **lossless** — two REAL GossipPlane+NetMailbox stacks with epochs
+  250 s apart exchange verdict streams both ways over real UDP: every
+  wire delivers (zero drops/gaps/dups), the canonical rebased digests
+  converge byte-identically, and a sampled verdict's ABSOLUTE expiry
+  survives the tx-epoch -> rx-epoch rebase within f32 quantization.
+* **partition_heal** — a partition is injected (NetChaos at the real
+  sendto seam), verdicts are published into it and provably lost,
+  the cut is healed, and the anti-entropy resync re-converges the
+  digests within a BOUNDED number of gossip ticks (pinned in the
+  artifact).
+* **federation** — two supervisor HostBeacons exchange liveness; one
+  stops; the survivor detects the death within the timeout.
+* **seq_boundary** — the u64 wire sequence, split across two u32
+  words in both transports' headers, crosses the 2^32 word boundary
+  intact (NetMailbox end-to-end over loopback AND the shm
+  VerdictMailbox twin).
+
+The transport itself is jax-free; the GossipPlane merge path pulls the
+writeback decoder's jax import chain, so the verify gate pins
+JAX_PLATFORMS=cpu.  Fast (~2 s): this is transport discipline, not
+compute.  The two-host loopback harness is THE chaos campaign's
+(``chaos/campaign.py::_net_pair`` — one pair-builder, epoch delta and
+all, so this gate and the network chaos scenarios provably exercise
+the same wiring).
+"""
+
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from flowsentryx_tpu.chaos.campaign import (  # noqa: E402
+    NET_EPOCH_DELTA_S as EPOCH_DELTA_S,
+    _local_now,
+    _net_pair,
+    _nupd as _upd,
+)
+
+OUT = Path(__file__).resolve().parents[1] / "artifacts" / "NET_r19.json"
+
+HEAL_TICK_BOUND = 60
+
+
+def _fail(msg: str) -> None:
+    print(f"net_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _pair(tmp: Path, name: str, k_max: int = 8,
+          resync_s: float = 0.05):
+    return _net_pair(tmp, name, k_max=k_max, resync_s=resync_s)
+
+
+def _digest(plane) -> str:
+    from flowsentryx_tpu.cluster.transport import map_digest
+
+    return map_digest(plane.net.net_map)
+
+
+def _converge(a, b, want_sources: int, bound: int = HEAL_TICK_BOUND):
+    for i in range(bound):
+        a.tick(force=True)
+        b.tick(force=True)
+        if (_digest(a) == _digest(b)
+                and len(a.net.net_map) == want_sources):
+            return i + 1
+        time.sleep(0.01)
+    return None
+
+
+def section_lossless(tmp: Path) -> dict:
+    a, b = _pair(tmp, "lossless", resync_s=1000.0)
+    try:
+        # both directions, multi-wire streams (40 keys = 5 wires at
+        # k_max=8), published in the publisher's OWN epoch
+        a.publish(_upd(a, 1000, 40), now=_local_now(a))
+        b.publish(_upd(b, 5000, 24), now=_local_now(b))
+        ticks = _converge(a, b, 64)
+        if ticks is None:
+            _fail(f"lossless exchange never converged: "
+                  f"{_digest(a)} vs {_digest(b)}")
+        ra, rb = a.net.report(), b.net.report()
+        for side, r in (("A", ra), ("B", rb)):
+            if r["tx_drop"] or r["rx_gap"] or r["rx_dup"]:
+                _fail(f"loopback drain not lossless on {side}: {r}")
+        if ra["net_digest"] != rb["net_digest"]:
+            _fail("digests diverged after clean drain")
+        # rebase exactness: B's key 5000 was published 10 s out on
+        # B's clock; its ABSOLUTE expiry on A must match
+        until_on_a = a.sink.blocked.get(5000)
+        if until_on_a is None:
+            _fail("B's verdict 5000 never reached A's sink")
+        # the true absolute expiry, from B's own map bits
+        bits = b.net._own_map[5000]
+        until_b = float(np.uint32(bits).view(np.float32))
+        abs_err = abs((until_on_a + a.net.t0_wall_ns * 1e-9)
+                      - (until_b + b.net.t0_wall_ns * 1e-9))
+        if abs_err > 0.005:
+            _fail(f"rebased absolute expiry off by {abs_err:.4f}s")
+        return {
+            "wires": {"a_tx": ra["tx_wires"], "b_tx": rb["tx_wires"],
+                      "a_rx": ra["rx_wires"], "b_rx": rb["rx_wires"]},
+            "digest": ra["net_digest"],
+            "sources": ra["net_sources"],
+            "epoch_delta_s": EPOCH_DELTA_S,
+            "rebase_abs_error_s": round(abs_err, 6),
+            "ticks_to_converge": ticks,
+        }
+    finally:
+        a.net.close()
+        b.net.close()
+
+
+def section_partition_heal(tmp: Path) -> dict:
+    from flowsentryx_tpu.chaos.faults import NetChaos
+
+    a, b = _pair(tmp, "heal", resync_s=0.05)
+    try:
+        chaos = NetChaos(a.net)
+        chaos.partition()
+        a.publish(_upd(a, 2000, 12), now=_local_now(a))
+        for _ in range(3):
+            a.tick(force=True)
+            b.tick(force=True)
+        lost = chaos.dropped
+        if not lost or b.net.net_map:
+            _fail(f"partition not effective: lost={lost}, "
+                  f"b_sources={len(b.net.net_map)}")
+        chaos.heal()
+        ticks = _converge(a, b, 12)
+        chaos.uninstall()
+        if ticks is None:
+            _fail(f"digests did not converge within "
+                  f"{HEAL_TICK_BOUND} ticks after heal")
+        return {
+            "wires_lost_in_cut": lost,
+            "ticks_to_converge": ticks,
+            "tick_bound": HEAL_TICK_BOUND,
+            "digest": _digest(a),
+            "resyncs": a.net.report()["resyncs"],
+        }
+    finally:
+        a.net.close()
+        b.net.close()
+
+
+def section_federation() -> dict:
+    from flowsentryx_tpu.cluster.transport import HostBeacon
+
+    wall = time.time_ns()
+    h0 = HostBeacon(0, wall, interval_s=0.05, timeout_s=0.4)
+    h1 = HostBeacon(1, wall, interval_s=0.05, timeout_s=0.4)
+    try:
+        h0.add_peer(1, h1.addr)
+        h1.add_peer(0, h0.addr)
+        deadline = time.monotonic() + 3.0
+        while (h0.report()["peers"]["1"]["age_s"] is None
+               or h1.report()["peers"]["0"]["age_s"] is None):
+            h0.tick()
+            h1.tick()
+            if time.monotonic() > deadline:
+                _fail("federation beacons never established liveness")
+            time.sleep(0.02)
+        if h0.dead_hosts() or h1.dead_hosts():
+            _fail("a beaconing peer reads as dead")
+        alive_age = h0.report()["peers"]["1"]["age_s"]
+        # host 1 dies: host 0 must notice within the timeout (+ slack)
+        h1.close()
+        t0 = time.monotonic()
+        while 1 not in h0.dead_hosts():
+            h0.tick()
+            if time.monotonic() - t0 > 2.0:
+                _fail("dead peer host never detected")
+            time.sleep(0.02)
+        detect_s = time.monotonic() - t0
+        return {
+            "liveness_established": True,
+            "alive_age_s": alive_age,
+            "death_detected_s": round(detect_s, 3),
+            "timeout_s": 0.4,
+        }
+    finally:
+        h0.close()
+        try:
+            h1.close()
+        except OSError:
+            pass
+
+
+def section_seq_boundary(tmp: Path) -> dict:
+    from flowsentryx_tpu.cluster.mailbox import VerdictMailbox
+    from flowsentryx_tpu.cluster.transport import NetMailbox
+
+    # net leg: force the per-peer tx seq to straddle 2^32
+    mono, wall = (time.clock_gettime_ns(time.CLOCK_MONOTONIC),
+                  time.time_ns())
+    # reorder_window=0: the receiver anchors its expectation AT the
+    # first seq (no mid-stream-join grace window), so this section
+    # pins pure u64 split/reassembly with zero gap accounting
+    na = NetMailbox(0, 0, mono, wall, k_max=4, reorder_window=0)
+    nb = NetMailbox(1, 0, mono, wall, k_max=4, reorder_window=0)
+    try:
+        na.add_peer((1, 0), nb.addr)
+        nb.add_peer((0, 0), na.addr)
+        base = (1 << 32) - 2
+        na._tx_seq[(1, 0)] = base
+        now = (time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+               - mono) * 1e-9
+        for j in range(3):
+            wire = np.zeros(2 * 4 + 4, np.uint32)
+            wire[0], wire[4] = 100 + j, np.float32(now + 10).view(
+                np.uint32)
+            wire[8] = 1
+            wire[11] = np.float32(now).view(np.uint32)
+            na.queue_tx(wire, 1)
+            na.pump()
+        time.sleep(0.05)
+        nb.pump()
+        got = nb.pop_wires(8)
+        net_seqs = [seq for _s, seq, *_ in got]
+        want = [base + 1, base + 2, base + 3]
+        if net_seqs != want or nb.rx_gap or nb.rx_dup:
+            _fail(f"NetMailbox u64 seq boundary broke: {net_seqs} != "
+                  f"{want} (gap={nb.rx_gap} dup={nb.rx_dup})")
+    finally:
+        na.close()
+        nb.close()
+    # shm twin: the same split across the VerdictMailbox header words
+    mbx = VerdictMailbox.create(tmp / "bnd.mbx", slots=4, k_max=2)
+    shm_seqs = []
+    for j, seq in enumerate([(1 << 32) - 1, 1 << 32, (1 << 32) + 1]):
+        wire = np.full(2 * 2 + 4, j, np.uint32)
+        assert mbx.publish(wire, seq, 1)
+        [(got_seq, _w)] = mbx.pop_wires(1)
+        shm_seqs.append(got_seq)
+    if shm_seqs != [(1 << 32) - 1, 1 << 32, (1 << 32) + 1]:
+        _fail(f"VerdictMailbox u64 seq boundary broke: {shm_seqs}")
+    return {"net_seqs": net_seqs, "shm_seqs": shm_seqs}
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    tmp = Path(tempfile.mkdtemp(prefix="fsx_net_smoke_"))
+    artifact = {
+        "lossless": section_lossless(tmp),
+        "partition_heal": section_partition_heal(tmp),
+        "federation": section_federation(),
+        "seq_boundary": section_seq_boundary(tmp),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(artifact, indent=2) + "\n")
+    lt = artifact["lossless"]
+    ph = artifact["partition_heal"]
+    print(f"net_smoke: lossless {lt['wires']['a_tx']}+"
+          f"{lt['wires']['b_tx']} wires, digest {lt['digest']}, "
+          f"rebase err {lt['rebase_abs_error_s'] * 1e3:.2f} ms")
+    print(f"net_smoke: partition healed in {ph['ticks_to_converge']} "
+          f"tick(s) (bound {ph['tick_bound']}), "
+          f"{ph['wires_lost_in_cut']} wire(s) lost in the cut")
+    print(f"net_smoke: federation death detected in "
+          f"{artifact['federation']['death_detected_s']}s; u64 seq "
+          f"boundary pinned on both transports")
+    print(f"net_smoke: PASS ({artifact['wall_s']}s) -> {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

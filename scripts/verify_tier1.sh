@@ -147,6 +147,20 @@ echo "== cluster smoke: 2-engine drain + gossip + kill/restart =="
 # scaling evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py || exit 1
 
+echo "== net smoke: multi-host gossip transport on loopback =="
+# The network leg of the gossip plane (docs/CLUSTER.md §multi-host):
+# two simulated hosts with epochs 250 s apart drain verdict streams
+# losslessly over real UDP (digests converge byte-identically on the
+# canonical rebased form; a sampled absolute expiry survives the
+# rebase within f32 quantization), a partition is injected and healed
+# (anti-entropy re-converges within a bounded tick count, pinned),
+# a dead peer host is detected by the federation beacons, and the
+# u64 sequence split crosses the 2^32 word boundary intact on BOTH
+# transports.  ~2 s; rewrites artifacts/NET_r19.json.  (The transport
+# itself is jax-free; the GossipPlane merge path pulls the writeback
+# decoder's jax import chain, hence the cpu pin.)
+env JAX_PLATFORMS=cpu python scripts/net_smoke.py || exit 1
+
 echo "== chaos smoke: seeded fault-injection campaign + planted regressions =="
 # The robustness gate (docs/CHAOS.md): the seeded quick campaign over
 # the REAL stack — supervised rank kill/respawn, crash-loop park with
@@ -154,9 +168,12 @@ echo "== chaos smoke: seeded fault-injection campaign + planted regressions =="
 # on a live engine, shm slot corruption (bad magic/seq gap) skipped
 # and counted, poisoned-batch quarantine (counted + spooled), gossip
 # stall/flood drop accounting, clock jumps, the wedged-sink watchdog
-# trip — every invariant green AND all three planted regressions
-# (split-atomicity, CRC skipped, backoff removed) caught by their
-# named invariants.  Rewrites artifacts/CHAOS_r17.json each run.
+# trip, and the six network faults over real loopback UDP (partition,
+# heal, reorder, duplication, loss burst, lying epoch) — every
+# invariant green AND all five planted regressions (split-atomicity,
+# CRC skipped, backoff removed, dup-suppression removed, epoch-rebase
+# skipped) caught by their named invariants.  Rewrites
+# artifacts/CHAOS_r17.json each run.
 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 
 echo "== latency smoke: seal->verdict plane + SLO degradation =="

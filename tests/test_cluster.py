@@ -85,6 +85,24 @@ class TestVerdictMailbox:
             assert got_seq == seq
             np.testing.assert_array_equal(got, wire)
 
+    def test_u64_seq_split_across_2pow32_boundary(self, tmp_path):
+        """Satellite (ISSUE 15): the u64 seq is split across two u32
+        header words (cell[0]=lo, cell[1]=hi) — pin the split AND the
+        reassembly exactly at the 2^32 word boundary (a lo-word-only
+        regression would alias seq 2^32 to 0 and read a torn-restart
+        gap where there is none)."""
+        mbx = VerdictMailbox.create(tmp_path / "m", slots=4, k_max=2)
+        wire = np.zeros(2 * 2 + 4, np.uint32)
+        for seq in [(1 << 32) - 1, 1 << 32, (1 << 32) + 1,
+                    (1 << 63) + 7]:
+            assert mbx.publish(wire, seq, 0)
+            cell = mbx._cells[(int(mbx._head[0]) - 1)
+                              & (mbx.slots - 1)]
+            assert int(cell[0]) == seq & 0xFFFFFFFF   # lo word
+            assert int(cell[1]) == seq >> 32          # hi word
+            [(got_seq, _w)] = mbx.pop_wires(1)
+            assert got_seq == seq
+
     def test_popped_wire_survives_producer_overwrite(self, tmp_path):
         # pop_wires copies: the returned wire must stay intact when the
         # producer laps the ring over the same slot
@@ -437,6 +455,41 @@ class TestClusterSupervisor:
         assert lat["seal_to_verdict"]["p50"] < 500
         assert set(lat["per_rank_p99"]) == {"0", "1"}
 
+    def test_boot_stamps_wall_epoch_twin(self, tmp_path):
+        # the monotonic epoch's CLOCK_REALTIME twin (ISSUE 15): what a
+        # peer HOST rebases this fleet's verdict wires with — stamped
+        # into every status block next to c_t0
+        sup = self._sup(tmp_path,
+                        [{"stub_serve_s": 0.1}, {"stub_serve_s": 0.1}])
+        sup.boot()
+        agg = sup.run()
+        assert agg["t0_wall_ns"] > 0
+        for r in range(2):
+            st = StatusBlock(status_path(tmp_path / "cl", r))
+            assert st.ctl_get("c_t0_wall") == agg["t0_wall_ns"]
+
+    def test_refusal_names_ranks_ages_and_remediation(self, tmp_path):
+        """Satellite (ISSUE 15): the boot-over-live-plane refusal must
+        tell the operator WHICH ranks are live, HOW fresh their
+        heartbeats are, and WHAT to do — not just that it refused."""
+        d = tmp_path / "cl"
+        create_plane(d, 2)
+        now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        for r, age_s in ((0, 1.0), (1, 3.0)):
+            st = StatusBlock(status_path(d, r))
+            st.ctl_set("c_state", schema.CSTATE_SERVING)
+            st.ctl_set("c_hbeat", now_ns - int(age_s * 1e9))
+        sup = self._sup(tmp_path,
+                        [{"stub_serve_s": 0.1}, {"stub_serve_s": 0.1}])
+        with pytest.raises(RuntimeError) as ei:
+            sup.boot()
+        msg = str(ei.value)
+        assert "rank 0 heartbeated" in msg
+        assert "rank 1 heartbeated" in msg
+        assert "s ago" in msg            # the ages, human-readable
+        assert "Remediation" in msg      # what to actually do
+        assert "fresh directory" in msg
+
     def test_boot_ignores_future_heartbeat_as_stale(self, tmp_path):
         # CLOCK_MONOTONIC restarts at reboot: a persisted plane whose
         # heartbeats are AHEAD of the current clock is a dead fleet,
@@ -598,6 +651,48 @@ class TestClusterCLI:
             ["cluster", "--mega", "2", "--device-loop", "2",
              "--verdict-k", "0"], capsys)
         assert rc == 1 and "--verdict-k 0" in cap.err
+
+    def test_cluster_multi_host_flag_refusals(self, capsys):
+        # the --hosts trio (ISSUE 15), each refusal naming its problem
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:9000,10.0.0.2:9000"],
+            capsys)
+        assert rc == 1 and "--host-id" in cap.err
+        rc, cap = self._run(["cluster", "--host-id", "0"], capsys)
+        assert rc == 1 and "--hosts" in cap.err
+        rc, cap = self._run(
+            ["cluster", "--gossip-listen", "0.0.0.0:9000"], capsys)
+        assert rc == 1 and "--hosts" in cap.err
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:9000,nonsense",
+             "--host-id", "0"], capsys)
+        assert rc == 1 and "not IP:PORT" in cap.err
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:9000", "--host-id", "0"],
+            capsys)
+        assert rc == 1 and "1 host(s)" in cap.err
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:9000,10.0.0.2:9000",
+             "--host-id", "2"], capsys)
+        assert rc == 1 and "not in [0, 2)" in cap.err
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:9000,10.0.0.2:9000",
+             "--host-id", "0", "--gossip-listen", "bad"], capsys)
+        assert rc == 1 and "--gossip-listen" in cap.err
+        # derived engine ports (base+1+r) must fit under 65536 too —
+        # otherwise the "refusal" is a bind crash-loop in a child
+        rc, cap = self._run(
+            ["cluster", "--hosts", "10.0.0.1:65534,10.0.0.2:9000",
+             "--host-id", "0"], capsys)
+        assert rc == 1 and "exceeds 65535" in cap.err
+        # a 1-engine rank of a multi-host fleet is LEGITIMATE: the
+        # --engines >= 2 refusal must not fire before the next check
+        # in line (here: a bogus listen port keeps it jax-free)
+        rc, cap = self._run(
+            ["cluster", "--engines", "1", "--shards", "1",
+             "--hosts", "10.0.0.1:9000,10.0.0.2:9000",
+             "--host-id", "0", "--gossip-listen", "x:0"], capsys)
+        assert rc == 1 and "fsx serve" not in cap.err
 
     def test_serve_cluster_rank_refusals(self, tmp_path, capsys):
         base = ["serve", "--scenario", "benign", "--packets", "64"]

@@ -50,8 +50,9 @@ class TestContractsOnRepo:
                             "wstate", "emit_drop", "spin_us", "idle_us",
                             # cluster status block (PR 10): engine line
                             "c_hbeat", "c_state", "c_batches", "c_records",
-                            # supervisor line
-                            "c_stop", "c_gen", "c_t0"}
+                            # supervisor line (c_t0_wall: ISSUE 15,
+                            # the monotonic epoch's wall twin)
+                            "c_stop", "c_gen", "c_t0", "c_t0_wall"}
         for name in declared:
             if name.startswith("c_"):
                 # cluster status-block fields live in the STATUS_*
@@ -335,6 +336,95 @@ class TestCursorAndCtlViolations:
 # ---------------------------------------------------------------------------
 # the tuning table
 # ---------------------------------------------------------------------------
+
+class TestNetRegistry:
+    """ISSUE 15 satellite: the transport's contracts — owner sections
+    for the NetMailbox (publish=queue_tx only, merge=everything
+    network-facing), the cross-section handoff deque, the epoch-rebase
+    fields, and the c_t0_wall writer side — with one planted negative
+    per new discipline."""
+
+    def test_netmailbox_plan_pins_expected_disciplines(self):
+        plan = contracts.NETMAILBOX_PLAN
+        assert plan.sections["publish"] == ("queue_tx",)
+        assert "pump" in plan.sections["merge"]
+        assert "_accept" in plan.sections["merge"]
+        f = plan.fields
+        assert f["txq_dropped"].discipline == "section:publish"
+        assert f["_outq"].discipline == "documented"
+        for merge_field in ("_sock", "_tx_seq", "_own_map", "net_map",
+                            "_rx_state", "_ready", "epoch_skew_max",
+                            "epoch_skew_dropped", "rx_gap", "rx_dup",
+                            "reorder_evict"):
+            assert f[merge_field].discipline == "section:merge", \
+                merge_field
+        assert f["peers"].discipline == "quiescent-write"
+        # the engine plane registers its net leg
+        assert contracts.GOSSIP_PLAN.fields["net"].discipline \
+            == "documented"
+
+    def test_planted_publish_counter_written_from_merge_side(self):
+        # txq_dropped belongs to the publish section alone: a pump-side
+        # bump would be a second writer racing the sink section
+        src = (
+            "class C:\n"
+            "    def queue_tx(self):\n"
+            "        self._txq += 1\n"
+            "    def pump(self):\n"
+            "        self._txq += 1\n")
+        out = _check(src, _plan(
+            {"_txq": FieldContract("section:publish", "drops")},
+            sections={"publish": ("queue_tx",), "merge": ("pump",)}))
+        assert [f.line for f in out] == [5]
+        assert "publish" in out[0].reason
+
+    def test_planted_canonical_map_written_from_publish_side(self):
+        # net_map (the canonical rebased map) is merge-owned: folding
+        # it at queue_tx time would race the rx fold
+        src = (
+            "class C:\n"
+            "    def queue_tx(self):\n"
+            "        self.net_map[1] = 2\n"
+            "    def pump(self):\n"
+            "        self.net_map[1] = 2\n")
+        out = _check(src, _plan(
+            {"net_map": FieldContract("section:merge",
+                                      "canonical map")},
+            sections={"publish": ("queue_tx",), "merge": ("pump",)}))
+        assert [f.line for f in out] == [3]
+        assert "merge" in out[0].reason
+
+    def test_planted_peer_table_written_while_serving(self):
+        # peers is quiescent-write: a merge-side mutation would race
+        # the publish side's... nothing mechanical guards it but the
+        # quiescent rule — which is exactly what must flag it
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.peers = {}\n"
+            "    def add_peer(self, k, a):\n"
+            "        self.peers[k] = a\n"
+            "    def pump(self):\n"
+            "        self.peers[1] = ('x', 2)\n")
+        out = _check(src, _plan(
+            {"peers": FieldContract("quiescent-write", "peer table")},
+            quiescent=("__init__", "add_peer")))
+        assert [f.line for f in out] == [7]
+
+    def test_repo_netmailbox_obeys_its_plan(self):
+        rep = run_contracts()
+        assert not [f for f in rep.findings
+                    if "transport" in f.path]
+
+    def test_ctl_t0_wall_is_supervisor_written(self):
+        assert contracts.CTL_WRITERS["c_t0_wall"] == "supervisor"
+        # a cluster-engine-side write of the wall epoch would be a
+        # second writer on a supervisor-owned TSO field
+        src = "st.ctl_set('c_t0_wall', 5)\n"
+        out = check_ctl(ast.parse(src), "planted.py",
+                        "cluster-engine")
+        assert len(out) == 1 and "supervisor" in out[0].reason
+
 
 class TestTuningTable:
     def test_engine_and_ingest_reference_the_table(self):

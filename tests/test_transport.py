@@ -1,0 +1,603 @@
+"""The multi-host gossip transport (cluster/transport.py): datagram
+framing, the u64 sequence discipline (dup suppression, bounded
+reorder, gap accounting), epoch rebase + skew bounds, the publish-side
+backpressure posture, handshake/backoff peer discovery, federation
+beacons, and the GossipPlane net-leg integration — all on real
+loopback sockets.
+
+The cross-process choreography (partition/heal convergence, federation
+death detection, the 2^32 boundary end-to-end) is ALSO re-proved per
+verify run by ``scripts/net_smoke.py`` → ``artifacts/NET_r19.json``;
+the six network chaos faults + two planted regressions ride
+``scripts/chaos_smoke.py``."""
+
+import platform
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.engine import health
+from flowsentryx_tpu.engine.writeback import BlacklistUpdate, CollectSink
+from flowsentryx_tpu.cluster.transport import (
+    HostBeacon,
+    NetHandshakeTimeout,
+    NetMailbox,
+    engine_net_mailbox,
+    map_digest,
+    pack_packet,
+    unpack_packet,
+    until_wall_us,
+)
+
+pytestmark = pytest.mark.skipif(
+    platform.system() != "Linux",
+    reason="loopback UDP + CLOCK_MONOTONIC semantics (Linux)")
+
+EPOCH_DELTA_S = 250.0
+
+
+def _clocks(delta_s: float = 0.0):
+    mono = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+    wall = time.time_ns()
+    d = int(delta_s * 1e9)
+    return mono - d, wall - d
+
+
+def _mk_wire(keys, untils, k=4, now=0.0):
+    wire = np.zeros(2 * k + 4, np.uint32)
+    keys = np.asarray(keys, np.uint32)
+    wire[:len(keys)] = keys
+    wire[k:k + len(keys)] = np.asarray(untils, np.float32).view(
+        np.uint32)
+    wire[2 * k] = len(keys)
+    wire[2 * k + 3] = np.float32(now).view(np.uint32)
+    return wire
+
+
+@pytest.fixture()
+def pair():
+    """A (fresh-epoch) and B (epoch 250 s older) on loopback."""
+    mono_a, wall_a = _clocks()
+    mono_b, wall_b = _clocks(EPOCH_DELTA_S)
+    a = NetMailbox(0, 0, mono_a, wall_a, k_max=4)
+    b = NetMailbox(1, 0, mono_b, wall_b, k_max=4)
+    a.add_peer((1, 0), b.addr)
+    b.add_peer((0, 0), a.addr)
+    yield a, b
+    a.close()
+    b.close()
+
+
+def _bnow(b):
+    return (time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+            - b.t0_ns) * 1e-9
+
+
+def _pump_until(mbx, pred, timeout_s=2.0):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        mbx.pump()
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# datagram framing
+# ---------------------------------------------------------------------------
+
+class TestPacket:
+    @pytest.mark.parametrize("seq", [
+        1, (1 << 32) - 1, 1 << 32, (1 << 32) + 1, (1 << 63) + 5])
+    def test_u64_seq_split_roundtrip(self, seq):
+        # the VerdictMailbox header idiom on the wire: u64 across two
+        # u32 words, pinned across the 2^32 word boundary (satellite)
+        wall = time.time_ns()
+        pkt = unpack_packet(pack_packet(
+            schema.NET_KIND_WIRE, 3, 1, seq, 2, wall,
+            _mk_wire([7], [1.0])))
+        assert pkt["seq"] == seq
+        assert pkt["t0_wall_ns"] == wall
+        assert pkt["host"] == 3 and pkt["rank"] == 1
+        assert pkt["count"] == 2
+        assert len(pkt["wire"]) == 2 * 4 + 4
+
+    def test_ctl_packet_has_no_wire(self):
+        pkt = unpack_packet(pack_packet(
+            schema.NET_KIND_HELLO, 0, 0, 0, 0, 123))
+        assert pkt["kind"] == schema.NET_KIND_HELLO
+        assert pkt["wire"] is None
+
+    def test_malformed_rejected(self):
+        assert unpack_packet(b"short") is None
+        assert unpack_packet(b"\0" * 64) is None  # bad magic
+        good = pack_packet(schema.NET_KIND_WIRE, 0, 0, 1, 1,
+                           123, _mk_wire([1], [1.0]))
+        assert unpack_packet(good[:-2]) is None   # torn word
+        # a wire payload that cannot be [2K+4]
+        bad = pack_packet(schema.NET_KIND_WIRE, 0, 0, 1, 1, 123,
+                          np.zeros(5, np.uint32))
+        assert unpack_packet(bad) is None
+
+
+class TestCanonicalForm:
+    def test_until_wall_us_exact_integer_arithmetic(self):
+        bits = np.array([np.float32(12.25).view(np.uint32)], np.uint32)
+        wall = 1_700_000_000_123_456_789
+        [us] = until_wall_us(bits, wall).tolist()
+        assert us == wall // 1000 + 12_250_000
+
+    def test_map_digest_order_insensitive(self):
+        assert (map_digest({1: 10, 2: 20})
+                == map_digest({2: 20, 1: 10}))
+        assert map_digest({1: 10}) != map_digest({1: 11})
+
+
+# ---------------------------------------------------------------------------
+# the mailbox: loopback delivery, rebase, seq discipline
+# ---------------------------------------------------------------------------
+
+class TestNetMailbox:
+    def test_requires_stamped_epoch(self):
+        with pytest.raises(ValueError, match="t0_wall_ns"):
+            NetMailbox(0, 0, 123, 0)
+
+    def test_roundtrip_rebases_into_rx_epoch(self, pair):
+        a, b = pair
+        ln = _bnow(b)
+        b.queue_tx(_mk_wire([101, 202], [ln + 10.0, ln + 12.5],
+                            now=ln), 2)
+        b.pump()
+        assert _pump_until(a, lambda: a.rx_wires == 1)
+        [(src, seq, wire, keys, untils)] = a.pop_wires(4)
+        assert src == (1, 0) and seq == 1
+        assert keys.tolist() == [101, 202]
+        # B's clock reads ~250 s; A's ~0: the rebase subtracts the
+        # epoch delta so the ABSOLUTE expiry is preserved
+        abs_err = abs(
+            (float(untils[0]) + a.t0_wall_ns * 1e-9)
+            - (ln + 10.0 + b.t0_wall_ns * 1e-9))
+        assert abs_err < 0.005
+        # canonical digests converge byte-identically despite the
+        # numerically different local forms
+        assert map_digest(a.net_map) == map_digest(b.net_map)
+
+    def test_duplicate_datagram_suppressed_and_counted(self, pair):
+        a, b = pair
+        ln = _bnow(b)
+        pkt = pack_packet(schema.NET_KIND_WIRE, 1, 0, 1, 1,
+                          b.t0_wall_ns, _mk_wire([7], [ln + 9],
+                                                 now=ln))
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.sendto(pkt, a.addr)
+            sock.sendto(pkt, a.addr)
+        finally:
+            sock.close()
+        assert _pump_until(a, lambda: a.rx_pkts >= 2)
+        assert a.rx_wires == 1 and a.rx_dup == 1
+
+    def test_reorder_restored_within_window(self, pair):
+        a, b = pair
+        ln = _bnow(b)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            for seq in (3, 1, 2):
+                sock.sendto(pack_packet(
+                    schema.NET_KIND_WIRE, 1, 0, seq, 1, b.t0_wall_ns,
+                    _mk_wire([seq], [ln + 9], now=ln)), a.addr)
+                time.sleep(0.002)
+        finally:
+            sock.close()
+        assert _pump_until(a, lambda: a.rx_wires == 3)
+        seqs = [s for _, s, *_ in a.pop_wires(8)]
+        assert seqs == [1, 2, 3]
+        assert a.rx_dup == 0 and a.rx_gap == 0
+
+    def test_window_overflow_evicts_and_counts_never_grows(self):
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4, reorder_window=3)
+        try:
+            a.add_peer((1, 0), ("127.0.0.1", 1))
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                # first seq 20 anchors expectation at 17; 25/30 keep
+                # the hole below them unfilled: the buffer must cap at
+                # 3 and concede-and-count, not grow or stall
+                for seq in (20, 19, 18, 25, 30):
+                    sock.sendto(pack_packet(
+                        schema.NET_KIND_WIRE, 1, 0, seq, 1, wall,
+                        _mk_wire([seq], [9.0])), a.addr)
+                    time.sleep(0.002)
+                    a.pump()
+                    st = a._rx_state[(1, 0)]
+                    assert len(st["buf"]) <= 3
+            finally:
+                sock.close()
+            assert a.reorder_evict >= 1
+            assert a.rx_gap >= 1
+        finally:
+            a.close()
+
+    def test_hole_conceded_at_timeout(self):
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4,
+                       reorder_timeout_s=0.05)
+        try:
+            a.add_peer((1, 0), ("127.0.0.1", 1))
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                for seq in (1, 3):   # 2 is lost forever
+                    sock.sendto(pack_packet(
+                        schema.NET_KIND_WIRE, 1, 0, seq, 1, wall,
+                        _mk_wire([seq], [9.0])), a.addr)
+            finally:
+                sock.close()
+            assert _pump_until(a, lambda: a.rx_wires == 1)
+            assert a.rx_gap == 0          # still hoping for seq 2
+            time.sleep(0.07)
+            a.pump()                      # past the timeout: concede
+            assert a.rx_wires == 2 and a.rx_gap == 1
+            assert a.gap_timeouts == 1
+        finally:
+            a.close()
+
+    def test_queue_tx_backpressure_drops_and_counts(self):
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4, outq_max=2)
+        try:
+            w = _mk_wire([1], [9.0])
+            t0 = time.monotonic()
+            assert a.queue_tx(w, 1) and a.queue_tx(w, 1)
+            assert not a.queue_tx(w, 1)   # full: False, instantly
+            assert time.monotonic() - t0 < 0.1
+            assert a.txq_dropped == 1
+            assert a.report()["tx_drop"] == 1
+        finally:
+            a.close()
+
+    def test_sendto_failure_drops_and_counts_never_raises(self):
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4)
+        try:
+            # an unroutable/invalid destination: the send seam must
+            # fail open (drop-and-count), never raise into the tick
+            a.add_peer((1, 0), ("255.255.255.255", 1))
+            a.queue_tx(_mk_wire([1], [9.0]), 1)
+            a.pump()
+            assert a.tx_sock_drops >= 1
+            assert a.report()["tx_drop"] >= 1
+        finally:
+            a.close()
+
+    def test_stale_epoch_refused_and_gauged(self, pair):
+        a, b = pair
+        # a peer whose stamp lies by an hour: refused, counted, gauged
+        bogus_wall = b.t0_wall_ns - int(3600 * 1e9)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.sendto(pack_packet(
+                schema.NET_KIND_WIRE, 1, 0, 1, 1, bogus_wall,
+                _mk_wire([7], [10.0], now=0.0)), a.addr)
+        finally:
+            sock.close()
+        assert _pump_until(a, lambda: a.rx_pkts >= 1)
+        assert a.epoch_skew_dropped == 1
+        assert a.rx_wires == 0 and not a.net_map
+        assert a.epoch_skew_max > schema.RANGE_EPOCH_SKEW_S
+
+    def test_hello_resets_peer_and_queues_resync(self, pair):
+        a, b = pair
+        ln = _bnow(b)
+        b.queue_tx(_mk_wire([42], [ln + 9], now=ln), 1)
+        b.pump()
+        assert _pump_until(a, lambda: a.rx_wires == 1)
+        # B "reboots": its HELLO must reset A's seq expectation and
+        # trigger a full-map resync back to it
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.sendto(pack_packet(
+                schema.NET_KIND_HELLO, 1, 0, 0, 0, b.t0_wall_ns),
+                a.addr)
+            assert _pump_until(a, lambda: a.hellos_rx == 1)
+        finally:
+            sock.close()
+        assert (1, 0) not in a._rx_state  # sequence space reset
+        assert a.resyncs >= 0  # resync queued (fires on this pump)
+
+    def test_handshake_discovers_peers_with_backoff(self, pair):
+        a, b = pair
+        deadline = time.monotonic() + 5.0
+        done_a = False
+        # drive both sides from one thread: a's handshake slices are
+        # interleaved with b pumps (b's WELCOME answers the HELLOs)
+        while not done_a and time.monotonic() < deadline:
+            try:
+                a.handshake(timeout_s=0.05)
+                done_a = True
+            except NetHandshakeTimeout:
+                b.pump()
+        assert done_a
+        b.pump()
+        assert (0, 0) in b._peers_seen  # a's HELLO discovered it too
+
+    def test_spoofed_source_address_rejected(self):
+        # a datagram claiming a registered endpoint must arrive FROM
+        # that endpoint's registered host address — a misconfigured
+        # process on another box cannot impersonate a peer (or reset
+        # its dup-suppression state with a forged HELLO)
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4)
+        try:
+            a.add_peer((1, 0), ("10.9.9.9", 9))
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                for kind in (schema.NET_KIND_WIRE,
+                             schema.NET_KIND_HELLO):
+                    sock.sendto(pack_packet(
+                        kind, 1, 0, 1, 1, wall,
+                        _mk_wire([7], [9.0])), a.addr)
+            finally:
+                sock.close()
+            assert _pump_until(a, lambda: a.rx_alien == 2)
+            assert a.rx_wires == 0 and a.hellos_rx == 0
+            assert not a.net_map
+        finally:
+            a.close()
+
+    def test_resync_prunes_long_expired_verdicts(self):
+        # without pruning, a long-serving engine re-broadcasts every
+        # key it ever condemned on every anti-entropy interval
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4,
+                       resync_interval_s=0.0)
+        try:
+            ln = 0.0
+            # one verdict expired far beyond the grace window, one live
+            dead_until = ln - schema.RANGE_EPOCH_SKEW_S - 5.0
+            a.queue_tx(_mk_wire([1, 2], [dead_until, ln + 10.0],
+                                now=ln), 2)
+            a.pump()   # folds into _own_map, then the due resync prunes
+            assert 1 not in a._own_map and 2 in a._own_map
+            assert 1 not in a.net_map and 2 in a.net_map
+            assert a.pruned == 1
+        finally:
+            a.close()
+
+    def test_rx_staging_bounded_drops_and_counts(self, pair):
+        a, b = pair
+        ln = _bnow(b)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            from flowsentryx_tpu.sync import tuning
+
+            # two waves with pumps between (one burst larger than the
+            # staging bound would first hit the kernel rcvbuf): the
+            # staging deque must cap at NET_OUTQ_MAX and drop-count
+            seq = 0
+            deadline = time.monotonic() + 5.0
+            while a.rx_overflow == 0:
+                for _ in range(160):
+                    seq += 1
+                    sock.sendto(pack_packet(
+                        schema.NET_KIND_WIRE, 1, 0, seq, 1,
+                        b.t0_wall_ns,
+                        _mk_wire([seq], [ln + 9], now=ln)), a.addr)
+                a.pump()
+                assert time.monotonic() < deadline, \
+                    f"no overflow after {a.rx_pkts} pkts"
+                time.sleep(0.002)
+            assert len(a._ready) <= tuning.NET_OUTQ_MAX
+            # the canonical map still took every delivered entry —
+            # nothing is silently lost, the resync re-delivers
+            assert len(a.net_map) == a.rx_wires
+        finally:
+            sock.close()
+
+    def test_hello_resync_neither_shadows_nor_postpones_periodic(self):
+        # a HELLO-triggered resync serves only the (re)appeared peer
+        # and must not consume the periodic deadline — otherwise a
+        # host mid-handshake with peer C postpones the loss repair
+        # every OTHER peer's one-interval bound promises
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4,
+                       resync_interval_s=1000.0)
+        try:
+            a.add_peer((1, 0), ("127.0.0.1", 1))
+            a.add_peer((2, 0), ("127.0.0.1", 2))
+            a.queue_tx(_mk_wire([5], [10.0], now=0.0), 1)
+            a.pump()   # drain: one wire to each peer
+            assert a._tx_seq == {(1, 0): 1, (2, 0): 1}
+            deadline_before = a._next_resync
+            a._resync_peers.add((1, 0))   # peer 1 HELLO'd
+            a.pump()
+            # only the hello peer got the resync, and the periodic
+            # deadline was NOT pushed out
+            assert a._tx_seq == {(1, 0): 2, (2, 0): 1}
+            assert a._next_resync == deadline_before
+            # a due periodic includes every peer even with a HELLO
+            # pending
+            a._resync_peers.add((1, 0))
+            a._next_resync = 0.0
+            a.pump()
+            assert a._tx_seq == {(1, 0): 3, (2, 0): 2}
+        finally:
+            a.close()
+
+    def test_handshake_timeout_names_silent_peer(self):
+        mono, wall = _clocks()
+        a = NetMailbox(0, 0, mono, wall, k_max=4)
+        try:
+            a.add_peer((2, 1), ("127.0.0.1", 1))  # nobody home
+            with pytest.raises(NetHandshakeTimeout,
+                               match="h2r1"):
+                a.handshake(timeout_s=0.15)
+        finally:
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# federation beacons
+# ---------------------------------------------------------------------------
+
+class TestHostBeacon:
+    def test_liveness_then_death_detected(self):
+        wall = time.time_ns()
+        h0 = HostBeacon(0, wall, interval_s=0.03, timeout_s=0.3)
+        h1 = HostBeacon(1, wall, interval_s=0.03, timeout_s=0.3)
+        try:
+            h0.add_peer(1, h1.addr)
+            h1.add_peer(0, h0.addr)
+            deadline = time.monotonic() + 3.0
+            while (h0.report()["peers"]["1"]["age_s"] is None
+                   or h1.report()["peers"]["0"]["age_s"] is None):
+                h0.tick()
+                h1.tick()
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert not h0.dead_hosts() and not h1.dead_hosts()
+            h1.close()
+            t0 = time.monotonic()
+            while 1 not in h0.dead_hosts():
+                h0.tick()
+                assert time.monotonic() - t0 < 2.0
+                time.sleep(0.01)
+        finally:
+            h0.close()
+            try:
+                h1.close()
+            except OSError:
+                pass
+
+    def test_never_heard_peer_is_dead_after_grace(self):
+        h = HostBeacon(0, time.time_ns(), timeout_s=0.05)
+        try:
+            h.add_peer(1, ("127.0.0.1", 1))
+            time.sleep(0.07)
+            assert h.dead_hosts() == [1]
+        finally:
+            h.close()
+
+
+# ---------------------------------------------------------------------------
+# GossipPlane integration + spec derivation + health surfacing
+# ---------------------------------------------------------------------------
+
+class TestGossipPlaneNet:
+    def _planes(self, tmp_path):
+        from flowsentryx_tpu.cluster.gossip import (
+            GossipPlane, create_plane,
+        )
+
+        mono_a, wall_a = _clocks()
+        mono_b, wall_b = _clocks(EPOCH_DELTA_S)
+        na = NetMailbox(0, 0, mono_a, wall_a, k_max=4)
+        nb = NetMailbox(1, 0, mono_b, wall_b, k_max=4)
+        na.add_peer((1, 0), nb.addr)
+        nb.add_peer((0, 0), na.addr)
+        planes = []
+        for h, net in ((0, na), (1, nb)):
+            create_plane(tmp_path / f"h{h}", 1, k_max=4, net=True)
+            planes.append(GossipPlane(
+                tmp_path / f"h{h}", 0, 1, sink=CollectSink(),
+                merge_interval_s=0.0, net=net))
+        return planes
+
+    def test_cross_host_block_reaches_peer_sink_rebased(
+            self, tmp_path):
+        a, b = self._planes(tmp_path)
+        try:
+            ln = _bnow(b.net)
+            b.publish(BlacklistUpdate(
+                key=np.array([101], np.uint32),
+                until_s=np.array([ln + 10.0], np.float32)), now=ln)
+            b.tick(force=True)
+            deadline = time.monotonic() + 2.0
+            while not a.sink.blocked:
+                a.tick(force=True)
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            until_a = a.sink.blocked[101]
+            # rebased ~10 s out on A's clock, not ~260
+            assert 5.0 < until_a < 15.0
+            ra, rb = a.report(), b.report()
+            assert ra["net"]["net_digest"] == rb["net"]["net_digest"]
+            # intra-host shm digests are untouched by the net leg
+            assert ra["merged_digest"] == GossipPlane_digest_empty()
+        finally:
+            a.net.close()
+            b.net.close()
+
+    def test_single_host_report_has_no_net_key(self, tmp_path):
+        from flowsentryx_tpu.cluster.gossip import (
+            GossipPlane, create_plane,
+        )
+
+        create_plane(tmp_path, 2)
+        p = GossipPlane(tmp_path, 0, 2)
+        assert "net" not in p.report()
+
+    def test_single_engine_plane_requires_net(self, tmp_path):
+        from flowsentryx_tpu.cluster.gossip import (
+            GossipPlane, create_plane,
+        )
+
+        with pytest.raises(ValueError, match=">= 2 engines"):
+            create_plane(tmp_path / "x", 1)
+        create_plane(tmp_path / "y", 1, net=True)
+        with pytest.raises(ValueError, match="network leg"):
+            GossipPlane(tmp_path / "y", 0, 1)
+
+    def test_engine_net_mailbox_port_and_peer_derivation(self):
+        spec = {"hosts": [["127.0.0.1", 39100], ["127.0.0.1", 39200]],
+                "host_id": 0, "engines_per_host": 2, "listen": None}
+        mono, wall = _clocks()
+        m = engine_net_mailbox(spec, rank=1, t0_ns=mono,
+                               t0_wall_ns=wall)
+        try:
+            assert m.addr[1] == 39100 + 1 + 1
+            assert m.peers == {(1, 0): ("127.0.0.1", 39201),
+                               (1, 1): ("127.0.0.1", 39202)}
+        finally:
+            m.close()
+
+
+def GossipPlane_digest_empty():
+    from flowsentryx_tpu.cluster.gossip import GossipPlane
+
+    return GossipPlane._digest({})
+
+
+class TestHealthNet:
+    def test_net_counters_are_degraded_reasons(self):
+        h = health.engine_health(gossip={
+            "tx_dropped": 0, "rx_seq_gaps": 0,
+            "net": {"tx_drop": 3, "rx_gap": 2, "rx_dup": 1,
+                    "reorder_evict": 4, "epoch_skew_dropped": 2,
+                    "epoch_skew_max": 301.25},
+        })
+        assert h["state"] == health.DEGRADED
+        assert set(h["reasons"]) == {
+            "net_tx_drop:3", "net_rx_gap:2", "net_rx_dup:1",
+            "net_reorder_evict:4", "net_epoch_skew_dropped:2",
+            "net_epoch_skew_max:301.25"}
+
+    def test_clean_net_block_stays_healthy(self):
+        h = health.engine_health(gossip={
+            "tx_dropped": 0,
+            "net": {"tx_drop": 0, "rx_gap": 0, "rx_dup": 0,
+                    "reorder_evict": 0, "epoch_skew_dropped": 0,
+                    "epoch_skew_max": 0.004},
+        })
+        assert h["state"] == health.HEALTHY
+
+    def test_dead_host_folds_cluster_failed(self):
+        agg = health.cluster_health(
+            {0: {"state": "healthy", "reasons": []}}, [], [],
+            dead_hosts=[1])
+        assert agg["state"] == health.FAILED
+        assert "hosts_dead:1" in agg["reasons"]
