@@ -106,6 +106,28 @@ FAULTS: dict[str, tuple[str, str]] = {
         "reboot t0_wall); the rebased skew bound (RANGE_EPOCH_SKEW_S) "
         "must refuse-and-count them — a broken clock must never "
         "blacklist anyone at the wrong time"),
+    # -- elastic-fleet faults (ISSUE 16: live shard rebalancing) ------------
+    "handoff_kill_midship": (
+        "rebalance-interrupt",
+        "SIGKILL the donor mid-stream while it ships a shard span "
+        "over the handoff mailbox; the recipient must refuse the "
+        "unsealed stream (no STAGED ack, nothing inserted) and the "
+        "donor's copy must still account every row exactly — the "
+        "exact-conservation invariant at the worst interruption "
+        "point"),
+    "layout_flip_lost": (
+        "rebalance-flip",
+        "one rank never observes the committed layout generation "
+        "(its flip 'message' lost); the handoff fence must NOT lift "
+        "until every active rank acks the new generation — a "
+        "partially-flipped fleet never serves a split route"),
+    "adopt_half_dead": (
+        "supervisor-adopt",
+        "a replacement supervisor re-attaches (boot(adopt=True)) to "
+        "a plane whose ranks are half dead; the adopt census must "
+        "classify live/dead correctly, respawn ONLY the dead rank "
+        "from its checkpoint, and never attach a second consumer to "
+        "a span a live rank still drains"),
 }
 
 

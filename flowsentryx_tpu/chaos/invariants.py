@@ -63,6 +63,18 @@ Catalog (one line each; the scenario docstrings carry the detail):
 * ``epoch_rebase_exact`` — a rebased verdict's ABSOLUTE expiry equals
   the originator's (within f32 quantization): the tx-epoch ->
   rx-epoch rebase loses no time.
+* ``handoff_rows_conserved`` — a live shard handoff interrupted at
+  ANY step loses no row and double-counts no row: the pre-handoff
+  row multiset equals the post-state multiset exactly, with no key
+  resident in two tables (cluster/rebalance.py ``rows_conserved``).
+* ``layout_flip_converges`` — a committed layout-generation flip
+  holds its fence until EVERY active rank has acked the new
+  generation; a rank that missed the flip message stalls the fence,
+  never splits the route.
+* ``adopt_no_second_consumer`` — a supervisor adopting a live plane
+  never spawns a second consumer for a span a live rank still
+  drains: live ranks adopt untouched, only confirmed-dead ranks
+  respawn.
 """
 
 from __future__ import annotations

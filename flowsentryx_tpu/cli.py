@@ -1509,20 +1509,56 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
         print(f"fsx cluster: --engines must be >= 1 (got "
               f"{args.engines})", file=sys.stderr)
         return 1
-    if args.shards < args.engines:
+    # Elastic-fleet shape (docs/CLUSTER.md §elastic): the plane is
+    # PROVISIONED at --max-engines (rings, status blocks, mailboxes
+    # all pre-exist) and only --engines of them spawn at boot — the
+    # autoscaler grows/shrinks the live set inside that envelope, so
+    # total_shards = max * W never changes and every reshape is a
+    # pure ownership flip.
+    if (args.min_engines is not None or args.max_engines is not None) \
+            and not args.elastic:
+        print("fsx cluster: --min-engines/--max-engines require "
+              "--elastic (they bound the autoscaler's live-rank "
+              "envelope)", file=sys.stderr)
+        return 1
+    if args.elastic and args.hosts:
+        print("fsx cluster: --elastic is single-host for now (the "
+              "handoff mailbox and fence protocol ride the shm "
+              "plane; cross-host handoff coordination is a "
+              "documented follow-up — docs/CLUSTER.md §elastic)",
+              file=sys.stderr)
+        return 1
+    provision = args.engines
+    if args.elastic:
+        provision = args.max_engines or max(args.engines + 1,
+                                            args.engines)
+        if provision < args.engines:
+            print(f"fsx cluster: --max-engines {provision} < "
+                  f"--engines {args.engines}: the initial live set "
+                  "cannot exceed the provisioned envelope",
+                  file=sys.stderr)
+            return 1
+        if (args.min_engines or 1) > args.engines:
+            print(f"fsx cluster: --min-engines {args.min_engines} > "
+                  f"--engines {args.engines}: the fleet would boot "
+                  "already below its floor", file=sys.stderr)
+            return 1
+    if args.shards < provision:
         print(f"fsx cluster: --shards {args.shards} cannot feed "
-              f"--engines {args.engines}: every engine needs at "
-              "least one ring shard to drain (pair with fsxd "
+              f"{provision} provisioned engines: every engine needs "
+              "at least one ring shard to drain (pair with fsxd "
               "--shards N*W)", file=sys.stderr)
         return 1
-    if args.shards % args.engines:
+    if args.shards % provision:
         print(f"fsx cluster: --shards {args.shards} is not a multiple "
-              f"of --engines {args.engines}: each engine owns an "
-              "equal contiguous span of the ring-shard fan-out "
-              "(rank r drains shards [r*W, (r+1)*W), W = shards/"
-              "engines)", file=sys.stderr)
+              f"of {provision} (the provisioned engine count: "
+              "--max-engines under --elastic, --engines otherwise): "
+              "each engine owns an equal contiguous span of the "
+              "ring-shard fan-out (rank r drains shards "
+              "[r*W, (r+1)*W), W = shards/provisioned)",
+              file=sys.stderr)
         return 1
-    w = args.shards // args.engines
+    w = args.shards // provision
     if args.checkpoint:
         # validate by FORMATTING, not substring: '{rank:02d}' is a
         # fine placeholder, '{host}' is a KeyError waiting to fire
@@ -1679,12 +1715,12 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
 
     cluster_dir = args.cluster_dir or f"{args.feature_ring}.cluster"
     specs = []
-    for r in range(args.engines):
+    for r in range(provision):
         specs.append({
             # the per-core deployment shape (runner.pin_core_for):
             # rank r owns core r when the fleet fits the host, with
             # the XLA pool sized to match
-            "pin_core": pin_core_for(r, args.engines, args.pin_cores),
+            "pin_core": pin_core_for(r, provision, args.pin_cores),
             "cfg_json": cfg.to_json(),
             "ring_base": args.feature_ring,
             "workers": w,
@@ -1699,11 +1735,19 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
                            if args.checkpoint else None),
             "checkpoint_every": args.checkpoint_every,
         })
+    policy = None
+    if args.elastic:
+        from flowsentryx_tpu.cluster.elastic import ElasticPolicy
+
+        policy = ElasticPolicy(min_engines=args.min_engines or 1,
+                               max_engines=provision)
     sup = ClusterSupervisor(cluster_dir, specs,
                             max_restarts=args.max_restarts,
-                            net=netspec)
+                            net=netspec, elastic=policy,
+                            n_live=(args.engines if args.elastic
+                                    else None))
     try:
-        sup.boot()
+        sup.boot(adopt=args.adopt)
     except RuntimeError as e:
         # e.g. a live fleet already owns this plane (booting over it
         # would truncate mmaps under its serving engines)
@@ -1714,6 +1758,9 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
         net_note = (f", host {netspec['host_id']} of "
                     f"{len(netspec['hosts'])} (UDP gossip + "
                     "federation beacons)")
+    if args.elastic:
+        net_note += (f", elastic "
+                     f"[{args.min_engines or 1}, {provision}]")
     print(f"fsx cluster: {args.engines} engines x {w} worker(s), "
           f"shards 0..{args.shards - 1}, gossip plane {cluster_dir}"
           f"{net_note}", file=sys.stderr)
@@ -1809,6 +1856,7 @@ def _merged_engine_health(globs: list, reports: list | None = None) -> dict:
 
     per_report: dict = {}
     states: list[str] = []
+    rebalance_totals: dict = {}
     for path, doc, err in (reports if reports is not None
                            else _iter_engine_reports(globs)):
         if err is not None:
@@ -1848,13 +1896,26 @@ def _merged_engine_health(globs: list, reports: list | None = None) -> dict:
                               "epoch_skew_dropped", "epoch_skew_max",
                               "net_digest")
                 }
+        rb = rep.get("rebalance")
+        if rb:
+            # live-handoff / adoption accounting (cluster/
+            # rebalance.py): per-rank here, summed below — "did rows
+            # move, and did any fall off the happy path" is the same
+            # one query as the health ladder
+            entry["rebalance"] = rb
+            for k, v in rb.items():
+                if isinstance(v, int):
+                    rebalance_totals[k] = rebalance_totals.get(k, 0) + v
         per_report[path] = entry
         if h.get("state"):
             states.append(h["state"])
-    return {
+    out = {
         "state": (health_mod.worst(*states) if states else None),
         "reports": per_report,
     }
+    if rebalance_totals:
+        out["rebalance"] = rebalance_totals
+    return out
 
 
 def _cmd_status(args: argparse.Namespace) -> int:
@@ -2017,9 +2078,24 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
                     reasons = sorted({
                         r for e in hl["reports"].values()
                         for r in e.get("reasons", [])})
-                    alerts.append(
-                        f"engine health {hl['state'].upper()}: "
-                        + (", ".join(reasons) or "rank-level failure"))
+                    # the elastic fleet's reshaping friction gets its
+                    # own alert line (cluster/rebalance.py counters:
+                    # refused handoff streams, discarded stages,
+                    # suppressed autoscale plans...) so an operator
+                    # can tell "serving is degraded" from "reshaping
+                    # is degraded" without decoding reason prefixes
+                    reshape = [r for r in reasons if r.startswith(
+                        ("rebalance_", "elastic_"))]
+                    steady = [r for r in reasons if r not in reshape]
+                    if steady or not reshape:
+                        alerts.append(
+                            f"engine health {hl['state'].upper()}: "
+                            + (", ".join(steady)
+                               or "rank-level failure"))
+                    if reshape:
+                        alerts.append(
+                            f"fleet reshaping {hl['state'].upper()}: "
+                            + ", ".join(reshape))
             if prev is not None and "error" not in stats:
                 dt = max(t - prev_t, 1e-9)
                 rec["per_s"] = {
@@ -2836,6 +2912,34 @@ def build_parser() -> argparse.ArgumentParser:
                          "1-thread XLA pool (auto: only when the "
                          "fleet fits the host's cores; the per-core "
                          "deployment shape, docs/CLUSTER.md)")
+    cl.add_argument("--elastic", action="store_true",
+                    help="self-reshaping fleet: provision the plane "
+                         "at --max-engines, boot --engines of them "
+                         "live, and let the autoscaler grow/shrink/"
+                         "rebalance via live shard handoffs "
+                         "(hysteresis + cooldown; every decision "
+                         "logged with its signal vector — "
+                         "docs/CLUSTER.md §elastic)")
+    cl.add_argument("--min-engines", type=int, default=None,
+                    metavar="N",
+                    help="autoscaler floor: never shrink the live "
+                         "set below N engines (requires --elastic; "
+                         "default 1)")
+    cl.add_argument("--max-engines", type=int, default=None,
+                    metavar="N",
+                    help="autoscaler ceiling AND the provisioned "
+                         "plane size: rings/status blocks/mailboxes "
+                         "for N ranks exist from boot so growth is "
+                         "spawn-only (requires --elastic; default "
+                         "--engines + 1; --shards must divide by it)")
+    cl.add_argument("--adopt", action="store_true",
+                    help="re-attach to a LIVE plane instead of "
+                         "refusing it: census the ranks from their "
+                         "status blocks (serving ranks keep serving "
+                         "un-respawned; dead ranks respawn; their "
+                         "spans can be adopted by survivors via "
+                         "checkpoint-sourced handoffs — docs/"
+                         "CLUSTER.md §elastic)")
     cl.set_defaults(fn=_cmd_cluster)
 
     tp = sub.add_parser("top", help="per-IP kernel table, formatted")
@@ -2871,8 +2975,10 @@ def build_parser() -> argparse.ArgumentParser:
     mo.add_argument("--alert-degraded", action="store_true",
                     help="alert when any merged engine report's "
                          "health ladder reads DEGRADED or FAILED, "
-                         "naming the reasons (requires "
-                         "--engine-report; docs/CHAOS.md §health)")
+                         "naming the reasons; rebalance_*/elastic_* "
+                         "reshaping reasons get their own alert line "
+                         "(requires --engine-report; docs/CHAOS.md "
+                         "§health, docs/CLUSTER.md §elastic)")
     mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")
@@ -2887,7 +2993,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "glob (fsx serve output, or a cluster dir's "
                          "report_r*_g*.json) into one seal->verdict "
                          "latency block (HDR bucket merge; "
-                         "repeatable)")
+                         "repeatable) plus the health ladder with "
+                         "per-rank and summed handoff/adoption "
+                         "counters (docs/CLUSTER.md §elastic)")
     st.set_defaults(fn=_cmd_status)
 
     pc = sub.add_parser("pcap", help="convert a capture to flow records")

@@ -180,10 +180,15 @@ class StatusBlock:
         "c_state": schema.STATUS_STATE_OFFSET,
         "c_batches": schema.STATUS_BATCHES_OFFSET,
         "c_records": schema.STATUS_RECORDS_OFFSET,
+        "c_pid": schema.STATUS_PID_OFFSET,
+        "c_handoff": schema.STATUS_HANDOFF_OFFSET,
+        "c_layout_ack": schema.STATUS_LAYOUT_ACK_OFFSET,
         "c_stop": schema.STATUS_STOP_OFFSET,
         "c_gen": schema.STATUS_GEN_OFFSET,
         "c_t0": schema.STATUS_T0_OFFSET,
         "c_t0_wall": schema.STATUS_T0_WALL_OFFSET,
+        "c_layout_gen": schema.STATUS_LAYOUT_GEN_OFFSET,
+        "c_fence": schema.STATUS_FENCE_OFFSET,
     }
 
     def __init__(self, path: str | Path):

@@ -114,6 +114,10 @@ def engine_main(spec: dict) -> int:
                                  spec["t0_ns"], spec["t0_wall_ns"])
     plane = GossipPlane(spec["cluster_dir"], spec["rank"],
                         spec["n_engines"], net=net)
+    # pid in the status block: the adopt path's liveness probe
+    # (``boot(adopt=True)`` judges an unowned rank by os.kill(pid, 0)
+    # + heartbeat freshness — a proc handle it never had can't help)
+    plane.status.ctl_set("c_pid", os.getpid())
     plane.set_state(schema.CSTATE_SPAWNING)
     try:
         _serve(spec, plane)
@@ -176,6 +180,17 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
     restore_info = None
     if spec.get("restore"):
         restore_info = eng.restore(spec["restore"])
+    # live-rebalance hooks (cluster/rebalance.py): boot-time reconcile
+    # first — adopt a committed-but-uninserted staged spool and drop
+    # rows the committed layout says this rank no longer owns (the two
+    # post-flip death windows) — then step the handoff state machine
+    # between run chunks below, where the engine is quiescent.
+    from flowsentryx_tpu.cluster.rebalance import EngineRebalancer
+
+    rebalancer = EngineRebalancer(
+        spec["cluster_dir"], rank, plane.status,
+        crash_midship=bool(spec.get("handoff_crash_midship")))
+    reconciled = rebalancer.reconcile(eng)
     eng.warm()
     if spec.get("ready_token"):
         Path(spec["ready_token"]).touch()
@@ -218,6 +233,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         while True:
             rep = eng.run(max_seconds=chunk_s)
             plane.note_progress(rep.batches, rep.records)
+            rebalancer.step(eng)
             if next_ckpt is not None and time.monotonic() >= next_ckpt:
                 eng.checkpoint(ckpt)
                 next_ckpt = time.monotonic() + every
@@ -270,6 +286,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         out = {
             "rank": rank, "n_engines": n, "gen": spec.get("gen", 0),
             "restored": restore_info,
+            "reconciled": reconciled,
             "report": rep._asdict(),
         }
         p = Path(spec["report_path"])
@@ -288,6 +305,7 @@ def stub_engine_main(spec: dict) -> int:
     _own_process_group()
     plane = GossipPlane(spec["cluster_dir"], spec["rank"],
                         spec["n_engines"])
+    plane.status.ctl_set("c_pid", os.getpid())  # adopt-path liveness
     plane.set_state(schema.CSTATE_SPAWNING)
     gen = spec.get("gen", 0)
     crash_after = spec.get("stub_crash_after_s")

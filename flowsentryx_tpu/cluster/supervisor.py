@@ -47,6 +47,20 @@ from flowsentryx_tpu.engine.metrics import LatencyHist
 from flowsentryx_tpu.sync import tuning
 
 
+def _pid_alive(pid: int) -> bool:
+    """Liveness of a process this supervisor never spawned (adopted
+    ranks): signal 0 probes existence without touching it."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, different uid
+    return True
+
+
 class ClusterSupervisor:
     """Supervise ``len(specs)`` engine processes (module docstring).
 
@@ -74,6 +88,8 @@ class ClusterSupervisor:
         t0_ns: int | None = None,
         t0_wall_ns: int | None = None,
         net: dict | None = None,
+        elastic=None,
+        n_live: int | None = None,
     ):
         if len(specs) < 2 and net is None:
             raise ValueError(
@@ -124,19 +140,65 @@ class ClusterSupervisor:
         self._stalled: set[int] = set()
         self._booted = False
         self._stop_sent = False
+        # -- elastic fleet (ISSUE 16; cluster/rebalance.py+elastic.py)
+        #: Autoscaling policy (cluster/elastic.py ElasticPolicy) or
+        #: None for a fixed fleet.  The plane is provisioned at
+        #: ``len(specs)`` ( = max_engines) so a grow is JUST a spawn:
+        #: status blocks, mailboxes and ring files for every possible
+        #: rank exist from boot; mailboxes to unspawned ranks fill and
+        #: drop (counted), the universal fail-open posture.
+        self._elastic = elastic
+        #: Ranks this supervisor currently runs.  run()/poll() judge
+        #: completion against this set, not ``range(n)`` — parked
+        #: (shrunk) ranks leave it without counting as failed.
+        self._active: set[int] = set(range(
+            self.n if n_live is None else max(1, min(n_live, self.n))))
+        #: Ranks adopted live from a previous supervisor
+        #: (boot(adopt=True)): no proc handle — poll() judges them by
+        #: os.kill(c_pid, 0) + heartbeat freshness instead.
+        self._adopted: set[int] = set()
+        #: The ONE in-flight handoff (serialized fleet-wide: the flip
+        #: rule's "every rank converges before the fence lifts" is a
+        #: statement about a single layout generation at a time).
+        self._handoff: dict | None = None
+        self._handoff_seq = 0
+        self.rebalance_counters = {
+            "rows_shipped": 0, "flips": 0, "fences": 0, "aborts": 0,
+            "adoptions": 0}
+        self.adopted_spans: list[dict] = []
+        self.elastic_executed = 0
+        self._elastic_next = 0.0
+        self._pending_grow: dict | None = None
+        self._pending_shrink: dict | None = None
+        self._shrunk: set[int] = set()
+        self._last_records: dict[int, tuple[float, int]] = {}
+        self._rates: dict[int, float] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
-    def boot(self) -> None:
-        """Create the shm plane, stamp the epoch, spawn every rank."""
+    def boot(self, adopt: bool = False) -> None:
+        """Create the shm plane, stamp the epoch, spawn every rank.
+
+        ``adopt=True`` re-attaches to an EXISTING plane instead of
+        creating one (:meth:`_adopt_plane`): the live-engine scan that
+        makes a cold boot refuse is exactly the adopt path's rank
+        census — live ranks keep serving untouched (judged by pid +
+        heartbeat from here on), dead ranks respawn ``gen+1`` from
+        their checkpoints.  A supervisor death is thereby a non-event
+        for the fleet, both directions.
+        """
         if self._booted:
             raise RuntimeError("ClusterSupervisor already booted")
         self._booted = True
         self.cluster_dir.mkdir(parents=True, exist_ok=True)
+        if adopt:
+            self._adopt_plane()
+            return
         self._refuse_live_plane()
         gplane.create_plane(self.cluster_dir, self.n, k_max=self.k_max,
                             slots=self.mailbox_slots,
                             net=self.net is not None)
+        self._write_initial_layout()
         if self.t0_ns is None:
             # the shared epoch: every engine's device clock and every
             # gossiped `until` is relative to this one anchor, which is
@@ -170,6 +232,95 @@ class ClusterSupervisor:
                 timeout_s=self.net.get(
                     "host_timeout_s", tuning.NET_HOST_TIMEOUT_S))
         for r in range(self.n):
+            if r in self._active:
+                self._spawn(r)
+
+    def _uniform_workers(self) -> int:
+        """The per-rank ring width when every spec agrees on one (the
+        shard-assignment precondition); 0 when specs carry none (the
+        lifecycle stubs — no rings, no layout)."""
+        ws = {s.get("workers") for s in self.specs}
+        return int(next(iter(ws))) if len(ws) == 1 and None not in ws \
+            else 0
+
+    def _write_initial_layout(self) -> None:
+        """Publish the generation-0 shard assignment (layout.json):
+        ``total_shards = n * w`` FIXED for the fleet's lifetime, spans
+        of unspawned ranks folded onto the live ones — every shard has
+        one live owner from the first record (rebalance.py)."""
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        w = self._uniform_workers()
+        if not w:
+            return
+        rb.ShardAssignment.initial(
+            self.n * w, w, len(self._active)).save(self.cluster_dir)
+
+    def _adopt_plane(self) -> None:
+        """boot(adopt=True): attach to a plane a previous supervisor
+        left behind.  Precondition: the plane exists and matches this
+        fleet's shape (the inverse of :meth:`_refuse_live_plane` — a
+        live plane is exactly what this path wants).  Live ranks (pid
+        alive + fresh heartbeat) are adopted as-is; dead ranks respawn
+        ``gen+1`` from their checkpoints through the normal crash
+        path."""
+        plane_file = self.cluster_dir / "plane.json"
+        if not plane_file.exists():
+            raise RuntimeError(
+                f"adopt=True but {plane_file} does not exist — nothing "
+                "to adopt; boot without adopt to create the plane")
+        meta = json.loads(plane_file.read_text())
+        if int(meta.get("n_engines", -1)) != self.n:
+            raise RuntimeError(
+                f"adopt=True: plane has {meta.get('n_engines')} "
+                f"engines, this supervisor supervises {self.n} — an "
+                "adopted fleet must match the plane's shape")
+        now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        _LIVE = (schema.CSTATE_SPAWNING, schema.CSTATE_SERVING,
+                 schema.CSTATE_DRAINING)
+        dead: list[int] = []
+        for r in range(self.n):
+            st = StatusBlock(status_path(self.cluster_dir, r))
+            self._status.append(st)
+            # the shared epoch is the PLANE's, not ours: every gossiped
+            # `until` in flight is relative to it
+            if self.t0_ns is None and st.ctl_get("c_t0"):
+                self.t0_ns = st.ctl_get("c_t0")
+                self.t0_wall_ns = st.ctl_get("c_t0_wall") or None
+            self._gen[r] = st.ctl_get("c_gen")
+            state = st.ctl_get("c_state")
+            hb = st.ctl_get("c_hbeat")
+            pid = st.ctl_get("c_pid")
+            fresh = (hb and 0 <= now_ns - hb
+                     < 2 * self.heartbeat_timeout_s * 1e9)
+            if state in _LIVE and fresh and pid and _pid_alive(pid):
+                # serving: adopt untouched (no proc handle — poll()
+                # judges this rank by its pid from now on)
+                self._adopted.add(r)
+                self._active.add(r)
+            elif state == schema.CSTATE_DONE:
+                self._done.add(r)
+                self._active.discard(r)
+            elif r in self._active:
+                dead.append(r)
+        if self.t0_ns is None:
+            raise RuntimeError(
+                "adopt=True: no rank ever stamped the shared epoch — "
+                "this plane never served; boot without adopt")
+        if self.net is not None:
+            from flowsentryx_tpu.cluster import transport
+
+            self.federation = transport.host_beacon(
+                self.net, self.t0_wall_ns,
+                interval_s=self.net.get(
+                    "beacon_interval_s", tuning.NET_BEACON_INTERVAL_S),
+                timeout_s=self.net.get(
+                    "host_timeout_s", tuning.NET_HOST_TIMEOUT_S))
+        for r in dead:
+            # died under the previous supervisor: the normal crash
+            # path — gen+1, restore from its last checkpoint
+            self.restarts[r] += 1
+            self._gen[r] += 1
             self._spawn(r)
 
     def _refuse_live_plane(self) -> None:
@@ -178,8 +329,9 @@ class ClusterSupervisor:
         out from under serving engines' mmaps (SIGBUS on their next
         publish/tick) and would attach this fleet as a SECOND consumer
         to ring shards the orphans still drain.  A dead fleet's
-        leftover plane is fine to stomp; true supervisor re-attach is
-        a ROADMAP follow-up."""
+        leftover plane is fine to stomp; to take over a LIVE fleet,
+        use ``boot(adopt=True)`` — the same scan, inverted into the
+        adopt path's rank census (:meth:`_adopt_plane`)."""
         now_ns = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
         _LIVE = (schema.CSTATE_SPAWNING, schema.CSTATE_SERVING,
                  schema.CSTATE_DRAINING)
@@ -211,10 +363,11 @@ class ClusterSupervisor:
                 "the plane would truncate their mmap'd mailboxes "
                 "mid-serve (SIGBUS on their next publish) and attach "
                 "this fleet as a second consumer on their SPSC ring "
-                "shards. Remediation: stop the old fleet (its own "
-                "supervisor's stop-drain, or kill the listed ranks "
-                "and wait for their heartbeats to go stale), or point "
-                "--cluster-dir at a fresh directory")
+                "shards. Remediation: adopt the live fleet instead "
+                "(boot(adopt=True) / fsx cluster --adopt), stop the "
+                "old fleet (its own supervisor's stop-drain, or kill "
+                "the listed ranks and wait for their heartbeats to go "
+                "stale), or point --cluster-dir at a fresh directory")
 
     def _spawn(self, rank: int) -> None:
         spec = dict(self.specs[rank])
@@ -259,6 +412,7 @@ class ClusterSupervisor:
                               name=f"fsx-cluster-r{rank}")
         p.start()
         self._procs[rank] = p
+        self._adopted.discard(rank)  # ours now: judged by proc handle
         self._status[rank].ctl_set("c_gen", gen)
 
     @staticmethod
@@ -352,7 +506,8 @@ class ClusterSupervisor:
             # a revived host leaves the set, so a relapse re-announces
             self._dead_hosts_announced = dead
         for r in range(self.n):
-            if r in self._failed or r in self._done:
+            if (r not in self._active or r in self._failed
+                    or r in self._done):
                 continue
             # a backoff-delayed respawn whose delay elapsed fires now
             if r in self._respawn_at:
@@ -365,7 +520,31 @@ class ClusterSupervisor:
             p = self._procs[r]
             st = self._status[r]
             state = st.ctl_get("c_state")
-            if p is not None and not p.is_alive():
+            if p is None and r in self._adopted:
+                # adopted rank: no proc handle — pid + heartbeat are
+                # the liveness evidence (boot(adopt=True)).  DONE is
+                # judged BEFORE pid liveness: the exited child is a
+                # zombie only its original (dead) supervisor could
+                # reap, so its pid can read alive indefinitely
+                if state == schema.CSTATE_DONE:
+                    self._adopted.discard(r)
+                    self._done.add(r)
+                    continue
+                pid = st.ctl_get("c_pid")
+                if not _pid_alive(pid):
+                    self._adopted.discard(r)
+                    if state == schema.CSTATE_DONE:
+                        self._done.add(r)
+                        continue
+                    if pid:
+                        try:  # orphaned drain workers, same as killpg
+                            os.killpg(pid, signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError,
+                                OSError):
+                            pass
+                    self._decide_respawn(r, now)
+                    continue
+            elif p is not None and not p.is_alive():
                 if state == schema.CSTATE_DONE:
                     self._done.add(r)
                     continue
@@ -376,19 +555,7 @@ class ClusterSupervisor:
                 self._killpg(p)
                 p.join(timeout=1.0)
                 self._procs[r] = None  # corpse handled
-                self._death_times[r] = [
-                    t for t in self._death_times[r]
-                    if now - t < self.restart_window_s]
-                recent = len(self._death_times[r])
-                self._death_times[r].append(now)
-                if recent < self.max_restarts:
-                    delay = min(
-                        self.restart_backoff_s * (2 ** recent),
-                        self.restart_backoff_max_s)
-                    self._respawn_at[r] = now + delay
-                else:
-                    self._failed.add(r)
-                    self._announce_park(r, recent + 1)
+                self._decide_respawn(r, now)
                 continue
             hb = st.ctl_get("c_hbeat")
             if (hb and state == schema.CSTATE_SERVING
@@ -396,6 +563,426 @@ class ClusterSupervisor:
                 self._stalled.add(r)
             else:
                 self._stalled.discard(r)
+        self._handoff_tick(now)
+
+    def _decide_respawn(self, r: int, now: float) -> None:
+        """Restart-vs-park under the crash-loop discipline (sliding
+        window + exponential backoff) — shared by the proc-handle and
+        adopted-pid death paths."""
+        self._death_times[r] = [
+            t for t in self._death_times[r]
+            if now - t < self.restart_window_s]
+        recent = len(self._death_times[r])
+        self._death_times[r].append(now)
+        if recent < self.max_restarts:
+            delay = min(
+                self.restart_backoff_s * (2 ** recent),
+                self.restart_backoff_max_s)
+            self._respawn_at[r] = now + delay
+        else:
+            self._failed.add(r)
+            self._announce_park(r, recent + 1)
+
+    # -- live shard handoff coordination (cluster/rebalance.py) -------------
+
+    def live_ranks(self) -> list[int]:
+        """Active ranks currently able to serve (spawned or adopted,
+        not failed/done/parked)."""
+        return [r for r in sorted(self._active)
+                if r not in self._failed and r not in self._done
+                and r not in self._shrunk
+                and (self._procs[r] is not None or r in self._adopted)]
+
+    def start_handoff(self, shards, donor: int, recipient: int, *,
+                      rows=None) -> int:
+        """Open one handoff (module docstring of rebalance.py has the
+        full state machine): write the descriptor, create the mailbox,
+        stamp the fence — the engines do the rest between run chunks;
+        :meth:`poll` advances the supervisor half.  ``rows`` switches
+        to checkpoint-sourced adoption: the SUPERVISOR is the donor
+        (``donor=-1``) and publishes the rows itself — the dead rank
+        has no process to ask."""
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        if self._handoff is not None:
+            raise RuntimeError(
+                "a handoff is already in flight (one shard span moves "
+                "at a time, fleet-wide)")
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        if asg is None:
+            raise RuntimeError("no layout.json: this fleet has no "
+                               "shard assignment to rebalance")
+        shards = sorted(int(s) for s in shards)
+        self._handoff_seq += 1
+        hid = self._handoff_seq
+        mbx_path = rb.handoff_mailbox_path(self.cluster_dir, hid)
+        n_rows = None
+        if rows is not None:
+            keys, states = rows
+            n_rows = len(keys)
+            # size the mailbox to hold the WHOLE stream: the
+            # supervisor must not block its control loop waiting for
+            # the recipient to drain mid-publish
+            per = 512
+            need = max(2, (n_rows + per - 1) // per + 2)
+            slots = 1
+            while slots < need:
+                slots *= 2
+            mbx = rb.HandoffMailbox.create(mbx_path, slots=slots,
+                                           rows_per_slot=per)
+            rb.ship_rows(mbx, keys, states)
+        else:
+            rb.HandoffMailbox.create(mbx_path)
+        rb._write_atomic(rb.handoff_json_path(self.cluster_dir),
+                         json.dumps({
+                             "id": hid, "shards": shards,
+                             "donor": donor, "recipient": recipient,
+                             "to_gen": asg.generation + 1,
+                             "total_shards": asg.total_shards,
+                             "source": "ckpt" if rows is not None
+                             else "engine",
+                         }) + "\n")
+        for r in ([recipient] if donor < 0 else [donor, recipient]):
+            self._status[r].ctl_set("c_fence", hid)
+        self.rebalance_counters["fences"] += 1
+        self._handoff = {
+            "id": hid, "shards": shards, "donor": donor,
+            "recipient": recipient, "to_gen": asg.generation + 1,
+            "phase": "shipping", "n_rows": n_rows,
+            "deadline": time.monotonic() + tuning.HANDOFF_TIMEOUT_S,
+        }
+        return hid
+
+    def _handoff_phase_of(self, rank: int, hid: int) -> int:
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        return rb._phase_of(self._status[rank].ctl_get("c_handoff"),
+                            hid)
+
+    def _handoff_tick(self, now: float) -> None:
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        h = self._handoff
+        if h is None:
+            return
+        if h["phase"] == "shipping":
+            # pre-commit, abort is always safe: nothing moved — the
+            # donor owns the span until layout.json says otherwise
+            live = self.live_ranks()
+            party_dead = (h["recipient"] not in live
+                          or (h["donor"] >= 0 and h["donor"] not in live))
+            if party_dead or now > h["deadline"]:
+                self._abort_handoff(
+                    "party died" if party_dead else "timed out")
+                return
+            donor_ok = (h["donor"] < 0
+                        or self._handoff_phase_of(h["donor"], h["id"])
+                        >= schema.HP_SHIPPED)
+            recip_ok = (self._handoff_phase_of(h["recipient"], h["id"])
+                        >= schema.HP_STAGED)
+            if donor_ok and recip_ok:
+                # COMMIT: the atomic flip — layout.json first (the
+                # durable truth a crashed rank reconciles against),
+                # then the generation stamp every rank observes
+                asg = rb.ShardAssignment.load(self.cluster_dir)
+                asg = asg.reassign(h["shards"], h["recipient"])
+                asg.save(self.cluster_dir)
+                for r in range(self.n):
+                    self._status[r].ctl_set("c_layout_gen",
+                                            asg.generation)
+                self.rebalance_counters["flips"] += 1
+                if h["n_rows"] is None:
+                    try:  # the staged spool is the shipped-row census
+                        import numpy as np
+
+                        with np.load(rb.staged_path(
+                                self.cluster_dir,
+                                h["recipient"])) as z:
+                            h["n_rows"] = int(len(z["keys"]))
+                    except (OSError, ValueError, KeyError):
+                        h["n_rows"] = 0
+                h["phase"] = "committing"
+            return
+        # committing: the flip is DURABLE — never aborted.  The fence
+        # lifts only when every live active rank has echoed the new
+        # generation (a dead rank's respawn acks via its boot-time
+        # reconcile, so this converges without a force)
+        waiting = [r for r in sorted(self._active)
+                   if r not in self._failed and r not in self._done
+                   and self._status[r].ctl_get("c_layout_ack")
+                   < h["to_gen"]]
+        if not waiting:
+            self._finish_handoff()
+
+    def _clear_fences(self) -> None:
+        for st in self._status:
+            st.ctl_set("c_fence", 0)
+
+    def _finish_handoff(self) -> None:
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        h = self._handoff
+        self._clear_fences()
+        self.rebalance_counters["rows_shipped"] += int(h["n_rows"] or 0)
+        for p in (rb.handoff_json_path(self.cluster_dir),
+                  Path(rb.handoff_mailbox_path(self.cluster_dir,
+                                               h["id"])),
+                  rb.staged_path(self.cluster_dir, h["recipient"])):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        self._handoff = None
+
+    def _abort_handoff(self, why: str) -> None:
+        """Pre-commit unwind: clear the fence, delete the descriptor /
+        mailbox / spool.  The recipient discards its staged rows on
+        observing the cleared fence (counted); the donor never stopped
+        owning the span — exact conservation by doing nothing."""
+        import sys
+
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        h = self._handoff
+        self._clear_fences()
+        for p in (rb.handoff_json_path(self.cluster_dir),
+                  Path(rb.handoff_mailbox_path(self.cluster_dir,
+                                               h["id"])),
+                  rb.staged_path(self.cluster_dir, h["recipient"])):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+        self.rebalance_counters["aborts"] += 1
+        print(f"fsx cluster: handoff {h['id']} (shards {h['shards']} "
+              f"rank {h['donor']} -> {h['recipient']}) ABORTED: {why}; "
+              "donor keeps the span, nothing moved", file=sys.stderr)
+        self._handoff = None
+
+    def adopt_dead_span(self, dead_rank: int, recipient: int) -> dict:
+        """Dead-span adoption: ship a confirmed-dead rank's span to a
+        survivor from its LAST CHECKPOINT (the supervisor is the
+        donor — jax-free npz read, rebalance.load_ckpt_rows).  Rows
+        newer than the checkpoint died with the rank (the same loss
+        window every gen+1 restart has always had); what the
+        checkpoint holds is conserved exactly.  Announced in
+        :meth:`aggregate` as ``adopted_spans``."""
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        if asg is None:
+            raise RuntimeError("no layout.json: nothing to adopt")
+        span = asg.spans_of(dead_rank)
+        if not span:
+            raise RuntimeError(f"rank {dead_rank} owns no shards")
+        ckpt = self.specs[dead_rank].get("checkpoint")
+        keys = states = None
+        if ckpt:
+            ck_file = Path(self._ckpt_file(ckpt))
+            prev = ck_file.with_name(ck_file.name + ".prev")
+            for cand in (ck_file, prev):
+                if cand.exists():
+                    try:
+                        keys, states = rb.load_ckpt_rows(cand)
+                        break
+                    except (OSError, ValueError, KeyError):
+                        continue
+        if keys is None:
+            import numpy as np
+
+            keys = np.empty(0, np.uint32)
+            states = np.empty((0, schema.NUM_TABLE_COLS), np.float32)
+        # only the dead rank's span rows ship (its checkpoint should
+        # hold nothing else, but a pre-flip snapshot may)
+        import numpy as np
+
+        sel = np.isin(schema.shard_of(keys, asg.total_shards),
+                      np.asarray(span, np.uint32))
+        hid = self.start_handoff(span, -1, recipient,
+                                 rows=(keys[sel], states[sel]))
+        entry = {"dead_rank": dead_rank, "recipient": recipient,
+                 "shards": list(span), "rows": int(np.sum(sel)),
+                 "handoff_id": hid}
+        self.adopted_spans.append(entry)
+        self.rebalance_counters["adoptions"] += 1
+        return entry
+
+    # -- autoscaling (cluster/elastic.py) ------------------------------------
+
+    def _ring_backlog(self) -> dict[int, int]:
+        """Unread records per live rank, straight off the shm ring
+        cursors (head u64 minus tail u64 — the producer/consumer
+        cursor pair every ring publishes).  This is the REAL ingest
+        queue depth, readable without attaching as a consumer and
+        without waiting for a report."""
+        out: dict[int, int] = {}
+        w = self._uniform_workers()
+        if not w:
+            return out
+        for r in self.live_ranks():
+            base = self.specs[r].get("ring_base")
+            total = self.specs[r].get("total_shards", self.n * w)
+            if not base:
+                continue
+            depth = 0
+            for s in range(r * w, (r + 1) * w):
+                p = schema.shard_ring_path(base, s, total)
+                try:
+                    with open(p, "rb") as f:
+                        f.seek(schema.SHM_HEAD_OFFSET)
+                        head = int.from_bytes(f.read(8), "little")
+                        f.seek(schema.SHM_TAIL_OFFSET)
+                        tail = int.from_bytes(f.read(8), "little")
+                    depth += max(0, head - tail)
+                except OSError:
+                    continue
+            out[r] = depth
+        return out
+
+    def _sample_signals(self, now: float) -> dict:
+        """The elastic signal vector: ring backlog (above) + per-rank
+        record-rate skew from the c_records counters.  Report-borne
+        signals (p99 vs slo, gossip tx_drop, watchdog trips) ride in
+        when the caller merges the last aggregate — mid-run, the ctl
+        plane is what exists."""
+        backlog = self._ring_backlog()
+        live = self.live_ranks()
+        rates = []
+        for r in live:
+            rec = self._status[r].ctl_get("c_records")
+            prev = self._last_records.get(r)
+            self._last_records[r] = (now, rec)
+            if prev and now > prev[0]:
+                rate = max(0.0, (rec - prev[1]) / (now - prev[0]))
+                self._rates[r] = rate
+                rates.append(rate)
+        signals: dict = {}
+        if backlog:
+            vals = [backlog.get(r, 0) for r in live]
+            signals["backlog_per_engine"] = (
+                sum(vals) / max(1, len(vals)))
+            signals["backlog_max"] = max(vals) if vals else 0
+            signals["backlog"] = {str(r): backlog.get(r, 0)
+                                  for r in live}
+        if rates and max(rates) > 0:
+            mean = sum(rates) / len(rates)
+            signals["rate_skew"] = (max(rates) / mean) if mean else 1.0
+        return signals
+
+    def elastic_tick(self, now: float | None = None) -> dict | None:
+        """One autoscaler tick (run() calls this each poll when a
+        policy is installed): sample → decide → execute.  Every
+        executed plan is printed WITH its signal vector — an
+        unauditable autoscaler is an outage generator."""
+        if self._elastic is None:
+            return None
+        now = time.monotonic() if now is None else now
+        if now < self._elastic_next:
+            return None
+        self._elastic_next = now + tuning.ELASTIC_TICK_S
+        self._finish_pending_grow()
+        self._finish_pending_shrink()
+        signals = self._sample_signals(now)
+        plan = self._elastic.decide(signals, len(self.live_ranks()),
+                                    now)
+        if plan["action"] != "hold":
+            self._execute_plan(plan, now)
+        return plan
+
+    def _log_plan(self, plan: dict, what: str) -> None:
+        import sys
+
+        print(f"fsx cluster elastic: {plan['action'].upper()} {what} "
+              f"— {plan['reason']} | signals={json.dumps(plan['signals'])}",
+              file=sys.stderr)
+
+    def _finish_pending_grow(self) -> None:
+        """Second half of a grow: once the new rank is SERVING and the
+        handoff lane is free, hand it half the hottest live span."""
+        g = self._pending_grow
+        if g is None or self._handoff is not None:
+            return
+        r = g["rank"]
+        if r in self._failed:
+            self._pending_grow = None
+            return
+        if self._status[r].ctl_get("c_state") != schema.CSTATE_SERVING:
+            return
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        donors = [d for d in self.live_ranks() if d != r
+                  and len(asg.spans_of(d)) >= 2]
+        if not donors:
+            self._pending_grow = None
+            return
+        donor = max(donors, key=lambda d: (
+            self._rates.get(d, 0.0), len(asg.spans_of(d))))
+        span = asg.spans_of(donor)
+        self.start_handoff(span[len(span) // 2:], donor, r)
+        self._pending_grow = None
+
+    def _execute_plan(self, plan: dict, now: float) -> None:
+        if self._handoff is not None or self._pending_grow is not None:
+            return  # lane busy: the plan re-emits next tick
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        action = plan["action"]
+        if action == "grow":
+            spare = [r for r in range(self.n)
+                     if r not in self._active and r not in self._shrunk]
+            if not spare:
+                return
+            r = spare[0]
+            self._active.add(r)
+            self._gen[r] = 0
+            self._spawn(r)
+            self._pending_grow = {"rank": r}
+            self.elastic_executed += 1
+            self._elastic.executed(now)
+            self._log_plan(plan, f"-> spawn rank {r} gen-0")
+            return
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        if asg is None:
+            return
+        live = self.live_ranks()
+        if action == "shrink" and len(live) >= 2:
+            victim = max(live)
+            span = asg.spans_of(victim)
+            survivors = [r for r in live if r != victim]
+            coldest = min(survivors,
+                          key=lambda r: self._rates.get(r, 0.0))
+            if span:
+                self.start_handoff(span, victim, coldest)
+            self._pending_shrink = {"rank": victim}
+            self.elastic_executed += 1
+            self._elastic.executed(now)
+            self._log_plan(plan, f"-> drain rank {victim} span to "
+                                 f"rank {coldest}, then park")
+        elif action == "rebalance" and len(live) >= 2:
+            hottest = max(live, key=lambda r: self._rates.get(r, 0.0))
+            coldest = min(live, key=lambda r: self._rates.get(r, 0.0))
+            span = asg.spans_of(hottest)
+            if hottest == coldest or len(span) < 2:
+                return
+            self.start_handoff(span[len(span) // 2:], hottest, coldest)
+            self.elastic_executed += 1
+            self._elastic.executed(now)
+            self._log_plan(plan, f"-> move {len(span) // 2} shard(s) "
+                                 f"rank {hottest} -> {coldest}")
+
+    def _finish_pending_shrink(self) -> None:
+        """After a shrink's handoff committed: the victim owns nothing
+        — stop-drain it alone and park it as SHRUNK (not failed: its
+        span is served, this is the fleet getting smaller on
+        purpose)."""
+        s = self._pending_shrink
+        if s is None or self._handoff is not None:
+            return
+        victim = s["rank"]
+        self._status[victim].ctl_set("c_stop", 1)
+        self._shrunk.add(victim)
+        self._pending_shrink = None
 
     def request_stop(self) -> None:
         """Ask every engine to drain its shard and exit (the fleet's
@@ -413,8 +1000,9 @@ class ClusterSupervisor:
         tails to be served."""
         t0 = time.monotonic()
         deadline = None if max_seconds is None else t0 + max_seconds
-        while len(self._done) + len(self._failed) < self.n:
+        while len(self._done) + len(self._failed) < len(self._active):
             self.poll()
+            self.elastic_tick()
             if (deadline is not None and not self._stop_sent
                     and time.monotonic() >= deadline):
                 self.request_stop()
@@ -529,17 +1117,48 @@ class ClusterSupervisor:
         if self.federation is not None:
             hosts_block = self.federation.report()
             dead_hosts = self.federation.dead_hosts()
+        health = health_mod.cluster_health(
+            per_rank_health, sorted(self._failed),
+            sorted(self._stalled), dead_hosts=dead_hosts)
+        # elastic/rebalance reasons the engines cannot see (a
+        # suppressed plan or an aborted handoff is supervisor state):
+        # folded here so `fsx monitor --alert-degraded` alerts on them
+        sup_reasons = []
+        if self._elastic is not None and self._elastic.suppressed:
+            sup_reasons.append(
+                f"elastic_plans_suppressed:{self._elastic.suppressed}")
+        if self.rebalance_counters["aborts"]:
+            sup_reasons.append(
+                f"rebalance_aborts:{self.rebalance_counters['aborts']}")
+        if sup_reasons:
+            health["reasons"] = list(health["reasons"]) + sup_reasons
+            health["state"] = health_mod.worst(health["state"],
+                                              health_mod.DEGRADED)
+        elastic_block = None
+        if self._elastic is not None:
+            elastic_block = {
+                "min_engines": self._elastic.min_engines,
+                "max_engines": self._elastic.max_engines,
+                "executed": self.elastic_executed,
+                "suppressed": self._elastic.suppressed,
+                "shrunk_ranks": sorted(self._shrunk),
+                # every decision with the signal vector that drove it
+                "decisions": self._elastic.decisions[-200:],
+            }
         return {
             "engines": self.n,
+            "active_ranks": sorted(self._active),
+            "adopted_ranks": sorted(self._adopted),
+            "rebalance": dict(self.rebalance_counters,
+                              adopted_spans=list(self.adopted_spans)),
+            "elastic": elastic_block,
             "t0_ns": self.t0_ns,
             "t0_wall_ns": self.t0_wall_ns,
             "restarts": list(self.restarts),
             "failed_ranks": sorted(self._failed),
             "stalled_ranks": sorted(self._stalled),
             "hosts": hosts_block,
-            "health": health_mod.cluster_health(
-                per_rank_health, sorted(self._failed),
-                sorted(self._stalled), dead_hosts=dead_hosts),
+            "health": health,
             "records": total_records,
             "batches": total_batches,
             "max_wall_s": round(max_wall, 4),

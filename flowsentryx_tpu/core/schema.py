@@ -469,6 +469,28 @@ WIRE_ID_COMPACT16 = 1
 SHM_GOSSIP_MAGIC = 0x465358474F535331   # "FSXGOSS1"
 GOSSIP_SLOT_HDR_WORDS = 4
 
+#: Live shard-handoff mailbox (cluster/rebalance.py): the VerdictMailbox
+#: SPSC geometry with ROW payloads — each slot is a 4-word u32 header
+#: (seq lo/hi, row count, slot kind) followed by ``rows_per_slot``
+#: packed table rows of ``1 + NUM_TABLE_COLS`` u32 words (key, then the
+#: f32 state columns bit-cast).  ``row_words`` rides the file header's
+#: 4th u64 so a geometry mismatch between donor and recipient is
+#: structurally impossible.  The stream ends with one SEAL slot whose
+#: payload carries the total row count (u64 split) and a CRC32 over the
+#: shipped bytes in ship order — the recipient refuses a short or torn
+#: stream instead of staging it.
+SHM_HANDOFF_MAGIC = 0x4653584844464631  # "FSXHDFF1"
+HANDOFF_SLOT_HDR_WORDS = 4
+HANDOFF_KIND_ROWS = 0
+HANDOFF_KIND_SEAL = 1
+
+#: Engine-side handoff phase acks (STATUS_HANDOFF_OFFSET encoding
+#: ``handoff_id * 8 + HP_*``; cluster/rebalance.py state machine).
+HP_SHIPPED = 1     # donor: span rows published + sealed
+HP_STAGED = 2      # recipient: stream verified + spooled crash-safe
+HP_DROPPED = 3     # donor: observed the flip, span rows dropped
+HP_INSERTED = 4    # recipient: observed the flip, staged rows inserted
+
 # -- multi-host gossip datagram layout (cluster/transport.py) ---------------
 # One UDP datagram per verdict wire: a 9-word u32 header followed by the
 # SAME [2K+4]-word compact verdict wire the shm mailboxes carry (564 B
@@ -513,6 +535,19 @@ STATUS_HBEAT_OFFSET = 64                # u64 CLOCK_MONOTONIC ns
 STATUS_STATE_OFFSET = 72                # u64 CSTATE_*
 STATUS_BATCHES_OFFSET = 80              # u64 batches served (monitor)
 STATUS_RECORDS_OFFSET = 88              # u64 records served (monitor)
+#: Engine process id, stamped at boot (cluster/runner.py).  A
+#: re-attaching supervisor (``boot(adopt=True)``) owns no Process
+#: handles for ranks it did not spawn — pid + os.kill(pid, 0) +
+#: heartbeat age is how it re-derives liveness from the plane alone.
+STATUS_PID_OFFSET = 96
+#: Engine-side handoff progress ack: ``handoff_id * 8 + HP_*`` phase
+#: (cluster/rebalance.py state machine).  0 = no handoff touched.
+STATUS_HANDOFF_OFFSET = 104
+#: Engine's echo of the last shard-assignment generation it converged
+#: on (reloaded layout.json + applied its side of the flip).  The
+#: supervisor lifts the fence only once every live rank's ack matches
+#: the stamped generation.
+STATUS_LAYOUT_ACK_OFFSET = 112
 # supervisor-written line
 STATUS_STOP_OFFSET = 128                # u64 drain-and-exit request
 STATUS_GEN_OFFSET = 136                 # u64 restart generation
@@ -524,6 +559,17 @@ STATUS_T0_OFFSET = 144                  # u64 shared cluster epoch (ns)
 #: rebased tx-epoch -> rx-epoch (cluster/transport.py).  0 = no
 #: network leg (single-host fleets never stamp it).
 STATUS_T0_WALL_OFFSET = 152             # u64 CLOCK_REALTIME ns at t0
+#: Current shard-assignment generation (cluster/rebalance.py): the
+#: supervisor stamps it on every rank AFTER atomically publishing the
+#: matching layout.json — the layout-generation flip rule.  Engines
+#: observe the stamp between run chunks, reload the layout, apply
+#: their side of the flip (donor drops the span, recipient inserts its
+#: staged rows) and echo via STATUS_LAYOUT_ACK_OFFSET.
+STATUS_LAYOUT_GEN_OFFSET = 160
+#: Active handoff id (nonzero = a span is FENCED: producers route no
+#: new records for the moving shards — they fall to the kernel tier,
+#: counted — until the flip commits or the handoff aborts to 0).
+STATUS_FENCE_OFFSET = 168
 
 CSTATE_SPAWNING = 1
 CSTATE_SERVING = 2

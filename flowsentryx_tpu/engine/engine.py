@@ -145,6 +145,11 @@ class EngineReport(NamedTuple):
     #: supervisor; queryable via ``fsx status --engine-report`` and
     #: alertable via ``fsx monitor --alert-degraded``.
     health: dict | None = None
+    #: Live-rebalance audit (cluster/rebalance.py): rows shipped /
+    #: adopted / dropped-post-flip, handoffs donated/adopted, refused
+    #: streams, staged discards, boot-time foreign-row drops.  None
+    #: until the first handoff touches this engine.
+    rebalance: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -681,6 +686,12 @@ class Engine:
         #: (a DEGRADED reason: flow memory resumed one generation
         #: stale).  Written only in the quiescent restore().
         self._restore_fallbacks = 0
+        #: Live-rebalance audit counters (cluster/rebalance.py drives
+        #: the quiescent span methods below; engine/health.py folds
+        #: the loss-shaped ones — adopt_dropped, staged_discarded,
+        #: foreign_dropped — into the DEGRADED ladder).  Written only
+        #: between run chunks, read by _build_report: single-thread.
+        self._rebalance: dict[str, int] = {}
         #: Dispatch watchdog (engine/watchdog.py): trips when batches
         #: are in flight but nothing sinks for the stall bound —
         #: dumping per-thread stacks and surfacing loudly instead of
@@ -1757,6 +1768,83 @@ class Engine:
             self.sink.t0_ns = ck.t0_ns
         return info
 
+    # -- live shard handoff (cluster/rebalance.py; ISSUE 16) ----------------
+    #
+    # All three methods are QUIESCENT: the rebalancer calls them
+    # between run() chunks, where no dispatch is in flight, so the
+    # host fetch / re-place round-trip sees (and publishes) a stable
+    # table — the same contract as checkpoint()/restore().
+
+    def count_rebalance(self, name: str, n: int = 1) -> None:
+        self._rebalance[name] = self._rebalance.get(name, 0) + int(n)
+
+    def _host_table(self) -> tuple[np.ndarray, np.ndarray]:
+        return (np.asarray(self.table.key),
+                np.asarray(self.table.state))
+
+    def _replace_table(self, key: np.ndarray, state: np.ndarray) -> None:
+        """Re-place host arrays on device — the restore() placement
+        idiom (sharded over the mesh, or plain device_put)."""
+        table = schema.IpTableState(key=key, state=state)
+        if self.mesh is not None:
+            from flowsentryx_tpu import parallel as par
+
+            table = par.shard_table(table, self.mesh)
+        else:
+            table = schema.IpTableState(key=jax.device_put(key),
+                                        state=jax.device_put(state))
+        self.table = table
+
+    def extract_span_rows(
+        self, shards, total_shards: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Occupied ``(keys, states)`` of the given RING shards (the
+        ingest-affinity hash ``schema.shard_of`` over the table keys —
+        the table key IS the folded saddr, so the donor's wire rows
+        are selected by exactly the rule producers route by).  Pure
+        read: the table is untouched (the donor keeps serving the
+        span until the flip commits)."""
+        key, state = self._host_table()
+        occ = key != 0
+        sel = occ & np.isin(schema.shard_of(key, total_shards),
+                            np.asarray(list(shards), np.uint32))
+        return key[sel].copy(), state[sel].copy()
+
+    def drop_span_rows(self, shards, total_shards: int) -> int:
+        """Zero every row of the given ring shards (donor post-flip,
+        or boot-time foreign-row reconcile).  Returns the count."""
+        key, state = self._host_table()
+        key, state = key.copy(), state.copy()
+        sel = (key != 0) & np.isin(schema.shard_of(key, total_shards),
+                                   np.asarray(list(shards), np.uint32))
+        n = int(np.sum(sel))
+        if n:
+            key[sel] = 0
+            state[sel] = 0.0
+            self._replace_table(key, state)
+        return n
+
+    def adopt_rows(self, keys, states) -> tuple[int, int]:
+        """Probe-insert handed-off rows into the live table
+        (:func:`flowsentryx_tpu.engine.table.insert_rows`).  Returns
+        ``(inserted, dropped)`` — dropped rows (key collision or probe
+        exhaustion) are the caller's to count as a DEGRADED reason,
+        never silent."""
+        from flowsentryx_tpu.engine import table as tbl
+
+        keys = np.asarray(keys, np.uint32).reshape(-1)
+        if not len(keys):
+            return 0, 0
+        key, state = self._host_table()
+        plan = tbl.TablePlan(capacity=self.cfg.table.capacity,
+                             n_shards=self._n_shards(),
+                             salt=self.cfg.table.salt,
+                             probes=self.cfg.table.probes)
+        key, state, dropped = tbl.insert_rows(key, state, keys, states,
+                                              plan)
+        self._replace_table(key, state)
+        return len(keys) - dropped, dropped
+
     # -- live model hot-swap ------------------------------------------------
 
     def hot_swap(self, params) -> None:
@@ -2434,7 +2522,9 @@ class Engine:
                 ingest=ingest_stats,
                 gossip=cluster_rep,
                 watchdog=self._watchdog.to_dict(),
-                restore_fallbacks=self._restore_fallbacks),
+                restore_fallbacks=self._restore_fallbacks,
+                rebalance=self._rebalance or None),
+            rebalance=dict(self._rebalance) or None,
         )
 
 

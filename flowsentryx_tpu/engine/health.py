@@ -46,6 +46,8 @@ def engine_health(
     gossip: dict | None = None,
     watchdog: dict | None = None,
     restore_fallbacks: int = 0,
+    rebalance: dict | None = None,
+    elastic: dict | None = None,
 ) -> dict:
     """Derive one engine's health from its report blocks (module
     docstring).  Every argument is the corresponding
@@ -120,6 +122,31 @@ def engine_health(
             failed = True
     if restore_fallbacks:
         reasons.append(f"restore_fallbacks:{restore_fallbacks}")
+    if rebalance:
+        # live-handoff loss accounting (cluster/rebalance.py): each
+        # of these means rows or a stream went somewhere other than
+        # the happy path — DEGRADED, never FAILED (the span is still
+        # served by whoever owned it; conservation is the chaos
+        # campaign's invariant, these are the operator's breadcrumbs)
+        for key, name in (
+                ("adopt_dropped", "rebalance_adopt_dropped"),
+                ("staged_discarded", "rebalance_staged_discarded"),
+                ("streams_refused", "rebalance_streams_refused"),
+                ("foreign_dropped", "rebalance_foreign_dropped")):
+            v = int(rebalance.get(key) or 0)
+            if v:
+                reasons.append(f"{name}:{v}")
+    if elastic:
+        # autoscaler friction (cluster/elastic.py): suppressed plans
+        # mean the fleet WANTED to reshape and could not (cooldown or
+        # clamp) — visible so an operator can raise max_engines
+        # instead of discovering the clamp in a postmortem
+        v = int(elastic.get("suppressed") or 0)
+        if v:
+            reasons.append(f"elastic_plans_suppressed:{v}")
+        v = int(elastic.get("aborts") or 0)
+        if v:
+            reasons.append(f"elastic_handoff_aborts:{v}")
     state = FAILED if failed else (DEGRADED if reasons else HEALTHY)
     return {"state": state, "reasons": reasons}
 

@@ -173,9 +173,26 @@ def reshard_rows(
         return new_key, new_state, 0
     k_occ = key[occ]
     st_occ = state[occ]
+    dropped = _probe_insert(k_occ, st_occ, new_key, new_state, plan)
+    return new_key, new_state, dropped
+
+
+def _probe_insert(
+    k_occ: np.ndarray,
+    st_occ: np.ndarray,
+    new_key: np.ndarray,
+    new_state: np.ndarray,
+    plan: TablePlan,
+) -> int:
+    """Probe-insert ``(k_occ, st_occ)`` into ``new_key``/``new_state``
+    IN PLACE (rows whose ``new_key`` is nonzero are occupied and
+    skipped over, exactly like the device probe).  Returns the dropped
+    count — keys whose whole probe sequence was taken.  Shared by
+    :func:`reshard_rows` (empty target) and :func:`insert_rows`
+    (populated target)."""
     cand = _global_candidates(k_occ, plan)          # [R, P]
-    placed = np.zeros(len(occ), bool)
-    taken = np.zeros(plan.capacity, bool)
+    placed = np.zeros(len(k_occ), bool)
+    taken = new_key != 0
     for p in range(plan.probes):
         idx = np.flatnonzero(~placed)
         if not len(idx):
@@ -194,4 +211,36 @@ def reshard_rows(
         new_state[slots_w] = st_occ[rows_w]
         taken[slots_w] = True
         placed[rows_w] = True
-    return new_key, new_state, int(np.sum(~placed))
+    return int(np.sum(~placed))
+
+
+def insert_rows(
+    key: np.ndarray,
+    state: np.ndarray,
+    add_keys: np.ndarray,
+    add_states: np.ndarray,
+    plan: TablePlan,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Probe-insert foreign rows into an EXISTING table — the
+    handoff-adoption twin of :func:`reshard_rows` (``cluster/
+    rebalance.py``): the recipient's live table keeps every row where
+    it is, and each adopted key runs the insert probe over the
+    remaining free slots, landing exactly where a live insert would
+    have.  An adopted key already present in the table can only mean
+    double-ownership upstream (the conservation invariant's job to
+    catch); the incoming copy is DROPPED and counted rather than
+    overwriting live state.  Returns ``(key, state, dropped)`` on
+    fresh host arrays — the caller re-places them on device."""
+    key = np.asarray(key, np.uint32).copy()
+    state = np.asarray(state, np.float32).copy()
+    add_keys = np.asarray(add_keys, np.uint32).reshape(-1)
+    add_states = np.asarray(add_states, np.float32).reshape(
+        len(add_keys), schema.NUM_TABLE_COLS)
+    live = add_keys != 0
+    present = np.isin(add_keys, key[key != 0])
+    sel = live & ~present
+    dropped = int(np.sum(live & present))
+    if np.any(sel):
+        dropped += _probe_insert(add_keys[sel], add_states[sel],
+                                 key, state, plan)
+    return key, state, dropped

@@ -156,3 +156,30 @@ class ClusterLayout:
         the cluster smoke proves engine-local residency with)."""
         return (cluster_rank_of(saddr, self.n_engines,
                                 self.workers_per_engine) == self.rank)
+
+
+def assigned_rank_of(saddr, owners, w: int = 1) -> np.ndarray:
+    """Owner ENGINE of each folded source under a LIVE shard
+    assignment (``cluster/rebalance.py ShardAssignment.owners``) — the
+    elastic-fleet generalization of :func:`cluster_rank_of`: the hash
+    rule is unchanged (``shard_of`` over ``len(owners)`` ring shards),
+    but the shard→rank map is the versioned assignment instead of the
+    boot-frozen ``shard // w``.  Generation-0 assignments reproduce
+    :func:`cluster_rank_of` exactly (test-pinned); ``w`` is accepted
+    for signature symmetry and unused — the owners vector IS the
+    route."""
+    del w
+    owners = np.asarray(owners, np.int64)
+    return owners[shard_of(saddr, len(owners)).astype(np.int64)]
+
+
+def assigned_ring_of(saddr, owners, w: int) -> np.ndarray:
+    """Ring index a producer writes each record to under a live
+    assignment: the OWNER's physical ring span — rank ``owners[s]``
+    drains rings ``[owners[s]*w, (owners[s]+1)*w)`` forever (ring
+    attachment is boot-frozen; OWNERSHIP is what migrates), and the
+    record keeps its within-span lane ``s % w`` so per-flow ordering
+    survives a flip."""
+    owners = np.asarray(owners, np.int64)
+    s = shard_of(saddr, len(owners)).astype(np.int64)
+    return owners[s] * int(w) + s % int(w)

@@ -140,6 +140,13 @@ _ENGINE_QUIESCENT = (
     "__init__", "warm", "reset_stream", "restore", "checkpoint",
     "_build_report", "_reset_dispatch_counters",
     "_start_sink_thread", "_stop_sink_thread", "watch_artifact",
+    # the live-handoff table accessors (cluster/rebalance.py): called
+    # by EngineRebalancer.reconcile (pre-warm) and .step, which the
+    # cluster runner drives at CHUNK BOUNDARIES — the same
+    # no-launch-in-flight condition the runner's periodic checkpoint()
+    # call already documents and relies on
+    "_host_table", "_replace_table", "extract_span_rows",
+    "drop_span_rows", "adopt_rows", "count_rebalance",
 )
 
 _ENGINE_LAUNCH = (
@@ -229,6 +236,11 @@ ENGINE_PLAN = ClassPlan(
         "_h2d_puts_overlapped": _DISP, "_t0_auto": _DISP,
         "_watch_path": _DISP, "_watch_mtime": _DISP,
         "_watch_next": _DISP, "_hot_swaps": _DISP,
+        "_rebalance": FieldContract(
+            "dispatch",
+            "live-handoff counters (count_rebalance): advanced by "
+            "EngineRebalancer at chunk boundaries on the serving "
+            "loop's thread; read by the quiescent report"),
         # -- cross-thread by protocol ---------------------------------
         "params": FieldContract(
             "atomic-ref",
@@ -536,8 +548,82 @@ INGEST_PLAN = ClassPlan(
     },
 )
 
+REBALANCE_PLAN = ClassPlan(
+    module="flowsentryx_tpu/cluster/rebalance.py",
+    cls="EngineRebalancer",
+    quiescent=("__init__",),
+    fields={
+        # No in-process threads: reconcile() runs pre-warm and step()
+        # runs inside the engine's serving loop — both on the rank's
+        # dispatch thread.  The entries pin that (a helper thread
+        # driving a handoff would race the engine's table accessors,
+        # which are launch-section state), and the cross-PROCESS
+        # protocol — who may write c_fence / c_handoff /
+        # c_layout_ack, who may store the handoff mailbox's cursors —
+        # is governed by CTL_WRITERS and the HandoffMailbox
+        # CursorPlan below.
+        "_acked_gen": FieldContract(
+            "dispatch",
+            "last layout generation this rank acked: the reconcile/"
+            "flip dedup latch"),
+        "_fence_seen": FieldContract(
+            "dispatch",
+            "the serve-one-more-chunk latch: a donor ships only on "
+            "the SECOND fenced tick, so rows already dispatched "
+            "before the fence landed are in the table when the span "
+            "is extracted"),
+        "_staged": FieldContract(
+            "dispatch",
+            "rows received + spooled but not yet flipped in "
+            "(id, keys, states); discarded when the fence clears "
+            "without a flip (counted staged_discarded)"),
+        "_receiver": FieldContract(
+            "dispatch",
+            "the per-handoff stream reassembler (seq/CRC "
+            "discipline); reset whenever a stream is refused"),
+        "_mbx": FieldContract(
+            "dispatch",
+            "the recipient's attached handoff mailbox (consumer "
+            "side of the CursorPlan)"),
+        "_mbx_hid": FieldContract(
+            "dispatch",
+            "handoff id _mbx was opened for: the retry-after-abort "
+            "latch — a new handoff has a NEW mailbox file, so a "
+            "stale mapping must be reopened, never drained"),
+    },
+)
+
+ELASTIC_PLAN = ClassPlan(
+    module="flowsentryx_tpu/cluster/elastic.py",
+    cls="ElasticPolicy",
+    quiescent=("__post_init__",),
+    fields={
+        # The policy is a pure decide-function driven ONLY by the
+        # supervisor's control loop (its single thread) — these
+        # entries pin that: the decision state must never be shared
+        # with a helper thread, or hysteresis streaks and the
+        # cooldown clock would interleave and the fleet would flap.
+        "_streak": FieldContract(
+            "dispatch",
+            "consecutive-tick want counters (hysteresis): advanced "
+            "by decide(), reset by executed()"),
+        "_cooldown_until": FieldContract(
+            "dispatch",
+            "enforced-quiet deadline after an executed plan"),
+        "suppressed": FieldContract(
+            "dispatch",
+            "plans wanted but not emitted (cooldown/clamp): feeds "
+            "the elastic_plans_suppressed DEGRADED reason"),
+        "decisions": FieldContract(
+            "dispatch",
+            "the audit log: every plan with its full signal vector "
+            "(aggregate() surfaces the tail)"),
+    },
+)
+
 REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN,
-                                   GOSSIP_PLAN, NETMAILBOX_PLAN)
+                                   GOSSIP_PLAN, NETMAILBOX_PLAN,
+                                   REBALANCE_PLAN, ELASTIC_PLAN)
 
 CURSORS: tuple[CursorPlan, ...] = (
     CursorPlan(module="flowsentryx_tpu/engine/shm.py", cls="ShmRing",
@@ -553,6 +639,15 @@ CURSORS: tuple[CursorPlan, ...] = (
                cls="VerdictMailbox",
                producer=("publish",),
                consumer=("pop_wires",)),
+    # live-handoff mailbox (cluster/rebalance.py): donor publishes
+    # from its serving loop, recipient pops from its own — one
+    # process per side, the same TSO publish-after-copy /
+    # release-after-copy protocol as the gossip mailbox, and the
+    # same single-writer-per-cursor premise this plan makes checkable
+    CursorPlan(module="flowsentryx_tpu/cluster/rebalance.py",
+               cls="HandoffMailbox",
+               producer=("_publish",),
+               consumer=("pop_slots",)),
 )
 
 #: One writer side per sealed-queue control field (engine/shm.py
@@ -569,12 +664,24 @@ CTL_WRITERS: dict[str, str] = {
     # heartbeat, lifecycle state, progress counters.
     "c_hbeat": "cluster-engine", "c_state": "cluster-engine",
     "c_batches": "cluster-engine", "c_records": "cluster-engine",
+    # ... the elastic-fleet additions (ISSUE 16): the rank's pid (the
+    # adopt census + adopted-rank liveness probe), its handoff phase
+    # ack (handoff_id*8 + HP_*), and the layout generation it has
+    # converged to — all ENGINE-written, the supervisor only reads.
+    "c_pid": "cluster-engine", "c_handoff": "cluster-engine",
+    "c_layout_ack": "cluster-engine",
     # SUPERVISOR-written: stop request, restart generation, the shared
     # cluster t0 epoch every gossiped `until` is relative to — and its
     # CLOCK_REALTIME twin, stamped at the same instant, which is what
     # lets a PEER HOST rebase this host's wires (cluster/transport.py).
     "c_stop": "supervisor", "c_gen": "supervisor",
     "c_t0": "supervisor", "c_t0_wall": "supervisor",
+    # ... and the rebalance control pair: the committed layout
+    # generation (the atomic route flip — engines converge TO it and
+    # ack via c_layout_ack) and the handoff fence (nonzero = the
+    # handoff id freezing this rank's span feed).  One writer each:
+    # the coordinator that owns the handoff state machine.
+    "c_layout_gen": "supervisor", "c_fence": "supervisor",
 }
 
 #: Which side each production module writes from.  Modules not listed
@@ -585,6 +692,7 @@ CTL_MODULE_SIDE: dict[str, str] = {
     "flowsentryx_tpu/ingest/sharded.py": "engine",
     "flowsentryx_tpu/cluster/gossip.py": "cluster-engine",
     "flowsentryx_tpu/cluster/runner.py": "cluster-engine",
+    "flowsentryx_tpu/cluster/rebalance.py": "cluster-engine",
     "flowsentryx_tpu/cluster/supervisor.py": "supervisor",
 }
 

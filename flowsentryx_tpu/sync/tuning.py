@@ -216,3 +216,53 @@ NET_HOST_TIMEOUT_S = 5.0
 #: ladder's total span (0.1+0.2+...+5 s), so a genuine crash loop
 #: cannot out-wait the window between respawns.
 RESTART_WINDOW_S = 60.0
+
+# -- elastic fleet (ISSUE 16) ----------------------------------------------
+
+#: Autoscaler decision cadence (``ClusterSupervisor.run --elastic``):
+#: the supervisor samples the signal vector (ring backlog, record-rate
+#: skew, last aggregate's p99 / tx_drop / watchdog trips) once per
+#: tick.  2 s sits between the 0.2 s poll (too noisy — one dispatch
+#: burst would read as load) and the report cadence (too slow — a
+#: backlog grows by millions of records per minute at line rate).
+ELASTIC_TICK_S = 2.0
+
+#: Hysteresis: a grow/shrink/rebalance signal must hold for this many
+#: CONSECUTIVE ticks before the policy emits a plan.  3 ticks x 2 s
+#: rides out a single checkpoint stall or jit recompile (both < 5 s
+#: here) without deferring a genuine ramp for more than ~6 s.
+ELASTIC_HYSTERESIS_TICKS = 3
+
+#: Cooldown after any EXECUTED plan: the fleet needs one full
+#: handoff + report cycle to show the plan's effect; re-deciding
+#: before that double-provisions on the same backlog spike (the
+#: classic autoscaler oscillation).  Decisions suppressed by the
+#: cooldown are counted and logged, not silently dropped.
+ELASTIC_COOLDOWN_S = 10.0
+
+#: Grow when the mean per-live-engine ring backlog exceeds this many
+#: records (sustained, see hysteresis).  One dispatch batch is 256-2k
+#: records; 8k backlog is several seconds of drain at smoke-scale
+#: rates — real pressure, not jitter.
+ELASTIC_GROW_BACKLOG = 8192
+
+#: Shrink when every live engine's backlog stays under this (and
+#: n_live > min).  64 records is sub-batch — effectively idle.
+ELASTIC_SHRINK_BACKLOG = 64
+
+#: Rebalance (move half the hottest rank's span to the coldest) when
+#: the max/mean record-rate skew across live ranks exceeds this.
+#: 2.0 means one rank does double the fleet average — past hash
+#: jitter, into hot-span territory.
+ELASTIC_SKEW_RATIO = 2.0
+
+#: Donor-side handoff ship timeout (``rebalance.ship_rows``): a full
+#: mailbox means the recipient stopped draining; past this the
+#: handoff aborts (fence clears, donor keeps the span) rather than
+#: wedging the fleet behind one dead recipient.
+HANDOFF_SHIP_TIMEOUT_S = 30.0
+
+#: Supervisor-side bound on a whole handoff (fence stamp -> all acks).
+#: Past this the supervisor aborts and clears the fence: the span was
+#: never unserved (donor kept it), so the safe exit is always "undo".
+HANDOFF_TIMEOUT_S = 60.0

@@ -27,7 +27,11 @@ once (gen 1, ``restore=`` its last checkpoint — the report records
 the restore actually happened), the SURVIVOR loses nothing (rank 0
 serves every record of its shard, keeps publishing, and still holds
 the dead engine's pre-crash blocks in its merged view), and nobody
-ends FAILED.
+ends FAILED.  The cycle ends with the SUPERVISOR-death drill (ISSUE
+16): the original supervisor is abandoned mid-serve and a
+replacement ``boot(adopt=True)`` onto the live plane — the census
+must adopt both serving ranks untouched (no respawn) and the
+replacement owns the stop-drain to DONE.
 
 Results merge into ``artifacts/CLUSTER_r14.json`` under ``"smoke"``
 (the ``"paced"`` scaling comparison vs the single-engine PR 9 worktree
@@ -328,17 +332,42 @@ def _phase_b(tmp: str) -> dict:
         failures.append(
             f"restarted rank 1 left {rings[1].readable()} records "
             "unread in its ring shard")
-    sup.request_stop()
-    t_end = time.monotonic() + 60.0
-    while (len(sup._done) + len(sup._failed) < ENGINES
-           and time.monotonic() < t_end):
-        sup.poll()
-        time.sleep(0.05)
-    sup.close()
-    agg = sup.aggregate()
 
-    if agg["restarts"] != [0, 1]:
-        failures.append(f"restarts {agg['restarts']} != [0, 1]")
+    # the supervisor-death drill (ISSUE 16 adopt path): the ORIGINAL
+    # supervisor vanishes — never polled again, never closed while the
+    # fleet lives — and a replacement boot(adopt=True)s onto the SAME
+    # plane.  The census must find both ranks live (pid + heartbeat)
+    # and adopt them untouched; the replacement then owns the
+    # stop-drain, proving a supervisor death is a fleet non-event.
+    sup2 = ClusterSupervisor(cluster_dir, sup.specs, t0_ns=t0_ns,
+                             heartbeat_timeout_s=60.0)
+    sup2.boot(adopt=True)
+    adopted = sorted(sup2._adopted)
+    if adopted != [0, 1]:
+        failures.append(
+            f"adopting supervisor found live ranks {adopted}, "
+            "expected [0, 1] — a serving fleet must be adopted, "
+            "not respawned")
+    if any(sup2.restarts):
+        failures.append(
+            f"adopt respawned a live rank (restarts={sup2.restarts})")
+    sup2.request_stop()
+    t_end = time.monotonic() + 60.0
+    while (len(sup2._done) + len(sup2._failed) < ENGINES
+           and time.monotonic() < t_end):
+        sup2.poll()
+        time.sleep(0.05)
+    if len(sup2._done) < ENGINES:
+        failures.append(
+            f"adopted fleet did not drain to DONE under the new "
+            f"supervisor (done={sorted(sup2._done)} "
+            f"failed={sorted(sup2._failed)})")
+    sup2.close()
+    sup.close()  # the abandoned original: reap handles only
+    agg = sup2.aggregate()
+
+    if sup.restarts != [0, 1]:
+        failures.append(f"restarts {sup.restarts} != [0, 1]")
     if agg["failed_ranks"]:
         failures.append(f"failed ranks {agg['failed_ranks']}")
     gen1 = [r for r in agg["reports"]
@@ -366,7 +395,8 @@ def _phase_b(tmp: str) -> dict:
         "produced": produced,
         "restart_latency_s": round(time.monotonic() - killed_at, 2)
         if restarted else None,
-        "restarts": agg["restarts"],
+        "restarts": sup.restarts,
+        "supervisor_adopted_ranks": adopted,
         "survivor_records": got[0],
         "gossip_rank0": cl0,
         "failures": failures,

@@ -40,11 +40,22 @@ Stages, in order; the gate fails if any stage fails:
    the real source by AST walk.  ``fsx sync`` is the full surface
    (it adds the bounded-interleaving model checker); this stage is
    its review-speed gate, jax-free like the rest of the module.
-7. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+7. **cluster jax-free** — an AST pass over
+   ``flowsentryx_tpu/cluster/`` that bans MODULE-LEVEL imports of jax
+   or the known jax-importing modules (``fused``/``ops``/
+   ``engine.writeback``/``engine.checkpoint``/``engine.engine``): the
+   cluster plane is the supervisor's and every rank's process-spawn
+   import path, and one module-level jax import there turns every
+   fleet boot, adopt census, and chaos stub into a multi-second jax
+   pay — the exact regression the supervisor inlined
+   ``checkpoint.prev_path`` to avoid.  Function-LOCAL imports stay
+   legal (the lazy-import defense; ``GossipPlane.tick``'s writeback
+   import is the documented exception).  ``# noqa`` exempts a line.
+8. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-6 there.
-8. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-7 there.
+9. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -342,6 +353,72 @@ def stage_np_default_int() -> list[str]:
     return fails
 
 
+#: The jax-free package: every module here sits on the fleet's
+#: process-spawn import path (supervisor, adopt census, chaos stubs),
+#: where one module-level jax import costs seconds per spawn.
+CLUSTER_JAX_FREE_TREE = "flowsentryx_tpu/cluster"
+
+#: Module-level import prefixes banned under the cluster tree: jax
+#: itself plus the repo modules documented to import jax at module
+#: level.  A prefix bans the module and everything under it.
+CLUSTER_JAX_IMPORTERS = (
+    "jax",
+    "flowsentryx_tpu.fused",
+    "flowsentryx_tpu.ops",
+    "flowsentryx_tpu.engine.writeback",
+    "flowsentryx_tpu.engine.checkpoint",
+    "flowsentryx_tpu.engine.engine",
+)
+
+
+def _cluster_jax_findings(path: Path) -> list[str]:
+    """Module-level jax(-importing) import findings for one cluster
+    module (stage 7 docstring)."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    out = []
+    for node in tree.body:  # MODULE level only: locals stay legal
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.Import):
+            mods = [a.name for a in node.names]
+        elif node.module is None or node.level:
+            continue  # relative import: stays inside cluster/
+        else:
+            mods = [node.module]
+        hits = [m for m in mods
+                if any(m == p or m.startswith(p + ".")
+                       for p in CLUSTER_JAX_IMPORTERS)]
+        if not hits:
+            continue
+        line = (lines[node.lineno - 1]
+                if node.lineno <= len(lines) else "")
+        if "noqa" in line:
+            continue
+        try:
+            rel = path.relative_to(REPO)
+        except ValueError:
+            rel = path
+        for m in hits:
+            out.append(
+                f"{rel}:{node.lineno}: module-level import of {m!r} "
+                "puts jax on the cluster plane's spawn path — every "
+                "fleet boot/adopt/stub pays the jax import; move it "
+                "function-local (the GossipPlane.tick discipline)")
+    return out
+
+
+def stage_cluster_jax_free() -> list[str]:
+    fails = []
+    for path in sorted((REPO / CLUSTER_JAX_FREE_TREE).rglob("*.py")):
+        fails.extend(_cluster_jax_findings(path))
+    return fails
+
+
 def stage_sync_contracts() -> list[str]:
     """The thread-contract half of ``fsx sync`` as a lint stage (quick
     mode: pure AST, no model checking, no jax)."""
@@ -393,6 +470,7 @@ def main(argv: list[str] | None = None) -> int:
         "np_default_int": stage_np_default_int(),
         "device_loop_purity": stage_device_loop_purity(),
         "sync_contracts": stage_sync_contracts(),
+        "cluster_jax_free": stage_cluster_jax_free(),
         "ruff": stage_ruff(),
         "mypy": stage_mypy(),
     }

@@ -1,0 +1,529 @@
+"""Bounded CPU elastic-fleet smoke — the live-rebalance CI gate.
+
+Drives the REAL fleet three times per verify run (docs/CLUSTER.md
+§elastic; ISSUE 16):
+
+Phase A — clean handoff under live load: a 3-rank-provisioned fleet
+boots 2 live engines (shard 2 folded onto rank 0), serves a live
+trickle, and mid-serve the supervisor moves shard 2 rank 0 -> rank 1
+through the full protocol (fence -> ship -> stage -> flip -> ack).
+Asserts **exact row conservation** (donor ``rows_shipped`` ==
+recipient ``rows_adopted``, zero ``adopt_dropped`` — the stream is
+CRC-sealed, so equality is byte-identity), **survivor throughput
+never zero** (the fleet serves records WHILE the handoff is in
+flight), a single flip with zero aborts, and a lossless total drain
+(every produced record served).
+
+Phase B — autoscale grow 2 -> 3: the same fleet under an
+:class:`~flowsentryx_tpu.cluster.elastic.ElasticPolicy` with a real
+ingest backlog.  The policy must decide GROW from the ring-cursor
+backlog signal (hysteresis-confirmed), the supervisor spawns rank 2
+gen-0, and once it serves, half the hottest span moves to it.
+Asserts the grow executed, the flip landed rank 2 a span, rank 2
+actually serves records routed to it post-flip, and the decision was
+logged with its signal vector.
+
+Phase C — SIGKILL mid-handoff + recovery: the donor carries the
+``handoff_crash_midship`` chaos spec and dies without cleanup halfway
+through shipping.  Asserts the supervisor ABORTS the handoff (party
+died — donor keeps the span, nothing moved), respawns the donor gen-1
+from its checkpoint, and a RETRY handoff then completes with the same
+exact-conservation equality — the stale-mailbox trap a retry must not
+fall into (cluster/rebalance.py ``_mbx_hid``).
+
+Results write ``artifacts/REBALANCE_r20.json``, re-proved by every
+``scripts/verify_tier1.sh`` run.
+
+Usage: JAX_PLATFORMS=cpu python scripts/rebalance_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PROVISIONED = 3          # ranks the plane is sized for ( = max_engines)
+LIVE = 2                 # ranks booted live (shard 2 folds onto rank 0)
+TOTAL_SHARDS = PROVISIONED  # workers=1: one physical ring per rank
+BATCH = 256
+RING_SLOTS = 1 << 15
+BOOT_TIMEOUT_S = 240
+
+
+def _records(n: int, seed: int):
+    from flowsentryx_tpu.engine.traffic import Scenario, TrafficGen, TrafficSpec
+
+    return TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=32, n_benign_ips=96, attack_fraction=0.8, seed=seed,
+    )).next_records(n)
+
+
+def _cfg_json() -> str:
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=BATCH),
+        table=dataclasses.replace(cfg.table, capacity=1 << 14),
+        limiter=dataclasses.replace(
+            cfg.limiter, pps_threshold=200.0, bps_threshold=1e9),
+    ).to_json()
+
+
+def _make_rings(base: str):
+    from flowsentryx_tpu.core import schema
+    from flowsentryx_tpu.engine.shm import ShmRing
+
+    return [
+        ShmRing.create(schema.shard_ring_path(base, k, TOTAL_SHARDS),
+                       RING_SLOTS, schema.FLOW_RECORD_DTYPE)
+        for k in range(TOTAL_SHARDS)
+    ]
+
+
+def _specs(base: str, cfg_json: str, **extra):
+    return [dict(cfg_json=cfg_json, ring_base=base, workers=1,
+                 total_shards=TOTAL_SHARDS, precompact=False,
+                 queue_slots=16, chunk_s=0.1, gossip_quiesce_s=2.0,
+                 **extra)
+            for _ in range(PROVISIONED)]
+
+
+class Feeder:
+    """The daemon fan-out, assignment-routed: each record's logical
+    shard goes to the ring ``rebalance.assigned_ring_of`` names under
+    the CURRENT published layout (reloaded per round, so a flip
+    reroutes the very next feed).  Records of a shard with a handoff
+    IN FLIGHT are deferred until the fence drops — the pausing move
+    the production daemon grows in the docs/CLUSTER.md follow-up."""
+
+    def __init__(self, cluster_dir: str, rings, recs):
+        import numpy as np
+
+        from flowsentryx_tpu.core import schema
+
+        self.cluster_dir = cluster_dir
+        self.rings = rings
+        self.recs = recs
+        self.shard = schema.shard_of(recs["saddr"], TOTAL_SHARDS)
+        self.cursor = 0
+        self.produced = 0
+        self.deferred = np.zeros(0, dtype=recs.dtype)
+        self.deferred_shard = np.zeros(0, np.uint32)
+
+    def _route(self, part, shard) -> int:
+        import numpy as np
+
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        owners = asg.owners if asg is not None else tuple(
+            range(TOTAL_SHARDS))
+        moving: set[int] = set()
+        hp = rb.handoff_json_path(self.cluster_dir)
+        if hp.exists():
+            try:
+                moving = set(json.loads(hp.read_text()).get("shards", ()))
+            except (OSError, ValueError):
+                pass
+        hold = np.isin(shard, np.fromiter(moving, np.uint32,
+                                          len(moving)))
+        if hold.any():
+            self.deferred = np.concatenate([self.deferred, part[hold]])
+            self.deferred_shard = np.concatenate(
+                [self.deferred_shard, shard[hold]])
+            part, shard = part[~hold], shard[~hold]
+        wrote = 0
+        for s in set(int(x) for x in shard):
+            ring = self.rings[rb.assigned_ring_of(s, owners, 1)]
+            sub = part[shard == np.uint32(s)]
+            w = ring.produce(sub)
+            if w < len(sub):
+                # ring full: keep the tail — backpressure, not loss
+                rest = sub[w:]
+                self.deferred = np.concatenate([self.deferred, rest])
+                self.deferred_shard = np.concatenate(
+                    [self.deferred_shard,
+                     np.full(len(rest), s, np.uint32)])
+            wrote += w
+        self.produced += wrote
+        return wrote
+
+    def feed(self, n: int, *, recycle: bool = False) -> int:
+        import numpy as np
+
+        wrote = 0
+        if len(self.deferred):
+            part, shard = self.deferred, self.deferred_shard
+            self.deferred = np.zeros(0, dtype=self.recs.dtype)
+            self.deferred_shard = np.zeros(0, np.uint32)
+            wrote += self._route(part, shard)
+        if len(self.deferred) >= n:
+            return wrote  # rings full: don't balloon the hold buffer
+        if recycle and self.cursor >= len(self.recs):
+            self.cursor = 0  # load phase: replay the corpus
+        end = min(self.cursor + n, len(self.recs))
+        if end > self.cursor:
+            part = self.recs[self.cursor:end]
+            shard = self.shard[self.cursor:end]
+            self.cursor = end
+            wrote += self._route(part, shard)
+        return wrote
+
+    @property
+    def exhausted(self) -> bool:
+        return self.cursor >= len(self.recs) and not len(self.deferred)
+
+
+def _mk_sup(tmp: str, tag: str, *, elastic=None, ckpt=False,
+            crash_midship_rank: int | None = None):
+    from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+    base = os.path.join(tmp, f"{tag}_ring")
+    cluster_dir = os.path.join(tmp, f"{tag}_cluster")
+    recs = _records(BATCH * 64, seed=97)
+    rings = _make_rings(base)
+    specs = _specs(base, _cfg_json())
+    for r, spec in enumerate(specs):
+        if ckpt:
+            spec["checkpoint"] = os.path.join(tmp, f"{tag}_ckpt_r{r}.npz")
+            spec["checkpoint_every"] = 0.25
+        if r == crash_midship_rank:
+            spec["handoff_crash_midship"] = True
+    sup = ClusterSupervisor(
+        cluster_dir, specs, t0_ns=int(recs["ts_ns"].min()),
+        heartbeat_timeout_s=60.0, n_live=LIVE, elastic=elastic)
+    sup.boot()
+    from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
+
+    status = [StatusBlock(status_path(cluster_dir, r))
+              for r in range(PROVISIONED)]
+    return sup, status, Feeder(cluster_dir, rings, recs), rings
+
+
+def _wait_serving(sup, status, feeder, ranks, failures, *,
+                  min_records: int = 1) -> bool:
+    from flowsentryx_tpu.core import schema
+
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while time.monotonic() < deadline:
+        sup.poll()
+        feeder.feed(BATCH)
+        if all(status[r].ctl_get("c_state") == schema.CSTATE_SERVING
+               and status[r].ctl_get("c_records") >= min_records
+               for r in ranks):
+            return True
+        time.sleep(0.05)
+    failures.append(f"ranks {list(ranks)} never all reached SERVING "
+                    f"with >= {min_records} records served")
+    return False
+
+
+def _drain(sup, status, feeder, rings, failures, *, ranks) -> dict:
+    """Feed out the corpus, stop-drain the fleet, aggregate."""
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while not feeder.exhausted and time.monotonic() < deadline:
+        sup.poll()
+        feeder.feed(BATCH * 4)
+        time.sleep(0.02)
+    while (any(r.readable() for r in rings)
+           and time.monotonic() < deadline):
+        sup.poll()
+        time.sleep(0.05)
+    left = [r.readable() for r in rings]
+    if any(left):
+        failures.append(f"rings not drained: {left} records left")
+    sup.request_stop()
+    t_end = time.monotonic() + 90.0
+    while (len(sup._done) + len(sup._failed) < len(ranks)
+           and time.monotonic() < t_end):
+        sup.poll()
+        time.sleep(0.05)
+    sup.close()
+    return sup.aggregate()
+
+
+def _rebalance_of(agg: dict, rank: int, gen: int | None = None) -> dict:
+    best: dict = {}
+    for rep in agg["reports"]:
+        if rep.get("rank") != rank:
+            continue
+        if gen is not None and rep.get("gen") != gen:
+            continue
+        best = rep.get("report", {}).get("rebalance") or best
+    return best
+
+
+def _phase_a(tmp: str) -> dict:
+    """Clean handoff under live load: shard 2 moves rank 0 -> 1."""
+    failures: list[str] = []
+    sup, status, feeder, rings = _mk_sup(tmp, "a")
+    _wait_serving(sup, status, feeder, range(LIVE), failures)
+
+    served_before = sum(status[r].ctl_get("c_records")
+                        for r in range(LIVE))
+    hid = sup.start_handoff([2], donor=0, recipient=1)
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while sup._handoff is not None and time.monotonic() < deadline:
+        sup.poll()
+        feeder.feed(BATCH)
+        time.sleep(0.02)
+    if sup._handoff is not None:
+        failures.append(f"handoff {hid} never completed")
+    served_after = sum(status[r].ctl_get("c_records")
+                       for r in range(LIVE))
+    if served_after <= served_before:
+        failures.append(
+            f"fleet served nothing while the handoff was in flight "
+            f"({served_before} -> {served_after}): survivor "
+            "throughput must never be zero")
+    if sup.rebalance_counters["flips"] != 1:
+        failures.append(f"flips {sup.rebalance_counters['flips']} != 1")
+    if sup.rebalance_counters["aborts"] != 0:
+        failures.append(f"clean handoff aborted "
+                        f"{sup.rebalance_counters['aborts']} times")
+    from flowsentryx_tpu.cluster import rebalance as rb
+
+    asg = rb.ShardAssignment.load(sup.cluster_dir)
+    if asg.generation != 1 or asg.owners[2] != 1:
+        failures.append(f"layout gen {asg.generation} owners "
+                        f"{asg.owners}: shard 2 must belong to rank 1")
+
+    agg = _drain(sup, status, feeder, rings, failures,
+                 ranks=range(LIVE))
+    donor = _rebalance_of(agg, 0)
+    recip = _rebalance_of(agg, 1)
+    conservation = {
+        "rows_shipped": donor.get("rows_shipped", 0),
+        "rows_adopted": recip.get("rows_adopted", 0),
+        "adopt_dropped": recip.get("adopt_dropped", 0),
+        "rows_dropped_post_flip": donor.get("rows_dropped_post_flip", 0),
+    }
+    if not donor.get("rows_shipped"):
+        failures.append("donor shipped no rows — the corpus must "
+                        "populate shard 2 before the handoff")
+    if (donor.get("rows_shipped", 0)
+            != recip.get("rows_adopted", -1)
+            + recip.get("adopt_dropped", 0)):
+        failures.append(f"row conservation violated: {conservation}")
+    if recip.get("adopt_dropped"):
+        failures.append(f"recipient dropped adopted rows: "
+                        f"{conservation}")
+    if recip.get("handoffs_adopted") != 1 or \
+            donor.get("handoffs_donated") != 1:
+        failures.append(f"handoff counters off: donor={donor} "
+                        f"recipient={recip}")
+    if agg["records"] != feeder.produced:
+        failures.append(f"served {agg['records']} != produced "
+                        f"{feeder.produced}: the handoff lost records")
+    if agg["failed_ranks"] or any(agg["restarts"]):
+        failures.append(f"failed={agg['failed_ranks']} "
+                        f"restarts={agg['restarts']}")
+    return {"records": agg["records"],
+            "served_during_handoff": served_after - served_before,
+            "conservation": conservation, "failures": failures}
+
+
+def _phase_b(tmp: str) -> dict:
+    """Autoscale grow 2 -> 3 from a real ingest backlog."""
+    from flowsentryx_tpu.cluster.elastic import ElasticPolicy
+    from flowsentryx_tpu.core import schema
+
+    failures: list[str] = []
+    policy = ElasticPolicy(min_engines=2, max_engines=3,
+                           grow_backlog=64.0, shrink_backlog=0.0,
+                           skew_ratio=1e9, hysteresis_ticks=2,
+                           cooldown_s=2.0)
+    sup, status, feeder, rings = _mk_sup(tmp, "b", elastic=policy)
+    _wait_serving(sup, status, feeder, range(LIVE), failures)
+
+    # saturate the rings faster than the engines drain (the corpus
+    # replays): the ring-cursor backlog signal stays far above
+    # grow_backlog across the hysteresis window and the whole grow
+    # choreography — decide, spawn, first-serve, span move
+    deadline = time.monotonic() + BOOT_TIMEOUT_S * 2
+    grown = False
+    while time.monotonic() < deadline:
+        sup.poll()
+        sup.elastic_tick()
+        feeder.feed(BATCH * 16, recycle=True)
+        if (2 in sup.live_ranks()
+                and status[2].ctl_get("c_state") == schema.CSTATE_SERVING
+                and sup.rebalance_counters["flips"] >= 1
+                and sup._handoff is None):
+            grown = True
+            break
+        time.sleep(0.05)
+    if not grown:
+        failures.append(
+            f"fleet never grew to 3 serving ranks with a committed "
+            f"span move (live={sup.live_ranks()} "
+            f"flips={sup.rebalance_counters['flips']})")
+    from flowsentryx_tpu.cluster import rebalance as rb
+
+    asg = rb.ShardAssignment.load(sup.cluster_dir)
+    if grown and 2 not in set(asg.owners):
+        failures.append(f"rank 2 owns no shard after the grow "
+                        f"(owners {asg.owners})")
+    growths = [d for d in policy.decisions if d["action"] == "grow"]
+    if not growths:
+        failures.append("no GROW decision in the policy log")
+    elif "backlog_per_engine" not in growths[-1]["signals"]:
+        failures.append(f"grow decided without its signal vector: "
+                        f"{growths[-1]}")
+    if sup.elastic_executed < 1:
+        failures.append("no elastic plan executed")
+
+    agg = _drain(sup, status, feeder, rings, failures,
+                 ranks=range(PROVISIONED) if grown else range(LIVE))
+    r2 = [rep for rep in agg["reports"] if rep.get("rank") == 2]
+    if grown and (not r2 or not r2[-1].get("report", {}).get("records")):
+        failures.append("grown rank 2 served no records — the flip "
+                        "must route its span's traffic to it")
+    if agg["failed_ranks"] or any(agg["restarts"]):
+        failures.append(f"failed={agg['failed_ranks']} "
+                        f"restarts={agg['restarts']}")
+    return {"records": agg["records"], "grown": grown,
+            "grow_decision": growths[-1] if growths else None,
+            "owners": list(asg.owners), "failures": failures}
+
+
+def _phase_c(tmp: str) -> dict:
+    """SIGKILL mid-handoff: abort, gen-1 respawn, retry conserves."""
+    from flowsentryx_tpu.core import schema
+
+    failures: list[str] = []
+    sup, status, feeder, rings = _mk_sup(tmp, "c", ckpt=True,
+                                         crash_midship_rank=0)
+    _wait_serving(sup, status, feeder, range(LIVE), failures)
+    ck0 = sup.specs[0]["checkpoint"]
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while not os.path.exists(ck0) and time.monotonic() < deadline:
+        sup.poll()
+        feeder.feed(BATCH)
+        time.sleep(0.05)
+    if not os.path.exists(ck0):
+        failures.append("rank 0 never checkpointed")
+
+    hid = sup.start_handoff([2], donor=0, recipient=1)
+    # the donor dies mid-ship (handoff_crash_midship): disarm the
+    # chaos spec the moment the corpse is observed, BEFORE the poll
+    # that respawns it — gen 1 must ship cleanly on the retry
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    disarmed = False
+    while time.monotonic() < deadline:
+        p0 = sup._procs[0]
+        if not disarmed and p0 is not None and not p0.is_alive():
+            sup.specs[0]["handoff_crash_midship"] = False
+            disarmed = True
+        sup.poll()
+        feeder.feed(BATCH)
+        if (disarmed and sup.restarts[0] >= 1
+                and status[0].ctl_get("c_gen") == 1
+                and status[0].ctl_get("c_state") == schema.CSTATE_SERVING):
+            break
+        time.sleep(0.02)
+    if not disarmed or sup.restarts[0] != 1:
+        failures.append(
+            f"donor crash cycle wrong (disarmed={disarmed} "
+            f"restarts={sup.restarts})")
+    if sup.rebalance_counters["aborts"] != 1:
+        failures.append(f"aborts {sup.rebalance_counters['aborts']} "
+                        "!= 1: a dead party must abort the handoff")
+    from flowsentryx_tpu.cluster import rebalance as rb
+
+    asg = rb.ShardAssignment.load(sup.cluster_dir)
+    if asg.generation != 0:
+        failures.append(f"aborted handoff flipped the layout to gen "
+                        f"{asg.generation}: nothing may move")
+
+    hid2 = sup.start_handoff([2], donor=0, recipient=1)
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while sup._handoff is not None and time.monotonic() < deadline:
+        sup.poll()
+        feeder.feed(BATCH)
+        time.sleep(0.02)
+    if sup.rebalance_counters["flips"] != 1:
+        failures.append(
+            f"retry handoff {hid2} after abort {hid} never committed "
+            f"(flips={sup.rebalance_counters['flips']})")
+
+    agg = _drain(sup, status, feeder, rings, failures,
+                 ranks=range(LIVE))
+    donor = _rebalance_of(agg, 0, gen=1)
+    recip = _rebalance_of(agg, 1)
+    conservation = {
+        "rows_shipped": donor.get("rows_shipped", 0),
+        "rows_adopted": recip.get("rows_adopted", 0),
+        "adopt_dropped": recip.get("adopt_dropped", 0),
+    }
+    if not donor.get("rows_shipped"):
+        failures.append("gen-1 donor shipped no rows on the retry")
+    if (donor.get("rows_shipped", 0)
+            != recip.get("rows_adopted", -1)
+            + recip.get("adopt_dropped", 0)):
+        failures.append(f"retry conservation violated: {conservation}")
+    gen1 = [r for r in agg["reports"]
+            if r.get("rank") == 0 and r.get("gen") == 1]
+    if not gen1 or not gen1[0].get("restored"):
+        failures.append("gen-1 donor did not restore from its "
+                        "checkpoint")
+    if agg["failed_ranks"]:
+        failures.append(f"failed ranks {agg['failed_ranks']}")
+    return {"records": agg["records"],
+            "aborts": sup.rebalance_counters["aborts"],
+            "conservation": conservation, "failures": failures}
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="fsx_rbsmoke_")
+    try:
+        a = _phase_a(tmp)
+        b = _phase_b(tmp)
+        c = _phase_c(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    failures = [f"phase A: {m}" for m in a.pop("failures")] + \
+               [f"phase B: {m}" for m in b.pop("failures")] + \
+               [f"phase C: {m}" for m in c.pop("failures")]
+
+    out = {
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "provisioned": PROVISIONED,
+        "live_at_boot": LIVE,
+        "live_handoff": a,
+        "autoscale_grow": b,
+        "crash_midship": c,
+        "ok": not failures,
+        "failures": failures,
+    }
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "REBALANCE_r20.json")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"rebalance smoke: wrote {out_path}")
+    print(f"rebalance smoke: handoff conservation="
+          f"{a['conservation']} grow={b['grown']} "
+          f"crash-retry conservation={c['conservation']}")
+    for msg in failures:
+        print(f"rebalance smoke: FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,6 +147,20 @@ echo "== cluster smoke: 2-engine drain + gossip + kill/restart =="
 # scaling evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/cluster_smoke.py || exit 1
 
+echo "== rebalance smoke: live shard handoff + autoscale grow + mid-ship kill =="
+# The elastic-fleet gate (docs/CLUSTER.md §elastic): a 3-rank-
+# provisioned fleet (2 live) moves shard 2 between engines UNDER LIVE
+# LOAD through the full fence->ship->stage->flip protocol with exact
+# row conservation (donor rows_shipped == recipient rows_adopted,
+# CRC-sealed byte identity) and nonzero survivor throughput; an
+# ElasticPolicy grows the fleet 2->3 off the real ring-cursor backlog
+# signal (hysteresis-confirmed, decision logged with its signal
+# vector) and the new rank serves its moved span; a donor SIGKILLed
+# mid-ship aborts cleanly (nothing moves), respawns gen-1 from its
+# checkpoint, and the RETRY conserves exactly — rewriting
+# artifacts/REBALANCE_r20.json each run.
+env JAX_PLATFORMS=cpu python scripts/rebalance_smoke.py || exit 1
+
 echo "== net smoke: multi-host gossip transport on loopback =="
 # The network leg of the gossip plane (docs/CLUSTER.md §multi-host):
 # two simulated hosts with epochs 250 s apart drain verdict streams

@@ -262,3 +262,76 @@ class TestNpDefaultIntStage:
 
     def test_repo_is_clean(self):
         assert lint.stage_np_default_int() == []
+
+
+def _cluster_jax_findings(tmp_path, src):
+    p = tmp_path / "newmod.py"
+    p.write_text(src)
+    old = lint.REPO
+    lint.REPO = tmp_path
+    try:
+        return lint._cluster_jax_findings(p)
+    finally:
+        lint.REPO = old
+
+
+class TestClusterJaxFreeStage:
+    """The cluster plane's import hygiene: module-level jax (or
+    jax-importing-module) imports are banned under cluster/ — one
+    there puts a multi-second jax pay on every fleet boot, adopt
+    census, and chaos stub spawn."""
+
+    def test_module_level_jax_flagged(self, tmp_path):
+        out = _cluster_jax_findings(tmp_path, (
+            "import jax\n\n"
+            "def f():\n"
+            "    return jax.devices()\n"))
+        assert len(out) == 1
+        assert "module-level import of 'jax'" in out[0]
+        assert "newmod.py:1" in out[0]
+
+    def test_from_jax_submodule_flagged(self, tmp_path):
+        out = _cluster_jax_findings(tmp_path, (
+            "from jax.numpy import asarray\n"))
+        assert len(out) == 1 and "'jax.numpy'" in out[0]
+
+    def test_jax_importing_repo_module_flagged(self, tmp_path):
+        out = _cluster_jax_findings(tmp_path, (
+            "from flowsentryx_tpu.engine.writeback import "
+            "decode_verdict_wire\n"))
+        assert len(out) == 1
+        assert "'flowsentryx_tpu.engine.writeback'" in out[0]
+
+    def test_function_local_writeback_allowed(self, tmp_path):
+        # the GossipPlane.tick discipline: lazy-importing the jax
+        # surface inside the function that needs it stays legal
+        out = _cluster_jax_findings(tmp_path, (
+            "def tick():\n"
+            "    from flowsentryx_tpu.engine.writeback import (\n"
+            "        decode_verdict_wire,\n"
+            "    )\n"
+            "    return decode_verdict_wire\n"))
+        assert out == []
+
+    def test_jax_free_engine_modules_allowed(self, tmp_path):
+        # health/metrics/shm are jax-free by design and legal at
+        # module level (the supervisor imports all three)
+        out = _cluster_jax_findings(tmp_path, (
+            "from flowsentryx_tpu.engine import health\n"
+            "from flowsentryx_tpu.engine.metrics import LatencyHist\n"
+            "from flowsentryx_tpu.engine.shm import RingNotReady\n"))
+        assert out == []
+
+    def test_jaxlib_lookalike_not_flagged(self, tmp_path):
+        # the prefix match is per-component: 'jaxtools' is not 'jax'
+        out = _cluster_jax_findings(tmp_path, (
+            "import jaxtools\n"))
+        assert out == []
+
+    def test_noqa_exempts(self, tmp_path):
+        out = _cluster_jax_findings(tmp_path, (
+            "import jax  # noqa: measured, spawn path unaffected\n"))
+        assert out == []
+
+    def test_repo_cluster_tree_is_clean(self):
+        assert lint.stage_cluster_jax_free() == []

@@ -33,7 +33,7 @@ class TestContractsOnRepo:
         assert rep.ok, "\n".join(str(f) for f in rep.findings)
         assert rep.stats["classes"] >= 3
         assert rep.stats["registered_fields"] >= 40
-        assert rep.stats["cursor_classes"] == 3
+        assert rep.stats["cursor_classes"] == 4
         assert rep.stats["ctl_sites"] > 0
 
     def test_quick_mode_runs_same_checks(self):
@@ -52,7 +52,11 @@ class TestContractsOnRepo:
                             "c_hbeat", "c_state", "c_batches", "c_records",
                             # supervisor line (c_t0_wall: ISSUE 15,
                             # the monotonic epoch's wall twin)
-                            "c_stop", "c_gen", "c_t0", "c_t0_wall"}
+                            "c_stop", "c_gen", "c_t0", "c_t0_wall",
+                            # rebalance plane (ISSUE 16): the engine
+                            # ack line vs the supervisor fence line
+                            "c_pid", "c_handoff", "c_layout_ack",
+                            "c_layout_gen", "c_fence"}
         for name in declared:
             if name.startswith("c_"):
                 # cluster status-block fields live in the STATUS_*
@@ -424,6 +428,87 @@ class TestNetRegistry:
         out = check_ctl(ast.parse(src), "planted.py",
                         "cluster-engine")
         assert len(out) == 1 and "supervisor" in out[0].reason
+
+
+class TestRebalanceRegistry:
+    """ISSUE 16 satellite: the elastic plane's contracts — the
+    EngineRebalancer's dispatch-owned handoff state, the ElasticPolicy
+    decision state, the HandoffMailbox SPSC cursors, and the five new
+    ctl lines split engine-ack vs supervisor-fence — with one planted
+    negative per new discipline."""
+
+    def test_rebalance_plans_pin_expected_disciplines(self):
+        rb = contracts.REBALANCE_PLAN
+        assert rb.cls == "EngineRebalancer"
+        for f in ("_acked_gen", "_fence_seen", "_staged", "_receiver",
+                  "_mbx"):
+            assert rb.fields[f].discipline == "dispatch", f
+        el = contracts.ELASTIC_PLAN
+        assert el.cls == "ElasticPolicy"
+        for f in ("_streak", "_cooldown_until", "suppressed",
+                  "decisions"):
+            assert el.fields[f].discipline == "dispatch", f
+        # the engine plane registers its rebalance counter line
+        assert contracts.ENGINE_PLAN.fields["_rebalance"].discipline \
+            == "dispatch"
+
+    def test_planted_rebalancer_state_written_from_worker(self):
+        # a worker thread staging handoff rows would race the serving
+        # loop's reconcile/step — _staged is dispatch-owned
+        src = (
+            "class C:\n"
+            "    def step(self):\n"
+            "        self._staged = None\n"
+            "    def run(self):\n"
+            "        self._staged = 1\n")
+        out = _check(src, _plan(
+            {"_staged": FieldContract("dispatch", "staged rows")},
+            worker_targets=("run",)))
+        assert [f.line for f in out] == [5]
+
+    def test_planted_fence_stamped_from_engine_side(self):
+        # only the supervisor stamps the fence: an engine stamping its
+        # own fence could unfence itself mid-commit and serve a
+        # half-flipped route
+        assert contracts.CTL_WRITERS["c_fence"] == "supervisor"
+        src = "def f(st):\n    st.ctl_set('c_fence', 0)\n"
+        out = check_ctl(ast.parse(src), "planted.py", "cluster-engine")
+        assert len(out) == 1 and "supervisor" in out[0].reason
+
+    def test_planted_layout_ack_forged_by_supervisor(self):
+        # the ack line is the ENGINE's proof it observed the flip; the
+        # supervisor acking for a rank would lift the fence without
+        # convergence
+        assert contracts.CTL_WRITERS["c_layout_ack"] == "cluster-engine"
+        src = "def f(st):\n    st.ctl_set('c_layout_ack', 2)\n"
+        out = check_ctl(ast.parse(src), "planted.py", "supervisor")
+        assert len(out) == 1 and "cluster-engine" in out[0].reason
+
+    def test_planted_handoff_mailbox_consumer_stores_head(self):
+        # the SPSC rule on the handoff stream: the recipient storing
+        # the head cursor would republish slots under the donor
+        src = (
+            "class M:\n"
+            "    def _publish(self, n):\n"
+            "        self._head[0] = n\n"
+            "    def pop_slots(self, n):\n"
+            "        self._head[0] = n\n"
+            "        self._tail[0] = n\n")
+        out = check_cursors(ast.parse(src), "planted.py", CursorPlan(
+            module="planted.py", cls="M",
+            producer=("_publish",), consumer=("pop_slots",)))
+        assert len(out) == 1
+        assert "head cursor stored outside the producer side" \
+            in out[0].reason
+
+    def test_repo_rebalance_obeys_its_plan(self):
+        rep = run_contracts()
+        assert not [f for f in rep.findings
+                    if "rebalance" in f.path or "elastic" in f.path]
+
+    def test_rebalance_module_is_engine_side(self):
+        assert contracts.CTL_MODULE_SIDE[
+            "flowsentryx_tpu/cluster/rebalance.py"] == "cluster-engine"
 
 
 class TestTuningTable:
