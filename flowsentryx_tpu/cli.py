@@ -457,6 +457,70 @@ def _cmd_sync(args: argparse.Namespace) -> int:
     return 0 if out["ok"] else 1
 
 
+def _cmd_crash(args: argparse.Namespace) -> int:
+    """Crash-consistency model checking of the durable-state
+    protocols — the fifth static leg (docs/CRASH.md, docs/STATIC.md).
+
+    Runs the REAL protocol code — the fenced handoff and dead-span
+    adoption (cluster/rebalance.py + cluster/supervisor.py), the
+    layout generation flip, and checkpoint write/rotate/fallback
+    (engine/checkpoint.py) — over a simulated filesystem with honest
+    POSIX semantics, forks a crash at every atomic step (power loss
+    and per-party process death), reconstructs every legal post-crash
+    durable state (namespace-journal prefixes × torn un-fsynced
+    files, plus a media-fault flavor), runs the real recovery path,
+    and asserts the named invariant catalog: exact row conservation,
+    no dual ownership, monotone layout generation, checkpoint always
+    loadable from current-or-.prev, fresh handoff ids on retry,
+    single SPSC consumer, convergence.  Planted regressions must each
+    be caught with a printed crash schedule, from runs whose
+    unplanted controls are clean.
+
+    jax-free; ``--quick`` trims the torn-file fan-out (same crash
+    points and protocols, fewer tear variants per un-synced file).
+    """
+    from flowsentryx_tpu.crash import run_crash
+
+    rep = run_crash(quick=args.quick)
+    if not args.json:
+        for s in rep["scenarios"]:
+            status = "OK" if s["violations"] == 0 else "FAILED"
+            print(f"fsx crash: {s['scenario']}: {status} "
+                  f"({s['crash_points']} crash points, "
+                  f"{s['states_explored']} durable states, "
+                  f"{s['recoveries']} recoveries"
+                  + (", CAPPED" if s["capped"] else "") + ")")
+            if s["counterexample"]:
+                print("  " + s["counterexample"].replace("\n", "\n  "),
+                      file=sys.stderr)
+        for p in rep["plants"]:
+            ok = p["caught"] and p["control_ok"]
+            why = ("caught by " + p["caught_by"] if p["caught"]
+                   else "NOT CAUGHT")
+            if not p["control_ok"]:
+                why += "; control run dirty"
+            print(f"fsx crash: plant {p['plant']}: "
+                  f"{'OK' if ok else 'FAILED'} ({why})")
+            if p["schedule"] and not args.quiet_plants:
+                print("  " + p["schedule"].replace("\n", "\n  "))
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(rep, indent=2) + "\n")
+        if not args.json:
+            print(f"fsx crash: report -> {p}")
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    elif rep["ok"]:
+        t = rep["totals"]
+        print(f"fsx crash: PASS ({t['crash_points']} crash points, "
+              f"{t['states_explored']} durable states, "
+              f"{t['recoveries']} recoveries, {rep['elapsed_s']} s)")
+    else:
+        print("fsx crash: FAIL", file=sys.stderr)
+    return 0 if rep["ok"] else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """Deterministic fault-injection campaign over the REAL stack —
     the robustness leg of the verification suite (the static legs
@@ -2540,6 +2604,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also write the JSON report here (the "
                          "artifacts/SYNC_*.json evidence file)")
     sy.set_defaults(fn=_cmd_sync)
+
+    cr = sub.add_parser(
+        "crash",
+        help="crash-consistency model checking: run the real "
+             "durable-state protocols (handoff, adoption, layout "
+             "flip, checkpoint rotation) over a simulated fs with "
+             "honest POSIX semantics, crash every atomic step, and "
+             "assert the invariant catalog (jax-free; the fifth "
+             "static leg)")
+    cr.add_argument("--quick", action="store_true",
+                    help="trim the torn-file fan-out per crash point "
+                         "(same crash points and protocols; what the "
+                         "tier-1 gate runs)")
+    cr.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    cr.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/CRASH_*.json evidence file)")
+    cr.add_argument("--quiet-plants", action="store_true",
+                    help="suppress the planted regressions' printed "
+                         "crash schedules (kept in the JSON report)")
+    cr.set_defaults(fn=_cmd_crash)
 
     rg = sub.add_parser(
         "ranges",

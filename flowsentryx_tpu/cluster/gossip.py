@@ -42,6 +42,7 @@ from pathlib import Path
 
 import numpy as np
 
+from flowsentryx_tpu.core import durable
 from flowsentryx_tpu.cluster.mailbox import (
     StatusBlock, VerdictMailbox, mailbox_path, status_path,
 )
@@ -79,8 +80,9 @@ def create_plane(cluster_dir, n_engines: int, k_max: int = 64,
     # geometry stamp, written LAST (its presence implies the files
     # above exist): GossipPlane refuses an n_engines mismatch — an
     # engine attaching a 3-engine plane as rank 0/2 would otherwise
-    # serve happily while silently excluding rank 2 from gossip
-    (Path(cluster_dir) / "plane.json").write_text(json.dumps(
+    # serve happily while silently excluding rank 2 from gossip.
+    # atomic+durable: the adopt census reads this after any crash.
+    durable.atomic_write(Path(cluster_dir) / "plane.json", json.dumps(
         {"n_engines": n_engines, "k_max": k_max, "slots": slots,
          "net": bool(net)}))
 

@@ -103,7 +103,7 @@ class VerdictMailbox:
         slot_bytes = (schema.GOSSIP_SLOT_HDR_WORDS + 2 * k_max + 4) * 4
         nbytes = schema.SHM_HDR_SIZE + slots * slot_bytes
         path = Path(path)
-        with open(path, "wb") as f:
+        with open(path, "wb") as f:  # noqa: shm ring create (tmpfs), not durable state
             f.truncate(nbytes)
         with open(path, "r+b") as f:
             mm = mmap.mmap(f.fileno(), 0)
@@ -212,7 +212,7 @@ class StatusBlock:
         start zeroed — CSTATE 0 reads as "never booted")."""
         _require_tso()
         path = Path(path)
-        with open(path, "wb") as f:
+        with open(path, "wb") as f:  # noqa: shm status block (tmpfs), not durable state
             f.truncate(schema.SHM_STATUS_SIZE)
         with open(path, "r+b") as f:
             mm = mmap.mmap(f.fileno(), 0)

@@ -51,10 +51,15 @@ The handoff state machine (docs/CLUSTER.md has the diagram)::
                               ack HP_DROPPED           ack HP_INSERTED
                               c_layout_ack=gen+1       c_layout_ack=gen+1
     all live acks == gen+1:
-    clear fences, delete handoff.json/spool/mailbox
+    clear fences, delete handoff.json/mailbox
+    (the staged SPOOL outlives the handoff: until the recipient's
+     next checkpoint covers the adopted rows it is their only durable
+     copy — the recipient releases it via :meth:`note_checkpointed`)
 
 Exact-row conservation at EVERY interruption point (the chaos
-campaign's ``handoff_rows_conserved`` invariant):
+campaign's ``handoff_rows_conserved`` invariant; ``fsx crash`` proves
+it exhaustively — every atomic step, every legal post-crash durable
+state, docs/CRASH.md):
 
 * death before the flip commits → the supervisor ABORTS: fence
   cleared, staged rows discarded (memory and spool), layout.json
@@ -66,6 +71,10 @@ campaign's ``handoff_rows_conserved`` invariant):
 * recipient death AFTER the flip, before its insert → the staged
   spool was written BEFORE HP_STAGED was acked (crash-safe by
   construction); its next boot adopts the spool.  Nothing lost.
+* power loss AFTER the flip, before the recipient's next checkpoint →
+  the spool is still on disk (it is NOT deleted at flip-finish) and
+  re-adoption is idempotent (duplicate keys drop), so rebooting from
+  the pre-flip checkpoint re-adopts the shipped rows.  Nothing lost.
 
 The fence is the quiesce: while ``c_fence`` names a handoff, producers
 stop routing new records for the moving shards (they fall to the
@@ -77,18 +86,21 @@ and every survivor keeps serving everything, throughout.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import io
 import json
 import mmap
 import os
 import socket
 import time
+import zipfile
 import zlib
 from pathlib import Path
 
 import numpy as np
 
-from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core import durable, schema
 from flowsentryx_tpu.engine.shm import RingNotReady, _require_tso
 
 #: One packed table row on the handoff wire: key word + the f32 state
@@ -120,9 +132,111 @@ def staged_path(cluster_dir: str | Path, rank: int) -> Path:
 
 
 def _write_atomic(path: Path, text: str) -> None:
-    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
-    tmp.write_text(text)
-    os.replace(tmp, path)
+    """Durable-state publish (layout.json, handoff.json): the shared
+    atomic-write helper — fsync file then parent dir, so the publish
+    survives POWER loss once this returns, not just a process crash
+    (core/durable.py; the fsx crash checker's forcing function)."""
+    durable.atomic_write(path, text)
+
+
+# -- the fs + mailbox seams (the fsx crash checker's injection points) ------
+
+#: Swapped by :func:`use_mailbox_cls` so the crash checker can drive
+#: the REAL handoff state machine (supervisor + both engine halves)
+#: over a simulated mailbox with shm's volatility made explicit.
+#: ``None`` means the real shm :class:`HandoffMailbox`.
+_MAILBOX_CLS: type | None = None
+
+
+def mailbox_cls() -> type:
+    """The mailbox class/factory the handoff protocol instantiates —
+    must provide ``create(path, ...)`` and ``__call__(path)`` (open).
+    Both supervisor and engine sides resolve through here, so they
+    agree on the plane by construction."""
+    return HandoffMailbox if _MAILBOX_CLS is None else _MAILBOX_CLS
+
+
+@contextlib.contextmanager
+def use_mailbox_cls(cls):
+    global _MAILBOX_CLS
+    prev = _MAILBOX_CLS
+    _MAILBOX_CLS = cls
+    try:
+        yield cls
+    finally:
+        _MAILBOX_CLS = prev
+
+
+#: np.load errors that mean "this spool is damaged" (the checkpoint
+#: module's _DAMAGE_ERRORS contract, minus the engine import).
+_SPOOL_DAMAGE = (OSError, EOFError, zipfile.BadZipFile, zlib.error,
+                 KeyError, IndexError, ValueError)
+
+
+def save_spool(path: Path, keys, states, *, handoff_id: int,
+               to_gen: int) -> None:
+    """Publish the recipient's staged spool ATOMICALLY AND DURABLY
+    (npz bytes through :func:`durable.atomic_write`).  Ordering is the
+    protocol's crash-safety: this must complete — fsync included —
+    BEFORE HP_STAGED is acked, because the supervisor commits the flip
+    on that ack and a post-flip recipient death recovers the rows from
+    exactly this file (the ``spool_ack_reorder`` planted regression in
+    fsx crash shows the schedule that loses rows otherwise)."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, keys=np.asarray(keys, np.uint32),
+                        states=np.asarray(states, np.float32),
+                        handoff_id=np.uint64(handoff_id),
+                        to_gen=np.uint64(to_gen))
+    durable.atomic_write(path, buf.getvalue())
+
+
+def load_spool(path: Path) -> dict | None:
+    """The staged spool's contents, ``None`` when absent; raises
+    ``ValueError`` on a torn/corrupt file (one named damage class, so
+    every consumer — reconcile, flip, supervisor census — refuses the
+    same way instead of leaking zipfile internals)."""
+    fs = durable.get_fs()
+    if not fs.exists(path):
+        return None
+    try:
+        with np.load(io.BytesIO(fs.read_bytes(path))) as z:
+            return {"keys": np.asarray(z["keys"], np.uint32),
+                    "states": np.asarray(z["states"], np.float32),
+                    "handoff_id": int(z["handoff_id"]),
+                    "to_gen": int(z["to_gen"])}
+    except _SPOOL_DAMAGE as e:
+        raise ValueError(
+            f"staged spool {path} is torn or corrupt: "
+            f"{type(e).__name__}: {e}") from e
+
+
+def discard_uncommitted_spool(cluster_dir: str | Path,
+                              rank: int) -> bool:
+    """Unlink ``rank``'s staged spool ONLY if it cannot be anyone's
+    durable truth: torn, or staged for a flip that never committed
+    (``to_gen`` beyond the committed layout generation).  A spool
+    at-or-below the committed generation is the shipped rows' LAST
+    durable copy until the recipient's next checkpoint covers them
+    (:meth:`EngineRebalancer.note_checkpointed`) — deleting it on
+    abort/neutralize would reopen the post-commit loss window the
+    fsx crash checker found.  Returns True when a spool was removed."""
+    fs = durable.get_fs()
+    spool = staged_path(cluster_dir, rank)
+    if not fs.exists(spool):
+        return False
+    asg = ShardAssignment.load(cluster_dir)
+    gen = asg.generation if asg is not None else -1
+    try:
+        sp = load_spool(spool)
+        if sp is not None and sp["to_gen"] <= gen:
+            return False
+    except ValueError:
+        pass  # torn: nothing adoptable in it, safe to clear
+    try:
+        fs.unlink(spool)
+    except OSError:
+        return False
+    return True
 
 
 # -- shard assignment -------------------------------------------------------
@@ -199,10 +313,11 @@ class ShardAssignment:
 
     @classmethod
     def load(cls, cluster_dir: str | Path) -> "ShardAssignment | None":
+        fs = durable.get_fs()
         p = layout_path(cluster_dir)
-        if not p.exists():
+        if not fs.exists(p):
             return None
-        d = json.loads(p.read_text())
+        d = json.loads(fs.read_text(p))
         return cls(generation=int(d["generation"]),
                    owners=tuple(int(r) for r in d["owners"]))
 
@@ -354,7 +469,7 @@ class HandoffMailbox:
                       + rows_per_slot * row_words) * 4
         nbytes = schema.SHM_HDR_SIZE + slots * slot_bytes
         path = Path(path)
-        with open(path, "wb") as f:
+        with open(path, "wb") as f:  # noqa: shm handoff mailbox (tmpfs), not durable state
             f.truncate(nbytes)
         with open(path, "r+b") as f:
             mm = mmap.mmap(f.fileno(), 0)
@@ -627,15 +742,16 @@ class NetHandoff:
 
 def load_ckpt_rows(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
     """Occupied ``(keys, states)`` rows of a checkpoint npz WITHOUT the
-    engine import chain (engine/checkpoint.py pulls jax at module
-    level; the supervisor adopting a dead rank's span must stay on the
-    jax-free path — the same reason supervisor.py inlines the .prev
-    layout).  Mirrors ``checkpoint._fold_crc`` byte-for-byte so a
-    corrupt snapshot is refused here too, never adopted."""
+    engine import chain (the supervisor adopting a dead rank's span
+    stays off engine/* imports entirely — engine/checkpoint.py is
+    jax-free since the fsx crash refactor, but the cluster plane keeps
+    its own reader all the same).  Mirrors ``checkpoint._fold_crc``
+    byte-for-byte so a corrupt snapshot is refused here too, never
+    adopted."""
     path = Path(path)
     entries: dict[str, np.ndarray] = {}
     stored_crc = None
-    with np.load(path) as z:
+    with np.load(io.BytesIO(durable.get_fs().read_bytes(path))) as z:
         for name in z.files:
             if name == "integrity_crc32":
                 stored_crc = int(z[name])
@@ -697,11 +813,12 @@ class EngineRebalancer:
         self._mbx_hid = 0
 
     def _handoff(self, handoff_id: int) -> dict | None:
+        fs = durable.get_fs()
         p = handoff_json_path(self.cluster_dir)
-        if not p.exists():
+        if not fs.exists(p):
             return None
         try:
-            d = json.loads(p.read_text())
+            d = json.loads(fs.read_text(p))
         except (OSError, ValueError):
             return None
         return d if d.get("id") == handoff_id else None
@@ -720,23 +837,24 @@ class EngineRebalancer:
         if asg is None:
             return out
         spool = staged_path(self.cluster_dir, self.rank)
-        if spool.exists():
-            try:
-                with np.load(spool) as z:
-                    to_gen = int(z["to_gen"])
-                    keys = np.asarray(z["keys"], np.uint32)
-                    states = np.asarray(z["states"], np.float32)
-                if to_gen <= asg.generation:
-                    # the flip committed before we died: the rows are
-                    # ours and exist nowhere else — insert them
-                    inserted, dropped = eng.adopt_rows(keys, states)
-                    out["adopted_rows"] = inserted
-                    eng.count_rebalance("rows_adopted", inserted)
-                    if dropped:
-                        eng.count_rebalance("adopt_dropped", dropped)
-                    spool.unlink()
-            except (OSError, ValueError, KeyError):
-                pass  # torn spool: the handoff will abort and retry
+        try:
+            sp = load_spool(spool)
+            if sp is not None and sp["to_gen"] <= asg.generation:
+                # the flip committed before we died: the rows are
+                # ours — insert them.  The spool STAYS on disk until
+                # a checkpoint covers the rows (note_checkpointed);
+                # unlinking here would make this very adoption the
+                # only copy, and a crash before the next checkpoint
+                # would lose it.  Re-adoption on a later boot is
+                # harmless: adopt_rows drops duplicate keys.
+                inserted, dropped = eng.adopt_rows(sp["keys"],
+                                                   sp["states"])
+                out["adopted_rows"] = inserted
+                eng.count_rebalance("rows_adopted", inserted)
+                if dropped:
+                    eng.count_rebalance("adopt_dropped", dropped)
+        except (OSError, ValueError, KeyError):
+            pass  # torn spool: the handoff will abort and retry
         mine = set(asg.spans_of(self.rank))
         foreign = [s for s in range(asg.total_shards) if s not in mine]
         if foreign:
@@ -748,6 +866,32 @@ class EngineRebalancer:
         self._acked_gen = asg.generation
         self.status.ctl_set("c_layout_ack", asg.generation)
         return out
+
+    def note_checkpointed(self) -> bool:
+        """Called by the runner right after a checkpoint save returns:
+        every adopted row is now covered by a durable checkpoint, so
+        the staged spool — until this moment the shipped rows' last
+        independent durable copy — can finally be released.  Only a
+        spool whose flip this engine has already applied
+        (``to_gen <= _acked_gen``) goes; a newer one belongs to an
+        in-flight handoff and stays.  Found by the fsx crash checker:
+        unlinking the spool at flip-finish (before any recipient
+        checkpoint) loses the rows at power crash."""
+        spool = staged_path(self.cluster_dir, self.rank)
+        fs = durable.get_fs()
+        if not fs.exists(spool):
+            return False
+        try:
+            sp = load_spool(spool)
+        except ValueError:
+            return False  # torn: leave it for abort/retry hygiene
+        if sp is None or sp["to_gen"] > self._acked_gen:
+            return False
+        try:
+            fs.unlink(spool)
+        except OSError:
+            return False
+        return True
 
     def step(self, eng) -> bool:
         """One inter-chunk tick of the engine-side state machine.
@@ -798,7 +942,7 @@ class EngineRebalancer:
                 return True
             keys, states = eng.extract_span_rows(
                 h["shards"], h["total_shards"])
-            mbx = HandoffMailbox(
+            mbx = mailbox_cls()(
                 handoff_mailbox_path(self.cluster_dir, fence))
             on_slot = None
             if self.crash_midship:
@@ -813,7 +957,7 @@ class EngineRebalancer:
         if h.get("recipient") == self.rank and phase < schema.HP_STAGED:
             if self._mbx is None or self._mbx_hid != fence:
                 try:
-                    self._mbx = HandoffMailbox(
+                    self._mbx = mailbox_cls()(
                         handoff_mailbox_path(self.cluster_dir, fence))
                 except (OSError, RingNotReady):
                     self._mbx = None
@@ -832,13 +976,13 @@ class EngineRebalancer:
                 return True
             keys, states = self._receiver.rows()
             # crash-safe spool BEFORE the ack: a post-flip recipient
-            # death must find the rows on disk (reconcile adopts them)
-            spool = staged_path(self.cluster_dir, self.rank)
-            tmp = spool.with_name(f".{spool.stem}.tmp.{os.getpid()}.npz")
-            np.savez_compressed(tmp, keys=keys, states=states,
-                                handoff_id=np.uint64(fence),
-                                to_gen=np.uint64(h["to_gen"]))
-            os.replace(tmp, spool)
+            # death must find the rows on disk (reconcile adopts
+            # them), so the spool must be DURABLE — fsync'd file and
+            # rename — before HP_STAGED commits the supervisor to the
+            # flip (save_spool's ordering contract)
+            save_spool(staged_path(self.cluster_dir, self.rank),
+                       keys, states, handoff_id=fence,
+                       to_gen=h["to_gen"])
             self._staged = (h, keys, states)
             self._ack(fence, schema.HP_STAGED)
             return True
@@ -848,11 +992,12 @@ class EngineRebalancer:
         asg = ShardAssignment.load(self.cluster_dir)
         if asg is None or asg.generation < gen:
             return False  # layout.json not visible yet; next tick
+        fs = durable.get_fs()
         h = None
         p = handoff_json_path(self.cluster_dir)
-        if p.exists():
+        if fs.exists(p):
             try:
-                h = json.loads(p.read_text())
+                h = json.loads(fs.read_text(p))
             except (OSError, ValueError):
                 h = None
         if h is not None and h.get("to_gen") == gen:
@@ -872,13 +1017,11 @@ class EngineRebalancer:
                     self._staged = None
                 else:
                     # staged in a previous life: the spool has it
-                    spool = staged_path(self.cluster_dir, self.rank)
-                    if spool.exists():
-                        with np.load(spool) as z:
-                            keys = np.asarray(z["keys"], np.uint32)
-                            states = np.asarray(z["states"],
-                                                np.float32)
-                        inserted, dropped = eng.adopt_rows(keys, states)
+                    sp = load_spool(staged_path(self.cluster_dir,
+                                                self.rank))
+                    if sp is not None:
+                        inserted, dropped = eng.adopt_rows(
+                            sp["keys"], sp["states"])
                         eng.count_rebalance("rows_adopted", inserted)
                         eng.count_rebalance("handoffs_adopted", 1)
                 self._ack(h["id"], schema.HP_INSERTED)

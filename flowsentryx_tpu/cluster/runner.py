@@ -236,6 +236,9 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
             rebalancer.step(eng)
             if next_ckpt is not None and time.monotonic() >= next_ckpt:
                 eng.checkpoint(ckpt)
+                # the checkpoint now covers any adopted rows: release
+                # the staged spool (their durable copy until this save)
+                rebalancer.note_checkpointed()
                 next_ckpt = time.monotonic() + every
             if plane.stop_requested() and not stopped:
                 # drain-on-stop: workers empty their ring shards, the
@@ -291,7 +294,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         }
         p = Path(spec["report_path"])
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps(out, indent=2) + "\n")
+        p.write_text(json.dumps(out, indent=2) + "\n")  # noqa: report file, informational
 
 
 def stub_engine_main(spec: dict) -> int:
@@ -324,7 +327,7 @@ def stub_engine_main(spec: dict) -> int:
     if spec.get("report_path"):
         p = Path(spec["report_path"])
         p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps({
+        p.write_text(json.dumps({  # noqa: report file, informational
             "rank": spec["rank"], "gen": gen, "stub": True,
             "restored": spec.get("restore"),
             "report": {"records": 0, "batches": 0},

@@ -37,7 +37,7 @@ from pathlib import Path
 
 from flowsentryx_tpu.cluster import gossip as gplane
 from flowsentryx_tpu.cluster.mailbox import StatusBlock, status_path
-from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core import durable, schema
 # jax-free engine leaves (engine/__init__ is lazy — no jax rides in):
 # the HDR histogram class whose bucket counts the per-rank reports
 # carry, merged here into the cluster latency view, and the health
@@ -307,6 +307,7 @@ class ClusterSupervisor:
             raise RuntimeError(
                 "adopt=True: no rank ever stamped the shared epoch — "
                 "this plane never served; boot without adopt")
+        self._neutralize_stale_handoff()
         if self.net is not None:
             from flowsentryx_tpu.cluster import transport
 
@@ -628,11 +629,11 @@ class ClusterSupervisor:
             slots = 1
             while slots < need:
                 slots *= 2
-            mbx = rb.HandoffMailbox.create(mbx_path, slots=slots,
-                                           rows_per_slot=per)
+            mbx = rb.mailbox_cls().create(mbx_path, slots=slots,
+                                          rows_per_slot=per)
             rb.ship_rows(mbx, keys, states)
         else:
-            rb.HandoffMailbox.create(mbx_path)
+            rb.mailbox_cls().create(mbx_path)
         rb._write_atomic(rb.handoff_json_path(self.cluster_dir),
                          json.dumps({
                              "id": hid, "shards": shards,
@@ -693,12 +694,10 @@ class ClusterSupervisor:
                 self.rebalance_counters["flips"] += 1
                 if h["n_rows"] is None:
                     try:  # the staged spool is the shipped-row census
-                        import numpy as np
-
-                        with np.load(rb.staged_path(
-                                self.cluster_dir,
-                                h["recipient"])) as z:
-                            h["n_rows"] = int(len(z["keys"]))
+                        sp = rb.load_spool(rb.staged_path(
+                            self.cluster_dir, h["recipient"]))
+                        h["n_rows"] = (int(len(sp["keys"]))
+                                       if sp is not None else 0)
                     except (OSError, ValueError, KeyError):
                         h["n_rows"] = 0
                 h["phase"] = "committing"
@@ -724,12 +723,17 @@ class ClusterSupervisor:
         h = self._handoff
         self._clear_fences()
         self.rebalance_counters["rows_shipped"] += int(h["n_rows"] or 0)
+        fs = durable.get_fs()
+        # NOT unlinked here: the recipient's staged spool.  Until the
+        # recipient's next checkpoint covers the adopted rows, the
+        # spool is their only durable copy — the recipient releases it
+        # itself (EngineRebalancer.note_checkpointed).  Unlinking at
+        # finish lost the rows at power crash (fsx crash checker).
         for p in (rb.handoff_json_path(self.cluster_dir),
                   Path(rb.handoff_mailbox_path(self.cluster_dir,
-                                               h["id"])),
-                  rb.staged_path(self.cluster_dir, h["recipient"])):
+                                               h["id"]))):
             try:
-                p.unlink()
+                fs.unlink(p)
             except OSError:
                 pass
         self._handoff = None
@@ -745,19 +749,95 @@ class ClusterSupervisor:
 
         h = self._handoff
         self._clear_fences()
+        fs = durable.get_fs()
         for p in (rb.handoff_json_path(self.cluster_dir),
                   Path(rb.handoff_mailbox_path(self.cluster_dir,
-                                               h["id"])),
-                  rb.staged_path(self.cluster_dir, h["recipient"])):
+                                               h["id"]))):
             try:
-                p.unlink()
+                fs.unlink(p)
             except OSError:
                 pass
+        # the spool goes only if it was staged for THIS (uncommitted)
+        # attempt — one kept from an earlier committed flip is still
+        # the recipient's durable copy (rebalance.py helper docstring)
+        rb.discard_uncommitted_spool(self.cluster_dir, h["recipient"])
         self.rebalance_counters["aborts"] += 1
         print(f"fsx cluster: handoff {h['id']} (shards {h['shards']} "
               f"rank {h['donor']} -> {h['recipient']}) ABORTED: {why}; "
               "donor keeps the span, nothing moved", file=sys.stderr)
         self._handoff = None
+
+    def _neutralize_stale_handoff(self) -> None:
+        """Adopt-path hygiene (found by the fsx crash checker's
+        supervisor-crash mode): a supervisor that died mid-handoff
+        leaves the fence stamped and handoff.json/mailbox/spool
+        behind, and a successor's handoff ids restart at 1 — so its
+        FIRST handoff would collide with the dead attempt's id, read
+        the stale ``c_handoff`` acks and spool as its own, and commit
+        a flip whose rows were never shipped (row loss).  On adopt:
+        clear every fence (a fence with no live coordinator wedges the
+        span's ingest forever), seed the id counter past the stale id,
+        then either RESUME the handoff (flip already committed — the
+        layout is durable truth, the fleet just has to finish
+        observing it) or delete the dead attempt's artifacts (not
+        committed — nothing moved, the donor still owns the span, the
+        next handoff retries under a fresh id)."""
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        fs = durable.get_fs()
+        self._clear_fences()
+        p = rb.handoff_json_path(self.cluster_dir)
+        if not fs.exists(p):
+            return
+        try:
+            stale = json.loads(fs.read_text(p))
+        except (OSError, ValueError):
+            stale = {}
+        hid = int(stale.get("id", 0) or 0)
+        self._handoff_seq = max(self._handoff_seq, hid)
+        asg = rb.ShardAssignment.load(self.cluster_dir)
+        committed = (asg is not None and "to_gen" in stale
+                     and asg.generation >= int(stale["to_gen"]))
+        if committed and "recipient" in stale and "shards" in stale:
+            # the flip is DURABLE: RESUME it instead of cleaning it.
+            # The dead supervisor may have committed layout.json and
+            # then died before stamping c_layout_gen — without this
+            # re-stamp no live rank ever learns the new generation
+            # (engines react to ctl stamps, not to layout.json polls),
+            # the donor never drops, the recipient never inserts, and
+            # the fleet wedges on an un-announced flip (found by the
+            # fsx crash checker's supervisor-crash mode).  Re-stamping
+            # is idempotent for ranks that already observed it, and
+            # the normal committing -> finish path then converges and
+            # deletes the artifacts.
+            for st in self._status:
+                st.ctl_set("c_layout_gen", asg.generation)
+            self._handoff = {
+                "id": hid,
+                "shards": [int(s) for s in stale["shards"]],
+                "donor": int(stale.get("donor", -1)),
+                "recipient": int(stale["recipient"]),
+                "to_gen": int(stale["to_gen"]),
+                "phase": "committing",
+                "n_rows": None,
+                "deadline": time.monotonic()
+                + tuning.HANDOFF_TIMEOUT_S,
+            }
+            return
+        doomed = [p]
+        if hid:
+            doomed.append(Path(rb.handoff_mailbox_path(
+                self.cluster_dir, hid)))
+        for d in doomed:
+            try:
+                fs.unlink(d)
+            except OSError:
+                pass
+        if "recipient" in stale:
+            # guarded: a spool from an earlier COMMITTED flip is the
+            # recipient's durable copy and must survive this cleanup
+            rb.discard_uncommitted_spool(self.cluster_dir,
+                                         int(stale["recipient"]))
 
     def adopt_dead_span(self, dead_rank: int, recipient: int) -> dict:
         """Dead-span adoption: ship a confirmed-dead rank's span to a
@@ -781,7 +861,7 @@ class ClusterSupervisor:
             ck_file = Path(self._ckpt_file(ckpt))
             prev = ck_file.with_name(ck_file.name + ".prev")
             for cand in (ck_file, prev):
-                if cand.exists():
+                if durable.get_fs().exists(cand):
                     try:
                         keys, states = rb.load_ckpt_rows(cand)
                         break

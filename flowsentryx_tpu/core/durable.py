@@ -1,0 +1,138 @@
+"""Durable-state publishing: one atomic-write helper, one fs seam.
+
+Every durable-state protocol in this repo (checkpoint v2, the
+``layout.json`` generation flip, the handoff descriptor, the staged
+spool) publishes with the same move: write a same-directory temp file,
+``os.replace`` into place.  Before this module each site hand-rolled
+it — and NONE of them fsynced, which makes the rename atomic against a
+*process* crash but not against power loss: an un-fsynced rename lives
+in the page cache, so a host that loses power after the flip acked can
+reboot into layout generation N under a fleet that acked N+1 (the gen
+resurrection the ``fsx crash`` checker prints as a schedule).  The fix
+is the full POSIX discipline, centralized here:
+
+1. write the temp file,
+2. ``fsync`` the temp file (the DATA is durable),
+3. optionally rotate the incumbent to its ``.prev`` twin,
+4. ``os.replace`` temp over the destination (atomic),
+5. ``fsync`` the parent directory (the RENAME is durable).
+
+After step 5 returns, the publish survives power loss; before it, the
+old complete file survives instead — never a torn mix.  That
+"returns ⇒ durable" contract is what lets a protocol act on its own
+publish (stamp ``c_layout_gen``, ack ``HP_STAGED``) without a crash
+un-happening the state it acted on.
+
+The module-level fs seam (:func:`get_fs` / :func:`use_fs`) is how the
+crash-consistency model checker (``flowsentryx_tpu/crash/``) drives
+the REAL protocol code against a simulated filesystem with honest
+crash semantics — protocol modules call :func:`atomic_write` /
+``get_fs().read_bytes`` and never touch ``os`` for durable state
+directly (the ``durable_writes`` lint stage enforces this).
+
+jax-free by construction: this sits on the supervisor's sub-second
+spawn path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from pathlib import Path
+
+
+class RealFS:
+    """The real filesystem behind the seam (default).  Methods mirror
+    what the protocols need — existence, whole-file reads, unlink, and
+    the atomic publish — nothing else, so the simulated twin
+    (``crash/simfs.py``) stays honest by staying small."""
+
+    name = "real"
+
+    def exists(self, path: str | Path) -> bool:
+        return Path(path).exists()
+
+    def size(self, path: str | Path) -> int:
+        return os.stat(path).st_size
+
+    def read_bytes(self, path: str | Path) -> bytes:
+        return Path(path).read_bytes()
+
+    def read_text(self, path: str | Path) -> str:
+        return Path(path).read_text()
+
+    def unlink(self, path: str | Path) -> None:
+        os.unlink(path)
+
+    def write_atomic(self, path: str | Path, data: bytes | str, *,
+                     fsync: bool = True,
+                     rotate_prev: Path | None = None) -> None:
+        """The five-step publish (module docstring).  ``rotate_prev``
+        names where the incumbent is retained (checkpoint ``.prev``
+        rotation) — rotated only when an incumbent exists, both
+        renames atomic, so a crash between them leaves ``.prev``
+        complete and ``path`` absent: a restorable state, never a torn
+        one."""
+        path = Path(path)
+        if isinstance(data, str):
+            data = data.encode()
+        tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
+        try:
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o644)
+            try:
+                os.write(fd, data)
+                if fsync:
+                    os.fsync(fd)
+            finally:
+                os.close(fd)
+            if rotate_prev is not None and path.exists():
+                os.replace(path, rotate_prev)
+            os.replace(tmp, path)
+            if fsync:
+                # the rename is a NAMESPACE op: durable only once the
+                # parent directory's metadata is on disk
+                dfd = os.open(path.parent,
+                              os.O_RDONLY
+                              | getattr(os, "O_DIRECTORY", 0))
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+
+
+_FS: RealFS = RealFS()
+
+
+def get_fs():
+    """The filesystem behind the seam (RealFS unless a checker swapped
+    in a simulated one via :func:`use_fs`)."""
+    return _FS
+
+
+@contextlib.contextmanager
+def use_fs(fs):
+    """Scope a replacement filesystem over every durable-state
+    protocol (the crash checker's injection point).  Restores the
+    previous fs on exit, exceptions included."""
+    global _FS
+    prev = _FS
+    _FS = fs
+    try:
+        yield fs
+    finally:
+        _FS = prev
+
+
+def atomic_write(path: str | Path, data: bytes | str, *,
+                 fsync: bool = True,
+                 rotate_prev: Path | None = None) -> None:
+    """Publish ``data`` at ``path`` atomically AND durably through the
+    current fs seam — the one write idiom every durable-state protocol
+    uses (module docstring)."""
+    get_fs().write_atomic(path, data, fsync=fsync,
+                          rotate_prev=rotate_prev)

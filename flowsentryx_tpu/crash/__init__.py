@@ -1,0 +1,12 @@
+"""``fsx crash`` — crash-consistency model checking over the durable
+protocols (the fifth static leg; see ``checker.py`` and docs/CRASH.md).
+
+jax-free by construction: the checker imports only the cluster/core
+modules plus numpy, so it rides the same sub-second CI path as the
+other static legs.
+"""
+
+from .checker import (CrashSchedule, INVARIANTS, Violation,  # noqa: F401
+                      explore_scenario, run_crash)
+from .simfs import SimFS, Tracer  # noqa: F401
+from .world import World  # noqa: F401

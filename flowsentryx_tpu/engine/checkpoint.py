@@ -10,11 +10,16 @@ user-plane analog of map pinning.
 
 Production-scale upgrades (PR 8):
 
-* **Atomic writes** — the snapshot lands in a same-directory temp file
-  and ``os.replace``\\s into place, so a crash mid-snapshot can never
-  truncate the live checkpoint (the periodic ``--checkpoint-every``
-  loop overwrites the same path forever; a torn write there would
-  destroy the only copy).
+* **Atomic + durable writes** — the snapshot publishes through
+  :func:`flowsentryx_tpu.core.durable.atomic_write` (same-directory
+  temp, fsync the bytes, atomic ``os.replace``, fsync the parent
+  dir), so a crash mid-snapshot can never truncate the live
+  checkpoint, and a POWER crash after ``save_state`` returns can
+  never lose it either (the periodic ``--checkpoint-every`` loop
+  overwrites the same path forever; a torn or un-synced write there
+  would destroy the only copy).  The ``fsx crash`` model checker
+  drives this exact code against a simulated fs at every crash point
+  (docs/CRASH.md).
 * **Geometry header** — ``hash_salt`` (as before) plus ``n_shards``
   and ``capacity``: a table's global row indices are meaningful ONLY
   under the geometry that wrote them (owner = top hash bits, slot =
@@ -51,16 +56,15 @@ Integrity + retention (PR 13, the chaos campaign's forcing function):
 
 from __future__ import annotations
 
-import os
+import io
 import zipfile
 import zlib
 from pathlib import Path
 from typing import NamedTuple
 
-import jax
 import numpy as np
 
-from flowsentryx_tpu.core import schema
+from flowsentryx_tpu.core import durable, schema
 
 CHECKPOINT_SCHEMA_VERSION = 1
 
@@ -152,10 +156,6 @@ def save_state(
     key = np.asarray(table.key)  # fetched ONCE (shared with the header)
     cols = {f"table_{name}": state[:, i]
             for i, name in enumerate(schema.TABLE_COLUMN_NAMES)}
-    # same-directory temp + os.replace: rename is atomic on POSIX, so
-    # the live checkpoint is either the old complete snapshot or the
-    # new complete snapshot — never a torn write
-    tmp = path.with_name(f".{path.name}.tmp.{os.getpid()}")
     entries = {
         "table_key": key,
         **cols,
@@ -167,31 +167,18 @@ def save_state(
         "capacity": np.uint64(key.shape[0]),
         "schema_version": np.int64(CHECKPOINT_SCHEMA_VERSION),
     }
-    try:
-        np.savez_compressed(
-            tmp,
-            integrity_crc32=np.uint32(_fold_crc(entries)),
-            **entries,
-        )
-        # np.savez appends .npz to the temp stem too
-        tmp_written = (tmp if tmp.suffix == ".npz"
-                       else tmp.with_suffix(tmp.suffix + ".npz"))
-        if path.exists():
-            # retain the incumbent GOOD generation before publishing:
-            # a later restore that finds `path` corrupt (torn disk,
-            # bit flip) falls back to `.prev` instead of dying on the
-            # only copy.  Both renames are atomic; a crash between
-            # them leaves .prev complete and path absent — still a
-            # restorable state, never a torn one.
-            os.replace(path, prev_path(path))
-        os.replace(tmp_written, path)
-    except BaseException:
-        for t in (tmp, tmp.with_suffix(tmp.suffix + ".npz")):
-            try:
-                os.unlink(t)
-            except OSError:
-                pass
-        raise
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        integrity_crc32=np.uint32(_fold_crc(entries)),
+        **entries,
+    )
+    # atomic_write fsyncs the bytes then the rename, and retains the
+    # incumbent GOOD generation at .prev before publishing: a later
+    # restore that finds `path` corrupt (torn disk, bit flip) falls
+    # back to .prev instead of dying on the only copy.
+    durable.atomic_write(path, buf.getvalue(),
+                         rotate_prev=prev_path(path))
     return path
 
 
@@ -208,8 +195,9 @@ def peek_header(path: str | Path) -> dict:
     the pre-boot validation path, which read as a code bug instead of
     the operational fact it is."""
     path = Path(path)
+    fs = durable.get_fs()
     try:
-        size = path.stat().st_size
+        size = fs.size(path)
     except OSError as e:
         raise CheckpointCorrupt(
             f"checkpoint {path} is unreadable: {e}") from e
@@ -218,7 +206,7 @@ def peek_header(path: str | Path) -> dict:
             f"checkpoint {path} is empty (0 bytes): a file torn at "
             "create time, not a snapshot")
     try:
-        with np.load(path) as z:
+        with np.load(io.BytesIO(fs.read_bytes(path))) as z:
             cap = (int(z["capacity"]) if "capacity" in z
                    else int(z["table_key"].shape[0]))
             return {
@@ -257,13 +245,14 @@ def load_checkpoint(path: str | Path) -> Checkpoint:
     can therefore never be silently loaded.  Snapshots predating the
     CRC load with ``crc_checked=False``."""
     path = Path(path)
+    fs = durable.get_fs()
     entries: dict[str, np.ndarray] = {}
     stored_crc = None
     try:
-        if path.stat().st_size == 0:
+        if fs.size(path) == 0:
             raise CheckpointCorrupt(
                 f"checkpoint {path} is empty (0 bytes)")
-        with np.load(path) as z:
+        with np.load(io.BytesIO(fs.read_bytes(path))) as z:
             for name in z.files:
                 if name == "integrity_crc32":
                     stored_crc = int(z[name])
@@ -332,7 +321,13 @@ def load_state(
     path: str | Path,
 ) -> tuple[schema.IpTableState, schema.GlobalStats, int, int, tuple]:
     """Compatibility shim over :func:`load_checkpoint`: the historical
-    5-tuple, with table/stats already on the default device."""
+    5-tuple, with table/stats already on the default device.  The ONE
+    jax touch in this module, imported lazily — everything else is
+    host-side numpy, which is what lets the supervisor plane and the
+    ``fsx crash`` checker drive the real checkpoint protocol on the
+    sub-second jax-free import path."""
+    import jax
+
     ck = load_checkpoint(path)
     table = schema.IpTableState(key=jax.device_put(ck.table.key),
                                 state=jax.device_put(ck.table.state))

@@ -51,11 +51,21 @@ Stages, in order; the gate fails if any stage fails:
    ``checkpoint.prev_path`` to avoid.  Function-LOCAL imports stay
    legal (the lazy-import defense; ``GossipPlane.tick``'s writeback
    import is the documented exception).  ``# noqa`` exempts a line.
-8. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+8. **durable writes** — an AST pass over the durable-protocol scope
+   (``flowsentryx_tpu/cluster/`` + ``engine/checkpoint.py``) that bans
+   bare durable writes: ``open(..., "w"/"x"/"a")``,
+   ``.write_text``/``.write_bytes``, and path-targeted ``np.savez*``.
+   Protocol state must publish through ``core/durable.atomic_write``
+   (write tmp → fsync → rotate → rename → dir fsync — the discipline
+   the ``fsx crash`` checker proves crash-consistent; a bare write
+   tears at power loss).  In-memory ``savez`` into a file-like handle
+   stays legal (that is how checkpoint.py FEEDS atomic_write), and
+   ``# noqa`` exempts a line (shm ring creates, report files).
+9. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-7 there.
-9. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-8 there.
+10. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -419,6 +429,92 @@ def stage_cluster_jax_free() -> list[str]:
     return fails
 
 
+#: The durable-protocol scope: modules whose file writes ARE protocol
+#: state (layout.json, handoff.json, spools, checkpoints) — the files
+#: the fsx crash checker reconstructs after simulated power loss.
+#: Everything published here must go through durable.atomic_write.
+DURABLE_WRITE_SCOPE = (
+    "flowsentryx_tpu/cluster",
+    "flowsentryx_tpu/engine/checkpoint.py",
+)
+
+
+def _open_write_mode(node: ast.Call) -> str | None:
+    """The write mode of an ``open()`` call, None when it reads."""
+    mode = None
+    if len(node.args) > 1:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)):
+        return None  # absent (= "r") or dynamic: not this stage's call
+    return mode.value if any(c in mode.value for c in "wxa") else None
+
+
+def _durable_write_findings(path: Path) -> list[str]:
+    """Bare-durable-write findings for one protocol module (stage 8
+    docstring)."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        what = None
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            m = _open_write_mode(node)
+            if m is not None:
+                what = f"open(..., {m!r})"
+        elif isinstance(fn, ast.Attribute) \
+                and fn.attr in ("write_text", "write_bytes"):
+            what = f".{fn.attr}(...)"
+        elif (isinstance(fn, ast.Attribute)
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id == "np"
+              and fn.attr in ("savez", "savez_compressed")):
+            # savez into a bare-Name handle is the in-memory BytesIO
+            # idiom that FEEDS atomic_write; savez at anything else
+            # (a literal/Path expression) writes the disk directly
+            if not (node.args and isinstance(node.args[0], ast.Name)):
+                what = f"np.{fn.attr}(<path>, ...)"
+        if what is None:
+            continue
+        line = (lines[node.lineno - 1]
+                if node.lineno <= len(lines) else "")
+        if "noqa" in line:
+            continue
+        try:
+            rel = path.relative_to(REPO)
+        except ValueError:
+            rel = path
+        out.append(
+            f"{rel}:{node.lineno}: bare durable write {what} in the "
+            "durable-protocol scope — publish through "
+            "core/durable.atomic_write (fsync file + parent dir, "
+            "atomic rename; a bare write tears at power loss — the "
+            "fsx crash checker's fsync_skipped plant); # noqa for "
+            "non-protocol files (shm creates, reports)")
+    return out
+
+
+def stage_durable_writes() -> list[str]:
+    fails = []
+    for scope in DURABLE_WRITE_SCOPE:
+        p = REPO / scope
+        paths = [p] if p.suffix == ".py" else sorted(p.rglob("*.py"))
+        for path in paths:
+            if path.is_file():
+                fails.extend(_durable_write_findings(path))
+    return fails
+
+
 def stage_sync_contracts() -> list[str]:
     """The thread-contract half of ``fsx sync`` as a lint stage (quick
     mode: pure AST, no model checking, no jax)."""
@@ -471,6 +567,7 @@ def main(argv: list[str] | None = None) -> int:
         "device_loop_purity": stage_device_loop_purity(),
         "sync_contracts": stage_sync_contracts(),
         "cluster_jax_free": stage_cluster_jax_free(),
+        "durable_writes": stage_durable_writes(),
         "ruff": stage_ruff(),
         "mypy": stage_mypy(),
     }

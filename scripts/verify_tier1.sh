@@ -15,6 +15,9 @@
 #   * fsx sync        — host thread contracts + bounded-interleaving
 #     model checks (arena bound tightness re-proved per run); writes
 #     artifacts/SYNC_r13.json
+#   * fsx crash       — exhaustive crash-consistency model check of
+#     the durable-state protocols (planted regressions must be
+#     caught); writes artifacts/CRASH_r21.json
 #   * fsx audit       — static dtype/donation/transfer/retrace/
 #     collective/in-place contracts over every staged step variant (8
 #     virtual CPU devices so the sharded variant stages too); writes
@@ -75,6 +78,20 @@ echo "== fsx sync: host thread contracts + interleaving model checks =="
 # emitted one below.  Jax-free; writes the machine-readable artifact.
 python -m flowsentryx_tpu.cli sync --out artifacts/SYNC_r13.json \
     || exit 1
+
+echo "== fsx crash: crash-consistency model check of the durable protocols =="
+# The fifth static leg (docs/CRASH.md): drives the REAL checkpoint-
+# rotate, layout-flip, fenced-handoff and dead-span-adoption code over
+# a simulated POSIX fs, crashing at every atomic step (power loss +
+# each party's death), reconstructing every legal post-crash durable
+# state, running real recovery, and asserting the ten-invariant
+# catalog (row conservation, single ownership, generation
+# monotonicity, checkpoint fallback, ...).  Four planted regressions
+# must each be CAUGHT with a printed crash schedule and their
+# unplanted controls must be clean.  Jax-free; --quick trims tear
+# variants per un-synced file (full fan-out stays on `fsx crash`).
+python -m flowsentryx_tpu.cli crash --quick --quiet-plants \
+    --out artifacts/CRASH_r21.json || exit 1
 
 echo "== fsx audit: static step-graph contracts (docs/AUDIT.md) =="
 # --device-loop 2 also stages the drain-ring deep scans (single-device

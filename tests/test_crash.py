@@ -1,0 +1,269 @@
+"""The fsx crash model checker (flowsentryx_tpu/crash/): the sim fs's
+POSIX crash semantics, the exhaustive exploration of the real
+durable-state protocols, and — the checker's own verification — one
+test per invariant class proving a planted regression is CAUGHT with a
+printed crash schedule."""
+
+import numpy as np
+import pytest
+
+from flowsentryx_tpu.crash import checker
+from flowsentryx_tpu.crash.simfs import (CrashNow, SimFS, Tracer,
+                                         eligible_points)
+from flowsentryx_tpu.crash.world import World
+
+
+class TestSimFS:
+    def _fs(self, **kw):
+        t = Tracer()
+        t.enabled = True
+        return SimFS(t, **kw), t
+
+    def test_write_atomic_traces_five_steps(self):
+        fs, t = self._fs()
+        fs.write_atomic("/d/f", b"abc")
+        labels = [op for _, op in t.ops]
+        assert labels == ["write f.tmp (3 B)", "fsync f.tmp",
+                          "rename f.tmp -> f",
+                          "fsync parent dir of f"]
+        assert fs.read_bytes("/d/f") == b"abc"
+
+    def test_synced_publish_is_durable(self):
+        fs, _ = self._fs()
+        fs.write_atomic("/d/f", b"abc")
+        states, capped = fs.durable_states()
+        assert not capped
+        assert [st for _, st in states] == [{"/d/f": b"abc"}]
+
+    def test_unsynced_write_tears(self):
+        # fsync=False: the rename may or may not survive, and when it
+        # does the DATA can land torn at any enumerated boundary
+        fs, _ = self._fs()
+        fs.write_atomic("/d/f", b"abcdef", fsync=False)
+        states, _ = fs.durable_states()
+        visible = sorted(st["/d/f"] for _, st in states if "/d/f" in st)
+        assert b"" in visible          # nothing flushed
+        assert b"abcdef" in visible    # everything flushed
+        assert any(0 < len(v) < 6 for v in visible)  # a real tear
+        assert any("/d/f" not in st for _, st in states)  # rename lost
+
+    def test_fsync_noop_plant_loses_the_publish(self):
+        fs, _ = self._fs(fsync_is_noop=True)
+        fs.write_atomic("/d/f", b"abc")
+        states, _ = fs.durable_states()
+        assert any("/d/f" not in st for _, st in states)
+
+    def test_rename_is_atomic_old_or_new_never_mixed(self):
+        fs, _ = self._fs()
+        fs.write_atomic("/d/f", b"old")
+        fs.write_atomic("/d/f", b"newer", fsync=False)
+        for _, st in fs.durable_states()[0]:
+            assert st["/d/f"] in (b"old", b"", b"n", b"ne", b"newe",
+                                  b"newer")
+            # the un-fsynced RENAME either happened (new fid, possibly
+            # torn) or didn't (old file complete) — never a mix of both
+            if st["/d/f"] == b"old":
+                continue
+
+    def test_rotate_prev_decomposes_to_two_renames(self):
+        fs, t = self._fs()
+        fs.write_atomic("/d/f", b"g1")
+        fs.write_atomic("/d/f", b"g2", rotate_prev="/d/f.prev")
+        assert fs.read_bytes("/d/f.prev") == b"g1"
+        assert fs.read_bytes("/d/f") == b"g2"
+        assert "rename f -> f.prev" in [op for _, op in t.ops]
+
+    def test_media_fault_flips_one_bit_in_last_published(self):
+        fs, _ = self._fs()
+        fs.write_atomic("/d/f", b"abcd")
+        states, _ = fs.durable_states(media_fault=True)
+        datas = [st["/d/f"] for _, st in states]
+        assert b"abcd" in datas
+        flipped = [d for d in datas if d != b"abcd"]
+        assert len(flipped) == 1
+        assert len(flipped[0]) == 4  # same length, one bit differs
+
+    def test_from_state_round_trip(self):
+        t = Tracer()
+        fs = SimFS.from_state({"/d/a": b"x"}, t)
+        assert fs.read_bytes("/d/a") == b"x"
+        states, _ = fs.durable_states()
+        assert [st for _, st in states] == [{"/d/a": b"x"}]
+
+
+class TestTracer:
+    def test_crash_at_fires_before_the_op(self):
+        fs, t = TestSimFS()._fs()
+        t.crash_at, t.crash_actor = 2, None
+        with pytest.raises(CrashNow):
+            fs.write_atomic("/d/f", b"abc")
+        assert t.fired and "rename" in t.crashed_op
+        assert len(t.ops) == 2  # the crashed op never applied
+
+    def test_actor_filtering(self):
+        fs, t = TestSimFS()._fs()
+        t.actor = "rank0"
+        fs.write_atomic("/d/a", b"x")
+        t.actor = "rank1"
+        fs.write_atomic("/d/b", b"y")
+        assert eligible_points(t.ops, None) == 8
+        assert eligible_points(t.ops, "rank0") == 4
+        t2 = Tracer()
+        t2.enabled, t2.crash_at, t2.crash_actor = True, 0, "rank1"
+        fs2 = SimFS(t2)
+        t2.actor = "rank0"
+        fs2.write_atomic("/d/a", b"x")  # rank0 ops don't count
+        t2.actor = "rank1"
+        with pytest.raises(CrashNow):
+            fs2.write_atomic("/d/b", b"y")
+        assert t2.crashed_op.startswith("rank1:")
+
+
+class TestScenariosClean:
+    """The real protocols survive exhaustive crashing — the positive
+    half: every crash point, every legal durable state, zero
+    violations (a violation here is a shipped-protocol bug)."""
+
+    @pytest.mark.parametrize("sc_cls", [
+        checker.CheckpointScenario, checker.FlipScenario,
+        checker.HandoffScenario, checker.AdoptionScenario,
+    ], ids=lambda c: c.name)
+    def test_scenario_clean(self, sc_cls):
+        res = checker.explore_scenario(sc_cls(), quick=True)
+        assert res["violations"] == 0, res["counterexample"]
+        assert res["crash_points"] > 10  # exhaustive, not vacuous
+        assert res["recoveries"] > 0
+
+
+class TestPlantsCaught:
+    """The checker's own verification: each planted regression — one
+    per invariant class — must be caught with a printed crash
+    schedule.  A checker that cannot catch the bug class it exists
+    for is the silent failure mode these tests pin."""
+
+    def _assert_schedule(self, res, invariant):
+        assert res["violations"] > 0, "plant NOT caught"
+        assert res["first_invariant"] == invariant
+        cx = res["counterexample"]
+        assert cx is not None
+        assert ">>> CRASH" in cx and invariant in cx
+        assert "  0. " in cx.replace("   0. ", "  0. ")  # numbered ops
+
+    def test_fsync_skipped_caught_by_gen_monotone(self):
+        # every fsync a no-op: a power crash resurrects a superseded
+        # layout generation — what every pre-durable.py site risked
+        res = checker.explore_scenario(
+            checker.FlipScenario(), quick=True,
+            build_kw={"fsync_is_noop": True}, stop_on_violation=True)
+        self._assert_schedule(res, "layout_gen_monotone")
+
+    def test_prev_rotation_dropped_caught_by_ckpt_fallback(self):
+        # no .prev retention: a media fault on the only copy leaves
+        # nothing loadable after completed saves
+        with checker.plant_prev_rotation_dropped():
+            res = checker.explore_scenario(
+                checker.CheckpointScenario(), quick=True,
+                stop_on_violation=True)
+        self._assert_schedule(res, "ckpt_current_or_prev")
+
+    def test_spool_ack_reorder_caught_by_conservation(self):
+        # HP_STAGED acked before the spool write lands: the supervisor
+        # commits the flip on the ack, a crash before the deferred
+        # write leaves the shipped rows nowhere durable
+        with checker.plant_spool_ack_reorder():
+            res = checker.explore_scenario(
+                checker.HandoffScenario(), quick=True,
+                modes=("power",), stop_on_violation=True)
+        self._assert_schedule(res, "row_conservation")
+
+    def test_dual_ownership_flip_caught(self):
+        # reconcile stops dropping foreign rows: a donor that dies
+        # after the flip reboots still holding the span it gave away
+        with checker.plant_dual_ownership_flip():
+            res = checker.explore_scenario(
+                checker.HandoffScenario(), quick=True,
+                modes=("rank0",), stop_on_violation=True)
+        self._assert_schedule(res, "no_dual_ownership")
+
+    def test_plants_restore_the_real_functions(self):
+        from flowsentryx_tpu.cluster import rebalance as rb
+
+        orig_save, orig_step = rb.save_spool, rb.EngineRebalancer.step
+        orig_rec = rb.EngineRebalancer.reconcile
+        with checker.plant_spool_ack_reorder():
+            assert rb.save_spool is not orig_save
+        with checker.plant_dual_ownership_flip():
+            assert rb.EngineRebalancer.reconcile is not orig_rec
+        assert rb.save_spool is orig_save
+        assert rb.EngineRebalancer.step is orig_step
+        assert rb.EngineRebalancer.reconcile is orig_rec
+
+
+class TestFullReport:
+    def test_run_crash_quick_green(self):
+        rep = checker.run_crash(quick=True)
+        assert rep["ok"] and rep["protocols_ok"] and rep["plants_ok"]
+        assert rep["schema"] == "fsx-crash-report-v1"
+        assert len(rep["scenarios"]) == 4
+        assert len(rep["plants"]) == 4
+        for p in rep["plants"]:
+            assert p["caught"] and p["control_ok"], p["plant"]
+            assert p["schedule"] and ">>> CRASH" in p["schedule"]
+            assert p["caught_by"] in checker.INVARIANTS
+        t = rep["totals"]
+        assert t["crash_points"] > 100 and t["violations"] == 0
+
+    def test_jax_free_import(self):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; import flowsentryx_tpu.crash; "
+             "sys.exit(1 if 'jax' in sys.modules else 0)"],
+            capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()
+
+
+class TestWorldPlumbing:
+    def test_party_crash_kills_only_that_actor(self):
+        w = World(n=2)
+        t = w.tracer
+        t.enabled, t.crash_at, t.crash_actor = True, 0, "rank0"
+        with w.installed():
+            from flowsentryx_tpu.core import durable
+
+            w.act("rank0", lambda: durable.atomic_write(
+                w.dir / "a", b"x"))
+            assert "rank0" in w.dead
+            # rank1 unaffected; dead actors no-op
+            w.act("rank1", lambda: durable.atomic_write(
+                w.dir / "b", b"y"))
+            assert w.fs.exists(w.dir / "b")
+            assert w.act("rank0", lambda: 1 / 0) is None
+
+    def test_handoff_rows_survive_sup_death_before_stamp(self):
+        # the wedge the committed-RESUME branch of
+        # _neutralize_stale_handoff exists for: supervisor dies
+        # between layout.json commit and the c_layout_gen stamps —
+        # the successor must resume the flip, not clean it up
+        sc = checker.HandoffScenario()
+        base = checker._run(sc)
+        ops = base.tracer.ops
+        stamp = next(i for i, (a, op) in enumerate(ops)
+                     if a == "supervisor" and "c_layout_gen" in op)
+        sup_pts = sum(1 for a, _ in ops[:stamp] if a == "supervisor")
+        w = checker._run(sc, crash_at=sup_pts - 1,
+                         crash_actor="supervisor")
+        assert w.tracer.fired
+        assert "layout.json" in w.tracer.crashed_op \
+            or "c_layout_gen" in w.tracer.crashed_op
+        assert sc.judge(w) == []
+
+    def test_keys_for_shard_places_by_real_hash(self):
+        from flowsentryx_tpu.core import schema
+
+        keys = checker._keys_for_shard(2, 4, 5)
+        assert len(keys) == 5
+        assert all(int(schema.shard_of(np.uint32(k), 4)) == 2
+                   for k in keys)

@@ -335,3 +335,97 @@ class TestClusterJaxFreeStage:
 
     def test_repo_cluster_tree_is_clean(self):
         assert lint.stage_cluster_jax_free() == []
+
+
+def _durable_findings(tmp_path, src,
+                      rel="flowsentryx_tpu/cluster/mod.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    old = lint.REPO
+    lint.REPO = tmp_path
+    try:
+        return lint.stage_durable_writes()
+    finally:
+        lint.REPO = old
+
+
+class TestDurableWritesStage:
+    """The durable-write gate: protocol state under cluster/ and
+    engine/checkpoint.py must publish through durable.atomic_write —
+    a bare write is exactly the fsync_skipped regression the fsx
+    crash checker demonstrates losing state at power loss."""
+
+    def test_open_write_mode_flagged(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "def publish(path, data):\n"
+            "    with open(path, 'wb') as f:\n"
+            "        f.write(data)\n"))
+        assert len(out) == 1
+        assert "open(..., 'wb')" in out[0] and "mod.py:2" in out[0]
+
+    def test_open_mode_kwarg_flagged(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "f = open('layout.json', mode='w')\n"))
+        assert len(out) == 1 and "open(..., 'w')" in out[0]
+
+    def test_open_read_modes_clean(self, tmp_path):
+        # r is a read; r+b is the shm mmap-update idiom, not a publish
+        out = _durable_findings(tmp_path, (
+            "def peek(path):\n"
+            "    with open(path, 'rb') as f:\n"
+            "        return f.read()\n"
+            "def mmap_update(path):\n"
+            "    return open(path, 'r+b')\n"))
+        assert out == []
+
+    def test_write_text_flagged(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "from pathlib import Path\n"
+            "def save(d):\n"
+            "    Path('handoff.json').write_text(d)\n"))
+        assert len(out) == 1 and ".write_text(...)" in out[0]
+
+    def test_path_targeted_savez_flagged(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "import numpy as np\n"
+            "def spool(keys):\n"
+            "    np.savez_compressed('staged.npz', keys=keys)\n"))
+        assert len(out) == 1
+        assert "np.savez_compressed(<path>" in out[0]
+
+    def test_bytesio_savez_clean(self, tmp_path):
+        # the checkpoint idiom: savez into an in-memory handle whose
+        # bytes then publish through atomic_write
+        out = _durable_findings(tmp_path, (
+            "import io\nimport numpy as np\n"
+            "from flowsentryx_tpu.core import durable\n"
+            "def save(path, keys):\n"
+            "    buf = io.BytesIO()\n"
+            "    np.savez_compressed(buf, keys=keys)\n"
+            "    durable.atomic_write(path, buf.getvalue())\n"))
+        assert out == []
+
+    def test_noqa_exempts(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "def mk(path):\n"
+            "    with open(path, 'wb') as f:  # noqa: shm create\n"
+            "        f.truncate(64)\n"))
+        assert out == []
+
+    def test_outside_scope_not_scanned(self, tmp_path):
+        out = _durable_findings(tmp_path, (
+            "def save(path, d):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(d)\n"), rel="flowsentryx_tpu/engine/other.py")
+        assert out == []
+
+    def test_checkpoint_module_in_scope(self, tmp_path):
+        out = _durable_findings(
+            tmp_path,
+            "open('ck.npz', 'wb')\n",
+            rel="flowsentryx_tpu/engine/checkpoint.py")
+        assert len(out) == 1
+
+    def test_repo_is_clean(self):
+        assert lint.stage_durable_writes() == []
