@@ -1057,6 +1057,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("fsx serve: --slo-us must be >= 0 (0 = throughput-tuned "
               "serving, no latency budget)", file=sys.stderr)
         return 1
+    if args.predict and not args.slo_us:
+        print("fsx serve: --predict requires --slo-us > 0 — the "
+              "governor's flush/pre-warm/shed decisions are all "
+              "phrased against the latency budget; without one there "
+              "is nothing to govern", file=sys.stderr)
+        return 1
     if args.sim_kernel_tier and args.ingest_workers:
         print("fsx serve: --sim-kernel-tier needs the inline record "
               "path; sealed-batch ingest bypasses the record stream "
@@ -1426,6 +1432,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  kernel_tier=kernel_tier,
                  gossip=gossip,
                  slo_us=args.slo_us,
+                 predict=args.predict,
                  watchdog_s=args.watchdog_s)
     if args.restore:
         from flowsentryx_tpu.engine.checkpoint import CheckpointCorrupt
@@ -1673,6 +1680,11 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
     if args.slo_us < 0:
         print("fsx cluster: --slo-us must be >= 0", file=sys.stderr)
         return 1
+    if args.predict and not args.slo_us:
+        print("fsx cluster: --predict requires --slo-us > 0 (the "
+              "governor acts against each rank's latency budget)",
+              file=sys.stderr)
+        return 1
     if not args.feature_ring:
         print("fsx cluster: --feature-ring BASE is required: engines "
               f"front the daemon's ring shards (pair with fsxd "
@@ -1794,6 +1806,7 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
             "mega": args.mega or 0,
             "device_loop": args.device_loop,
             "slo_us": args.slo_us,
+            "predict": bool(args.predict),
             "artifact": args.artifact,
             "checkpoint": (args.checkpoint.format(rank=r)
                            if args.checkpoint else None),
@@ -1982,6 +1995,30 @@ def _merged_engine_health(globs: list, reports: list | None = None) -> dict:
     return out
 
 
+def _merged_predict(reports: list) -> dict | None:
+    """Merge the ``predict`` blocks of engine-report JSONs (the
+    dispatch governor's forecast + actuation counters, ISSUE 18) into
+    one fleet view via :meth:`DispatchGovernor.merge_reports` — the
+    same fold the cluster supervisor's ``aggregate()`` applies, so
+    ``fsx status`` on a report glob and the supervisor's own aggregate
+    never disagree.  Jax-free (engine/predict.py is numpy-only).
+    Returns None when no report carries a predict block (predictor-off
+    fleets don't grow an empty stanza)."""
+    blocks = []
+    for _path, doc, err in reports:
+        if err is not None:
+            continue
+        rep = doc.get("report") if isinstance(doc.get("report"),
+                                              dict) else doc
+        if rep.get("predict"):
+            blocks.append(rep["predict"])
+    if not blocks:
+        return None
+    from flowsentryx_tpu.engine.predict import DispatchGovernor
+
+    return DispatchGovernor.merge_reports(blocks)
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
     """Inspect the shm transport: ring cursors and backlog."""
     import numpy as np
@@ -2027,6 +2064,9 @@ def _cmd_status(args: argparse.Namespace) -> int:
                                          reports=reports)
         out["health"] = _merged_engine_health(args.engine_report,
                                               reports=reports)
+        predict = _merged_predict(reports)
+        if predict is not None:
+            out["predict"] = predict
     print(json.dumps(out, indent=2))
     return 0
 
@@ -2111,6 +2151,12 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
               "GLOB (the p99 comes from merged engine reports; the "
               "kernel maps cannot carry it)", file=sys.stderr)
         return 1
+    if args.alert_prewarm_miss and not args.engine_report:
+        print("fsx monitor: --alert-prewarm-miss requires "
+              "--engine-report GLOB (the governor's pre-warm counters "
+              "ride the engine reports; the kernel maps cannot carry "
+              "them)", file=sys.stderr)
+        return 1
     prev: dict | None = None
     prev_t = 0.0
     fh = open(args.out, "a") if args.out else None
@@ -2160,6 +2206,18 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
                         alerts.append(
                             f"fleet reshaping {hl['state'].upper()}: "
                             + ", ".join(reshape))
+                predict = _merged_predict(reports)
+                if predict is not None:
+                    rec["predict"] = predict
+                    misses = predict.get("prewarm_misses", 0)
+                    if (args.alert_prewarm_miss
+                            and misses >= args.alert_prewarm_miss):
+                        alerts.append(
+                            f"governor prewarm misses {misses} >= "
+                            f"{args.alert_prewarm_miss} (forecast "
+                            "pre-warmed rungs the traffic never "
+                            "filled — compile/warm work wasted on a "
+                            "stale or wrong burst model)")
             if prev is not None and "error" not in stats:
                 dt = max(t - prev_t, 1e-9)
                 rec["per_s"] = {
@@ -2901,6 +2959,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "prior releases.  The report's latency block "
                         "carries p50/p90/p99/p999 and budget-miss "
                         "accounting either way")
+    s.add_argument("--predict", action="store_true",
+                   help="predictive dispatch governor (requires "
+                        "--slo-us > 0): an online burst forecaster "
+                        "over per-record arrival stamps drives "
+                        "proactive rung pre-warming before each "
+                        "predicted burst onset, burst-end early "
+                        "flushes inside the budget, and anti-entropy "
+                        "deferral under budget pressure.  Confidence-"
+                        "gated: on aperiodic traffic the governor "
+                        "stays quiescent and the engine behaves "
+                        "exactly like plain --slo-us.  Forecast + "
+                        "actuation counters land in the report's "
+                        "predict block (fsx status/monitor surface "
+                        "them; fsx monitor --alert-prewarm-miss "
+                        "alerts on wasted pre-warms)")
     s.add_argument("--quarantine-dir", metavar="DIR",
                    help="spool refused sealed batches (RANGE_* "
                         "contract violations) here for post-mortem; "
@@ -2977,6 +3050,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-engine latency budget (fsx serve "
                          "--slo-us); the aggregate report merges every "
                          "rank's latency histogram")
+    cl.add_argument("--predict", action="store_true",
+                    help="per-engine predictive dispatch governor "
+                         "(fsx serve --predict; requires --slo-us); "
+                         "each rank forecasts its OWN shard's arrival "
+                         "process, and the aggregate report folds "
+                         "every rank's predict counters")
     cl.add_argument("--hosts", default=None, metavar="IP:PORT,...",
                     help="multi-host fleet: every host's gossip base "
                          "address, same list on every host (the "
@@ -3065,6 +3144,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "reshaping reasons get their own alert line "
                          "(requires --engine-report; docs/CHAOS.md "
                          "§health, docs/CLUSTER.md §elastic)")
+    mo.add_argument("--alert-prewarm-miss", type=int, default=0,
+                    metavar="N",
+                    help="alert when the merged governor prewarm-miss "
+                         "count reaches N (pre-warmed rungs the "
+                         "traffic never filled — a stale or wrong "
+                         "burst model burning compile/warm work; "
+                         "requires --engine-report; "
+                         "docs/ENGINE.md §prediction)")
     mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")

@@ -150,6 +150,11 @@ class GossipPlane:
         self._rx_next_seq = {peer: 1 for peer in self._rx}
         self._merge_ticks = 0
         self._next_tick = 0.0
+        # budget-pressure shedding (engine/predict.py governor):
+        # deferred anti-entropy ticks + the consecutive-deferral
+        # streak that bounds how long pressure may starve the merge
+        self._ticks_deferred = 0
+        self._defer_streak = 0
 
     # -- publish side (engine sink section) ---------------------------------
 
@@ -190,15 +195,35 @@ class GossipPlane:
 
     # -- merge side (dispatch thread) ---------------------------------------
 
-    def tick(self, force: bool = False) -> int:
+    def tick(self, force: bool = False, pressure: float = 0.0) -> int:
         """Heartbeat + merge every peer's pending wires into the local
         blacklist view (and the plane's sink).  Throttled to the merge
         interval — called from the engine loop every iteration, so an
         unthrottled tick would stat N-1 mailboxes per batch.  Returns
-        the number of verdicts merged this call."""
+        the number of verdicts merged this call.
+
+        ``pressure > 0`` is the engine governor's budget-pressure
+        shed signal (engine/predict.py): a due tick is DEFERRED —
+        re-paced at ``SHED_TICK_STRETCH`` merge intervals — so the
+        dispatch thread spends its squeezed headroom on verdict
+        latency, not anti-entropy.  Bounded starvation: after
+        ``SHED_MAX_DEFER`` consecutive deferrals the tick runs
+        anyway (pressure then rides through to the network leg's
+        pump, which applies the same discipline to its PERIODIC
+        resync only — hello-triggered resyncs and verdict publish
+        are never deferred).  Shed work is counted
+        (``ticks_deferred``), never silent."""
         t = time.monotonic()
         if not force and t < self._next_tick:
             return 0
+        if (pressure > 0.0 and not force
+                and self._defer_streak < tuning.SHED_MAX_DEFER):
+            self._defer_streak += 1
+            self._ticks_deferred += 1
+            self._next_tick = (
+                t + self.merge_interval_s * tuning.SHED_TICK_STRETCH)
+            return 0
+        self._defer_streak = 0
         # module NOTE: keeps the plane's import jax-free; by the first
         # tick the serving engine has long since paid the jax import
         from flowsentryx_tpu.engine.writeback import (
@@ -236,7 +261,7 @@ class GossipPlane:
         net_k: list[np.ndarray] = []
         net_u: list[np.ndarray] = []
         if self.net is not None:
-            self.net.pump()
+            self.net.pump(pressure=pressure)
             # drain deeper than the per-pump rx budget so a sustained
             # inflow converges instead of backing up into the (bounded,
             # drop-counted) rx staging queue
@@ -328,6 +353,7 @@ class GossipPlane:
             "rx_wires": self._rx_wires,
             "rx_seq_gaps": self._rx_seq_gaps,
             "merge_ticks": self._merge_ticks,
+            "ticks_deferred": self._ticks_deferred,
         }
         if self.net is not None:
             # the network-leg counters (tx_drop/rx_gap/rx_dup/

@@ -174,6 +174,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         mega_n=spec.get("mega") or 0,
         device_loop=spec.get("device_loop", 0),
         slo_us=spec.get("slo_us") or 0,
+        predict=bool(spec.get("predict")),
         watchdog_s=spec.get("watchdog_s"),
         gossip=plane,
     )

@@ -1214,6 +1214,21 @@ class ClusterSupervisor:
             health["reasons"] = list(health["reasons"]) + sup_reasons
             health["state"] = health_mod.worst(health["state"],
                                               health_mod.DEGRADED)
+        # predictive-governor merge (ISSUE 18): counters sum, the
+        # fleet "confident" is any-of, and the representative estimate
+        # is the highest-confidence rank's — each rank forecasts its
+        # OWN shard's arrival process, so averaging periods across
+        # ranks would blend unrelated waveforms into nonsense.
+        predict_block = None
+        predict_blocks = [
+            rep["report"]["predict"]
+            for _, rep in sorted(latest.items())
+            if isinstance(rep.get("report"), dict)
+            and rep["report"].get("predict")
+        ]
+        if predict_blocks:
+            from flowsentryx_tpu.engine.predict import DispatchGovernor
+            predict_block = DispatchGovernor.merge_reports(predict_blocks)
         elastic_block = None
         if self._elastic is not None:
             elastic_block = {
@@ -1245,5 +1260,6 @@ class ClusterSupervisor:
             "aggregate_records_per_s": round(
                 total_records / max(max_wall, 1e-9), 1),
             "latency": latency,
+            "predict": predict_block,
             "reports": reports,
         }

@@ -229,6 +229,13 @@ class NetMailbox:
         self.hellos_rx = 0
         self.rx_overflow = 0
         self.pruned = 0
+        # budget-pressure shedding (engine/predict.py governor):
+        # PERIODIC resyncs deferred under engine SLO pressure + the
+        # consecutive-deferral streak that bounds the starvation
+        # (hello-triggered resyncs are never deferred — a healed
+        # partition's repair must not wait on a busy engine)
+        self.resync_deferred = 0
+        self._resync_defer_streak = 0
 
     # -- lifecycle (quiescent: no serving thread alive) ---------------------
 
@@ -282,10 +289,21 @@ class NetMailbox:
         self._sendto(pack_packet(kind, self.host_id, self.rank, 0, 0,
                                  self.t0_wall_ns), self.peers[peer])
 
-    def pump(self) -> None:
+    def pump(self, pressure: float = 0.0) -> None:
         """One merge-section service pass: drain the publish handoff
         onto the network, run the anti-entropy resync when due, and
-        ingest every pending datagram (rx machinery below)."""
+        ingest every pending datagram (rx machinery below).
+
+        ``pressure > 0`` (the engine governor's budget-pressure shed
+        signal, forwarded through ``GossipPlane.tick``) defers a DUE
+        periodic resync — re-paced at ``SHED_TICK_STRETCH`` resync
+        intervals, capped at ``SHED_MAX_DEFER`` consecutive deferrals
+        so pressure can only stretch the loss-repair bound, never
+        starve it.  Verdict wires (the tx drain above) and
+        hello-triggered resyncs are NEVER deferred: fresh verdicts
+        are the latency-critical traffic, and a (re)appeared peer's
+        repair is what keeps a healed partition convergent.  Shed
+        work is counted (``resync_deferred``), never silent."""
         while True:
             try:
                 wire, count = self._outq.popleft()
@@ -300,6 +318,13 @@ class NetMailbox:
             for peer in self.peers:
                 self._send_wire(peer, wire, count)
         now = time.monotonic()
+        if (pressure > 0.0 and not self._resync_peers
+                and now >= self._next_resync
+                and self._resync_defer_streak < tuning.SHED_MAX_DEFER):
+            self._resync_defer_streak += 1
+            self.resync_deferred += 1
+            self._next_resync = (
+                now + self.resync_interval_s * tuning.SHED_TICK_STRETCH)
         if self._resync_peers or now >= self._next_resync:
             # HELLO-triggered resyncs serve ONLY the (re)appeared
             # peers and never consume the periodic deadline: a host
@@ -309,6 +334,7 @@ class NetMailbox:
             self._resync_peers.clear()
             if now >= self._next_resync:
                 self._next_resync = now + self.resync_interval_s
+                self._resync_defer_streak = 0
                 targets |= set(self.peers)
             self._prune_expired()
             self._resync(targets)
@@ -574,6 +600,7 @@ class NetMailbox:
             "epoch_skew_dropped": self.epoch_skew_dropped,
             "epoch_skew_max": round(self.epoch_skew_max, 6),
             "resyncs": self.resyncs,
+            "resync_deferred": self.resync_deferred,
             "hellos_rx": self.hellos_rx,
             "rx_overflow": self.rx_overflow,
             "pruned": self.pruned,

@@ -150,6 +150,14 @@ class EngineReport(NamedTuple):
     #: streams, staged discards, boot-time foreign-row drops.  None
     #: until the first handoff touches this engine.
     rebalance: dict | None = None
+    #: Predictive dispatch governor (engine/predict.py): the burst
+    #: estimator's period/duty/confidence, pre-warm hit/miss and
+    #: early-flush/hold actuation counters, and the budget-pressure
+    #: shed counts (anti-entropy ticks / resyncs deferred).  Merged
+    #: across ranks by the supervisor
+    #: (``DispatchGovernor.merge_reports``).  None unless serving with
+    #: ``predict=True`` (``fsx serve --predict``).
+    predict: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -227,6 +235,7 @@ class Engine:
         gossip: Any | None = None,
         slo_us: int = 0,
         watchdog_s: float | None = None,
+        predict: bool = False,
     ):
         self.cfg = cfg
         self.source = source
@@ -280,6 +289,12 @@ class Engine:
         #: deadline-aware policy reads it advisorily — a stale
         #: estimate can only mis-size a group, never corrupt state.
         self._rung_ewma_s: dict[int, float] = {}
+        #: Warm-seed floors for the NEGATED ring-round keys: the seed
+        #: is the only measurement whose wall covers uploads AND reap,
+        #: so :meth:`_note_round_s` may refine the round EWMA upward
+        #: but never below it (the decaying-optimistic-estimate
+        #: hazard PR 11 documented).  Written by :meth:`warm` only.
+        self._round_floor_s: dict[int, float] = {}
         #: Per-record seal→verdict latency plane (always on; the sink
         #: section is its single writer — sync/contracts.py).
         self._lat = LatencyRecorder()
@@ -702,6 +717,34 @@ class Engine:
         if watchdog_s is None:
             watchdog_s = tuning.WATCHDOG_STALL_S
         self._watchdog = DispatchWatchdog(watchdog_s)
+        #: Predictive dispatch governor (``fsx serve --predict``;
+        #: engine/predict.py): forecasts the arrival process from the
+        #: per-poll stamps this thread already takes and steers the
+        #: flush/pre-warm/shed decisions AROUND the hot path — every
+        #: hook below is gated ``if self._gov is not None``, so
+        #: ``predict=False`` (the default) stays bit-identical to the
+        #: reactive engine (test-pinned like every mode flag).
+        #: Dispatch-thread-only state (sync/contracts.py).
+        if predict and not self.slo_us:
+            # the governor's every actuation is phrased in budget
+            # headroom — without --slo-us there is no budget to
+            # pre-size against or shed under, only silent no-ops
+            raise ValueError(
+                "predict=True requires slo_us > 0: the governor "
+                "actuates the latency-budget machinery (pre-sizing, "
+                "early flush, pressure shedding are all phrased in "
+                "budget headroom)")
+        if predict:
+            from flowsentryx_tpu.engine.predict import DispatchGovernor
+
+            self._gov = DispatchGovernor(
+                rung_sizes=self._mega_sizes,
+                batch_records=cfg.batch.max_batch)
+        else:
+            self._gov = None
+        # lazily-built masked zero batch for pre-warm dispatches
+        # (one allocation, reused; _prewarm_dispatch)
+        self._warm_buf: np.ndarray | None = None
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -727,6 +770,32 @@ class Engine:
         self._rung_ewma_s[key] = (
             dt if prev is None
             else prev + tuning.SLO_EWMA_ALPHA * (dt - prev))
+
+    def _note_round_s(self, key: int, dt: float, out: Any) -> None:
+        """Guarded online refinement of the ring-ROUND EWMA key (the
+        PR 11 follow-up: rounds previously had NO refinement at all).
+
+        Three guards keep the hazard documented in PR 11 closed:
+        launch-absorbed rounds only (the readiness proof of
+        :meth:`_note_step_s`); ``dt`` must already carry the round's
+        upload wall on top of the launch wall (the caller sums them —
+        the reap is still invisible to a launch-side observation); and
+        the refined value is FLOORED at the warm seed, which is the
+        only measurement that saw uploads AND reap.  Net effect: a
+        round that measures slower than the seed raises the estimate
+        (a throttled host degrades to smaller rungs sooner), while a
+        round that measures faster — necessarily missing cost the
+        seed saw — leaves the conservative seed standing.  The key is
+        never CREATED here: warm() owns the seed, and an unseeded
+        engine self-warms at run() start."""
+        if not self._slo_budget_s or not self._out_ready(out):
+            return
+        prev = self._rung_ewma_s.get(key)
+        if prev is None:
+            return
+        floor = self._round_floor_s.get(key, prev)
+        self._rung_ewma_s[key] = max(
+            prev + tuning.SLO_EWMA_ALPHA * (dt - prev), floor)
 
     def _launch_single(self, raw: Any, t_enqueue: float,
                        n_records: int) -> _InFlight:
@@ -854,12 +923,16 @@ class Engine:
         self._dispatched_chunks += g
         self._group_hist[g] = self._group_hist.get(g, 0) + 1
         self._ring_rounds += 1
-        # NO online refinement for the ring-round key: the launch
-        # wall here omits the uploads+reap the warm seed deliberately
-        # includes, so feeding it in would decay the round estimate
-        # below the true round cost and let _slo_round_fits keep
-        # waiting for rounds that land past the budget — a static
-        # conservative seed beats a decaying optimistic one
+        # Ring-round refinement is GUARDED (PR 11 follow-up closed;
+        # :meth:`_note_round_s`): the launch wall alone omits the
+        # uploads+reap the warm seed deliberately includes, so the
+        # observation fed in is launch + the round's own upload wall,
+        # launch-absorbed rounds only, and the EWMA is floored at the
+        # warm seed — the estimate may sharpen UP toward the true
+        # round cost but can never decay below the seed and let
+        # _slo_round_fits keep waiting for rounds that land past the
+        # budget (the decaying-optimistic-estimate hazard).
+        self._note_round_s(-g, (t_d - t_l) + put_s, out)
         return _InFlight(out, t_enqueue, n_records, n_chunks=g,
                          t_launch=t_l, put_s=put_s, launch_s=t_d - t_l)
 
@@ -921,8 +994,37 @@ class Engine:
         """THE coalescing policy, shared by the inline and sealed
         loops so the two paths can never dispatch different group
         shapes for the same backlog: the largest staged rung the
-        backlog fills, else 1 (a single)."""
-        return next((s for s in self._mega_sizes if s <= backlog), 1)
+        backlog fills, else 1 (a single).  Delegates to
+        :func:`flowsentryx_tpu.ops.fused.rung_for_volume` — the ONE
+        copy of the rule, also read by the predictive governor's
+        pre-warm sizing (engine/predict.py), so a forecast can never
+        pre-warm a rung the backlog dispatch would not pick."""
+        return fused.rung_for_volume(backlog, self._mega_sizes)
+
+    def _prewarm_dispatch(self, rung: int) -> None:
+        """The governor's pre-warm actuation (engine/predict.py): ONE
+        masked zero-valid dispatch through the forecast rung.
+        :meth:`warm`'s masking argument makes it result-free — every
+        row carries n_valid=0, so table/stats/verdicts are untouched
+        and the latency plane ignores the entry (0 records).  The
+        observable effects are exactly the point: the rung's EWMA
+        refreshes launch-absorbed (so :meth:`_slo_cap` prices the
+        incoming burst off a HOT measurement) and the rung's
+        executable/arena path is warm when the burst lands.  Reaped
+        to empty before returning — the pipe must read idle again
+        before real traffic arrives."""
+        if self._warm_buf is None:
+            words = (schema.COMPACT_RECORD_WORDS
+                     if self.wire == schema.WIRE_COMPACT16
+                     else schema.RECORD_WORDS)
+            self._warm_buf = np.zeros(
+                (self.cfg.batch.max_batch + 1, words), np.uint32)
+        t0 = time.perf_counter()
+        if rung > 1 and self._arena is not None:
+            self._dispatch_mega([(self._warm_buf, t0)] * rung)
+        else:
+            self._dispatch(self._warm_buf, t0)
+        self._reap(0)
 
     # -- latency-budget (SLO) policy ----------------------------------------
     # Three advisory predicates over the warm-measured per-rung step-
@@ -1098,6 +1200,22 @@ class Engine:
         age = self.batcher.pending_age_s()
         if age <= 0.0:
             return False
+        if self._gov is not None:
+            # Predictive override (engine/predict.py): during a
+            # forecast on-window, HOLD the flush so the burst's
+            # records coalesce into one dispatch — but only while the
+            # governor proves the held records still land inside the
+            # budget (hold-safety bound); in the post-burst off-window
+            # flush EARLY at the forecast burst end instead of waiting
+            # for records to age into the reactive rule — the p99
+            # lever.  None = no confident forecast, fall through to
+            # the reactive rule below unchanged (the quiescent
+            # fallback the confidence gate guarantees).
+            d = self._gov.flush_decision(
+                time.perf_counter(), age,
+                self._rung_ewma_s.get(1, 0.0), self._slo_budget_s)
+            if d is not None:
+                return d
         return age >= max(
             self._slo_budget_s - self._rung_ewma_s.get(1, 0.0),
             self._slo_budget_s / 2)
@@ -1170,13 +1288,32 @@ class Engine:
         # watchdog's no-progress poll, same coverage argument)
         self._maybe_reload_artifact()
         self._watchdog.check(self._busy_depth())
+        pressure = 0.0
+        if self._gov is not None:
+            # governor heartbeat: re-estimate (throttled inside), then
+            # measure the SLO headroom of the OLDEST work anywhere on
+            # the host side — batcher residency or a staged pending
+            # group — as the shed-pressure signal.  Pure host floats;
+            # nothing here touches the device path.
+            now = time.perf_counter()
+            self._gov.update(now)
+            age = self.batcher.pending_age_s()
+            if self._pending:
+                age = max(age, now - self._pending[0][1])
+            pressure = self._gov.pressure(age, self._slo_budget_s)
         if self.gossip is not None:
             # merge peers' gossiped verdicts between dispatches (also
             # on idle iterations — a quiet engine still mitigates what
             # its peers condemn).  RX mailboxes + the plane's own sink
             # are dispatch-thread-owned; the engine sink is not touched
-            # here (its producer is the sink section).
-            self.gossip.tick()
+            # here (its producer is the sink section).  Under measured
+            # budget pressure the governor defers the plane's
+            # anti-entropy pacing (never its verdict publish — that
+            # happens in the sink section, untouched here).
+            if pressure:
+                self.gossip.tick(pressure=pressure)
+            else:
+                self.gossip.tick()
         if self._sink_active:
             self._handoff()
             self._check_sink()
@@ -1544,10 +1681,12 @@ class Engine:
                     # ring ROUNDS key negated (attribute docstring):
                     # a depth-1 round spans the top rung's chunk
                     # count but its wall includes uploads+reap —
-                    # never share slots
-                    self._rung_ewma_s[
-                        -(self.ring * self._ring_chunks)] = (
-                        time.perf_counter() - t0)
+                    # never share slots.  The seed is also the FLOOR
+                    # the online refinement may never dip below
+                    # (_note_round_s).
+                    key = -(self.ring * self._ring_chunks)
+                    self._rung_ewma_s[key] = time.perf_counter() - t0
+                    self._round_floor_s[key] = self._rung_ewma_s[key]
         # warm dispatches are compile triggers, not traffic — keep them
         # out of the dispatch-block accounting
         self._reset_dispatch_counters()
@@ -1632,6 +1771,12 @@ class Engine:
         self._sink_fallback = 0
         self._sunk_batches = 0
         self._reset_dispatch_counters()
+        if self._gov is not None:
+            # per-stream governor counters restart with the metrics;
+            # the predictor's arrival window and any live forecast
+            # deliberately survive — like the EWMA table, they are
+            # properties of the traffic process, not of one stream
+            self._gov.reset_counters()
         # A reap hook is per-stream plumbing: every current caller binds
         # it as a closure over the previous stream's source, so keeping
         # it across a rebind would yield silently wrong latencies (or a
@@ -2027,6 +2172,12 @@ class Engine:
                 # n_polled drives the idle backoff below — a hot source
                 # whose records all drop in-kernel is not an idle link.
                 n_polled = len(records)
+                if self._gov is not None and n_polled:
+                    # the governor observes the PRE-filter arrival
+                    # process (like the idle backoff): the burst shape
+                    # it forecasts is the link's, not the survivors'
+                    self._gov.note_arrivals(time.perf_counter(),
+                                            n_polled)
                 if self.kernel_tier is not None and n_polled:
                     records = self.kernel_tier.filter(records)
                 if not len(records):
@@ -2076,6 +2227,25 @@ class Engine:
                 break
             if not sealed and not n_polled:
                 if self._busy_depth() == 0:
+                    # Proactive rung pre-sizing (engine/predict.py):
+                    # inside the pre-warm lead window before a
+                    # forecast burst onset, spend this otherwise-idle
+                    # iteration re-dispatching the predicted rung with
+                    # a masked zero-valid batch — results untouched
+                    # (warm()'s masking argument), but the rung's
+                    # step-time EWMA refreshes launch-absorbed, so
+                    # _slo_cap prices the incoming burst with a HOT
+                    # measurement instead of a stale one and the XLA
+                    # executable/arena path is warm when the burst
+                    # lands.  Idle iterations only: a pre-warm must
+                    # never queue ahead of real traffic.
+                    if self._gov is not None:
+                        rung = self._gov.prewarm_rung(
+                            time.perf_counter(),
+                            self._rung_ewma_s.get(1, 0.0))
+                        if rung:
+                            self._prewarm_dispatch(rung)
+                            continue
                     # Idle link: back off instead of spinning poll() at
                     # 100% CPU (sync/tuning.py IDLE_SLEEP_S, the
                     # daemon-matched cadence).  A fraction of the batch
@@ -2213,6 +2383,10 @@ class Engine:
                 self._staged_bytes += int(sb.raw.nbytes)
                 metas.append((sb.t_enqueue, sb.n_records))
                 fill += 1
+            if self._gov is not None and batches:
+                self._gov.note_arrivals(
+                    time.perf_counter(),
+                    sum(sb.n_records for sb in batches))
             # ``want == 0`` (slot rows exhausted under a pending carry)
             # must flush, not poll: treat it as a short poll.
             short = len(batches) < want or want == 0
@@ -2299,6 +2473,10 @@ class Engine:
                 self._staged_bytes += int(sb.raw.nbytes)
                 metas.append((sb.t_enqueue, sb.n_records))
                 fill += 1
+            if self._gov is not None and batches:
+                self._gov.note_arrivals(
+                    time.perf_counter(),
+                    sum(sb.n_records for sb in batches))
             short = len(batches) < want
             if fill == c:
                 # slot full: upload NOW (overlapping in-flight compute)
@@ -2375,6 +2553,10 @@ class Engine:
                 for sb in batches:
                     self.batcher.batches_emitted += 1
                     self.batcher.records_emitted += sb.n_records
+                if self._gov is not None and batches:
+                    self._gov.note_arrivals(
+                        time.perf_counter(),
+                        sum(sb.n_records for sb in batches))
             if self.mega_n > 0:
                 for sb in batches:
                     self._pending.append((sb.raw, sb.t_enqueue))
@@ -2493,6 +2675,17 @@ class Engine:
                         else None)
         cluster_rep = (self.gossip.report()
                        if self.gossip is not None else None)
+        predict_rep = None
+        if self._gov is not None:
+            predict_rep = self._gov.report()
+            if cluster_rep is not None:
+                # fold the shed counters in next to the actuation
+                # counters they motivate — one block to alert on
+                predict_rep["gossip_ticks_deferred"] = cluster_rep.get(
+                    "ticks_deferred", 0)
+                predict_rep["net_resync_deferred"] = (
+                    cluster_rep.get("net") or {}).get(
+                        "resync_deferred", 0)
         return EngineReport(
             batches=self.batcher.batches_emitted,
             records=self.batcher.records_emitted,
@@ -2525,6 +2718,7 @@ class Engine:
                 restore_fallbacks=self._restore_fallbacks,
                 rebalance=self._rebalance or None),
             rebalance=dict(self._rebalance) or None,
+            predict=predict_rep,
         )
 
 

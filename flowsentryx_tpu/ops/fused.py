@@ -785,6 +785,17 @@ def pow2_group_sizes(mega_n: int) -> tuple[int, ...]:
     return tuple(reversed(sizes))
 
 
+def rung_for_volume(volume: int, sizes: tuple[int, ...]) -> int:
+    """THE ladder rung-selection policy: the largest rung of ``sizes``
+    (largest-first, :func:`pow2_group_sizes` order) that ``volume``
+    sealed batches fill, else 1 (singles).  One copy shared by the
+    engine's backlog dispatch (``Engine._rung_for``) and the
+    predictive governor's pre-warm sizing (``engine/predict.py``) —
+    the forecast must pre-warm exactly the rung the backlog will
+    dispatch through, so the two callers cannot be allowed to drift."""
+    return next((s for s in sizes if s <= volume), 1)
+
+
 def make_jitted_compact_megastep(
     cfg: FsxConfig,
     classify_batch,

@@ -156,8 +156,11 @@ _ENGINE_LAUNCH = (
     # never both: _pipe_active routes every _dispatch* through _submit
     # while the worker owns launches (interleave.py exercises this).
     # _note_step_s is the launch tail that folds the measured step
-    # wall into the SLO EWMA table — same single-launcher exclusivity.
+    # wall into the SLO EWMA table — same single-launcher exclusivity;
+    # _note_round_s is its ring-round twin (guarded refinement of the
+    # negated round keys, floored at the warm seed).
     "_launch_single", "_launch_group", "_launch_ring", "_note_step_s",
+    "_note_round_s",
 )
 
 _ENGINE_SINK = (
@@ -218,9 +221,18 @@ ENGINE_PLAN = ClassPlan(
             "mis-size a coalescing group, never corrupt state (each "
             "value is a whole-object float store, atomic in CPython); "
             "run()'s ring-seed probe reads it BEFORE any worker "
-            "thread is started (the auto-warm gate)",
+            "thread is started (the auto-warm gate); _run_inline's "
+            "read feeds the governor's pre-warm lead window — the "
+            "same advisory-float argument",
             extra=("_slo_cap", "_slo_pressed", "_slo_round_fits",
-                   "_deadline_flush_due", "run")),
+                   "_deadline_flush_due", "run", "_run_inline")),
+        "_round_floor_s": FieldContract(
+            "section:launch",
+            "warm-seed floors for the negated ring-round EWMA keys: "
+            "written only by the quiescent warm pass, read by the "
+            "launch tail (_note_round_s) to keep the guarded online "
+            "refinement from decaying the round estimate below the "
+            "only measurement that saw uploads AND reap"),
         "slo_us": FieldContract(
             "quiescent-write",
             "latency-budget mode flag (--slo-us): written only at "
@@ -236,6 +248,18 @@ ENGINE_PLAN = ClassPlan(
         "_h2d_puts_overlapped": _DISP, "_t0_auto": _DISP,
         "_watch_path": _DISP, "_watch_mtime": _DISP,
         "_watch_next": _DISP, "_hot_swaps": _DISP,
+        "_gov": FieldContract(
+            "dispatch",
+            "the predictive dispatch governor (engine/predict.py, its "
+            "own PREDICT_PLAN): observed on the serving loop's poll "
+            "sites, updated/read by the dispatch-thread policy hooks "
+            "(_deadline_flush_due / _reap_ready / prewarm), read at "
+            "quiescence by the report — no worker may touch it"),
+        "_warm_buf": FieldContract(
+            "dispatch",
+            "lazily-built masked zero batch for governor pre-warm "
+            "dispatches: built and read only on the inline serving "
+            "loop's idle branch"),
         "_rebalance": FieldContract(
             "dispatch",
             "live-handoff counters (count_rebalance): advanced by "
@@ -345,6 +369,17 @@ GOSSIP_PLAN = ClassPlan(
         "_next_tick": FieldContract(
             "section:merge", "tick throttle clock (tuning"
             ".GOSSIP_MERGE_INTERVAL_S)"),
+        "_ticks_deferred": FieldContract(
+            "section:merge",
+            "anti-entropy ticks shed under engine budget pressure "
+            "(engine/predict.py governor): counted, never silent — "
+            "the paced A/B's proof that deferral only happens under "
+            "measured headroom pressure"),
+        "_defer_streak": FieldContract(
+            "section:merge",
+            "consecutive-deferral cap (tuning.SHED_MAX_DEFER): "
+            "pressure may stretch the merge cadence but never starve "
+            "it"),
         "_rx": FieldContract(
             "section:merge",
             "RX mailboxes: their tail cursors are single-writer "
@@ -485,6 +520,17 @@ NETMAILBOX_PLAN = ClassPlan(
             "net_epoch_skew_max DEGRADED reason)"),
         "resyncs": FieldContract("section:merge",
                                  "anti-entropy accounting"),
+        "resync_deferred": FieldContract(
+            "section:merge",
+            "PERIODIC resyncs shed under engine budget pressure "
+            "(engine/predict.py governor via GossipPlane.tick): "
+            "counted, never silent; hello-triggered resyncs are "
+            "never deferred"),
+        "_resync_defer_streak": FieldContract(
+            "section:merge",
+            "consecutive-deferral cap (tuning.SHED_MAX_DEFER): "
+            "pressure stretches the loss-repair bound, never "
+            "starves it"),
         "hellos_rx": FieldContract("section:merge",
                                    "peer-discovery accounting"),
         "rx_overflow": FieldContract(
@@ -593,6 +639,77 @@ REBALANCE_PLAN = ClassPlan(
     },
 )
 
+PREDICT_PLAN = ClassPlan(
+    module="flowsentryx_tpu/engine/predict.py",
+    cls="DispatchGovernor",
+    quiescent=("__init__", "reset_counters", "report"),
+    fields={
+        # The governor runs ENTIRELY on the engine's dispatch thread
+        # (Engine._gov is dispatch-owned; every hook — note_arrivals
+        # on the poll sites, update/pressure in _reap_ready,
+        # flush_decision in _deadline_flush_due, prewarm_rung on the
+        # idle branch — executes there).  These entries pin that: a
+        # helper thread driving any of them would interleave the
+        # forecast lifecycle (arm → judge → re-arm) and the actuation
+        # counters the paced A/B evidence is built on.  reset_counters
+        # is quiescent by the reset_stream contract (no batches in
+        # flight), report by _build_report's.
+        "predictor": FieldContract(
+            "dispatch",
+            "the BurstPredictor and its arrival window (_t/_n lists "
+            "pruned in observe()): single-caller monotone-time "
+            "protocol — a second observer thread would break the "
+            "contiguous-tail pruning invariant"),
+        "forecast": FieldContract(
+            "dispatch",
+            "the live Forecast (None = quiescent fallback): swapped "
+            "whole-object by update(), read by every actuation"),
+        "_last_estimate_t": FieldContract(
+            "dispatch", "re-estimation throttle clock"),
+        "_last_arrival_t": FieldContract(
+            "dispatch",
+            "newest arrival stamp — the onset hit/miss judge's "
+            "evidence"),
+        "_armed_onset": FieldContract(
+            "dispatch",
+            "the predicted future onset under watch (arm → judge → "
+            "re-arm lifecycle in update())"),
+        "_prewarmed_onset": FieldContract(
+            "dispatch",
+            "onset a pre-warm was already issued for: the once-per-"
+            "onset latch"),
+        "forecasts": FieldContract("dispatch", "actuation accounting"),
+        "forecast_dropped": FieldContract(
+            "dispatch",
+            "forecasts expired by the confidence gate (the reactive-"
+            "fallback transitions, counted)"),
+        "onset_hits": FieldContract("dispatch",
+                                    "per-onset forecast judging"),
+        "onset_misses": FieldContract("dispatch",
+                                      "per-onset forecast judging"),
+        "prewarm_issued": FieldContract("dispatch",
+                                        "pre-warm accounting"),
+        "prewarm_hits": FieldContract("dispatch",
+                                      "pre-warm accounting"),
+        "prewarm_misses": FieldContract(
+            "dispatch",
+            "pre-warms spent on onsets that never arrived (the "
+            "--alert-prewarm-miss signal)"),
+        "early_flushes": FieldContract(
+            "dispatch",
+            "forecast-end flushes issued before the reactive rule "
+            "was due — the p99 lever, counted"),
+        "holds": FieldContract(
+            "dispatch",
+            "reactive-due flushes held inside a forecast on-window "
+            "(budget-bounded; flush_decision docstring)"),
+        "pressure_ticks": FieldContract(
+            "dispatch",
+            "iterations the shed-pressure signal fired on (pairs "
+            "with the gossip/net deferral counters)"),
+    },
+)
+
 ELASTIC_PLAN = ClassPlan(
     module="flowsentryx_tpu/cluster/elastic.py",
     cls="ElasticPolicy",
@@ -623,7 +740,8 @@ ELASTIC_PLAN = ClassPlan(
 
 REGISTRY: tuple[ClassPlan, ...] = (ENGINE_PLAN, CHANNEL_PLAN, INGEST_PLAN,
                                    GOSSIP_PLAN, NETMAILBOX_PLAN,
-                                   REBALANCE_PLAN, ELASTIC_PLAN)
+                                   REBALANCE_PLAN, ELASTIC_PLAN,
+                                   PREDICT_PLAN)
 
 CURSORS: tuple[CursorPlan, ...] = (
     CursorPlan(module="flowsentryx_tpu/engine/shm.py", cls="ShmRing",

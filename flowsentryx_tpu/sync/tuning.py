@@ -217,6 +217,102 @@ NET_HOST_TIMEOUT_S = 5.0
 #: cannot out-wait the window between respawns.
 RESTART_WINDOW_S = 60.0
 
+# -- predictive dispatch governor (ISSUE 18: engine/predict.py) -------------
+
+#: Arrival-histogram bin width for the burst period estimator.  The
+#: pulse regimes the SLO engine exists for (traffic.py pulse-wave
+#: specs, the PR 11 A/B corpus) have periods of a few batcher
+#: deadlines — single-digit ms — so 0.25 ms gives ~15-30 bins/period:
+#: enough autocorrelation resolution to place the period within ~2 %
+#: while keeping a full estimator pass (one FFT-free O(bins·lags)
+#: numpy correlation over the window) in the tens of µs, invisible at
+#: the PREDICT_REESTIMATE_S cadence.
+PREDICT_BIN_S = 0.25e-3
+
+#: Estimator observation window.  At the shortest supported period
+#: (2x the bin, Nyquist) this holds hundreds of cycles; at the pulse
+#: corpus's 7.5 ms it holds ~40 — both sides of PREDICT_MIN_PERIODS
+#: with margin — while bounding predictor memory and keeping the
+#: estimate tracking regime shifts within a window, not a serve.
+PREDICT_WINDOW_S = 0.3
+
+#: Confidence gate floor: the normalized autocorrelation peak
+#: (ac[lag]/ac[0]) a forecast must reach before ANY actuation.  Noise
+#: over a steady process autocorrelates near 0; a clean pulse wave
+#: scores > 0.7 within a handful of periods.  0.5 splits those modes
+#: with margin on both sides; below it the governor is quiescent and
+#: the engine is bit-identical to the reactive PR 11 policy.
+PREDICT_CONF_MIN = 0.5
+
+#: Confidence exit fraction (Schmitt-trigger hysteresis): once a
+#: forecast is LOCKED (an estimate reached PREDICT_CONF_MIN), tracking
+#: estimates keep it alive down to ``conf_min * this``.  The engine's
+#: own observation jitter — burst arrivals coalesce into whatever poll
+#: the dispatch loop was free to make — leaves a real pulse wave's
+#: measured confidence hovering AROUND the entry gate (measured
+#: 0.35-0.70 on the r22 pulse corpus), so a single threshold flaps the
+#: forecast at the re-estimate cadence and most bursts ride the
+#: reactive point anyway.  0.6 puts the exit at 0.30: above a full
+#: window of Poisson noise (measured ~0.06-0.10, so a regime change
+#: still drops the lock within one re-estimate) and below the pulse
+#: wave's worst tracking estimate.  Entry — and therefore EVERY
+#: quiescent guarantee — still requires the full PREDICT_CONF_MIN.
+PREDICT_CONF_EXIT_FRAC = 0.6
+
+#: Histogram box-smooth width (bins) applied before the period
+#: search.  The dispatch loop observes arrivals at POLL times, so a
+#: burst lands as 1-3 clumps jittered by up to a dispatch+reap pass
+#: (~1-1.5 ms on the pulse corpus — about this many bins); raw per-bin
+#: autocorrelation decorrelates under that jitter while the smoothed
+#: series keeps the period peak.  Costs period resolution at the
+#: short end: the estimator's lag floor is 2x this (1.5 ms minimum
+#: detectable period), far under any burst process the batcher's
+#: own deadline wouldn't already absorb.  1 disables.
+PREDICT_SMOOTH_BINS = 6
+
+#: Minimum whole periods the window must span at the estimated period
+#: before the estimate is eligible at all — an autocorr peak measured
+#: over fewer cycles is curve-fitting, not evidence.
+PREDICT_MIN_PERIODS = 4
+
+#: Re-estimation cadence: the estimator pass runs on the dispatch
+#: thread (engine ``_reap_ready``), so it is throttled like the gossip
+#: tick.  50 ms re-locks phase within ~7 periods of the fastest pulse
+#: the bin width resolves while costing < 0.1 % of the thread.
+PREDICT_REESTIMATE_S = 0.05
+
+#: Onset tolerance: arrivals within this of a predicted burst onset
+#: count the pre-warm as a HIT; an onset passing by more than this
+#: with no arrivals is a MISS (forecast expired, governor falls back
+#: to reactive until re-confirmed).  2 bins — the phase quantization
+#: of the estimator itself.
+PREDICT_ONSET_TOL_S = 2 * PREDICT_BIN_S
+
+#: Pre-warm lead margin added to the predicted rung's step-time EWMA:
+#: the pre-warm dispatch must RETIRE (and refresh the rung's EWMA)
+#: before the burst lands, so it is issued ewma+margin ahead of the
+#: predicted onset.  One bin absorbs the estimator's phase error.
+PREDICT_PREWARM_MARGIN_S = PREDICT_BIN_S
+
+#: Budget-pressure shedding threshold: when the oldest staged work's
+#: remaining SLO headroom fraction drops under this, the engine defers
+#: gossip anti-entropy/report ticks (never verdict publish).  0.25
+#: means shedding starts while there is still time to matter — a
+#: threshold at 0 would shed only after the budget is already lost.
+PREDICT_SHED_HEADROOM = 0.25
+
+#: Under pressure the gossip merge tick and the net resync cadence
+#: stretch by this factor — anti-entropy work drops to 1/4 rate, it
+#: does not stop (convergence bounds scale by the same factor,
+#: staying far inside the 10 s block TTL).
+SHED_TICK_STRETCH = 4
+
+#: Consecutive-deferral cap: after this many back-to-back deferred
+#: resyncs the next one runs regardless of pressure — a persistently
+#: squeezed engine must still heal partitions; shedding bounds the
+#: RATE of anti-entropy work, never its eventual occurrence.
+SHED_MAX_DEFER = 8
+
 # -- elastic fleet (ISSUE 16) ----------------------------------------------
 
 #: Autoscaler decision cadence (``ClusterSupervisor.run --elastic``):

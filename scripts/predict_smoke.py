@@ -1,0 +1,220 @@
+"""Bounded CPU predictive-governor smoke — the ISSUE 18 CI gate.
+
+Three legs, every run (the governor is re-proved by every
+``scripts/verify_tier1.sh`` pass, not benched once and trusted
+forever):
+
+* **forecast** — the pulse-wave ``PacedSource`` (the PR 11 corpus
+  shape: 96-record bursts every 7.5 ms) through a WARMED
+  ``--slo-us --predict`` engine with a gossip plane attached.  Gates:
+  the forecaster goes confident on the pulse schedule (``forecasts``
+  >= 1 with onset hits), at least one pre-warm was issued AND hit
+  (the rung was warm when the burst landed), the forecast-end early
+  flush fired, the latency plane stays sound (``negatives == 0``,
+  every record accounted), and the shed counters moved — with
+  ``gossip_ticks_deferred <= pressure_ticks`` (anti-entropy deferral
+  happened, and ONLY under measured headroom pressure).
+* **quiescent** — the same engine shape under a budget so large the
+  pressure signal can never fire, on a saturating (aperiodic) sealed
+  drain: the governor must actuate NOTHING (no confident forecast, no
+  pre-warm, no early flush, zero pressure ticks) and the gossip plane
+  must defer NOTHING — the deferral-only-under-pressure dual.
+* **registry** — ``fsx sync``'s ``run_contracts()`` over the live
+  repo: ok with zero findings (the governor/deferral fields stay
+  registered with their disciplines).
+
+Results merge into ``artifacts/PREDICT_r22.json`` under ``"smoke"``
+(the ``"paced"`` A/B evidence in the same artifact is preserved).
+
+Usage: JAX_PLATFORMS=cpu python scripts/predict_smoke.py [out.json]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BATCH = 256
+DEADLINE_US = 5000
+SLO_US = 5000
+RATE_PPS = 0.0128e6        # PR 11 pulse corpus shape: bursts SMALLER
+BURST_PERIOD_S = 0.0075    # than one batch, so every record rides the
+DUTY = 0.20                # deadline-flush point the governor moves
+PULSE_SECONDS = 2.5
+QUIESCENT_SLO_US = 500_000  # headroom so large pressure can't fire
+
+
+def _cfg():
+    import dataclasses
+
+    from flowsentryx_tpu.core.config import FsxConfig
+
+    cfg = FsxConfig()
+    return dataclasses.replace(
+        cfg,
+        batch=dataclasses.replace(cfg.batch, max_batch=BATCH,
+                                  deadline_us=DEADLINE_US),
+        table=dataclasses.replace(cfg.table, capacity=1 << 14),
+        limiter=dataclasses.replace(
+            cfg.limiter, pps_threshold=200.0, bps_threshold=1e9),
+    )
+
+
+def main() -> int:
+    from flowsentryx_tpu.cluster.gossip import GossipPlane, create_plane
+    from flowsentryx_tpu.engine import (
+        ArraySource, Engine, NullSink, PacedSource,
+    )
+    from flowsentryx_tpu.engine.traffic import (
+        Scenario, TrafficGen, TrafficSpec,
+    )
+
+    t_start = time.perf_counter()
+    failures: list[str] = []
+    pool = TrafficGen(TrafficSpec(
+        scenario=Scenario.UDP_FLOOD_MULTI, rate_pps=1e7,
+        n_attack_ips=64, n_benign_ips=192, attack_fraction=0.8, seed=41,
+    )).next_records(1 << 14)
+
+    # -- leg 1: forecast + actuation + shed on the pulse schedule ----------
+    plane_dir = tempfile.mkdtemp(prefix="fsx_predict_smoke_")
+    create_plane(plane_dir, 2)
+    plane = GossipPlane(plane_dir, 0, 2, merge_interval_s=0.0)
+    eng = Engine(_cfg(), ArraySource(pool[:0].copy()), NullSink(),
+                 readback_depth=2, sink_thread=False, mega_n="auto",
+                 slo_us=SLO_US, predict=True, gossip=plane)
+    eng.warm()
+    total = int(RATE_PPS * PULSE_SECONDS)
+    src = PacedSource(pool.copy(), rate_pps=RATE_PPS, total=total,
+                      burst_period_s=BURST_PERIOD_S, duty_cycle=DUTY)
+    eng.reset_stream(src)
+    rep = eng.run(max_seconds=PULSE_SECONDS + 4)
+    p = rep.predict
+    lat = rep.latency
+
+    if p is None:
+        failures.append("predict block missing from a --predict run")
+        p = {}
+    if rep.records < total:
+        failures.append(
+            f"pulse leg served {rep.records} of {total} offered records")
+    if lat["negatives"] != 0:
+        failures.append(
+            f"{lat['negatives']} negative stage interval(s) under the "
+            "governor: the stamp planes are NOT monotone")
+    if not p.get("forecasts"):
+        failures.append(
+            f"forecaster never went confident on the pulse schedule: {p}")
+    if not p.get("onset_hits"):
+        failures.append(
+            f"no predicted onset was confirmed by arrivals: {p}")
+    if not p.get("prewarm_issued"):
+        failures.append(f"no pre-warm was issued across "
+                        f"{p.get('forecasts')} forecasts: {p}")
+    if not p.get("prewarm_hits"):
+        failures.append(
+            f"no pre-warm HIT (rung warm when the burst landed): {p}")
+    if not p.get("early_flushes"):
+        failures.append(
+            f"the forecast-end early flush never fired — the p99 "
+            f"lever is dead: {p}")
+    if not p.get("pressure_ticks"):
+        failures.append(
+            f"pressure never fired under a {SLO_US} us budget on the "
+            f"pulse schedule: {p}")
+    deferred = p.get("gossip_ticks_deferred", 0)
+    if not deferred:
+        failures.append(
+            f"anti-entropy was never deferred under pressure: {p}")
+    if deferred > p.get("pressure_ticks", 0):
+        failures.append(
+            f"{deferred} gossip ticks deferred but pressure fired only "
+            f"{p.get('pressure_ticks')} times — deferral without "
+            "measured headroom pressure")
+
+    # -- leg 2: the quiescent dual (no pressure -> no shed, no actuation) --
+    plane_dir2 = tempfile.mkdtemp(prefix="fsx_predict_smoke_q_")
+    create_plane(plane_dir2, 2)
+    plane2 = GossipPlane(plane_dir2, 0, 2, merge_interval_s=0.0)
+    eng2 = Engine(_cfg(), ArraySource(pool.copy()), NullSink(),
+                  readback_depth=2, sink_thread=False, mega_n="auto",
+                  slo_us=QUIESCENT_SLO_US, predict=True, gossip=plane2)
+    eng2.warm()
+    eng2.reset_stream(ArraySource(pool.copy()))
+    rep2 = eng2.run()
+    q = rep2.predict or {}
+    if q.get("confident"):
+        failures.append(
+            f"governor went confident on a saturating aperiodic "
+            f"drain: {q}")
+    for k in ("prewarm_issued", "early_flushes", "pressure_ticks",
+              "gossip_ticks_deferred"):
+        if q.get(k):
+            failures.append(
+                f"quiescent control actuated: {k}={q[k]} with no "
+                f"pressure and no confident forecast ({q})")
+
+    # -- leg 3: the governor registry stays clean --------------------------
+    from flowsentryx_tpu.sync.contracts import run_contracts
+
+    crep = run_contracts()
+    if not crep.ok:
+        failures.append(
+            "fsx sync findings: "
+            + "; ".join(str(f) for f in crep.findings))
+
+    smoke = {
+        "ts": time.time(),
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "config": {
+            "batch": BATCH, "deadline_us": DEADLINE_US,
+            "slo_us": SLO_US, "rate_mpps": RATE_PPS / 1e6,
+            "burst_period_s": BURST_PERIOD_S, "duty_cycle": DUTY,
+            "seconds": PULSE_SECONDS,
+            "quiescent_slo_us": QUIESCENT_SLO_US,
+        },
+        "pulse": {
+            "records": rep.records,
+            "predict": p,
+            "negatives": lat["negatives"],
+            "p99_us": lat["seal_to_verdict"].get("p99"),
+        },
+        "quiescent": {
+            "records": rep2.records,
+            "predict": q,
+        },
+        "contracts_ok": crep.ok,
+        "ok": not failures,
+        "failures": failures,
+    }
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "PREDICT_r22.json")
+    try:
+        artifact = json.loads(open(out_path).read())
+    except (OSError, ValueError):
+        artifact = {}
+    artifact["smoke"] = smoke
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"predict smoke: wrote {out_path}")
+    print(f"predict smoke: forecasts={p.get('forecasts')} "
+          f"onset_hits={p.get('onset_hits')} "
+          f"prewarm_hits={p.get('prewarm_hits')} "
+          f"early_flushes={p.get('early_flushes')} "
+          f"ticks_deferred={deferred} negatives={lat['negatives']}")
+    for msg in failures:
+        print(f"predict smoke: FAIL {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

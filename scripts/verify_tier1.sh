@@ -218,6 +218,18 @@ echo "== latency smoke: seal->verdict plane + SLO degradation =="
 # A/B evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/latency_smoke.py || exit 1
 
+echo "== predict smoke: burst forecast + pre-warm + pressure shedding =="
+# Bounded CPU smoke of the predictive dispatch governor (docs/ENGINE.md
+# §prediction): re-proves the forecaster goes confident on the pulse
+# schedule, a pre-warm was issued AND hit, the forecast-end early
+# flush fired, gossip anti-entropy was deferred under measured budget
+# pressure (and ONLY then — the quiescent high-budget control leg
+# actuates nothing and defers nothing), the latency plane stays sound
+# (negatives == 0), and the fsx sync registry is clean — re-writing
+# the "smoke" section of artifacts/PREDICT_r22.json (the paced A/B
+# evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/predict_smoke.py || exit 1
+
 echo "== device-loop smoke: drain ring + double-buffered H2D =="
 # Bounded CPU smoke of the device-resident drain ring: re-proves that
 # full deep-scan rounds fire, copies/batch stays 1.0, and H2D overlap

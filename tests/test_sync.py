@@ -944,3 +944,100 @@ class TestSloRegistry:
             worker_targets=("_worker",), fields={}))
         assert any(f.contract == "unregistered"
                    and "_ewma" in f.reason for f in out)
+
+
+# ---------------------------------------------------------------------------
+# Predictive-governor registry (ISSUE 18): every new piece of shared
+# state — the governor itself, the ring-round refinement floor, the
+# pre-warm buffer, and the shed-deferral counters on both gossip
+# planes — registered with the correct discipline, and each new
+# discipline surface's planted violation caught.
+# ---------------------------------------------------------------------------
+
+class TestPredictRegistry:
+    def test_new_fields_registered_with_expected_disciplines(self):
+        f = contracts.ENGINE_PLAN.fields
+        assert f["_gov"].discipline == "dispatch"
+        assert f["_warm_buf"].discipline == "dispatch"
+        assert f["_round_floor_s"].discipline == "section:launch"
+        # the prewarm site reads the EWMA table from the serving loop:
+        # an explicit documented grant, like the PR 11 policy readers
+        assert "_run_inline" in f["_rung_ewma_s"].extra
+        assert "_note_round_s" in contracts.ENGINE_PLAN.sections["launch"]
+        g = contracts.GOSSIP_PLAN.fields
+        assert g["_ticks_deferred"].discipline == "section:merge"
+        assert g["_defer_streak"].discipline == "section:merge"
+        n = contracts.NETMAILBOX_PLAN.fields
+        assert n["resync_deferred"].discipline == "section:merge"
+        assert n["_resync_defer_streak"].discipline == "section:merge"
+
+    def test_governor_plan_covers_every_mutable_attr(self):
+        # registry-rot guard in the forward direction: every attribute
+        # DispatchGovernor.__init__/reset_counters assigns is a
+        # registered field — a new counter added without a contract
+        # entry fails here by name
+        import flowsentryx_tpu.engine.predict as predict_mod
+
+        gov = predict_mod.DispatchGovernor()
+        public = {k for k in vars(gov)
+                  if k not in ("rung_sizes", "batch_records",
+                               "conf_min")}  # quiescent config
+        assert public == set(contracts.PREDICT_PLAN.fields)
+
+    def test_planted_governor_touched_from_worker(self):
+        # the dispatch discipline on the governor: a worker thread
+        # driving any hook (here: the forecast swap) must be a finding
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def run(self):\n"
+            "        threading.Thread(target=self._worker).start()\n"
+            "        self.forecast = None\n"
+            "    def _worker(self):\n"
+            "        self.forecast = 1\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            worker_targets=("_worker",),
+            fields={"forecast": FieldContract("dispatch",
+                                              "live forecast")}))
+        assert len(out) == 1
+        assert out[0].line == 7 and "forecast" in out[0].reason
+
+    def test_planted_deferral_counter_outside_merge_section(self):
+        # the shed-deferral counters ride the merge section: a bump
+        # from the publish side (sink section territory) is a finding
+        src = (
+            "class C:\n"
+            "    def tick(self):\n"
+            "        self._ticks_deferred += 1\n"
+            "    def publish(self):\n"
+            "        self._ticks_deferred += 1\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            sections={"merge": ("tick",)},
+            fields={"_ticks_deferred": FieldContract(
+                "section:merge", "shed deferral accounting")}))
+        assert len(out) == 1
+        assert out[0].line == 5 and "'merge' section" in out[0].reason
+
+    def test_planted_round_floor_written_outside_launch(self):
+        # the ring-round floor is launch-section state (written by the
+        # warm seed and read by the refinement): a sink-side write is
+        # a finding
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def run(self):\n"
+            "        threading.Thread(target=self._sink_worker).start()\n"
+            "    def _note_round_s(self):\n"
+            "        self._round_floor_s[-16] = 0.1\n"
+            "    def _sink_worker(self):\n"
+            "        self._round_floor_s[-16] = 0.2\n")
+        out = check_class(ast.parse(src), "planted.py", ClassPlan(
+            module="planted.py", cls="C",
+            worker_targets=("_sink_worker",),
+            sections={"launch": ("_note_round_s",)},
+            fields={"_round_floor_s": FieldContract(
+                "section:launch", "warm-seed round floor")}))
+        assert len(out) == 1
+        assert out[0].line == 8 and "_round_floor_s" in out[0].reason
