@@ -521,6 +521,80 @@ def _cmd_crash(args: argparse.Namespace) -> int:
     return 0 if rep["ok"] else 1
 
 
+def _cmd_live(args: argparse.Namespace) -> int:
+    """Liveness & progress model checking — the sixth static leg
+    (docs/LIVENESS.md, docs/STATIC.md).
+
+    Builds the full state graph of the REAL protocol objects — the
+    ``SinkChannel`` submit/backpressure/stop drain, the supervisor's
+    fenced handoff with a message dropped at every stamp edge, the
+    elastic autoscale hysteresis, gossip pressure-shedding, and
+    quiesce — and proves deadlock-freedom (every park names its wake
+    edge), livelock-freedom under weak fairness (no reachable
+    no-progress cycle), and bounded starvation (every declared
+    obligation fires within its registered bound).  The PROGRESS
+    registry (flowsentryx_tpu/live/registry.py) is audited against an
+    AST scan of the protocol scope: every blocking loop must declare
+    its wake source and fairness assumption, and every registry entry
+    must still point at real code that the checker exercises.
+    Planted regressions (a deleted notify, a dropped fence-lift with
+    re-delivery removed, the shed streak cap removed, a zeroed
+    cooldown) must each be caught with a printed schedule, from runs
+    whose unplanted controls are clean.
+
+    jax-free, a few seconds; ``--quick`` trims the handoff drop-edge
+    fan-out (same protocols and plants, fewer dropped edges)."""
+    from flowsentryx_tpu.live.checker import run_live
+
+    rep = run_live(quick=args.quick)
+    if not args.json:
+        for c in rep["checks"]:
+            status = "OK" if c["ok"] else "FAILED"
+            print(f"fsx live: {c['check']}: {status} "
+                  f"({c['states']} states, {c['edges']} edges, "
+                  f"{c['terminals']} terminals"
+                  + (", CAPPED" if c["capped"] else "") + ")")
+            if c["counterexample"] and not c["ok"]:
+                cx = c["counterexample"]
+                print(f"  {cx['detail']}", file=sys.stderr)
+                print("  schedule: "
+                      + " -> ".join(cx["schedule"]), file=sys.stderr)
+        for p in rep["plants"]:
+            ok = p["caught"] and p["control_ok"]
+            why = ("caught by " + str(p["caught_by"]) if p["caught"]
+                   else "NOT CAUGHT")
+            if not p["control_ok"]:
+                why += "; control run dirty"
+            print(f"fsx live: plant {p['plant']}: "
+                  f"{'OK' if ok else 'FAILED'} ({why})")
+            if p["schedule"] and not args.quiet_plants:
+                print("  " + p["detail"])
+                print("  schedule: " + " -> ".join(p["schedule"]))
+        reg = rep["registry"]
+        print(f"fsx live: registry: "
+              f"{'OK' if reg['ok'] else 'FAILED'} "
+              f"({reg['entries']} entries, {reg['sites']} blocking "
+              f"sites)")
+        for f in reg["findings"]:
+            print(f"  {f}", file=sys.stderr)
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(rep, indent=2) + "\n")
+        if not args.json:
+            print(f"fsx live: report -> {p}")
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    elif rep["ok"]:
+        t = rep["totals"]
+        print(f"fsx live: PASS ({t['checks']} checks, "
+              f"{t['states']} states, {t['steps']} steps, "
+              f"{t['plants']} plants, {rep['elapsed_s']} s)")
+    else:
+        print("fsx live: FAIL", file=sys.stderr)
+    return 0 if rep["ok"] else 1
+
+
 def _cmd_chaos(args: argparse.Namespace) -> int:
     """Deterministic fault-injection campaign over the REAL stack —
     the robustness leg of the verification suite (the static legs
@@ -2684,6 +2758,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="suppress the planted regressions' printed "
                          "crash schedules (kept in the JSON report)")
     cr.set_defaults(fn=_cmd_crash)
+
+    lv = sub.add_parser(
+        "live",
+        help="liveness & progress model checking: state-graph search "
+             "over the real protocol objects proving deadlock-"
+             "freedom, livelock-freedom under weak fairness and "
+             "bounded starvation, plus the PROGRESS registry audit "
+             "of every blocking loop (jax-free; the sixth static "
+             "leg)")
+    lv.add_argument("--quick", action="store_true",
+                    help="trim the handoff drop-edge fan-out (same "
+                         "protocols and plants; what the tier-1 gate "
+                         "runs)")
+    lv.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    lv.add_argument("--out", metavar="PATH",
+                    help="also write the JSON report here (the "
+                         "artifacts/LIVE_*.json evidence file)")
+    lv.add_argument("--quiet-plants", action="store_true",
+                    help="suppress the planted regressions' printed "
+                         "catching schedules (kept in the JSON "
+                         "report)")
+    lv.set_defaults(fn=_cmd_live)
 
     rg = sub.add_parser(
         "ranges",

@@ -224,17 +224,10 @@ class GossipPlane:
                 t + self.merge_interval_s * tuning.SHED_TICK_STRETCH)
             return 0
         self._defer_streak = 0
-        # module NOTE: keeps the plane's import jax-free; by the first
-        # tick the serving engine has long since paid the jax import
-        from flowsentryx_tpu.engine.writeback import (
-            BlacklistUpdate, decode_verdict_wire,
-        )
-
         self._next_tick = t + self.merge_interval_s
         self.status.ctl_set(
             "c_hbeat", time.clock_gettime_ns(time.CLOCK_MONOTONIC))
-        merged_k: list[np.ndarray] = []
-        merged_u: list[np.ndarray] = []
+        raw_wires: list[np.ndarray] = []
         for peer, mbx in self._rx.items():
             while True:
                 got = mbx.pop_wires(64)
@@ -246,9 +239,7 @@ class GossipPlane:
                         # dropped-at-full gap: counted, never silent
                         self._rx_seq_gaps += 1
                     self._rx_next_seq[peer] = seq + 1
-                    vw = decode_verdict_wire(wire)
-                    merged_k.append(vw.key)
-                    merged_u.append(vw.until_s)
+                    raw_wires.append(wire)
                     self._rx_wires += 1
         # network leg: pump the datagram transport (tx drain, resync,
         # rx ingest) and merge its delivered wires.  NetMailbox already
@@ -269,8 +260,23 @@ class GossipPlane:
                 if len(keys):
                     net_k.append(keys)
                     net_u.append(untils)
-        if not merged_k and not net_k:
+        if not raw_wires and not net_k:
             return 0
+        # module NOTE below this line only: keeps the plane's import —
+        # and every tick that merges nothing — jax-free.  A serving
+        # engine has long since paid the jax import by its first merge;
+        # a quiescent plane (supervisor-side attach, the fsx live model
+        # planes) never pays it at all.
+        from flowsentryx_tpu.engine.writeback import (
+            BlacklistUpdate, decode_verdict_wire,
+        )
+
+        merged_k: list[np.ndarray] = []
+        merged_u: list[np.ndarray] = []
+        for wire in raw_wires:
+            vw = decode_verdict_wire(wire)
+            merged_k.append(vw.key)
+            merged_u.append(vw.until_s)
         self._merge_ticks += 1
         total = 0
         if merged_k:
@@ -304,13 +310,25 @@ class GossipPlane:
         next life — and a peer that never boots can't hold us past
         the deadline.  Runs in the merge section (it is a tick
         loop)."""
+        for _ in self._quiesce_steps(timeout_s, peers_quiet):
+            time.sleep(self.merge_interval_s)
+
+    def _quiesce_steps(self, timeout_s: float, peers_quiet=None,
+                       clock=time.monotonic):
+        """Steppable core of :meth:`quiesce`: one yield per pending
+        iteration, returning (StopIteration) on convergence or
+        deadline.  Split out so the liveness checker (``fsx live``,
+        ``quiesce_terminates``) can drive the REAL loop — idle-streak
+        reset, quiet predicate, deadline — under a model clock and an
+        adversarial tick schedule, with the production :meth:`quiesce`
+        being nothing but this generator plus a real sleep."""
         idle = 0
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = clock() + timeout_s
+        while clock() < deadline:
             idle = idle + 1 if self.tick(force=True) == 0 else 0
             if idle >= 3 and (peers_quiet is None or peers_quiet()):
                 return
-            time.sleep(self.merge_interval_s)
+            yield
 
     def stop_requested(self) -> bool:
         return self.status.ctl_get("c_stop") != 0

@@ -102,6 +102,7 @@ import numpy as np
 
 from flowsentryx_tpu.core import durable, schema
 from flowsentryx_tpu.engine.shm import RingNotReady, _require_tso
+from flowsentryx_tpu.sync import tuning
 
 #: One packed table row on the handoff wire: key word + the f32 state
 #: columns bit-cast to u32 (byte-identical round-trip by construction).
@@ -546,7 +547,8 @@ class HandoffMailbox:
 
 
 def ship_rows(mbx: HandoffMailbox, keys, states, *,
-              timeout_s: float = 30.0, on_slot=None) -> tuple[int, int]:
+              timeout_s: float = tuning.HANDOFF_SHIP_TIMEOUT_S,
+              on_slot=None) -> tuple[int, int]:
     """Donor-side shipper: chunk the span's rows into ROWS slots, then
     SEAL with total+CRC.  A full mailbox WAITS (bounded) — a handoff
     stream is the one seam here that may not drop-and-count, because
@@ -672,7 +674,7 @@ class NetHandoff:
             slot, np.uint32).tobytes()
 
     def send_stream(self, peer, slots: list[np.ndarray], *,
-                    timeout_s: float = 10.0,
+                    timeout_s: float = tuning.NET_HANDOFF_TIMEOUT_S,
                     rto_s: float = 0.05) -> None:
         """Ship every slot reliably: send the window, collect
         cumulative acks, retransmit past the RTO until all acked or
@@ -704,7 +706,8 @@ class NetHandoff:
                 acked = max(acked, int(w[1]) | (int(w[2]) << 32))
 
     def recv_stream(self, n_slots: int, slot_words: int, *,
-                    timeout_s: float = 10.0) -> list[np.ndarray]:
+                    timeout_s: float = tuning.NET_HANDOFF_TIMEOUT_S
+                    ) -> list[np.ndarray]:
         """Receive ``n_slots`` slots in order: out-of-order and
         duplicate datagrams (counted) are dropped — the cumulative ack
         makes the sender re-offer them — so the delivered stream is

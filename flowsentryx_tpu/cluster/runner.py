@@ -272,7 +272,7 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
     _QUIET = (schema.CSTATE_DRAINING, schema.CSTATE_DONE,
               schema.CSTATE_FAILED)
     plane.quiesce(
-        spec.get("gossip_quiesce_s", 2.0),
+        spec.get("gossip_quiesce_s", tuning.GOSSIP_QUIESCE_S),
         peers_quiet=lambda: all(st.ctl_get("c_state") in _QUIET
                                 for st in peers.values()))
     # re-snapshot the gossip accounting: the quiesce merges above are

@@ -660,11 +660,40 @@ class ClusterSupervisor:
         return rb._phase_of(self._status[rank].ctl_get("c_handoff"),
                             hid)
 
+    def _redeliver_stamps(self, h: dict | None) -> None:
+        """Idempotent re-delivery of the supervisor's cross-party
+        stamps (found by ``fsx live``'s ``handoff_drop`` scenario: a
+        LOST stamp — torn ctl write, a respawning rank racing the
+        write, the model's dropped edge — was previously written
+        exactly once, and a rank waiting on it waited forever; the
+        committing phase never aborts, so the whole fleet wedged
+        behind one lost message).  Re-asserted every tick, guarded by
+        a read so the steady state writes nothing — the crash
+        checker's trace-point census stays unchanged on clean runs.
+
+        Two stamps qualify (both supervisor-owned, both idempotent):
+        the fence LIFT (no handoff in flight ⇒ every ``c_fence`` must
+        read 0) and the commit's ``c_layout_gen`` (in committing phase
+        every rank must observe the new generation — the flip is
+        already durable in layout.json, so re-stamping can never
+        disagree with it)."""
+        if h is None:
+            for st in self._status:
+                if st.ctl_get("c_fence"):
+                    st.ctl_set("c_fence", 0)
+            return
+        if h["phase"] == "committing":
+            for r in range(self.n):
+                st = self._status[r]
+                if st.ctl_get("c_layout_gen") != h["to_gen"]:
+                    st.ctl_set("c_layout_gen", h["to_gen"])
+
     def _handoff_tick(self, now: float) -> None:
         from flowsentryx_tpu.cluster import rebalance as rb
 
         h = self._handoff
         if h is None:
+            self._redeliver_stamps(None)
             return
         if h["phase"] == "shipping":
             # pre-commit, abort is always safe: nothing moved — the
@@ -706,6 +735,7 @@ class ClusterSupervisor:
         # lifts only when every live active rank has echoed the new
         # generation (a dead rank's respawn acks via its boot-time
         # reconcile, so this converges without a force)
+        self._redeliver_stamps(h)
         waiting = [r for r in sorted(self._active)
                    if r not in self._failed and r not in self._done
                    and self._status[r].ctl_get("c_layout_ack")
@@ -1073,7 +1103,8 @@ class ClusterSupervisor:
 
     def run(self, max_seconds: float | None = None,
             poll_s: float = tuning.SUPERVISOR_POLL_S,
-            drain_timeout_s: float = 60.0) -> dict:
+            drain_timeout_s: float = tuning.SUPERVISOR_DRAIN_TIMEOUT_S
+            ) -> dict:
         """Supervise until every rank is DONE (or terminally failed).
         ``max_seconds`` bounds the SERVING phase: when it trips, the
         supervisor requests stop-drain and waits (bounded) for the
@@ -1094,7 +1125,8 @@ class ClusterSupervisor:
         self.close()
         return self.aggregate()
 
-    def close(self, timeout_s: float = 10.0) -> None:
+    def close(self,
+              timeout_s: float = tuning.SUPERVISOR_CLOSE_TIMEOUT_S) -> None:
         if not self._stop_sent:
             self.request_stop()
         deadline = time.monotonic() + timeout_s

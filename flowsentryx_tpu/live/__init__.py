@@ -1,0 +1,12 @@
+"""``fsx live`` — the liveness & progress leg of the static suite.
+
+Jax-free by design: the registry scan is pure ``ast``, the checker
+drives the real protocol objects through
+:func:`flowsentryx_tpu.sync.interleave.explore_live` on the same
+sub-second import path as the supervisor.  See docs/LIVENESS.md.
+"""
+
+from flowsentryx_tpu.live.registry import (  # noqa: F401
+    PROGRESS, ProgressEntry, registered_sites, scan_blocking_sites,
+    validate,
+)

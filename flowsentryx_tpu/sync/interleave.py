@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import dataclasses
 import tempfile
+import threading
 from pathlib import Path
 from typing import Any, Callable, Iterator
 
@@ -130,6 +131,51 @@ class CheckResult:
 MAX_STEPS = 5_000_000
 
 
+class InstrumentedCv(threading.Condition):
+    """A Condition that COUNTS its notifies, so the liveness model can
+    see wake edges.  The safety checker's ``(predicate, label)`` waits
+    re-evaluate their predicate every scheduling point — a model in
+    which a deleted ``notify_all`` is invisible, because the quantum
+    timeout on every real wait eventually re-polls.  The liveness
+    model (:func:`explore_live`) instead treats a :class:`CvWait` as
+    woken only by its DECLARED wake source actually firing: swap a
+    protocol object's ``cv`` for one of these (before any use) and the
+    real code's ``notify``/``notify_all`` calls become observable
+    events — the PROGRESS registry's wake edges, checked, not
+    assumed."""
+
+    def __init__(self, lock=None):
+        super().__init__(lock)
+        self.notifies = 0
+
+    def notify(self, n: int = 1) -> None:
+        self.notifies += 1
+        super().notify(n)
+
+    def notify_all(self) -> None:
+        self.notifies += 1
+        super().notify_all()
+
+
+@dataclasses.dataclass
+class CvWait:
+    """A liveness-model wait descriptor: runnable only once ``pred``
+    holds AND the wait has actually been woken — either the predicate
+    already held when the thread parked (the real code's pre-wait
+    check admits it without sleeping) or ``cv.notifies`` advanced
+    since.  ``source`` names the declared wake edge (the PROGRESS
+    registry's ``wake`` column) for deadlock diagnostics."""
+
+    pred: Callable[[], bool]
+    label: str
+    cv: InstrumentedCv
+    source: str = ""
+
+    def describe(self) -> str:
+        s = f" (wake source: {self.source})" if self.source else ""
+        return f"{self.label}{s}"
+
+
 class _Thread:
     """One cooperative thread: a generator plus its next-step gate."""
 
@@ -138,6 +184,8 @@ class _Thread:
         self.gen = gen
         self.desc: Any = None
         self.done = False
+        self._arm = 0          # cv notify count when the wait parked
+        self._entry_ok = False  # predicate held at park time
 
     def start(self) -> None:
         """Run setup code up to the first yield (atomic, at t=0)."""
@@ -147,11 +195,38 @@ class _Thread:
         if self.done:
             return False
         d = self.desc
-        return True if isinstance(d, str) else bool(d[0]())
+        if isinstance(d, str):
+            return True
+        if isinstance(d, CvWait):
+            return bool(d.pred()) and (self._entry_ok
+                                       or d.cv.notifies > self._arm)
+        return bool(d[0]())
 
     def label(self) -> str:
         d = self.desc
-        return d if isinstance(d, str) else d[1]
+        if isinstance(d, str):
+            return d
+        if isinstance(d, CvWait):
+            return d.label
+        return d[1]
+
+    def wait_desc(self) -> str:
+        """Human description of what this (blocked) thread waits on —
+        the deadlock report's per-thread wait predicate."""
+        d = self.desc
+        if isinstance(d, CvWait):
+            return d.describe()
+        return self.label()
+
+    def wake_armed(self) -> bool:
+        """For the state fingerprint: whether a parked CvWait has
+        already been handed its wake (the predicate may still be
+        false) — two states differing only in a pending wake are NOT
+        the same state."""
+        d = self.desc
+        if isinstance(d, CvWait):
+            return self._entry_ok or d.cv.notifies > self._arm
+        return True
 
     def step(self) -> None:
         """Execute the described step (runs to the next yield)."""
@@ -162,6 +237,13 @@ class _Thread:
             self.desc = next(self.gen)
         except StopIteration:
             self.done, self.desc = True, None
+            return
+        if isinstance(self.desc, CvWait):
+            # park: record the wake watermark and whether the real
+            # code's pre-wait predicate check would have admitted it
+            # without sleeping (no notify needed in that case)
+            self._arm = self.desc.cv.notifies
+            self._entry_ok = bool(self.desc.pred())
 
 
 def explore(
@@ -283,6 +365,357 @@ def explore(
                        interleavings=interleavings, steps=steps,
                        capped=capped,
                        counterexample=first_match or first_cx)
+
+
+# ---------------------------------------------------------------------------
+# liveness exploration: deadlock / livelock / starvation over a state graph
+# ---------------------------------------------------------------------------
+#
+# `explore()` above proves SAFETY: no schedule reaches a bad state.  It
+# cannot prove PROGRESS — a fleet that parks forever on a dropped wake
+# never reaches a bad state, it just stops.  `explore_live()` builds the
+# full state GRAPH (not just the schedule tree: states reached by
+# different prefixes are merged) and runs three detectors over it:
+#
+#   deadlock    some thread is live but NO thread is runnable; the report
+#               names each parked thread's wait predicate and declared
+#               wake source.
+#   livelock    a reachable cycle that is admissible under WEAK FAIRNESS
+#               (every thread on the cycle either steps or is observed
+#               not-runnable somewhere on it) along which no declared
+#               progress counter advances.  Detected per strongly
+#               connected component: an SCC with a cycle is a livelock
+#               iff each thread has an intra-SCC step edge or is
+#               not-runnable at some SCC node — a closed walk through
+#               the SCC then starves no continuously-enabled thread.
+#               Progress counters must be MONOTONIC (counts of completed
+#               work); they are part of the state key, so any edge that
+#               advances one leaves the SCC.
+#   starvation  a declared Obligation stays enabled for more than its
+#               registered bound of consecutive steps without firing.
+#               The per-obligation clock is folded into the state key
+#               (saturating at bound+1, keeping the space finite), so
+#               the detector is exact up to the bound.
+
+
+@dataclasses.dataclass
+class Obligation:
+    """A progress obligation: while ``enabled()`` holds, ``fired()``
+    must change value within ``bound`` consecutive model steps.  The
+    bound is the PROGRESS registry's declared bound — runtime and
+    checker share one number."""
+
+    name: str
+    enabled: Callable[[], bool]
+    fired: Callable[[], Any]
+    bound: int
+
+
+@dataclasses.dataclass
+class LiveSpec:
+    """What `explore_live` watches, built fresh by ``mk()`` alongside
+    the threads.
+
+    ``fingerprint`` must capture ALL mutable protocol state the threads
+    read (hashable) — two states with equal fingerprints, thread
+    states, progress and clocks are merged.  ``progress`` returns the
+    declared progress counters (hashable, monotonic).  ``finale`` runs
+    end-of-schedule assertions at terminal states, as in `explore`."""
+
+    fingerprint: Callable[[], Any]
+    progress: Callable[[], Any] = lambda: ()
+    obligations: list[Obligation] = dataclasses.field(default_factory=list)
+    finale: Callable[[], None] | None = None
+
+
+@dataclasses.dataclass
+class LiveCheckResult:
+    """Outcome of one liveness check (JSON-serialisable)."""
+
+    check: str
+    ok: bool
+    expect_violation: bool
+    states: int
+    edges: int
+    terminals: int
+    steps: int
+    capped: bool
+    detector: str | None
+    counterexample: Counterexample | None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.counterexample is not None:
+            d["counterexample"] = {
+                "schedule": list(self.counterexample.schedule),
+                "detail": self.counterexample.detail,
+            }
+        return d
+
+
+def _thread_state(ts: list[_Thread]) -> tuple:
+    """Per-thread component of the state key.  A parked CvWait whose
+    wake already arrived is a DIFFERENT state from one still waiting,
+    even if all protocol state matches."""
+    return tuple(("done",) if t.done else (t.label(), t.wake_armed())
+                 for t in ts)
+
+
+def explore_live(
+    check: str,
+    mk: Callable[[], tuple],
+    *,
+    expect_violation: bool = False,
+    expect_marker: str | None = None,
+    max_steps: int = MAX_STEPS,
+    max_states: int = 50_000,
+) -> LiveCheckResult:
+    """Build the state graph of the threads ``mk`` builds and prove
+    deadlock-freedom, livelock-freedom (under weak fairness) and
+    bounded starvation.
+
+    ``mk()`` returns ``(threads, spec)``: ``threads`` as in `explore`
+    (built over FRESH objects — the builder replays prefixes), ``spec``
+    a :class:`LiveSpec`.  ``expect_violation`` / ``expect_marker``
+    carry the planted-negative semantics of `explore`: the check is
+    a demo and ``ok`` means a counterexample whose detail contains the
+    marker was found."""
+    steps = 0
+    capped = False
+    terminals = 0
+    first_cx: Counterexample | None = None
+    first_match: Counterexample | None = None
+    first_det: str | None = None
+    match_det: str | None = None
+
+    def record(cx: Counterexample, det: str) -> bool:
+        """Track the counterexample; True = stop exploring now."""
+        nonlocal first_cx, first_match, first_det, match_det
+        if first_cx is None:
+            first_cx, first_det = cx, det
+        if first_match is None and (expect_marker is None
+                                    or expect_marker in cx.detail):
+            first_match, match_det = cx, det
+        # negative demos stop on the INTENDED class; positives stop on
+        # the first counterexample of any class
+        return (first_match is not None) if expect_violation \
+            else (first_cx is not None)
+
+    def replay(prefix: tuple) -> tuple:
+        nonlocal steps
+        pairs, spec = mk()
+        ts = [_Thread(n, g) for n, g in pairs]
+        for t in ts:
+            t.start()
+        trace: list[str] = []
+        for choice in prefix:
+            run = [t for t in ts if t.runnable()]
+            t = run[choice]
+            trace.append(f"{t.name}:{t.label()}")
+            steps += 1
+            t.step()  # prefix was validated when pushed; cannot raise
+        return ts, spec, trace
+
+    # ---- phase 1: graph build (memoized-replay DFS) -------------------
+    ts0, spec0, _ = replay(())
+    obs_n = len(spec0.obligations)
+    clocks0 = (0,) * obs_n
+    fired0 = tuple(ob.fired() for ob in spec0.obligations)
+    key0 = (_thread_state(ts0), spec0.fingerprint(), spec0.progress(),
+            clocks0)
+
+    # node bookkeeping: edges for SCC, meta for fairness + diagnostics
+    edges: dict[tuple, list[tuple]] = {key0: []}
+    meta: dict[tuple, dict] = {}
+    stack: list[tuple] = [(key0, (), clocks0, fired0)]
+    stopped = False
+
+    while stack and not stopped:
+        if steps >= max_steps or len(edges) >= max_states:
+            capped = True
+            break
+        key, prefix, clocks, fired_prev = stack.pop()
+        ts, spec, trace = replay(prefix)
+        run = [t for t in ts if t.runnable()]
+        live = [t for t in ts if not t.done]
+        meta[key] = {
+            "trace": trace,
+            "runnable": frozenset(t.name for t in run),
+            "names": frozenset(t.name for t in ts),
+        }
+        if not run:
+            if live:
+                waits = "; ".join(f"{t.name} waits on {t.wait_desc()}"
+                                  for t in live)
+                if record(Counterexample(
+                        schedule=trace,
+                        detail=f"deadlock: no runnable thread — {waits}"),
+                        "deadlock"):
+                    break
+                continue
+            terminals += 1
+            if spec.finale is not None:
+                try:
+                    spec.finale()
+                except ModelViolation as e:
+                    if record(Counterexample(schedule=trace,
+                                             detail=str(e)), "violation"):
+                        break
+            continue
+        for ci in range(len(run)):
+            # fresh replay per child: stepping mutates the objects
+            ts2, spec2, trace2 = replay(prefix)
+            t = [x for x in ts2 if x.runnable()][ci]
+            label = f"{t.name}:{t.label()}"
+            steps += 1
+            try:
+                t.step()
+            except ModelViolation as e:
+                if record(Counterexample(schedule=trace2 + [label],
+                                         detail=str(e)), "violation"):
+                    stopped = True
+                    break
+                if expect_violation:
+                    continue
+                stopped = True
+                break
+            obls = spec2.obligations
+            fired_now = tuple(ob.fired() for ob in obls)
+            new_clocks = tuple(
+                0 if (not obls[i].enabled()
+                      or fired_now[i] != fired_prev[i])
+                else min(clocks[i] + 1, obls[i].bound + 1)
+                for i in range(obs_n))
+            starving = [i for i in range(obs_n)
+                        if new_clocks[i] > obls[i].bound]
+            if starving:
+                i = starving[0]
+                if record(Counterexample(
+                        schedule=trace2 + [label],
+                        detail=f"starvation: obligation '{obls[i].name}' "
+                               f"enabled for > {obls[i].bound} steps "
+                               "without firing"), "starvation"):
+                    stopped = True
+                    break
+                if not expect_violation:
+                    stopped = True
+                    break
+                continue  # demo: don't expand past a starving state
+            child = (_thread_state(ts2), spec2.fingerprint(),
+                     spec2.progress(), new_clocks)
+            edges[key].append((t.name, label, child))
+            if child not in edges:
+                edges[child] = []
+                stack.append((child, prefix + (ci,), new_clocks,
+                              fired_now))
+
+    # ---- phase 2: livelock scan (Tarjan SCC, weak fairness) -----------
+    need_scan = not capped and (first_cx is None if not expect_violation
+                                else first_match is None)
+    if need_scan:
+        index: dict[tuple, int] = {}
+        low: dict[tuple, int] = {}
+        on: set[tuple] = set()
+        sccs: list[list[tuple]] = []
+        sstack: list[tuple] = []
+        counter = 0
+        for root in edges:
+            if root in index:
+                continue
+            work = [(root, iter(edges[root]))]
+            index[root] = low[root] = counter
+            counter += 1
+            sstack.append(root)
+            on.add(root)
+            while work:
+                node, it = work[-1]
+                adv = False
+                for (_tn, _lb, child) in it:
+                    if child not in index:
+                        index[child] = low[child] = counter
+                        counter += 1
+                        sstack.append(child)
+                        on.add(child)
+                        work.append((child, iter(edges.get(child, []))))
+                        adv = True
+                        break
+                    if child in on:
+                        low[node] = min(low[node], index[child])
+                if adv:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = sstack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    sccs.append(comp)
+
+        for comp in sccs:
+            comp_set = set(comp)
+            intra = [(n, tn, lb, ch) for n in comp
+                     for (tn, lb, ch) in edges.get(n, [])
+                     if ch in comp_set]
+            if not intra:
+                continue  # no cycle in this SCC
+            names = set()
+            for n in comp:
+                names |= meta.get(n, {}).get("names", frozenset())
+            steppers = {tn for (_n, tn, _lb, _ch) in intra}
+            fair = all(
+                tn in steppers
+                or any(tn not in meta.get(n, {}).get("runnable",
+                                                     frozenset())
+                       for n in comp)
+                for tn in names)
+            if not fair:
+                continue  # every escape-capable thread must eventually run
+            # representative cycle: walk intra-SCC edges from the
+            # shallowest node until a repeat
+            entry = min(comp, key=lambda n: len(meta.get(n, {})
+                                                .get("trace", [])))
+            cyc_labels: list[str] = []
+            seen = {entry}
+            node = entry
+            while True:
+                nxt = next(((tn, lb, ch) for (n2, tn, lb, ch) in intra
+                            if n2 == node), None)
+                if nxt is None:
+                    break
+                cyc_labels.append(nxt[1])
+                node = nxt[2]
+                if node in seen:
+                    break
+                seen.add(node)
+            tr = meta.get(entry, {}).get("trace", [])
+            cx = Counterexample(
+                schedule=list(tr) + [f"[cycle] {lb}" for lb in cyc_labels],
+                detail="livelock: weakly-fair cycle with no progress "
+                       f"({len(comp)} states; threads stepping: "
+                       f"{', '.join(sorted(steppers))})")
+            record(cx, "livelock")
+            break
+
+    if expect_violation:
+        ok = first_match is not None
+        det = match_det
+    else:
+        ok = first_cx is None and not capped
+        det = first_det
+    cx_out = first_match or first_cx
+    n_edges = sum(len(v) for v in edges.values())
+    return LiveCheckResult(check=check, ok=ok,
+                           expect_violation=expect_violation,
+                           states=len(edges), edges=n_edges,
+                           terminals=terminals, steps=steps,
+                           capped=capped, detector=det,
+                           counterexample=cx_out)
 
 
 # ---------------------------------------------------------------------------

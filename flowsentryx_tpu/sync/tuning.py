@@ -362,3 +362,45 @@ HANDOFF_SHIP_TIMEOUT_S = 30.0
 #: Past this the supervisor aborts and clears the fence: the span was
 #: never unserved (donor kept it), so the safe exit is always "undo".
 HANDOFF_TIMEOUT_S = 60.0
+
+# -- liveness bounds (ISSUE 19: fsx live) -----------------------------------
+#
+# Every bound below is REFERENCED from the PROGRESS registry
+# (``flowsentryx_tpu/live/registry.py``): the liveness checker proves
+# the obligation within the bound and the runtime enforces the same
+# number, so a retune here re-proves (or breaks) the model in the same
+# verify run.  Previously these were call-site literals the checker
+# could not see.
+
+#: Engine-exit gossip quiesce bound (``cluster/runner.py::_serve`` —
+#: previously a hard-coded ``spec.get("gossip_quiesce_s", 2.0)``
+#: default).  Quiesce returns early after 3 consecutive idle ticks
+#: (idle plane measures < 50 ms total at the 5 ms merge cadence);
+#: 2 s is therefore pure deadline headroom: ~400 merge intervals for a
+#: backlogged plane to drain its rx mailboxes and still two orders of
+#: magnitude under the supervisor's drain budget below.
+GOSSIP_QUIESCE_S = 2.0
+
+#: Cross-host handoff stream bound (``rebalance.NetHandoff`` — was a
+#: hard-coded 10.0 on both ``send_stream`` and ``recv_stream``).  A
+#: healthy same-rack stream moves a full span in tens of ms (slot
+#: ship + ack RTT per window); 10 s is the handshake/beacon discipline
+#: (NET_HANDSHAKE_TIMEOUT_S) — past it the peer host is somebody
+#: else's incident and the donor keeps the span, mirroring the shm
+#: path's HANDOFF_SHIP_TIMEOUT_S abort posture.
+NET_HANDOFF_TIMEOUT_S = 10.0
+
+#: Supervisor stop-drain budget (``ClusterSupervisor.run`` — was a
+#: hard-coded ``drain_timeout_s=60.0`` default): after a stop request
+#: every rank gets this long to finish its chunk, quiesce gossip
+#: (GOSSIP_QUIESCE_S) and checkpoint before being declared wedged.
+#: Matches HANDOFF_TIMEOUT_S — the slowest legitimate thing a rank
+#: can be mid-flight on at stop time is a handoff.
+SUPERVISOR_DRAIN_TIMEOUT_S = 60.0
+
+#: Supervisor close/join bound per child (``ClusterSupervisor.close``
+#: — was a hard-coded ``timeout_s=10.0``): SIGTERM -> join this long
+#: -> SIGKILL.  10 s covers a worst-case checkpoint flush (tier-1
+#: measures < 1 s at smoke scale) without letting a wedged child
+#: stall operator shutdown past one glance.
+SUPERVISOR_CLOSE_TIMEOUT_S = 10.0

@@ -40,7 +40,16 @@ Stages, in order; the gate fails if any stage fails:
    the real source by AST walk.  ``fsx sync`` is the full surface
    (it adds the bounded-interleaving model checker); this stage is
    its review-speed gate, jax-free like the rest of the module.
-7. **cluster jax-free** — an AST pass over
+7. **liveness waits** — an AST pass over the protocol scope
+   (``flowsentryx_tpu/live/registry.py``'s ``SCAN_MODULES``) that
+   bans UNTIMED ``*.wait()`` calls (a lost notify parks the thread
+   forever; every wait re-polls on a named tuning quantum) and
+   ``while True:`` loops with neither a bounded sleep nor a PROGRESS
+   registry entry declaring their wake source and fairness
+   assumption.  ``fsx live`` proves the registered loops' liveness by
+   state-graph search; this stage is the review-speed gate that no
+   blocking loop escapes the registry.  ``# noqa`` exempts a line.
+8. **cluster jax-free** — an AST pass over
    ``flowsentryx_tpu/cluster/`` that bans MODULE-LEVEL imports of jax
    or the known jax-importing modules (``fused``/``ops``/
    ``engine.writeback``/``engine.checkpoint``/``engine.engine``): the
@@ -51,7 +60,7 @@ Stages, in order; the gate fails if any stage fails:
    ``checkpoint.prev_path`` to avoid.  Function-LOCAL imports stay
    legal (the lazy-import defense; ``GossipPlane.tick``'s writeback
    import is the documented exception).  ``# noqa`` exempts a line.
-8. **durable writes** — an AST pass over the durable-protocol scope
+9. **durable writes** — an AST pass over the durable-protocol scope
    (``flowsentryx_tpu/cluster/`` + ``engine/checkpoint.py``) that bans
    bare durable writes: ``open(..., "w"/"x"/"a")``,
    ``.write_text``/``.write_bytes``, and path-targeted ``np.savez*``.
@@ -61,11 +70,11 @@ Stages, in order; the gate fails if any stage fails:
    tears at power loss).  In-memory ``savez`` into a file-like handle
    stays legal (that is how checkpoint.py FEEDS atomic_write), and
    ``# noqa`` exempts a line (shm ring creates, report files).
-9. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
+10. **ruff** — ``ruff check`` with the repo config (pyproject.toml)
    when ruff is installed; SKIPPED (loudly, not silently) when not.
    The container this repo grows in has no ruff and nothing may be
-   pip-installed, so the gate degrades to stages 1-8 there.
-10. **mypy** — same availability contract as ruff.
+   pip-installed, so the gate degrades to stages 1-9 there.
+11. **mypy** — same availability contract as ruff.
 
 Usage::
 
@@ -515,6 +524,95 @@ def stage_durable_writes() -> list[str]:
     return fails
 
 
+def _liveness_wait_findings(path: Path, rel: str,
+                            registered: set[tuple[str, str]]
+                            ) -> list[str]:
+    """Liveness-wait findings for one protocol module (stage docstring
+    in main): an UNTIMED ``*.wait()`` (no quantum — a lost notify
+    parks it forever), and a ``while True:`` loop that neither sleeps
+    a bounded quantum nor is registered in the PROGRESS registry
+    (flowsentryx_tpu/live/registry.py) under its ``(path, qualname)``.
+    ``# noqa`` exempts a line."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError:
+        return []  # stage_syntax owns reporting these
+    lines = src.splitlines()
+    out = []
+
+    def noqa(lineno: int) -> bool:
+        return lineno <= len(lines) and "noqa" in lines[lineno - 1]
+
+    def walk(node, stack):
+        for ch in ast.iter_child_nodes(node):
+            sub = stack
+            if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                sub = stack + [ch.name]
+            if (isinstance(ch, ast.Call)
+                    and isinstance(ch.func, ast.Attribute)
+                    and ch.func.attr == "wait"
+                    and not ch.args and not ch.keywords
+                    and not noqa(ch.lineno)):
+                out.append(
+                    f"{rel}:{ch.lineno}: untimed .wait() — a lost "
+                    "notify parks this thread forever; pass a "
+                    "quantum (sync/tuning constant) so the wait "
+                    "re-polls its predicate (# noqa if wedging is "
+                    "the point, as in chaos fault threads)")
+            if (isinstance(ch, ast.While)
+                    and isinstance(ch.test, ast.Constant)
+                    and ch.test.value is True
+                    and not noqa(ch.lineno)):
+                qn = ".".join(stack) or "<module>"
+                sleeps = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "sleep"
+                    for n in ast.walk(ch))
+                if not sleeps and (rel, qn) not in registered:
+                    out.append(
+                        f"{rel}:{ch.lineno}: while True: in {qn} has "
+                        "no bounded sleep and no PROGRESS registry "
+                        "entry — declare its wake source, fairness "
+                        "assumption and bound in "
+                        "flowsentryx_tpu/live/registry.py (what "
+                        "licenses a blocking loop in the protocol "
+                        "scope), or # noqa")
+            walk(ch, sub)
+
+    walk(tree, [])
+    return out
+
+
+def stage_liveness_waits() -> list[str]:
+    """Every blocking loop in the protocol scope has a declared wake
+    edge: untimed waits and unregistered ``while True:`` loops are
+    findings (the ``fsx live`` leg's lint half)."""
+    try:
+        from flowsentryx_tpu.live.registry import (
+            SCAN_MODULES, registered_sites,
+        )
+    except ImportError:
+        # run as a script: scripts/ is sys.path[0] (same contract as
+        # stage_sync_contracts — the REAL repo root, not REPO)
+        import sys as _sys
+
+        _sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+        from flowsentryx_tpu.live.registry import (
+            SCAN_MODULES, registered_sites,
+        )
+
+    registered = registered_sites()
+    fails = []
+    for rel in SCAN_MODULES:
+        p = REPO / rel
+        if p.is_file():
+            fails.extend(_liveness_wait_findings(p, rel, registered))
+    return fails
+
+
 def stage_sync_contracts() -> list[str]:
     """The thread-contract half of ``fsx sync`` as a lint stage (quick
     mode: pure AST, no model checking, no jax)."""
@@ -566,6 +664,7 @@ def main(argv: list[str] | None = None) -> int:
         "np_default_int": stage_np_default_int(),
         "device_loop_purity": stage_device_loop_purity(),
         "sync_contracts": stage_sync_contracts(),
+        "liveness_waits": stage_liveness_waits(),
         "cluster_jax_free": stage_cluster_jax_free(),
         "durable_writes": stage_durable_writes(),
         "ruff": stage_ruff(),

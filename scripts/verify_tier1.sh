@@ -93,6 +93,37 @@ echo "== fsx crash: crash-consistency model check of the durable protocols =="
 python -m flowsentryx_tpu.cli crash --quick --quiet-plants \
     --out artifacts/CRASH_r21.json || exit 1
 
+echo "== fsx live: liveness + progress model check of the blocking protocols =="
+# The sixth static leg (docs/LIVENESS.md): state-graph search over the
+# REAL protocol objects proving deadlock-freedom (every park names its
+# wake edge), livelock-freedom under weak fairness and bounded
+# starvation — the SinkChannel drain, the fenced handoff with a stamp
+# dropped at every edge (a lost fence-lift must recover, not wedge),
+# autoscale flap-freedom, shed deferral bounds, quiesce termination —
+# plus the PROGRESS registry audit closing every blocking loop over
+# its declared wake source.  Four planted regressions (deleted notify,
+# dropped fence-lift, removed streak cap, zeroed cooldown) must each
+# be CAUGHT with a printed schedule from clean controls.  Jax-free;
+# --quick trims the handoff drop-edge fan-out (full set on `fsx live`).
+python -m flowsentryx_tpu.cli live --quick --quiet-plants \
+    --out artifacts/LIVE_r23.json || exit 1
+
+echo "== fsx live: jax-free import path =="
+# The liveness leg rides the supervisor's sub-second import path: the
+# whole flowsentryx_tpu.live package plus the cluster plane it drives
+# must import without pulling jax (the same contract the
+# cluster_jax_free lint stage proves for cluster/ module levels).
+python - <<'PY' || exit 1
+import sys, time
+t0 = time.perf_counter()
+import flowsentryx_tpu.live.checker  # noqa: F401
+import flowsentryx_tpu.cluster.supervisor  # noqa: F401
+dt = time.perf_counter() - t0
+assert "jax" not in sys.modules, "fsx live import path pulled jax"
+assert dt < 1.0, f"cluster+live import took {dt:.2f}s (budget 1.0s)"
+print(f"live+cluster import: {dt*1000:.0f} ms, jax-free")
+PY
+
 echo "== fsx audit: static step-graph contracts (docs/AUDIT.md) =="
 # --device-loop 2 also stages the drain-ring deep scans (single-device
 # and sharded) so the 528 B-per-slot wire pin and the ring-carry

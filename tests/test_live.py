@@ -1,0 +1,559 @@
+"""The fsx live liveness checker (flowsentryx_tpu/live/ +
+sync/interleave.explore_live): detector units on synthetic thread
+sets, the PROGRESS registry's two-way closure, the real-protocol
+proofs, the four planted regressions with their catching schedules,
+the liveness_waits lint stage, and the CLI contract."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from flowsentryx_tpu.live import registry
+from flowsentryx_tpu.live import checker as live_checker
+from flowsentryx_tpu.sync import tuning
+from flowsentryx_tpu.sync.interleave import (
+    CvWait, InstrumentedCv, LiveSpec, ModelViolation, Obligation,
+    explore_live,
+)
+
+_spec = importlib.util.spec_from_file_location(
+    "fsx_lint_live",
+    Path(__file__).resolve().parents[1] / "scripts" / "lint.py")
+lint = importlib.util.module_from_spec(_spec)
+sys.modules["fsx_lint_live"] = lint
+_spec.loader.exec_module(lint)
+
+
+# ---------------------------------------------------------------------------
+# explore_live detector units (synthetic thread sets)
+# ---------------------------------------------------------------------------
+
+class TestExplorerDetectors:
+    def test_deadlock_names_wait_and_wake_source(self):
+        def mk():
+            cv = InstrumentedCv()
+            box = {"ready": False}
+
+            def a():
+                yield CvWait(lambda: box["ready"], "ready-wait", cv,
+                             source="b's notify (never sent)")
+
+            def b():
+                yield CvWait(lambda: False, "never", cv,
+                             source="nobody")
+
+            return ([("a", a()), ("b", b())],
+                    LiveSpec(fingerprint=lambda: (box["ready"],)))
+
+        r = explore_live("deadlock-unit", mk)
+        assert not r.ok and r.detector == "deadlock"
+        d = r.counterexample.detail
+        assert "a waits on ready-wait" in d
+        assert "wake source: b's notify (never sent)" in d
+
+    def test_missed_wakeup_is_deadlock(self):
+        # the notify fires BEFORE the waiter parks, and the waiter's
+        # predicate is false at park time: classic missed wakeup
+        def mk():
+            cv = InstrumentedCv()
+            box = {"n": 0}
+
+            def waiter():
+                yield CvWait(lambda: box["n"] >= 2, "n>=2", cv,
+                             source="bump notify")
+
+            def bumper():
+                yield "bump"
+                with cv:
+                    box["n"] += 1
+                    cv.notify_all()
+
+            return ([("waiter", waiter()), ("bumper", bumper())],
+                    LiveSpec(fingerprint=lambda: (box["n"],)))
+
+        r = explore_live("missed-wakeup", mk)
+        assert not r.ok and r.detector == "deadlock"
+
+    def test_entry_ok_predicate_needs_no_notify(self):
+        # predicate already true when the thread parks: it proceeds
+        # without any notify ever arriving
+        def mk():
+            cv = InstrumentedCv()
+
+            def t():
+                yield CvWait(lambda: True, "always", cv, source="-")
+                yield "work"
+
+            return ([("t", t())],
+                    LiveSpec(fingerprint=lambda: ()))
+
+        r = explore_live("entry-ok", mk)
+        assert r.ok and r.terminals == 1
+
+    def test_livelock_spin_cycle_detected(self):
+        def mk():
+            box = {"flag": False}
+
+            def spinner():
+                while not box["flag"]:
+                    yield "spin"
+
+            return ([("spinner", spinner())],
+                    LiveSpec(fingerprint=lambda: (box["flag"],)))
+
+        r = explore_live("livelock-unit", mk)
+        assert not r.ok and r.detector == "livelock"
+        assert "[cycle]" in r.counterexample.schedule[-1]
+
+    def test_fair_poll_with_live_setter_is_clean(self):
+        # the spinner's exit condition is owned by a continuously
+        # runnable setter: weak fairness says the setter eventually
+        # runs, so the spin cycle is not a fair livelock
+        def mk():
+            box = {"flag": False}
+
+            def spinner():
+                while not box["flag"]:
+                    yield "spin"
+
+            def setter():
+                yield "set"
+                box["flag"] = True
+
+            return ([("spinner", spinner()), ("setter", setter())],
+                    LiveSpec(fingerprint=lambda: (box["flag"],)))
+
+        r = explore_live("fair-poll", mk)
+        assert r.ok, (r.detector, r.counterexample)
+
+    def test_starvation_trips_at_declared_bound(self):
+        def mk():
+            box = {"i": 0}
+
+            def t():
+                for _ in range(10):
+                    yield "noop"
+                    box["i"] += 1
+
+            spec = LiveSpec(
+                fingerprint=lambda: (box["i"],),
+                obligations=[Obligation("never-fires",
+                                        lambda: True,
+                                        lambda: 0, 4)])
+            return [("t", t())], spec
+
+        r = explore_live("starve-unit", mk)
+        assert not r.ok and r.detector == "starvation"
+        assert "'never-fires'" in r.counterexample.detail
+        assert "> 4 steps" in r.counterexample.detail
+
+    def test_obligation_firing_resets_clock(self):
+        def mk():
+            box = {"i": 0}
+
+            def t():
+                for _ in range(10):
+                    yield "tick"
+                    box["i"] += 1
+
+            spec = LiveSpec(
+                fingerprint=lambda: (box["i"],),
+                obligations=[Obligation("fires-every-step",
+                                        lambda: True,
+                                        lambda: box["i"], 4)])
+            return [("t", t())], spec
+
+        r = explore_live("oblige-unit", mk)
+        assert r.ok
+
+    def test_finale_violation_reported_with_schedule(self):
+        def mk():
+            box = {"done": False}
+
+            def t():
+                yield "step"
+
+            def finale():
+                if not box["done"]:
+                    raise ModelViolation("work never done")
+
+            return ([("t", t())],
+                    LiveSpec(fingerprint=lambda: (box["done"],),
+                             finale=finale))
+
+        r = explore_live("finale-unit", mk)
+        assert not r.ok and r.detector == "violation"
+        assert "work never done" in r.counterexample.detail
+
+    def test_expect_marker_mismatch_fails_the_demo(self):
+        def mk():
+            def t():
+                yield "boom"
+                raise ModelViolation("actual failure text")
+
+            return [("t", t())], LiveSpec(fingerprint=lambda: ())
+
+        hit = explore_live("demo-hit", mk, expect_violation=True,
+                          expect_marker="actual failure")
+        miss = explore_live("demo-miss", mk, expect_violation=True,
+                            expect_marker="some other bug")
+        assert hit.ok and not miss.ok
+
+    def test_state_cap_reported_not_silent(self):
+        def mk():
+            box = {"i": 0}
+
+            def t():
+                while True:
+                    yield "grow"
+                    box["i"] += 1  # unbounded fingerprint
+
+            return [("t", t())], LiveSpec(
+                fingerprint=lambda: (box["i"],))
+
+        r = explore_live("cap-unit", mk, max_states=10)
+        assert r.capped and not r.ok
+
+
+# ---------------------------------------------------------------------------
+# PROGRESS registry closure
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_repo_registry_validates_clean(self):
+        rep = registry.validate()
+        assert rep["ok"], rep["findings"]
+        assert rep["entries"] == len(registry.PROGRESS)
+        assert rep["sites"] > 0
+
+    def test_every_bound_is_a_tuning_constant(self):
+        for e in registry.PROGRESS:
+            assert hasattr(tuning, e.bound), e.name
+            assert getattr(tuning, e.bound) > 0, e.name
+
+    def test_every_scanned_site_is_registered(self):
+        # the drift pin: add a blocking loop to the protocol scope
+        # without registering it and this fails
+        reg = registry.registered_sites()
+        for rec in registry.scan_blocking_sites():
+            assert (rec["path"], rec["qualname"]) in reg, rec
+
+    def test_unregistered_loop_is_a_finding(self, tmp_path):
+        mod = tmp_path / registry.SCAN_MODULES[0]
+        mod.parent.mkdir(parents=True)
+        mod.write_text("def rogue():\n"
+                       "    while True:\n"
+                       "        pass\n")
+        rep = registry.validate(root=tmp_path)
+        assert not rep["ok"]
+        assert any("unregistered blocking loop" in f
+                   and "rogue" in f for f in rep["findings"])
+
+    def test_stale_entry_is_a_finding(self, tmp_path):
+        # an empty tree: every entry points at nothing
+        rep = registry.validate(root=tmp_path)
+        assert any(f.startswith("stale entry") for f in rep["findings"])
+
+    def test_never_exercised_proof_is_a_finding(self):
+        rep = registry.validate(exercised=set())
+        assert any("never exercised" in f for f in rep["findings"])
+        proved = {e.proof for e in registry.PROGRESS if e.proof}
+        rep = registry.validate(exercised=proved)
+        assert not any("never exercised" in f for f in rep["findings"])
+
+    def test_scan_sees_waits_and_loops_noqa_exempts(self, tmp_path):
+        mod = tmp_path / registry.SCAN_MODULES[0]
+        mod.parent.mkdir(parents=True)
+        mod.write_text(
+            "class C:\n"
+            "    def w(self):\n"
+            "        self.cv.wait(0.1)\n"
+            "    def p(self):\n"
+            "        while True:\n"
+            "            pass\n"
+            "    def exempt(self):\n"
+            "        while True:  # noqa: licensed spin\n"
+            "            pass\n")
+        sites = registry.scan_blocking_sites(root=tmp_path)
+        by_qn = {s["qualname"]: s for s in sites}
+        assert "cv-wait" in by_qn["C.w"]["kinds"]
+        assert "while-true" in by_qn["C.p"]["kinds"]
+        assert "C.exempt" not in by_qn
+
+
+# ---------------------------------------------------------------------------
+# the real protocol proofs + plants (one quick run, module-scoped)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_report():
+    return live_checker.run_live(quick=True)
+
+
+class TestLiveReport:
+    def test_report_green(self, live_report):
+        assert live_report["ok"]
+        assert live_report["schema"] == "fsx-live-report-v1"
+        assert live_report["quick"] is True
+
+    def test_five_protocols_proved(self, live_report):
+        base = {c["check"].split("[")[0]
+                for c in live_report["checks"]}
+        assert {"channel_stop_drain_live", "handoff_drop",
+                "autoscale_flap", "shed_bounded",
+                "quiesce_terminates"} <= base
+
+    def test_every_check_clean_and_uncapped(self, live_report):
+        for c in live_report["checks"]:
+            assert c["ok"] and not c["capped"], c["check"]
+            assert c["states"] > 0 and c["edges"] > 0, c["check"]
+
+    def test_handoff_drop_edges_recover(self, live_report):
+        edges = [c for c in live_report["checks"]
+                 if c["check"].startswith("handoff_drop[")]
+        assert len(edges) >= 3  # clean + >=2 dropped stamps (quick)
+        assert all(c["ok"] for c in edges)
+
+    def test_registry_audited_in_report(self, live_report):
+        assert live_report["registry"]["ok"], \
+            live_report["registry"]["findings"]
+
+    def test_all_four_plants_caught_with_clean_controls(
+            self, live_report):
+        plants = {p["plant"]: p for p in live_report["plants"]}
+        assert set(plants) == {"notify_deleted", "fence_lift_dropped",
+                               "streak_cap_removed", "cooldown_zeroed"}
+        for name, p in plants.items():
+            assert p["caught"] and p["control_ok"], name
+            assert p["schedule"], name
+
+    def test_plants_exercise_every_detector_class(self, live_report):
+        dets = {p["caught_by"] for p in live_report["plants"]}
+        assert dets == {"deadlock", "livelock", "starvation",
+                        "violation"}
+
+    def test_catching_schedules_name_the_protocol(self, live_report):
+        plants = {p["plant"]: p for p in live_report["plants"]}
+        assert "wait_below(0)" in plants["notify_deleted"]["detail"]
+        assert "wake source" in plants["notify_deleted"]["detail"]
+        assert "livelock" in plants["fence_lift_dropped"]["detail"]
+        assert "anti_entropy_runs" in \
+            plants["streak_cap_removed"]["detail"]
+        assert "flap" in plants["cooldown_zeroed"]["detail"]
+
+    def test_report_json_serialisable(self, live_report):
+        json.dumps(live_report)
+
+
+class TestScenarioUnits:
+    def test_channel_scenario_clean(self):
+        r = live_checker._check_channel()
+        assert r.ok and r.terminals >= 1
+
+    def test_autoscale_boundary_shrink_at_cooldown_is_legal(self):
+        # cooldown_s left at the real tuning value: the first legal
+        # SHRINK lands exactly at the cooldown boundary and the model
+        # proves no interleaving beats it
+        r = live_checker._check_autoscale()
+        assert r.ok, (r.detector, r.counterexample)
+
+    def test_shed_bound_frozen_at_import(self):
+        # the plant patches tuning.SHED_MAX_DEFER at runtime; the
+        # checker's declared bound must NOT move with it
+        assert live_checker._SHED_BOUND == tuning.SHED_MAX_DEFER + 2
+        orig = tuning.SHED_MAX_DEFER
+        tuning.SHED_MAX_DEFER = 1 << 30
+        try:
+            assert live_checker._SHED_BOUND == orig + 2
+        finally:
+            tuning.SHED_MAX_DEFER = orig
+
+    def test_plant_contextmanagers_restore(self):
+        from flowsentryx_tpu.sync import channel as channel_mod
+        from flowsentryx_tpu.cluster import supervisor as sup_mod
+
+        orig_c = channel_mod.SinkChannel.complete
+        orig_r = sup_mod.ClusterSupervisor._redeliver_stamps
+        orig_s = tuning.SHED_MAX_DEFER
+        with live_checker._plant_notify_deleted():
+            assert channel_mod.SinkChannel.complete is not orig_c
+        with live_checker._plant_fence_lift_dropped():
+            assert (sup_mod.ClusterSupervisor._redeliver_stamps
+                    is not orig_r)
+        with live_checker._plant_streak_cap_removed():
+            assert tuning.SHED_MAX_DEFER == 1 << 30
+        assert channel_mod.SinkChannel.complete is orig_c
+        assert sup_mod.ClusterSupervisor._redeliver_stamps is orig_r
+        assert tuning.SHED_MAX_DEFER == orig_s
+
+
+# ---------------------------------------------------------------------------
+# the supervisor stamp re-delivery fix (found by handoff_drop)
+# ---------------------------------------------------------------------------
+
+class TestRedeliverStamps:
+    def _world_sup(self):
+        from flowsentryx_tpu.crash.world import SimSupervisor, World
+
+        w = World(n=2, w=2)
+        return w, SimSupervisor(w)
+
+    def test_lost_fence_lift_is_recleared(self):
+        w, sup = self._world_sup()
+        w.statuses[0].ctl_set("c_fence", 7)  # lift was lost
+        sup._handoff_tick(0.0)               # no handoff in flight
+        assert w.statuses[0].ctl_get("c_fence") == 0
+
+    def test_committing_restamps_lost_layout_gen(self):
+        w, sup = self._world_sup()
+        w.statuses[0].ctl_set("c_layout_gen", 3)
+        w.statuses[1].ctl_set("c_layout_gen", 2)  # stamp was lost
+        sup._redeliver_stamps({"phase": "committing", "to_gen": 3})
+        assert w.statuses[1].ctl_get("c_layout_gen") == 3
+
+    def test_steady_state_writes_nothing(self):
+        w, sup = self._world_sup()
+        for r in (0, 1):
+            w.statuses[r].ctl_set("c_layout_gen", 3)
+        writes = []
+        orig = type(w.statuses[0]).ctl_set
+        for r in (0, 1):
+            st = w.statuses[r]
+            st.ctl_set = (lambda name, value, _st=st:
+                          (writes.append(name),
+                           orig(_st, name, value)))
+        sup._redeliver_stamps(None)
+        sup._redeliver_stamps({"phase": "committing", "to_gen": 3})
+        assert writes == []  # guarded by reads: clean runs write 0 ctl
+
+
+# ---------------------------------------------------------------------------
+# liveness_waits lint stage
+# ---------------------------------------------------------------------------
+
+def _lw(tmp_path, src, registered=frozenset()):
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    return lint._liveness_wait_findings(p, "mod.py", set(registered))
+
+
+class TestLivenessWaitsStage:
+    def test_untimed_wait_flagged(self, tmp_path):
+        out = _lw(tmp_path, "def f(cv):\n    cv.wait()\n")
+        assert len(out) == 1 and "untimed .wait()" in out[0]
+        assert "mod.py:2" in out[0]
+
+    def test_timed_wait_clean(self, tmp_path):
+        assert _lw(tmp_path, "def f(cv):\n    cv.wait(0.25)\n") == []
+
+    def test_while_true_unregistered_flagged(self, tmp_path):
+        out = _lw(tmp_path,
+                  "class C:\n"
+                  "    def loop(self):\n"
+                  "        while True:\n"
+                  "            self.step()\n")
+        assert len(out) == 1
+        assert "C.loop" in out[0] and "PROGRESS registry" in out[0]
+
+    def test_while_true_registered_clean(self, tmp_path):
+        src = ("class C:\n"
+               "    def loop(self):\n"
+               "        while True:\n"
+               "            self.step()\n")
+        assert _lw(tmp_path, src,
+                   registered={("mod.py", "C.loop")}) == []
+
+    def test_while_true_with_bounded_sleep_clean(self, tmp_path):
+        assert _lw(tmp_path,
+                   "import time\n"
+                   "def f():\n"
+                   "    while True:\n"
+                   "        time.sleep(0.1)\n") == []
+
+    def test_noqa_exempts_both_findings(self, tmp_path):
+        assert _lw(tmp_path,
+                   "def f(cv):\n"
+                   "    cv.wait()  # noqa: wedge on purpose\n"
+                   "    while True:  # noqa: licensed\n"
+                   "        pass\n") == []
+
+    def test_repo_scope_is_clean(self):
+        assert lint.stage_liveness_waits() == []
+
+
+# ---------------------------------------------------------------------------
+# hoisted tuning constants (satellite b)
+# ---------------------------------------------------------------------------
+
+class TestTuningHoist:
+    def test_liveness_bounds_exist(self):
+        for name in ("GOSSIP_QUIESCE_S", "NET_HANDOFF_TIMEOUT_S",
+                     "SUPERVISOR_DRAIN_TIMEOUT_S",
+                     "SUPERVISOR_CLOSE_TIMEOUT_S"):
+            assert getattr(tuning, name) > 0, name
+
+    def test_protocol_defaults_reference_tuning(self):
+        import inspect
+
+        from flowsentryx_tpu.cluster import rebalance as rb
+        from flowsentryx_tpu.cluster.supervisor import ClusterSupervisor
+
+        def default(fn, name):
+            return inspect.signature(fn).parameters[name].default
+
+        assert default(rb.ship_rows, "timeout_s") \
+            == tuning.HANDOFF_SHIP_TIMEOUT_S
+        assert default(rb.NetHandoff.send_stream, "timeout_s") \
+            == tuning.NET_HANDOFF_TIMEOUT_S
+        assert default(rb.NetHandoff.recv_stream, "timeout_s") \
+            == tuning.NET_HANDOFF_TIMEOUT_S
+        assert default(ClusterSupervisor.run, "drain_timeout_s") \
+            == tuning.SUPERVISOR_DRAIN_TIMEOUT_S
+        assert default(ClusterSupervisor.close, "timeout_s") \
+            == tuning.SUPERVISOR_CLOSE_TIMEOUT_S
+
+    def test_quiesce_generator_bounded_by_model_clock(self, tmp_path):
+        from flowsentryx_tpu.cluster.gossip import (GossipPlane,
+                                                    create_plane)
+
+        create_plane(str(tmp_path), 2)
+        plane = GossipPlane(str(tmp_path), 0, 2)
+        plane.tick = lambda force=False, pressure=0.0: 7  # never idle
+        t = {"v": 0.0}
+        n = 0
+        gen = plane._quiesce_steps(1.0, clock=lambda: t["v"])
+        for _ in gen:
+            n += 1
+            t["v"] += 0.25
+        assert n <= 5  # deadline-bounded even when never converging
+
+
+# ---------------------------------------------------------------------------
+# CLI contract + import hygiene
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_live_quick_json_out(self, tmp_path, capsys):
+        from flowsentryx_tpu.cli import main
+
+        out = tmp_path / "LIVE.json"
+        rc = main(["live", "--quick", "--json", "--out", str(out)])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["ok"] and rep["schema"] == "fsx-live-report-v1"
+        disk = json.loads(out.read_text())
+        assert disk["schema"] == rep["schema"]
+        assert len(disk["plants"]) == 4
+
+    def test_jax_free_import(self):
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; from flowsentryx_tpu.live import checker; "
+             "from flowsentryx_tpu.live import registry; "
+             "sys.exit(1 if 'jax' in sys.modules else 0)"],
+            capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()
