@@ -625,16 +625,20 @@ def boot_audit(
     # graph: config, wire, mesh, the group-size set, the ring depth —
     # and the params leaves' shapes/dtypes (a later engine serving a
     # different artifact, e.g. an f64-poisoned .npz, is a different
-    # graph and must re-audit).
-    if params is None:
-        params_sig = ("default", cfg.model.name)
-    else:
-        leaves = jax.tree_util.tree_leaves(params)
-        params_sig = tuple(
-            (str(np.dtype(getattr(l, "dtype", type(l)))),
-             tuple(getattr(l, "shape", ()))) for l in leaves)
-    key = (cfg.to_json(), wire, shardable and int(mesh.devices.size),
-           sizes, device_loop, tuple(variants), params_sig)
+    # graph and must re-audit).  The ONE definition of that rule is
+    # core/signature.staging_signature — shared with the range
+    # certifier (same staging surface) and the persistent AOT compile
+    # cache (engine/compile_cache.py), so the three can never drift on
+    # what keys a staged shape.
+    from flowsentryx_tpu.core.signature import (
+        signature_digest, staging_signature,
+    )
+
+    sig = staging_signature(
+        cfg, wire=wire,
+        mesh_devices=int(mesh.devices.size) if shardable else 1,
+        mega_sizes=sizes, device_loop=device_loop, params=params)
+    key = (signature_digest(sig), tuple(variants))
     if _BOOT_CACHE.get(key):
         return None
     rep = run_audit(cfg, params=params, mesh=mesh,

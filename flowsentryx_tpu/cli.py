@@ -1082,6 +1082,56 @@ def _load_cfg(args: argparse.Namespace):
     return DEFAULT_CONFIG
 
 
+def _boot_salt(cache_dir: str | None, label: str) -> int:
+    """The auto boot-time hash salt, compile-cache aware.
+
+    ``TableConfig.salt`` is a jit closure constant — it is BAKED into
+    every staged executable — so a fresh random salt per boot would
+    miss the persistent AOT cache on every variant, silently, forever
+    (`fsx monitor --alert-cold-boot` would page on every restart).
+    With ``--compile-cache`` the salt is therefore drawn once and
+    PINNED in the cache dir: zero added exposure, because the
+    serialized executables beside it bake the very same salt — an
+    attacker who can read ``boot_salt`` can already read the salt out
+    of any ``.aot`` entry.  Rotating the salt is exactly "wipe the
+    cache dir" (or fix ``table.salt`` in the config file).  Without a
+    cache dir, behavior is unchanged: fresh random salt per boot."""
+    import secrets
+
+    if not cache_dir:
+        return secrets.randbits(32) | 1
+    path = os.path.join(cache_dir, "boot_salt")
+    try:
+        salt = int(Path(path).read_text().strip(), 0)
+        if salt & 1 and 0 < salt < 1 << 32:
+            return salt
+        print(f"fsx {label}: ignoring malformed {path} "
+              f"(value {salt:#x}); drawing a fresh boot salt",
+              file=sys.stderr)
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        print(f"fsx {label}: ignoring unreadable {path} ({e}); "
+              "drawing a fresh boot salt", file=sys.stderr)
+    salt = secrets.randbits(32) | 1
+    from flowsentryx_tpu.core import durable
+
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        durable.atomic_write(path, f"{salt:#010x}\n")
+    except OSError as e:
+        print(f"fsx {label}: could not pin boot salt in {path} ({e}) "
+              "— the compile cache will miss on the next boot",
+              file=sys.stderr)
+    else:
+        print(f"fsx {label}: --compile-cache: boot salt {salt:#x} "
+              f"pinned in {path} so cached executables (which bake "
+              "the salt) stay valid across restarts; rotate by "
+              "wiping the cache dir or fixing table.salt in config",
+              file=sys.stderr)
+    return salt
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     """Run the serving engine over a record source.
 
@@ -1170,6 +1220,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               "round would fetch full [ring*mega, B] block arrays — "
               "the exact transfer the ring exists to amortize",
               file=sys.stderr)
+        return 1
+    if args.tiered_warm and not args.mega:
+        print("fsx serve: --tiered-warm requires --mega N|auto: the "
+              "serving tier IS the top coalescing rung — with no "
+              "ladder there is nothing to tier (plain warm() already "
+              "compiles the one staged step)", file=sys.stderr)
         return 1
     if args.artifact_reload and not args.artifact:
         print("fsx serve: --artifact-reload requires --artifact PATH "
@@ -1350,9 +1406,16 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"x {max(args.mesh, 1)} shard(s): occupied rows will "
                 "be resharded at restore (engine/table.py)",
                 file=sys.stderr)
+    # the engine-stack import wall is part of boot-to-serving and the
+    # compile cache cannot shave it — measured and surfaced in the
+    # report's boot block next to the compile/cache-load timings
+    import time as _time
+
+    _t_imp = _time.perf_counter()
     from flowsentryx_tpu.engine import Engine, NullSink, TrafficSource
     from flowsentryx_tpu.engine.traffic import Scenario, TrafficSpec
 
+    import_s = _time.perf_counter() - _t_imp
     _honor_jax_platform()
     if args.feature_ring:
         from flowsentryx_tpu.engine.shm import ShmRingSource, ShmVerdictSink
@@ -1418,10 +1481,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             )
         cfg = _dc.replace(cfg, table=_dc.replace(cfg.table, salt=ck_salt))
     elif cfg.table.salt == 0:
-        import secrets
-
         cfg = _dc.replace(cfg, table=_dc.replace(
-            cfg.table, salt=secrets.randbits(32) | 1))
+            cfg.table, salt=_boot_salt(args.compile_cache, "serve")))
     mesh = None
     if args.mesh and args.mesh > 1:
         from flowsentryx_tpu.parallel import make_mesh
@@ -1507,7 +1568,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                  gossip=gossip,
                  slo_us=args.slo_us,
                  predict=args.predict,
-                 watchdog_s=args.watchdog_s)
+                 watchdog_s=args.watchdog_s,
+                 compile_cache=args.compile_cache)
+    eng.boot_import_s = round(import_s, 4)
     if args.restore:
         from flowsentryx_tpu.engine.checkpoint import CheckpointCorrupt
 
@@ -1530,8 +1593,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # pay every staged compile (each ladder rung, and the deep-scan
         # ring graph) at boot, not on the first traffic backlog; SLO
         # mode additionally needs warm()'s timed pass to seed the
-        # per-rung step-time EWMA the budget policy reads
-        eng.warm()
+        # per-rung step-time EWMA the budget policy reads.  Tiered:
+        # only the serving tier (singles + top rung) blocks boot, a
+        # background thread fills the rest — with --compile-cache the
+        # fill is milliseconds of deserialization per rung
+        eng.warm(tiered=args.tiered_warm)
     if gossip is not None:
         from flowsentryx_tpu.core import schema as _schema
 
@@ -1751,6 +1817,11 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
               "--verdict-k 0 (the ring's steady-state readback is the "
               "per-slot compact wire)", file=sys.stderr)
         return 1
+    if args.tiered_warm and not args.mega:
+        print("fsx cluster: --tiered-warm requires --mega N|auto "
+              "(the serving tier IS the top coalescing rung)",
+              file=sys.stderr)
+        return 1
     if args.slo_us < 0:
         print("fsx cluster: --slo-us must be >= 0", file=sys.stderr)
         return 1
@@ -1836,10 +1907,8 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
         # one shared random salt: every engine's table (and every
         # checkpoint) lives in the same hash universe, so operators
         # can reason about the fleet as one table split N ways
-        import secrets
-
         cfg = _dc.replace(cfg, table=_dc.replace(
-            cfg.table, salt=secrets.randbits(32) | 1))
+            cfg.table, salt=_boot_salt(args.compile_cache, "cluster")))
     if args.mega:
         # mirror the serve-side compact16 probe: refuse a model the
         # engines would refuse, once, here — not N times in N children
@@ -1882,6 +1951,12 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
             "slo_us": args.slo_us,
             "predict": bool(args.predict),
             "artifact": args.artifact,
+            # one shared cache dir across the fleet: every rank (and
+            # every provisioned-at-max SPARE) stages the same shape,
+            # so a GROW spawn's warm() hits the entries the boot-time
+            # pre-warm child stored (supervisor._maybe_prewarm)
+            "compile_cache": args.compile_cache,
+            "tiered_warm": bool(args.tiered_warm),
             "checkpoint": (args.checkpoint.format(rank=r)
                            if args.checkpoint else None),
             "checkpoint_every": args.checkpoint_every,
@@ -2093,6 +2168,42 @@ def _merged_predict(reports: list) -> dict | None:
     return DispatchGovernor.merge_reports(blocks)
 
 
+def _merged_boot(reports: list) -> dict | None:
+    """Merge the ``boot`` blocks of engine-report JSONs (compile-cache
+    hit/miss story, serving-ready and import walls) into one fleet
+    view — the same fold the cluster supervisor's ``aggregate()``
+    applies, so ``fsx status`` on a report glob never disagrees with
+    it.  Jax-free.  Returns None when no report carries a boot block
+    (engines that never warm()ed don't grow an empty stanza)."""
+    per_report: dict = {}
+    hits = misses = stores = 0
+    max_ready = 0.0
+    for path, doc, err in reports:
+        if err is not None:
+            continue
+        rep = doc.get("report") if isinstance(doc.get("report"),
+                                              dict) else doc
+        boot = rep.get("boot")
+        if not boot:
+            continue
+        per_report[path] = boot
+        cache = boot.get("cache")
+        if isinstance(cache, dict):
+            hits += cache.get("hits", 0)
+            misses += cache.get("misses", 0)
+            stores += cache.get("stores", 0)
+        max_ready = max(max_ready, boot.get("serving_ready_s") or 0.0)
+    if not per_report:
+        return None
+    return {
+        "per_report": per_report,
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_stores": stores,
+        "max_serving_ready_s": round(max_ready, 4),
+    }
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
     """Inspect the shm transport: ring cursors and backlog."""
     import numpy as np
@@ -2141,6 +2252,9 @@ def _cmd_status(args: argparse.Namespace) -> int:
         predict = _merged_predict(reports)
         if predict is not None:
             out["predict"] = predict
+        boot = _merged_boot(reports)
+        if boot is not None:
+            out["boot"] = boot
     print(json.dumps(out, indent=2))
     return 0
 
@@ -2231,6 +2345,12 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
               "ride the engine reports; the kernel maps cannot carry "
               "them)", file=sys.stderr)
         return 1
+    if args.alert_cold_boot and not args.engine_report:
+        print("fsx monitor: --alert-cold-boot requires "
+              "--engine-report GLOB (the compile-cache hit/miss story "
+              "rides the engine reports' boot block; the kernel maps "
+              "cannot carry it)", file=sys.stderr)
+        return 1
     prev: dict | None = None
     prev_t = 0.0
     fh = open(args.out, "a") if args.out else None
@@ -2280,6 +2400,26 @@ def _cmd_monitor(args: argparse.Namespace) -> int:
                         alerts.append(
                             f"fleet reshaping {hl['state'].upper()}: "
                             + ", ".join(reshape))
+                boot = _merged_boot(reports)
+                if boot is not None:
+                    rec["boot"] = boot
+                    if args.alert_cold_boot:
+                        # a rank whose boot block names a cache dir
+                        # yet loaded ZERO variants from it paid the
+                        # full ladder compile the cache exists to
+                        # prevent — a wiped/mispointed cache dir or a
+                        # silent toolchain drift, fleet-wide exactly
+                        # after the upgrades that most need fast
+                        # respawns
+                        cold = sorted(
+                            p for p, b in boot["per_report"].items()
+                            if isinstance(b.get("cache"), dict)
+                            and b["cache"].get("hits", 0) == 0)
+                        if cold:
+                            alerts.append(
+                                "cold boot under a configured "
+                                "compile cache (zero hits): "
+                                + ", ".join(cold))
                 predict = _merged_predict(reports)
                 if predict is not None:
                     rec["predict"] = predict
@@ -2983,6 +3123,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "the depth from a short boot-time calibration "
                         "drain's measured H2D overlap (one XLA compile "
                         "per candidate, announced)")
+    s.add_argument("--compile-cache", metavar="DIR",
+                   help="persistent AOT executable store: staged "
+                        "variants (each --mega rung, the --device-loop "
+                        "ring) serialize here on first boot and later "
+                        "boots of the same staged shape + toolchain "
+                        "load them in milliseconds instead of "
+                        "recompiling — sub-second boot-to-serving. "
+                        "Fail-open: any miss/drift/corrupt entry "
+                        "recompiles, counted in the report's boot "
+                        "block (fsx monitor --alert-cold-boot)")
+    s.add_argument("--tiered-warm", action="store_true",
+                   help="open serving on the top-rung tier (singles + "
+                        "largest --mega rung) and fill the remaining "
+                        "rungs/ring from a background thread — "
+                        "byte-identical verdicts throughout (unready "
+                        "rungs degrade to top-rung flushes); pair "
+                        "with --compile-cache for the sub-second "
+                        "cached boot (requires --mega)")
     s.add_argument("--cluster-rank", metavar="R/N", default=None,
                    help="serve as engine R of an N-engine cluster "
                         "(docs/CLUSTER.md): own ring shards "
@@ -3124,6 +3282,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-engine drain-ring depth (explicit only: "
                          "the auto calibration is a serve-boot "
                          "feature; requires --mega)")
+    cl.add_argument("--compile-cache", metavar="DIR",
+                    help="per-fleet persistent AOT executable store "
+                         "(fsx serve --compile-cache; every rank "
+                         "shares DIR — same staged shape, same "
+                         "entries).  With --elastic the supervisor "
+                         "additionally spawns a one-shot pre-warm "
+                         "child at boot so a GROW spare's warm() is "
+                         "pure cache hits")
+    cl.add_argument("--tiered-warm", action="store_true",
+                    help="per-engine tiered warm (fsx serve "
+                         "--tiered-warm): SERVING opens on the "
+                         "top-rung tier, a background thread fills "
+                         "the rest of the ladder; requires --mega")
     cl.add_argument("--verdict-k", type=int, default=None,
                     help="compact verdict-wire slots (fsx serve "
                          "--verdict-k)")
@@ -3249,6 +3420,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "burst model burning compile/warm work; "
                          "requires --engine-report; "
                          "docs/ENGINE.md §prediction)")
+    mo.add_argument("--alert-cold-boot", action="store_true",
+                    help="alert when a rank's boot block names a "
+                         "compile-cache dir yet loaded ZERO variants "
+                         "from it (the full ladder recompile the "
+                         "cache exists to prevent — a wiped or "
+                         "mispointed cache dir, or silent toolchain "
+                         "drift after an upgrade); requires "
+                         "--engine-report; docs/ENGINE.md §boot)")
     mo.set_defaults(fn=_cmd_monitor)
 
     st = sub.add_parser("status", help="inspect the shm transport")

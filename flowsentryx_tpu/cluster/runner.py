@@ -133,10 +133,15 @@ def engine_main(spec: dict) -> int:
 
 
 def _serve(spec: dict, plane: GossipPlane) -> None:
-    # jax and the engine import only here, inside the child
+    # jax and the engine import only here, inside the child — timed,
+    # because import wall is part of boot-to-serving and the compile
+    # cache cannot help with it (EngineReport.boot["import_s"])
+    _t_imp = time.perf_counter()
     from flowsentryx_tpu.core.config import FsxConfig
     from flowsentryx_tpu.engine import Engine, NullSink
     from flowsentryx_tpu.ingest import ShardedIngest
+
+    import_s = time.perf_counter() - _t_imp
 
     rank, n = spec["rank"], spec["n_engines"]
     w = spec["workers"]
@@ -177,7 +182,9 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         predict=bool(spec.get("predict")),
         watchdog_s=spec.get("watchdog_s"),
         gossip=plane,
+        compile_cache=spec.get("compile_cache"),
     )
+    eng.boot_import_s = round(import_s, 4)
     restore_info = None
     if spec.get("restore"):
         restore_info = eng.restore(spec["restore"])
@@ -192,7 +199,10 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         spec["cluster_dir"], rank, plane.status,
         crash_midship=bool(spec.get("handoff_crash_midship")))
     reconciled = rebalancer.reconcile(eng)
-    eng.warm()
+    # tiered: SERVING opens on the top-rung tier while a background
+    # thread fills the rest of the ladder from the compile cache —
+    # the sub-second-boot path for crash-respawns and GROW spares
+    eng.warm(tiered=bool(spec.get("tiered_warm")))
     if spec.get("ready_token"):
         Path(spec["ready_token"]).touch()
     if spec.get("start_token"):
@@ -296,6 +306,60 @@ def _serve(spec: dict, plane: GossipPlane) -> None:
         p = Path(spec["report_path"])
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(json.dumps(out, indent=2) + "\n")  # noqa: report file, informational
+
+
+def prewarm_main(spec: dict) -> int:
+    """One-shot fleet pre-warm: compile the fleet's staged geometry
+    into the persistent compile cache so a later GROW spare (or a
+    crash respawn) warms on pure cache hits — sub-second to SERVING
+    while the burst it was spawned for is still landing.
+
+    Spawned by the supervisor at elastic-fleet boot when the engine
+    specs carry ``compile_cache``.  Spare ranks are provisioned at max
+    with the SAME spec (same cfg/mega/device-loop/params geometry), so
+    one child with a null source covers every rank: ``warm()`` the
+    FULL ladder — every rung plus the deep-scan ring — storing each
+    executable, then exit.  Best-effort and non-blocking: the fleet
+    never waits on it, and any failure just means the spare compiles
+    (fail-open, like every cache path)."""
+    _own_process_group()
+    os.environ.setdefault("JAX_PLATFORMS",
+                          spec.get("jax_platform", "cpu"))
+    try:
+        import numpy as np
+
+        from flowsentryx_tpu.core.config import FsxConfig
+        from flowsentryx_tpu.core.schema import RECORD_WORDS
+        from flowsentryx_tpu.engine import Engine, NullSink
+        from flowsentryx_tpu.engine.sources import ArraySource
+
+        cfg = FsxConfig.from_json(spec["cfg_json"])
+        params = None
+        if spec.get("artifact"):
+            from flowsentryx_tpu.models.registry import load_artifact
+
+            params = load_artifact(cfg.model.name, spec["artifact"])
+        eng = Engine(
+            cfg,
+            ArraySource(np.empty((0, RECORD_WORDS), np.uint32)),
+            NullSink(),
+            params=params,
+            mega_n=spec.get("mega") or 0,
+            device_loop=spec.get("device_loop", 0),
+            slo_us=spec.get("slo_us") or 0,
+            sink_thread=False,
+            compile_cache=spec["compile_cache"],
+        )
+        eng.warm()
+        rep = eng._cache.report() if eng._cache is not None else {}
+        print(f"fsx cluster prewarm: cache ready at {rep.get('dir')} "
+              f"(stores {rep.get('stores', 0)}, hits "
+              f"{rep.get('hits', 0)}) — GROW spares warm from it",
+              file=sys.stderr)
+        return 0
+    except BaseException:  # noqa: BLE001 — best-effort, announced
+        traceback.print_exc()
+        return 1
 
 
 def stub_engine_main(spec: dict) -> int:

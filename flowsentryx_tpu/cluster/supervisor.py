@@ -100,6 +100,10 @@ class ClusterSupervisor:
         self.cluster_dir = Path(cluster_dir)
         self.n = len(specs)
         self.specs = specs
+        #: Real engines vs lifecycle stubs: pre-warming the compile
+        #: cache only makes sense when spares will boot REAL engines
+        #: (an entry override is tier-1's millisecond stub fleet).
+        self._entry_is_real = entry is None
         if entry is None:
             from flowsentryx_tpu.cluster.runner import engine_main
 
@@ -170,6 +174,10 @@ class ClusterSupervisor:
         self._elastic_next = 0.0
         self._pending_grow: dict | None = None
         self._pending_shrink: dict | None = None
+        #: the one-shot compile-cache pre-warm child (elastic fleets
+        #: with ``compile_cache`` specs; :meth:`_maybe_prewarm`)
+        self._prewarm_proc: mp.process.BaseProcess | None = None
+        self.prewarm_spawned = 0
         self._shrunk: set[int] = set()
         self._last_records: dict[int, tuple[float, int]] = {}
         self._rates: dict[int, float] = {}
@@ -234,6 +242,34 @@ class ClusterSupervisor:
         for r in range(self.n):
             if r in self._active:
                 self._spawn(r)
+        self._maybe_prewarm()
+
+    def _maybe_prewarm(self) -> None:
+        """Fleet pre-warm: when the fleet is elastic and its specs
+        carry a compile cache, spawn ONE short-lived background child
+        (:func:`runner.prewarm_main`) that compiles the fleet's staged
+        geometry into the cache at boot.  Spare ranks are provisioned
+        at max with the same spec, so a later GROW spawn's ``warm()``
+        is pure cache hits — the spare reaches SERVING in well under a
+        second instead of paying the full ladder compile while the
+        burst it was spawned for is already landing.  Best-effort and
+        non-blocking: the fleet never waits on it (daemon child), and
+        if it dies the spare just compiles — fail-open like every
+        cache path.  Stub fleets (entry override) skip: their spares
+        boot in milliseconds with no jax at all."""
+        if self._elastic is None or not self._entry_is_real:
+            return
+        spec = next(
+            (s for s in self.specs if s.get("compile_cache")), None)
+        if spec is None:
+            return
+        from flowsentryx_tpu.cluster.runner import prewarm_main
+
+        p = self._ctx.Process(target=prewarm_main, args=(dict(spec),),
+                              name="fsx-cluster-prewarm", daemon=True)
+        p.start()
+        self._prewarm_proc = p
+        self.prewarm_spawned += 1
 
     def _uniform_workers(self) -> int:
         """The per-rank ring width when every spec agrees on one (the
@@ -1261,6 +1297,32 @@ class ClusterSupervisor:
         if predict_blocks:
             from flowsentryx_tpu.engine.predict import DispatchGovernor
             predict_block = DispatchGovernor.merge_reports(predict_blocks)
+        # boot-latency merge (compile-cache tentpole): each rank's
+        # boot-to-serving story — cache hits/misses, serving-ready
+        # wall, import wall — summed/maxed into the fleet view.  A
+        # rank with ZERO hits under a configured cache dir is a cold
+        # boot the cache should have prevented (`fsx monitor
+        # --alert-cold-boot` reads exactly this block).
+        boot_block = None
+        boots = {
+            str(r): rep["report"]["boot"]
+            for r, rep in sorted(latest.items())
+            if isinstance(rep.get("report"), dict)
+            and rep["report"].get("boot")
+        }
+        if boots:
+            caches = [b["cache"] for b in boots.values()
+                      if isinstance(b.get("cache"), dict)]
+            boot_block = {
+                "per_rank": boots,
+                "cache_hits": sum(c.get("hits", 0) for c in caches),
+                "cache_misses": sum(c.get("misses", 0) for c in caches),
+                "cache_stores": sum(c.get("stores", 0) for c in caches),
+                "max_serving_ready_s": round(max(
+                    (b.get("serving_ready_s") or 0.0
+                     for b in boots.values()), default=0.0), 4),
+                "prewarm_spawned": self.prewarm_spawned,
+            }
         elastic_block = None
         if self._elastic is not None:
             elastic_block = {
@@ -1293,5 +1355,6 @@ class ClusterSupervisor:
                 total_records / max(max_wall, 1e-9), 1),
             "latency": latency,
             "predict": predict_block,
+            "boot": boot_block,
             "reports": reports,
         }

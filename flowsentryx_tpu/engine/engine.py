@@ -158,6 +158,15 @@ class EngineReport(NamedTuple):
     #: (``DispatchGovernor.merge_reports``).  None unless serving with
     #: ``predict=True`` (``fsx serve --predict``).
     predict: dict | None = None
+    #: Boot-latency accounting (ISSUE 20): per-variant compile vs
+    #: cache-hit timings from :meth:`Engine.warm`, the persistent AOT
+    #: compile-cache counters (hits / misses / corrupt / version_drift
+    #: — engine/compile_cache.py), serving-ready and background-fill
+    #: walls, import time (``Engine.boot_import_s``, stamped by the
+    #: CLI/runner) and time-to-first-verdict.  Aggregated per rank by
+    #: the cluster supervisor and alertable via ``fsx monitor
+    #: --alert-cold-boot``.  None until warm() runs.
+    boot: dict | None = None
 
 
 class _InFlight(NamedTuple):
@@ -236,7 +245,12 @@ class Engine:
         slo_us: int = 0,
         watchdog_s: float | None = None,
         predict: bool = False,
+        compile_cache: Any | None = None,
     ):
+        #: Boot-latency anchor: everything in EngineReport.boot —
+        #: serving-ready, background-fill-done, time-to-first-verdict
+        #: — is measured from construction start.
+        self._boot_t0 = time.perf_counter()
         self.cfg = cfg
         self.source = source
         self.sink = sink
@@ -745,6 +759,98 @@ class Engine:
         # lazily-built masked zero batch for pre-warm dispatches
         # (one allocation, reused; _prewarm_dispatch)
         self._warm_buf: np.ndarray | None = None
+        # -- boot-latency engine (ISSUE 20) -----------------------------
+        #: Persistent AOT executable store (engine/compile_cache.py):
+        #: staged variants lower().compile() once, serialize to disk,
+        #: and later boots of the same staged shape (the audit boot
+        #: cache's signature discipline, core/signature.py) reload in
+        #: tens of ms.  None = no cache (every warm compiles, exactly
+        #: the historical path).  Fail-open throughout: the jit
+        #: wrappers below stay captured as the fallback, so a cold or
+        #: corrupt cache only ever costs the compile it always cost.
+        if compile_cache is not None:
+            from flowsentryx_tpu.core.signature import staging_signature
+            from flowsentryx_tpu.engine.compile_cache import CompileCache
+
+            if isinstance(compile_cache, CompileCache):
+                self._cache = compile_cache
+            else:
+                sig = staging_signature(
+                    cfg, wire=self.wire,
+                    mesh_devices=(int(self.mesh.devices.size)
+                                  if self.mesh is not None else 1),
+                    mega_sizes=self._mega_sizes, device_loop=self.ring,
+                    params=self.params,
+                    donate=(fused.donation_supported()
+                            if donate is None else bool(donate)))
+                self._cache = CompileCache(compile_cache, sig)
+        else:
+            self._cache = None
+        #: Pristine jit wrappers + abstract arg specs per staged
+        #: variant, captured HERE (quiescent, the live device state in
+        #: scope) so AOT lowering — including on the background warm
+        #: fill thread — never touches launch-section fields.  Keys:
+        #: ("single",), ("mega", g), ("ring",).
+        self._aot_specs = self._capture_aot_specs(words)
+        #: The READY rung set: the rungs of the coalescing ladder whose
+        #: executables are installed and safe to dispatch without an
+        #: inline compile.  Defaults to the whole ladder (legacy warm
+        #: and un-warmed engines: byte-identical behavior); a tiered
+        #: warm shrinks it to the serving tier and the background fill
+        #: re-grows it rung by rung — grouping is dispatch-granularity
+        #: only, so the SHAPES dispatched change but the results never
+        #: do (the PR 5 invariant the partial-ladder parity test pins).
+        self._ready_sizes: tuple[int, ...] = self._mega_sizes
+        #: Whether the deep-scan ring may engage (same tiered-warm
+        #: story: rings not yet filled degrade to top-rung megastep
+        #: slot flushes, byte-identical by construction).
+        self._ring_ready: bool = bool(self.ring)
+        #: Background warm-fill plan + thread (warm(tiered=True)).
+        self._warm_plan: tuple = ()
+        self._warm_thread_obj: threading.Thread | None = None
+        #: Boot-latency block (EngineReport.boot); built by warm(),
+        #: extended by the warm fill thread via whole-dict rebinds.
+        self._boot: dict | None = None
+        #: Wall from construction to the FIRST real verdict sunk
+        #: (stamped in the sink section; masked warm batches carry no
+        #: records and never trip it).
+        self._first_verdict_s: float | None = None
+        #: Engine-stack import wall, stamped by the CLI/runner that
+        #: measured it (the engine cannot observe its own import).
+        self.boot_import_s = 0.0
+
+    def _capture_aot_specs(self, words: int) -> dict:
+        """Abstract (ShapeDtypeStruct) argument specs and the pristine
+        jit wrapper for every staged variant — the inputs to
+        ``wrapper.lower(*specs).compile()``.  Shardings are taken from
+        the LIVE arrays (mesh engines lower against the real sharded
+        layout; replicated wire entry), so the AOT executable is the
+        same artifact the jit path would build."""
+
+        def _abs(t):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype,
+                    sharding=getattr(a, "sharding", None)), t)
+
+        state = (_abs(self.table), _abs(self.stats), _abs(self.params))
+        b = self.cfg.batch.max_batch
+
+        def _wire(shape):
+            return jax.ShapeDtypeStruct(shape, np.uint32,
+                                        sharding=self._in_sharding)
+
+        specs: dict[tuple, tuple] = {
+            ("single",): (self.step, (*state, _wire((b + 1, words)))),
+        }
+        for g, fn in self.megasteps.items():
+            specs[("mega", g)] = (fn, (*state,
+                                       _wire((g, b + 1, words))))
+        if self.ring:
+            slot = _wire((self._ring_chunks, b + 1, words))
+            specs[("ring",)] = (self.ring_step,
+                                (*state, *([slot] * self.ring)))
+        return specs
 
     # -- pipeline stages ----------------------------------------------------
 
@@ -998,8 +1104,15 @@ class Engine:
         :func:`flowsentryx_tpu.ops.fused.rung_for_volume` — the ONE
         copy of the rule, also read by the predictive governor's
         pre-warm sizing (engine/predict.py), so a forecast can never
-        pre-warm a rung the backlog dispatch would not pick."""
-        return fused.rung_for_volume(backlog, self._mega_sizes)
+        pre-warm a rung the backlog dispatch would not pick.
+
+        Ranges over the READY rung set, not the staged ladder: while a
+        tiered warm's background fill is still installing executables,
+        the greedy flush picks the largest rung that is actually warm
+        (grouping is dispatch-granularity only — byte-identity to the
+        full ladder is pinned by test), and once the fill completes
+        the two sets are equal again (legacy warm: always equal)."""
+        return fused.rung_for_volume(backlog, self._ready_sizes)
 
     def _prewarm_dispatch(self, rung: int) -> None:
         """The governor's pre-warm actuation (engine/predict.py): ONE
@@ -1051,8 +1164,11 @@ class Engine:
         SLO mode anyway)."""
         headroom = self._slo_budget_s - (time.perf_counter() - t_oldest)
         if headroom <= 0.0:
+            # the top rung is ALWAYS in the ready set (serving tier of
+            # a tiered warm), so the budget-exceeded full-amortization
+            # path never waits on the background fill
             return self._mega_sizes[0] if self._mega_sizes else 1
-        for s in self._mega_sizes:
+        for s in self._ready_sizes:
             if self._rung_ewma_s.get(s, 0.0) <= headroom:
                 return s
         return 1
@@ -1122,7 +1238,11 @@ class Engine:
         # The budget bounds what the engine WAITS for — holds, round
         # fills, batcher residency — and the greedy flush's climb.
         slo = self._slo_budget_s
-        if self.ring:
+        # ring gating also covers the tiered-warm fill window: until
+        # the background thread installs the deep-scan executable
+        # (_ring_ready), backlogs drain through the ladder below —
+        # byte-identical, the ring's slot body IS the top megastep.
+        if self.ring and self._ring_ready:
             while len(self._pending) >= self._pending_cap:
                 self._ring_from_pending()
                 self._reap(self.readback_depth)
@@ -1605,6 +1725,12 @@ class Engine:
         self._device_now = max(self._device_now, now)
         self._sunk_batches += sum(g.n_chunks for g in group)
         t_done = time.perf_counter()
+        if (self._first_verdict_s is None
+                and any(g.n_records for g in group)):
+            # time-to-first-verdict (EngineReport.boot): anchored at
+            # construction; masked warm batches carry zero records and
+            # never trip it, so this is the first REAL verdict served
+            self._first_verdict_s = t_done - self._boot_t0
         self._last_sink_t = t_done
         sink_s = t_done - t_fetch
         for g in group:
@@ -1629,8 +1755,8 @@ class Engine:
         # one float store, whichever thread owns the sink section
         self._watchdog.note_progress()
 
-    def warm(self) -> None:
-        """Trigger the step's XLA compile with a zero-fill batch.
+    def warm(self, tiered: bool = False) -> None:
+        """Stage every serving executable with zero-fill batches.
 
         A long-lived server pays the multi-second compile once at boot;
         a benchmark or test that skips this charges it to the first
@@ -1638,7 +1764,70 @@ class Engine:
         seconds of records that arrive meanwhile).  The batch's meta
         row carries n_valid=0, so every row is masked — table, stats,
         and verdicts are unchanged.  Call before attaching a live
-        stream; must not be called with batches in flight."""
+        stream; must not be called with batches in flight.
+
+        With a persistent compile cache configured
+        (``Engine(compile_cache=dir)``; engine/compile_cache.py) each
+        variant is AOT-installed first: a cache hit deserializes the
+        executable in tens of ms and the ladder below pays no compile;
+        a miss compiles once via ``lower().compile()`` and publishes
+        the entry for the next boot.  Fail-open at every step — the
+        jit wrappers stay captured as the fallback path.
+
+        ``tiered=True`` is the boot-latency mode: only the SERVING
+        TIER — singles plus the top rung, the shapes every drain
+        starts from — warms in the foreground (plus, under ``--slo-us``
+        with a drain ring, the ring itself: the round sizer's EWMA
+        seed must cover uploads AND reap, which only this quiescent
+        pass can measure).  The engine is serving the moment this
+        returns; a background thread (:meth:`_warm_worker`) fills the
+        remaining rungs/ring AOT-only — it never dispatches — and
+        publishes each executable with one reference rebind, growing
+        the ready set until the full ladder is live.  Byte-identity to
+        a full-ladder warm is pinned by test: grouping is
+        dispatch-granularity only."""
+        if (self._warm_thread_obj is not None
+                and self._warm_thread_obj.is_alive()):
+            raise RuntimeError(
+                "warm() called while a background warm fill is active "
+                "— warm_fill_join() first (nothing else may touch the "
+                "staged executables while the fill thread installs)")
+        self._warm_thread_obj = None
+        serving_sizes = self._mega_sizes
+        ring_now = bool(self.ring)
+        fill_plan: list[tuple] = []
+        if tiered and self._mega_sizes:
+            serving_sizes = self._mega_sizes[:1]
+            # SLO + ring keeps the ring in the serving tier: run()'s
+            # auto-warm gate needs the negated round key seeded by a
+            # quiescent pass (the only measurement covering uploads
+            # AND reap), and the fill thread may never dispatch.
+            ring_now = bool(self.ring) and bool(self._slo_budget_s)
+            fill_plan = [("mega", g) for g in self._mega_sizes[1:]]
+            if self.ring and not ring_now:
+                fill_plan.append(("ring",))
+        boot: dict[str, Any] = {
+            "tiered": bool(fill_plan),
+            "variants": {},
+            "fill_pending": [self._variant_label(n) for n in fill_plan],
+        }
+        # AOT install (cache load or lower().compile()) BEFORE the
+        # dispatch ladder: installed executables replace the jit
+        # wrappers on self.step/self.megasteps/self.ring_step, so the
+        # ladder below triggers no compile on a warm cache.  Without a
+        # cache the ladder itself is the compile trigger, exactly the
+        # historical path (tiered mode still AOT-compiles so the
+        # background fill has executables to install).
+        if self._cache is not None or fill_plan:
+            names: list[tuple] = [("single",)]
+            names += [("mega", g) for g in serving_sizes]
+            if ring_now:
+                names.append(("ring",))
+            for name in names:
+                exe, entry = self._aot_build(name)
+                if exe is not None:
+                    self._aot_install(name, exe)
+                boot["variants"][self._variant_label(name)] = entry
         words = (schema.COMPACT_RECORD_WORDS
                  if self.wire == schema.WIRE_COMPACT16
                  else schema.RECORD_WORDS)
@@ -1654,7 +1843,10 @@ class Engine:
         # served ones; the online refinement (``_note_step_s``)
         # would otherwise start from compile-poisoned values.  A new
         # staged variant added here is automatically both compiled
-        # AND seeded — the two passes can never drift apart.
+        # AND seeded — the two passes can never drift apart.  In
+        # tiered mode the ladder covers the serving tier only;
+        # background-filled rungs follow the documented unseeded-rung
+        # rule (assumed free, first dispatch seeds).
         for timed in (False, True) if self._slo_budget_s else (False,):
             if timed:
                 self._rung_ewma_s.clear()
@@ -1663,13 +1855,13 @@ class Engine:
             self._reap(0)
             if timed:
                 self._rung_ewma_s[1] = time.perf_counter() - t0
-            for g in self._mega_sizes:
+            for g in serving_sizes:
                 t0 = time.perf_counter()
                 self._dispatch_mega([(warm, t0)] * g)
                 self._reap(0)
                 if timed:
                     self._rung_ewma_s[g] = time.perf_counter() - t0
-            if self.ring:
+            if ring_now:
                 zero_slot = np.zeros(
                     (self._ring_chunks,) + warm.shape, np.uint32)
                 t0 = time.perf_counter()
@@ -1687,9 +1879,128 @@ class Engine:
                     key = -(self.ring * self._ring_chunks)
                     self._rung_ewma_s[key] = time.perf_counter() - t0
                     self._round_floor_s[key] = self._rung_ewma_s[key]
+        # publish the ready set LAST: every executable above is
+        # installed and compile-free before a drain may pick its rung
+        self._ready_sizes = serving_sizes
+        self._ring_ready = ring_now
         # warm dispatches are compile triggers, not traffic — keep them
         # out of the dispatch-block accounting
         self._reset_dispatch_counters()
+        boot["serving_ready_s"] = round(
+            time.perf_counter() - self._boot_t0, 4)
+        if self._cache is not None:
+            boot["cache"] = self._cache.report()
+        self._boot = boot
+        if fill_plan:
+            self._warm_plan = tuple(fill_plan)
+            self._warm_thread_obj = threading.Thread(
+                target=self._warm_worker, name="fsx-warm", daemon=True)
+            self._warm_thread_obj.start()
+
+    # -- AOT executable staging (ISSUE 20) ----------------------------------
+
+    @staticmethod
+    def _variant_label(name: tuple) -> str:
+        return name[0] if len(name) == 1 else f"{name[0]}{name[1]}"
+
+    def _aot_build(self, name: tuple) -> tuple[Any | None, dict]:
+        """Load-or-compile ONE staged variant ahead of time.
+
+        Worker-safe by construction: touches only the pristine jit
+        wrappers and abstract arg specs captured at __init__
+        (``_aot_specs``) and the compile cache — never the live device
+        state, never a dispatch.  Returns ``(executable, entry)``
+        where entry is the per-variant boot record (source:
+        cache | compile | error, seconds); executable is None on
+        failure (fail-open: the jit wrapper keeps serving)."""
+        label = self._variant_label(name)
+        fn, args = self._aot_specs[name]
+        t0 = time.perf_counter()
+        if self._cache is not None:
+            exe = self._cache.load(label)
+            if exe is not None:
+                return exe, {
+                    "source": "cache",
+                    "seconds": round(time.perf_counter() - t0, 4)}
+        try:
+            exe = fn.lower(*args).compile()
+        except Exception as e:  # noqa: BLE001 — fail-open by contract
+            import sys
+
+            print(f"fsx warm: AOT staging of {label} failed ({e!r}); "
+                  "the jit path serves this variant (fail-open)",
+                  file=sys.stderr)
+            return None, {
+                "source": "error", "error": repr(e),
+                "seconds": round(time.perf_counter() - t0, 4)}
+        if self._cache is not None:
+            self._cache.store(label, exe)
+        return exe, {"source": "compile",
+                     "seconds": round(time.perf_counter() - t0, 4)}
+
+    def _aot_install(self, name: tuple, exe: Any) -> None:
+        """Publish one AOT executable over its jit wrapper — plain
+        whole-object rebinds only (the atomic-ref discipline: launch
+        sites read each reference once per dispatch, so an install
+        from the warm fill thread is safe mid-serve; either the jit
+        wrapper or the executable runs, byte-identical results)."""
+        if name[0] == "single":
+            self.step = exe
+        elif name[0] == "mega":
+            self.megasteps = {**self.megasteps, name[1]: exe}
+        else:
+            self.ring_step = exe
+
+    def _warm_worker(self) -> None:
+        """Background warm fill (warm(tiered=True)): AOT-stage the
+        remaining ladder rungs / ring, largest value first, and grow
+        the ready set as each lands.  NEVER dispatches — the launch
+        and sink sections keep their single owners; everything this
+        thread publishes (executables, ready set, boot block) is one
+        reference rebind.  Fail-open: an error leaves the jit
+        fallback serving that variant and is recorded in the boot
+        block, never raised into serving."""
+        try:
+            for name in self._warm_plan:
+                exe, entry = self._aot_build(name)
+                label = self._variant_label(name)
+                if exe is not None:
+                    self._aot_install(name, exe)
+                    if name[0] == "mega":
+                        self._ready_sizes = tuple(sorted(
+                            set(self._ready_sizes) | {name[1]},
+                            reverse=True))
+                    elif name[0] == "ring":
+                        self._ring_ready = True
+                boot = dict(self._boot or {})
+                boot["variants"] = {**boot.get("variants", {}),
+                                    label: entry}
+                boot["fill_pending"] = [
+                    v for v in boot.get("fill_pending", ())
+                    if v != label]
+                self._boot = boot
+            boot = dict(self._boot or {})
+            boot["fill_done_s"] = round(
+                time.perf_counter() - self._boot_t0, 4)
+            if self._cache is not None:
+                boot["cache"] = self._cache.report()
+            self._boot = boot
+        except BaseException as e:  # noqa: BLE001 — fail-open, counted
+            self._boot = {**(self._boot or {}), "fill_error": repr(e)}
+
+    def warm_fill_active(self) -> bool:
+        """Whether a tiered warm's background fill is still running."""
+        t = self._warm_thread_obj
+        return t is not None and t.is_alive()
+
+    def warm_fill_join(self, timeout: float | None = None) -> bool:
+        """Wait for the background warm fill; True when it is done
+        (including when none was started)."""
+        t = self._warm_thread_obj
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def _reset_dispatch_counters(self) -> None:
         self._group_hist = {}
@@ -2126,6 +2437,19 @@ class Engine:
                    else self._run_inline(max_batches, max_seconds))
         finally:
             self._stop_sink_thread()
+            # Serving is over; do not hand a daemon fill thread to
+            # interpreter teardown mid-XLA-compile (measured segfault
+            # in short-lived `fsx serve --batches N --tiered-warm`
+            # runs whose drain outpaces the ladder fill).  Bounded: a
+            # compile always terminates, and a long-lived server's
+            # fill finished long before its drain did.
+            if not self.warm_fill_join(300.0):
+                import sys
+
+                print("fsx engine: warm fill still compiling 300 s "
+                      "after the drain finished — abandoning it "
+                      "(report's fill_done_s will be missing)",
+                      file=sys.stderr)
         self._check_sink()  # a crash in the very last drain group
         return rep
 
@@ -2244,7 +2568,14 @@ class Engine:
                             time.perf_counter(),
                             self._rung_ewma_s.get(1, 0.0))
                         if rung:
-                            self._prewarm_dispatch(rung)
+                            # clamp the forecast rung to the READY set
+                            # (a tiered warm may still be filling):
+                            # pre-warming an uninstalled rung would
+                            # spend the idle window on an inline
+                            # compile instead of a hot re-dispatch
+                            self._prewarm_dispatch(
+                                self._rung_for(rung) if rung > 1
+                                else rung)
                             continue
                     # Idle link: back off instead of spinning poll() at
                     # 100% CPU (sync/tuning.py IDLE_SLEEP_S, the
@@ -2485,7 +2816,18 @@ class Engine:
                     sum(m[1] for m in metas)))
                 rows = None
                 if len(uploaded) == self.ring:
-                    self._dispatch_ring(uploaded)
+                    if self._ring_ready:
+                        self._dispatch_ring(uploaded)
+                    else:
+                        # tiered warm still filling the deep-scan
+                        # executable: flush the round's slots through
+                        # the top-rung megastep (byte-identical — the
+                        # ring's slot body IS that megastep), exactly
+                        # the partial-round path below
+                        for u in uploaded:
+                            self._dispatch_group_dev(
+                                u.dev, u.t_enqueue, u.n_records,
+                                u.put_s)
                     uploaded = []
                     self._reap(self.readback_depth)
             elif short:
@@ -2675,6 +3017,20 @@ class Engine:
                         else None)
         cluster_rep = (self.gossip.report()
                        if self.gossip is not None else None)
+        # Boot-latency block (ISSUE 20): one consistent snapshot of the
+        # warm/fill story (the fill thread publishes whole-dict
+        # rebinds, so a single read is coherent even mid-fill) plus
+        # the sink-stamped time-to-first-verdict and the caller-
+        # stamped import wall.
+        boot_rep = None
+        boot_snap = self._boot
+        if boot_snap is not None:
+            boot_rep = dict(boot_snap)
+            boot_rep["import_s"] = round(self.boot_import_s, 4)
+            boot_rep["time_to_first_verdict_s"] = (
+                round(self._first_verdict_s, 4)
+                if self._first_verdict_s is not None else None)
+            boot_rep["fill_active"] = self.warm_fill_active()
         predict_rep = None
         if self._gov is not None:
             predict_rep = self._gov.report()
@@ -2719,6 +3075,7 @@ class Engine:
                 rebalance=self._rebalance or None),
             rebalance=dict(self._rebalance) or None,
             predict=predict_rep,
+            boot=boot_rep,
         )
 
 

@@ -140,6 +140,11 @@ _ENGINE_QUIESCENT = (
     "__init__", "warm", "reset_stream", "restore", "checkpoint",
     "_build_report", "_reset_dispatch_counters",
     "_start_sink_thread", "_stop_sink_thread", "watch_artifact",
+    # boot-latency engine (ISSUE 20): spec capture runs inside
+    # __init__ (it reads the live table/stats to build abstract
+    # lowering args BEFORE any thread exists — precisely so the warm
+    # fill thread never has to)
+    "_capture_aot_specs",
     # the live-handoff table accessors (cluster/rebalance.py): called
     # by EngineRebalancer.reconcile (pre-warm) and .step, which the
     # cluster runner drives at CHUNK BOUNDARIES — the same
@@ -186,7 +191,7 @@ _DISP = FieldContract(
 ENGINE_PLAN = ClassPlan(
     module="flowsentryx_tpu/engine/engine.py",
     cls="Engine",
-    worker_targets=("_sink_worker", "_ring_worker"),
+    worker_targets=("_sink_worker", "_ring_worker", "_warm_worker"),
     sections={"launch": _ENGINE_LAUNCH, "sink": _ENGINE_SINK},
     quiescent=_ENGINE_QUIESCENT,
     fields={
@@ -271,6 +276,84 @@ ENGINE_PLAN = ClassPlan(
             "hot_swap's one-reference-assignment swap: launch sites "
             "read self.params exactly once per dispatch, so a plain "
             "rebind is safe from any thread; read-modify-write is not"),
+        # -- boot-latency engine (ISSUE 20): the warm fill thread -----
+        # publishes AOT executables and the ready set as whole-object
+        # rebinds; launch/policy sites read each reference once.
+        "step": FieldContract(
+            "atomic-ref",
+            "the staged single-batch executable: __init__ binds the "
+            "jit wrapper, _aot_install may rebind it to the AOT "
+            "executable (same graph, byte-identical results); the "
+            "launch section reads it once per dispatch"),
+        "megasteps": FieldContract(
+            "atomic-ref",
+            "the coalescing-ladder executables, rebound as a WHOLE "
+            "dict per AOT install ({**old, g: exe}) — never an item "
+            "store — so a launch mid-install sees the old or the new "
+            "dict, both serving byte-identical rungs"),
+        "ring_step": FieldContract(
+            "atomic-ref",
+            "the deep-scan executable, same rebind-only install story "
+            "as megasteps; the ring only engages after _ring_ready "
+            "flips, but the rebind alone is already safe"),
+        "_ready_sizes": FieldContract(
+            "atomic-ref",
+            "the READY rung set (tiered warm): grown by the fill "
+            "thread as one tuple rebind per installed rung, read "
+            "advisorily by the dispatch-thread policy helpers — a "
+            "stale read picks a smaller ready rung, never an "
+            "uninstalled one (the install rebind happens-before the "
+            "ready-set rebind on the fill thread, and CPython "
+            "publishes stores in order under the GIL)"),
+        "_ring_ready": FieldContract(
+            "atomic-ref",
+            "ring-engagement flag, flipped once by the fill thread "
+            "after ring_step installs; a stale False only routes one "
+            "more round through the byte-identical megastep flush"),
+        "_boot": FieldContract(
+            "atomic-ref",
+            "the EngineReport.boot block: warm() seeds it quiescent, "
+            "the fill thread extends it via whole-dict rebinds (one "
+            "writer at a time by protocol — the fill thread is the "
+            "only non-quiescent writer), _build_report snapshots one "
+            "reference"),
+        "_warm_plan": FieldContract(
+            "quiescent-write",
+            "the fill thread's work list: written by warm() before "
+            "the thread starts (the Thread.start happens-before "
+            "edge); read-only on the worker"),
+        "_warm_thread_obj": FieldContract(
+            "quiescent-write",
+            "fill-thread handle: written only by warm() (quiescent); "
+            "warm_fill_active/join read it from anywhere — join on a "
+            "live thread is the point",
+            extra=("warm_fill_active", "warm_fill_join")),
+        "_aot_specs": FieldContract(
+            "quiescent-write",
+            "pristine jit wrappers + abstract lowering args captured "
+            "at __init__; read-only ever after (what makes _aot_build "
+            "worker-safe without touching launch-section state)"),
+        "_cache": FieldContract(
+            "documented",
+            "the persistent AOT store (engine/compile_cache.py): the "
+            "reference is __init__-set and never rebound; its methods "
+            "run on ONE thread at a time by protocol — the quiescent "
+            "warm pass first, then the single fill thread it hands "
+            "off to"),
+        "_boot_t0": FieldContract(
+            "quiescent-write",
+            "construction-time boot anchor; written once in __init__, "
+            "read by the sink section's first-verdict stamp and the "
+            "fill thread's walls (a constant after construction)"),
+        "_first_verdict_s": FieldContract(
+            "section:sink",
+            "time-to-first-verdict stamp: written once where the "
+            "first real verdict sinks (single sink owner at a time), "
+            "read by the quiescent report"),
+        "boot_import_s": FieldContract(
+            "quiescent-write",
+            "engine-stack import wall, stamped by the CLI/runner "
+            "before run(); read by the quiescent report"),
         "_sink_active": FieldContract(
             "quiescent-write",
             "mode flag: written only while no worker exists "

@@ -445,6 +445,7 @@ def stage_cluster_jax_free() -> list[str]:
 DURABLE_WRITE_SCOPE = (
     "flowsentryx_tpu/cluster",
     "flowsentryx_tpu/engine/checkpoint.py",
+    "flowsentryx_tpu/engine/compile_cache.py",
 )
 
 

@@ -269,4 +269,15 @@ echo "== device-loop smoke: drain ring + double-buffered H2D =="
 # comparison evidence in the same file is preserved).
 env JAX_PLATFORMS=cpu python scripts/device_loop_smoke.py || exit 1
 
+echo "== boot smoke: persistent compile cache + tiered warm + GROW spare =="
+# Bounded CPU smoke of boot-to-serving (docs/ENGINE.md §boot), each leg
+# a FRESH subprocess: re-proves a cold boot stores the full ladder, a
+# cached boot is all-cache-hit and reaches SERVING >= 3x faster, the
+# tiered background fill completes with nothing pending, a GROW spare
+# booting from a prewarm_main-filled cache recompiles NOTHING, and all
+# legs serve byte-identical verdicts (stats + blacklist digests equal)
+# — re-writing the "smoke" section of artifacts/BOOT_r24.json (the
+# cold-vs-cached A/B evidence in the same file is preserved).
+env JAX_PLATFORMS=cpu python scripts/boot_smoke.py || exit 1
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
